@@ -1,0 +1,140 @@
+"""Accelerator abstraction.
+
+Reference parity: ``accelerator/abstract_accelerator.py:7-237`` — the
+``DeepSpeedAccelerator`` ABC every layer talks to instead of a hard-coded
+backend. The TPU rebuild keeps the indirection (it is what makes the test
+suite runnable on CPU with a virtual device mesh) but the surface is JAX-
+shaped: devices are ``jax.Device`` objects, "streams" collapse into XLA's
+async dispatch, and op builders become a named registry of Pallas/C++ kernels
+(see ``deepspeed_tpu.ops.registry``).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, List, Optional
+
+
+class DeepSpeedAccelerator(abc.ABC):
+
+    def __init__(self):
+        self._name: Optional[str] = None
+        self._communication_backend_name: Optional[str] = None
+
+    # ------------------------- device APIs ------------------------- #
+    @abc.abstractmethod
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        ...
+
+    @abc.abstractmethod
+    def device(self, device_index: Optional[int] = None):
+        ...
+
+    @abc.abstractmethod
+    def set_device(self, device_index: int) -> None:
+        ...
+
+    @abc.abstractmethod
+    def current_device(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def current_device_name(self) -> str:
+        ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def local_device_count(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        ...
+
+    # ------------------------- RNG APIs ---------------------------- #
+    @abc.abstractmethod
+    def random_seed(self, seed: int):
+        """Return a root PRNG key for ``seed`` (jax.random.key)."""
+
+    @abc.abstractmethod
+    def default_generator(self, device_index: int):
+        ...
+
+    # ------------------------- memory APIs ------------------------- #
+    @abc.abstractmethod
+    def memory_allocated(self, device_index: Optional[int] = None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def max_memory_allocated(self, device_index: Optional[int] = None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def total_memory(self, device_index: Optional[int] = None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def available_memory(self, device_index: Optional[int] = None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def empty_cache(self) -> None:
+        ...
+
+    @abc.abstractmethod
+    def memory_stats(self, device_index: Optional[int] = None) -> dict:
+        ...
+
+    @abc.abstractmethod
+    def reset_peak_memory_stats(self, device_index: Optional[int] = None) -> None:
+        ...
+
+    # ------------------------- dtype APIs -------------------------- #
+    @abc.abstractmethod
+    def is_bf16_supported(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def preferred_dtype(self):
+        ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self) -> List[Any]:
+        ...
+
+    # ------------------------- comm / misc ------------------------- #
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str:
+        ...
+
+    @abc.abstractmethod
+    def on_accelerator(self, array) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def pin_memory(self, array):
+        """Place host array in pinned (DMA-able) host memory if supported."""
+
+    @abc.abstractmethod
+    def range_push(self, msg: str) -> None:
+        """Profiler trace-annotation push (jax.profiler.TraceAnnotation)."""
+
+    @abc.abstractmethod
+    def range_pop(self) -> None:
+        ...
+
+    # ------------------------- op builder hooks -------------------- #
+    @abc.abstractmethod
+    def create_op_builder(self, class_name: str):
+        ...
+
+    @abc.abstractmethod
+    def get_op_builder(self, class_name: str):
+        ...

@@ -1,0 +1,47 @@
+"""Accelerator selection (reference: accelerator/real_accelerator.py:35-56).
+
+Selection order:
+1. explicit ``set_accelerator()``
+2. ``DS_ACCELERATOR`` env var (``tpu`` | ``cpu``)
+3. runtime probe: whatever ``jax.default_backend()`` reports.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from deepspeed_tpu.accelerator.abstract_accelerator import DeepSpeedAccelerator
+from deepspeed_tpu.accelerator.tpu_accelerator import CPU_Accelerator, TPU_Accelerator
+
+_accelerator: Optional[DeepSpeedAccelerator] = None
+
+
+def is_current_accelerator_supported() -> bool:
+    return get_accelerator()._name in ("tpu", "cpu", "axon")
+
+
+def get_accelerator() -> DeepSpeedAccelerator:
+    global _accelerator
+    if _accelerator is not None:
+        return _accelerator
+
+    accelerator_name = os.environ.get("DS_ACCELERATOR", None)
+    if accelerator_name is None:
+        try:
+            import jax
+            backend = jax.default_backend()
+        except Exception:
+            backend = "cpu"
+        accelerator_name = "cpu" if backend == "cpu" else backend
+
+    if accelerator_name == "cpu":
+        _accelerator = CPU_Accelerator()
+    else:
+        _accelerator = TPU_Accelerator(platform=accelerator_name)
+    return _accelerator
+
+
+def set_accelerator(accel: DeepSpeedAccelerator) -> None:
+    global _accelerator
+    _accelerator = accel

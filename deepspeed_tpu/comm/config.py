@@ -1,0 +1,27 @@
+"""Comms-logger config (reference: deepspeed/comm/config.py)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from deepspeed_tpu.config.config_utils import ConfigModel
+
+COMMS_LOGGER = "comms_logger"
+
+
+class CommsLoggerConfig(ConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = []
+
+
+class DeepSpeedCommsConfig:
+
+    def __init__(self, ds_config: dict):
+        self.comms_logger_enabled = COMMS_LOGGER in ds_config
+        if self.comms_logger_enabled:
+            self.comms_logger = CommsLoggerConfig(**ds_config[COMMS_LOGGER])
+        else:
+            self.comms_logger = CommsLoggerConfig()

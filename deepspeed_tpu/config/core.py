@@ -1,0 +1,316 @@
+"""Master config object.
+
+Reference parity: ``deepspeed/runtime/config.py`` — ``DeepSpeedConfig`` parses
+and validates the single JSON config dict, resolves the batch-size triad
+``train_batch = micro_batch × gradient_accumulation_steps × dp_world_size``
+(reference ``runtime/config.py:853-907``), and exposes typed sub-configs.
+
+TPU-native additions: a ``mesh`` section declaring named parallel axes
+(``dp``/``fsdp``/``tp``/``pp``/``ep``/``sp``) used to build the
+``jax.sharding.Mesh`` the engine runs on.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+from typing import Any, Dict, Optional, Union
+
+from deepspeed_tpu.config import constants as C
+from deepspeed_tpu.config.config_utils import dict_raise_error_on_duplicate_keys, get_scalar_param
+from deepspeed_tpu.config.precision import AMPConfig, BF16Config, FP16Config
+from deepspeed_tpu.monitor.config import DeepSpeedMonitorConfig, get_monitor_config
+from deepspeed_tpu.runtime.zero.config import ZeroConfig, get_zero_config
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+ADAGRAD_OPTIMIZER = "adagrad"
+ADAM_OPTIMIZER = "adam"
+ADAMW_OPTIMIZER = "adamw"
+LAMB_OPTIMIZER = "lamb"
+ONEBIT_ADAM_OPTIMIZER = "onebitadam"
+ZERO_ONE_ADAM_OPTIMIZER = "zerooneadam"
+ONEBIT_LAMB_OPTIMIZER = "onebitlamb"
+LION_OPTIMIZER = "lion"
+SGD_OPTIMIZER = "sgd"
+DEEPSPEED_OPTIMIZERS = [
+    ADAGRAD_OPTIMIZER, ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
+    ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER, LION_OPTIMIZER, SGD_OPTIMIZER
+]
+
+
+def get_fp16_config(param_dict: Dict) -> FP16Config:
+    return FP16Config(**param_dict.get(C.FP16, {}))
+
+
+def get_bf16_config(param_dict: Dict) -> BF16Config:
+    bf16_dict = param_dict.get(C.BFLOAT16, param_dict.get(C.BFLOAT16_OLD, {}))
+    return BF16Config(**bf16_dict)
+
+
+def get_amp_config(param_dict: Dict) -> AMPConfig:
+    return AMPConfig(**param_dict.get(C.AMP, {}))
+
+
+def get_optimizer_name(param_dict: Dict) -> Optional[str]:
+    if C.OPTIMIZER in param_dict and C.TYPE in param_dict[C.OPTIMIZER]:
+        return param_dict[C.OPTIMIZER][C.TYPE]
+    return C.OPTIMIZER_TYPE_DEFAULT
+
+
+def get_optimizer_params(param_dict: Dict) -> Optional[Dict]:
+    if get_optimizer_name(param_dict) is not None and C.OPTIMIZER_PARAMS in param_dict[C.OPTIMIZER]:
+        return param_dict[C.OPTIMIZER][C.OPTIMIZER_PARAMS]
+    return None
+
+def get_optimizer_gradient_clipping(param_dict: Dict) -> Optional[float]:
+    optimizer_params = get_optimizer_params(param_dict)
+    if optimizer_params is not None and C.MAX_GRAD_NORM in optimizer_params:
+        return optimizer_params[C.MAX_GRAD_NORM]
+    return None
+
+
+def get_scheduler_name(param_dict: Dict) -> Optional[str]:
+    if C.SCHEDULER in param_dict and C.TYPE in param_dict[C.SCHEDULER]:
+        return param_dict[C.SCHEDULER][C.TYPE]
+    return C.SCHEDULER_TYPE_DEFAULT
+
+
+def get_scheduler_params(param_dict: Dict) -> Optional[Dict]:
+    if get_scheduler_name(param_dict) is not None and C.SCHEDULER_PARAMS in param_dict[C.SCHEDULER]:
+        return param_dict[C.SCHEDULER][C.SCHEDULER_PARAMS]
+    return None
+
+
+class DeepSpeedConfig:
+    """Parses + validates the framework config (a dict or a path to JSON)."""
+
+    def __init__(self,
+                 config: Union[str, Dict],
+                 mpu=None,
+                 mesh=None,
+                 world_size: Optional[int] = None):
+        if isinstance(config, dict):
+            self._param_dict = copy.deepcopy(config)
+        elif isinstance(config, str) and os.path.exists(config):
+            with open(config) as f:
+                self._param_dict = json.load(f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        else:
+            raise DeepSpeedConfigError(
+                f"Expected a string path to an existing config file, or a dict. Received: {config}")
+
+        # Data-parallel world size used for batch triad resolution. Priority:
+        # explicit arg > mpu (reference contract) > mesh dp axes > jax.device_count.
+        if world_size is not None:
+            self.world_size = world_size
+        elif mpu is not None:
+            self.world_size = mpu.get_data_parallel_world_size()
+        elif mesh is not None:
+            ws = 1
+            for ax in ("dp", "fsdp"):
+                if ax in mesh.shape:
+                    ws *= mesh.shape[ax]
+            self.world_size = ws
+        else:
+            try:
+                import jax
+                self.world_size = jax.device_count()
+            except Exception:
+                self.world_size = 1
+
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    # ------------------------------------------------------------------ #
+
+    def _initialize_params(self, param_dict: Dict) -> None:
+        self.train_batch_size = get_scalar_param(param_dict, C.TRAIN_BATCH_SIZE, C.TRAIN_BATCH_SIZE_DEFAULT)
+        self.train_micro_batch_size_per_gpu = get_scalar_param(param_dict, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                                                               C.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        self.gradient_accumulation_steps = get_scalar_param(param_dict, C.GRADIENT_ACCUMULATION_STEPS,
+                                                            C.GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+        self.steps_per_print = get_scalar_param(param_dict, C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = get_scalar_param(param_dict, C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+        self.disable_allgather = get_scalar_param(param_dict, C.DISABLE_ALLGATHER, C.DISABLE_ALLGATHER_DEFAULT)
+        self.communication_data_type = get_scalar_param(param_dict, C.COMMUNICATION_DATA_TYPE,
+                                                        C.COMMUNICATION_DATA_TYPE_DEFAULT)
+        self.prescale_gradients = get_scalar_param(param_dict, C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = get_scalar_param(param_dict, C.GRADIENT_PREDIVIDE_FACTOR,
+                                                          C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.sparse_gradients_enabled = get_scalar_param(param_dict, C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+
+        self.zero_config = get_zero_config(param_dict)
+        self.zero_optimization_stage = self.zero_config.stage
+        self.zero_enabled = self.zero_optimization_stage > 0
+
+        self.fp16_config = get_fp16_config(param_dict)
+        self.fp16_enabled = self.fp16_config.enabled
+        self.fp16_auto_cast = self.fp16_config.auto_cast
+        self.bf16_config = get_bf16_config(param_dict)
+        self.bfloat16_enabled = self.bf16_config.enabled
+        assert not (self.fp16_enabled and self.bfloat16_enabled), "bf16 and fp16 modes cannot be simultaneously enabled"
+        self.fp16_master_weights_and_gradients = self.fp16_config.fp16_master_weights_and_grads
+        self.amp_config = get_amp_config(param_dict)
+        self.amp_enabled = self.amp_config.enabled
+        self.loss_scale = self.fp16_config.loss_scale
+        self.initial_dynamic_scale = self.fp16_config.initial_dynamic_scale
+        self.dynamic_loss_scale_args = dict(
+            init_scale=2**self.fp16_config.initial_scale_power,
+            scale_window=self.fp16_config.loss_scale_window,
+            min_scale=self.fp16_config.min_loss_scale,
+            delayed_shift=self.fp16_config.hysteresis,
+        ) if self.fp16_config.dynamic_loss_scale else None
+
+        self.gradient_clipping = get_scalar_param(param_dict, C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
+
+        self.optimizer_name = get_optimizer_name(param_dict)
+        if self.optimizer_name is not None and self.optimizer_name.lower() in DEEPSPEED_OPTIMIZERS:
+            self.optimizer_name = self.optimizer_name.lower()
+        self.optimizer_params = get_optimizer_params(param_dict)
+        self.optimizer_legacy_fusion = get_scalar_param(param_dict.get(C.OPTIMIZER, {}), C.LEGACY_FUSION,
+                                                        C.LEGACY_FUSION_DEFAULT)
+        self.zero_allow_untested_optimizer = get_scalar_param(param_dict, C.ZERO_ALLOW_UNTESTED_OPTIMIZER,
+                                                              C.ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT)
+        self.scheduler_name = get_scheduler_name(param_dict)
+        self.scheduler_params = get_scheduler_params(param_dict)
+
+        self.wall_clock_breakdown = get_scalar_param(param_dict, C.WALL_CLOCK_BREAKDOWN,
+                                                     C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = get_scalar_param(param_dict, C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT)
+        self.monitor_config: DeepSpeedMonitorConfig = get_monitor_config(param_dict)
+
+        self.gradient_accumulation_dtype = param_dict.get(C.DATA_TYPES, {}).get(C.GRAD_ACCUM_DTYPE,
+                                                                                C.GRAD_ACCUM_DTYPE_DEFAULT)
+
+        # sub-sections whose typed configs live in their subsystems; parsed lazily
+        self.pipeline = param_dict.get("pipeline", {})
+        self.pld_enabled = param_dict.get(C.PROGRESSIVE_LAYER_DROP, {}).get(C.PLD_ENABLED, C.PLD_ENABLED_DEFAULT)
+        self.pld_params = param_dict.get(C.PROGRESSIVE_LAYER_DROP, {}) if self.pld_enabled else False
+        self.curriculum_enabled_legacy = param_dict.get(C.CURRICULUM_LEARNING, {}).get(C.CURRICULUM_ENABLED,
+                                                                                       C.CURRICULUM_ENABLED_DEFAULT)
+        self.curriculum_params_legacy = param_dict.get(C.CURRICULUM_LEARNING, False)
+
+        from deepspeed_tpu.runtime.data_pipeline.config import get_data_efficiency_config
+        self.data_efficiency_config = get_data_efficiency_config(param_dict)
+        self.data_efficiency_enabled = self.data_efficiency_config.get("enabled", False)
+
+        checkpoint_params = param_dict.get(C.CHECKPOINT, {})
+        validation_mode = checkpoint_params.get(C.CHECKPOINT_TAG_VALIDATION, C.CHECKPOINT_TAG_VALIDATION_DEFAULT)
+        self.checkpoint_tag_validation_enabled = validation_mode != "Ignore"
+        self.checkpoint_tag_validation_fail = validation_mode == "Fail"
+        if validation_mode.title() not in C.CHECKPOINT_TAG_VALIDATION_MODES:
+            raise DeepSpeedConfigError(f"Checkpoint config contains invalid tag_validation value: {validation_mode}")
+        self.load_universal_checkpoint = checkpoint_params.get(C.LOAD_UNIVERSAL_CHECKPOINT,
+                                                               C.LOAD_UNIVERSAL_CHECKPOINT_DEFAULT)
+        self.use_node_local_storage = checkpoint_params.get(C.USE_NODE_LOCAL_STORAGE_CHECKPOINT,
+                                                            C.USE_NODE_LOCAL_STORAGE_CHECKPOINT_DEFAULT)
+        self.dataloader_drop_last = get_scalar_param(param_dict, C.DATALOADER_DROP_LAST,
+                                                     C.DATALOADER_DROP_LAST_DEFAULT)
+
+        from deepspeed_tpu.comm.config import DeepSpeedCommsConfig
+        self.comms_config = DeepSpeedCommsConfig(param_dict)
+
+        from deepspeed_tpu.profiling.config import DeepSpeedFlopsProfilerConfig
+        self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(**param_dict.get("flops_profiler", {}))
+
+        from deepspeed_tpu.runtime.activation_checkpointing.config import DeepSpeedActivationCheckpointingConfig
+        self.activation_checkpointing_config = DeepSpeedActivationCheckpointingConfig(
+            **param_dict.get("activation_checkpointing", {}))
+
+        from deepspeed_tpu.compression.config import get_compression_config
+        self.compression_config = get_compression_config(param_dict)
+
+        from deepspeed_tpu.elasticity.config import ElasticityConfig
+        self.elasticity_enabled = param_dict.get(C.ELASTICITY, {}).get(C.ENABLED, C.ENABLED_DEFAULT)
+        self.elasticity_config = ElasticityConfig(param_dict.get(C.ELASTICITY, {})) if self.elasticity_enabled \
+            else None
+
+        from deepspeed_tpu.inference.config import WeightQuantConfig
+        self.weight_quantization_config = WeightQuantConfig(
+            **param_dict["weight_quantization"]) if "weight_quantization" in param_dict else None
+
+        # TPU-native mesh axes: {"dp": -1} means "all remaining devices on dp"
+        self.mesh_axes: Dict[str, int] = dict(param_dict.get(C.MESH, C.MESH_AXES_DEFAULT))
+
+        # Sparse attention section (structure configs parsed by ops.sparse_attention)
+        self.sparse_attention = param_dict.get(C.SPARSE_ATTENTION, None)
+
+        self.nebula_config = param_dict.get("nebula", {})
+        self.autotuning_config = param_dict.get("autotuning", {})
+
+    # ------------------------------------------------------------------ #
+    # Batch triad (reference runtime/config.py:853-907)
+
+    def _batch_assertion(self) -> None:
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        assert train_batch > 0, f"Train batch size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, f"Micro batch size per gpu: {micro_batch} has to be greater than 0"
+        assert grad_acc > 0, f"Gradient accumulation steps: {grad_acc} has to be greater than 0"
+        assert train_batch == micro_batch * grad_acc * self.world_size, (
+            f"Check batch related parameters. train_batch_size is not equal "
+            f"to micro_batch_per_gpu * gradient_acc_step * world_size "
+            f"{train_batch} != {micro_batch} * {grad_acc} * {self.world_size}")
+
+    def _set_batch_related_parameters(self) -> None:
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        # all values are provided nothing needs to be set
+        if train_batch is not None and micro_batch is not None and grad_acc is not None:
+            return
+        # global_accumulation_steps needs to be set
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= self.world_size
+            self.gradient_accumulation_steps = grad_acc
+        # micro_batch_per_gpu needs to be set
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // self.world_size
+            micro_batch //= grad_acc
+            self.train_micro_batch_size_per_gpu = micro_batch
+        # train_batch_size needs to be set
+        elif micro_batch is not None and grad_acc is not None:
+            train_batch_size = micro_batch * grad_acc
+            train_batch_size *= self.world_size
+            self.train_batch_size = train_batch_size
+        # gradient_accumulation_steps and micro_batch_per_gpus is set
+        elif train_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train_batch // self.world_size
+        # train_batch_size and gradient_accumulation_step is set
+        elif micro_batch is not None:
+            self.train_batch_size = micro_batch * self.world_size
+            self.gradient_accumulation_steps = 1
+        else:
+            raise DeepSpeedConfigError("Either train_batch_size or train_micro_batch_size_per_gpu needs to be "
+                                       "provided")
+
+    def _configure_train_batch_size(self) -> None:
+        self._set_batch_related_parameters()
+        self._batch_assertion()
+
+    def _do_sanity_check(self) -> None:
+        if self.zero_enabled and self.zero_optimization_stage > 3:
+            raise DeepSpeedConfigError(f"Max supported ZeRO stage is 3, got {self.zero_optimization_stage}")
+        if self.fp16_master_weights_and_gradients:
+            assert self.zero_enabled and self.zero_optimization_stage in (
+                1, 2), "Fp16_master_weights_and_grads is only supported with ZeRO Stage 1/2 for now."
+
+    def print_user_config(self) -> str:
+        return json.dumps(self._param_dict, sort_keys=True, indent=4, default=repr)
+
+    def print(self, name: str) -> None:
+        logger.info(f"{name}:")
+        for arg in sorted(vars(self)):
+            if arg != "_param_dict":
+                logger.info(f"  {arg} {'.' * (29 - len(arg))} {getattr(self, arg)}")
+        logger.info(f"  json = {self.print_user_config()}")

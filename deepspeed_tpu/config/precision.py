@@ -1,0 +1,59 @@
+"""fp16 / bf16 mixed-precision sub-configs.
+
+Reference parity: ``deepspeed/runtime/config.py:118-220`` (fp16/bf16 dict
+extractors) and ``deepspeed/runtime/fp16/loss_scaler.py`` scale parameters.
+On TPU, bf16 is the native fast path (MXU); fp16 is kept for parity and uses
+dynamic loss scaling folded into the compiled step.
+"""
+
+from __future__ import annotations
+
+from pydantic import Field
+
+from deepspeed_tpu.config.config_utils import ConfigModel
+
+
+class FP16Config(ConfigModel):
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0  # 0 => dynamic loss scaling
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+    fp16_master_weights_and_grads: bool = False
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == 0
+
+    @property
+    def initial_dynamic_scale(self) -> float:
+        return 2.0**self.initial_scale_power if self.dynamic_loss_scale else self.loss_scale
+
+
+class BF16Config(ConfigModel):
+    enabled: bool = False
+    # TPU-native extension: accumulate grads in fp32 even when compute is bf16
+    accumulate_grads_in_fp32: bool = True
+
+
+class AMPConfig(ConfigModel):
+    enabled: bool = False
+    opt_level: str = "O1"
+
+
+class FloatingPointConfig(ConfigModel):
+    """Aggregated precision selection used by the engine."""
+    fp16: FP16Config = Field(default_factory=FP16Config)
+    bf16: BF16Config = Field(default_factory=BF16Config)
+    amp: AMPConfig = Field(default_factory=AMPConfig)
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        if self.fp16.enabled:
+            return jnp.float16
+        return jnp.float32

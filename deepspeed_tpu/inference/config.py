@@ -1,0 +1,159 @@
+"""Inference config.
+
+Reference parity: ``deepspeed/inference/config.py`` — ``DeepSpeedInferenceConfig``
+(dtype, tensor-parallel degree, MoE, quantization, max_out_tokens,
+kernel-injection toggles) plus the quantization sub-configs.
+
+TPU mapping: ``replace_with_kernel_inject`` swaps HF/flax layers for the
+fused Pallas inference blocks; ``enable_cuda_graph`` has no TPU analogue —
+``jax.jit`` + donated KV-cache buffers already gives a captured graph — so it
+is accepted and ignored (warn once).
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Any, Dict, Optional, Union
+
+from pydantic import Field
+
+from deepspeed_tpu.config.config_utils import ConfigModel
+from deepspeed_tpu.utils.logging import warn_once
+
+
+class DtypeEnum(str, Enum):
+    fp32 = "fp32"
+    fp16 = "fp16"
+    bf16 = "bf16"
+    int8 = "int8"
+
+    @classmethod
+    def from_any(cls, value) -> "DtypeEnum":
+        if isinstance(value, cls):
+            return value
+        aliases = {
+            "float32": "fp32", "float": "fp32", "fp32": "fp32",
+            "float16": "fp16", "half": "fp16", "fp16": "fp16",
+            "bfloat16": "bf16", "bf16": "bf16",
+            "int8": "int8",
+        }
+        name = str(value).replace("torch.", "").replace("jnp.", "")
+        if name not in aliases:
+            raise ValueError(f"Unsupported dtype: {value}")
+        return cls(aliases[name])
+
+    @property
+    def jnp(self):
+        import jax.numpy as jnp
+        return {
+            DtypeEnum.fp32: jnp.float32,
+            DtypeEnum.fp16: jnp.float16,
+            DtypeEnum.bf16: jnp.bfloat16,
+            DtypeEnum.int8: jnp.int8,
+        }[self]
+
+
+class MoETypeEnum(str, Enum):
+    residual = "residual"
+    standard = "standard"
+
+
+class DeepSpeedTPConfig(ConfigModel):
+    """Tensor-parallel config ("tensor_parallel" section)."""
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Optional[Any] = None
+    tp_group: Optional[Any] = None
+
+
+class DeepSpeedMoEConfig(ConfigModel):
+    """MoE inference config ("moe" section)."""
+    enabled: bool = True
+    ep_size: int = 1
+    moe_experts: list = Field([1], alias="num_experts")
+    type: MoETypeEnum = MoETypeEnum.standard
+    ep_mp_group: Optional[Any] = None
+    ep_group: Optional[Any] = None
+
+
+class QuantTypeEnum(str, Enum):
+    asym = "asymmetric"
+    sym = "symmetric"
+
+
+class BaseQuantConfig(ConfigModel):
+    enabled: bool = True
+    num_bits: int = 8
+    q_type: QuantTypeEnum = QuantTypeEnum.sym
+    q_groups: int = 1
+
+
+class WeightQuantConfig(BaseQuantConfig):
+    enabled: bool = True
+    quantized_initialization: Dict = {}
+    post_init_quant: Dict = {}
+
+
+class ActivationQuantConfig(BaseQuantConfig):
+    enabled: bool = True
+
+
+class QKVQuantConfig(ConfigModel):
+    enabled: bool = True
+
+
+class QuantizationConfig(ConfigModel):
+    enabled: bool = True
+    activation: ActivationQuantConfig = Field(default_factory=ActivationQuantConfig)
+    weight: WeightQuantConfig = Field(default_factory=WeightQuantConfig)
+    qkv: QKVQuantConfig = Field(default_factory=QKVQuantConfig)
+
+
+class InferenceCheckpointConfig(ConfigModel):
+    checkpoint_dir: Optional[str] = None
+    save_mp_checkpoint_path: Optional[str] = None
+    base_dir: Optional[str] = None
+
+
+class DeepSpeedInferenceConfig(ConfigModel):
+    """Master inference config (``deepspeed_tpu.init_inference`` kwarg set)."""
+
+    replace_with_kernel_inject: bool = Field(False, alias="kernel_inject")
+    dtype: DtypeEnum = DtypeEnum.fp16
+    tensor_parallel: DeepSpeedTPConfig = Field(default_factory=DeepSpeedTPConfig, alias="tp")
+    enable_cuda_graph: bool = False  # accepted for parity; jit is the TPU analogue
+    zero: Dict = {}
+    triangular_masking: bool = Field(True, alias="tm")
+    moe: Union[bool, DeepSpeedMoEConfig] = Field(default_factory=DeepSpeedMoEConfig)
+    quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    checkpoint: Optional[Union[str, Dict]] = None
+    base_dir: str = ""
+    set_empty_params: bool = False
+    save_mp_checkpoint_path: Optional[str] = None
+    checkpoint_config: InferenceCheckpointConfig = Field(default_factory=InferenceCheckpointConfig, alias="ckpt_config")
+    return_tuple: bool = True
+    training_mp_size: int = 1
+    replace_method: str = Field("auto", json_schema_extra={"deprecated": True})
+    injection_policy: Optional[Dict] = Field(None, alias="injection_dict")
+    injection_policy_tuple: Optional[tuple] = None
+    config: Optional[Dict] = Field(None, alias="args")
+    max_out_tokens: int = Field(1024, alias="max_tokens")
+    min_out_tokens: int = Field(1, alias="min_tokens")
+    transposed_mode: bool = Field(False, alias="transposed_mode")
+    mp_size: int = Field(1, json_schema_extra={"deprecated": True, "new_param": "tensor_parallel.tp_size"})
+    mpu: Optional[Any] = Field(None, json_schema_extra={"deprecated": True, "new_param": "tensor_parallel.mpu"})
+    ep_size: int = Field(1, json_schema_extra={"deprecated": True, "new_param": "moe.ep_size"})
+    ep_group: Optional[Any] = Field(None, alias="expert_group",
+                                    json_schema_extra={"deprecated": True, "new_param": "moe.ep_group"})
+    ep_mp_group: Optional[Any] = Field(None, alias="expert_mp_group",
+                                       json_schema_extra={"deprecated": True, "new_param": "moe.ep_mp_group"})
+    moe_experts: list = Field([1], json_schema_extra={"deprecated": True, "new_param": "moe.moe_experts"})
+    moe_type: MoETypeEnum = Field(MoETypeEnum.standard,
+                                  json_schema_extra={"deprecated": True, "new_param": "moe.type"})
+
+    def __init__(self, **data):
+        if data.get("enable_cuda_graph"):
+            warn_once("enable_cuda_graph has no TPU analogue; jax.jit already captures the graph. Ignoring.")
+        if "dtype" in data and data["dtype"] is not None:
+            data["dtype"] = DtypeEnum.from_any(data["dtype"])
+        super().__init__(**data)

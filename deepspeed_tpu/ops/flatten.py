@@ -1,0 +1,30 @@
+"""Flatten/unflatten dense tensor lists.
+
+Reference parity: ``csrc/utils/flatten_unflatten.cpp`` (UtilsBuilder) — used
+by every flat-buffer optimizer. In JAX this is ``jax.flatten_util`` territory;
+we keep the two-function API shape.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+
+def flatten(tensors: Sequence) -> jnp.ndarray:
+    """Concatenate tensors into one contiguous 1-D buffer."""
+    return jnp.concatenate([jnp.ravel(t) for t in tensors]) if tensors else jnp.zeros((0,))
+
+
+def unflatten(flat, tensors: Sequence) -> List:
+    """View a flat buffer as the shapes of ``tensors``."""
+    outputs = []
+    offset = 0
+    for t in tensors:
+        numel = 1
+        for d in t.shape:
+            numel *= d
+        outputs.append(jnp.reshape(flat[offset:offset + numel], t.shape).astype(t.dtype))
+        offset += numel
+    return outputs

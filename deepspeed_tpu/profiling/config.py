@@ -1,0 +1,17 @@
+"""FLOPS profiler config (reference: deepspeed/profiling/config.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from deepspeed_tpu.config.config_utils import ConfigModel
+
+
+class DeepSpeedFlopsProfilerConfig(ConfigModel):
+    enabled: bool = False
+    recompute_fwd_factor: float = 0.0
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None

@@ -1,0 +1,21 @@
+"""Activation checkpointing config.
+
+Reference parity: ``deepspeed/runtime/activation_checkpointing/config.py``.
+On TPU these knobs drive ``jax.checkpoint`` (remat) policies rather than
+manual save/recompute: ``partition_activations`` becomes sequence/TP-axis
+sharding of saved activations; ``cpu_checkpointing`` becomes a host-offload
+remat policy (``jax.ad_checkpoint.checkpoint_policies.offload_dot_with_no_batch_dims``-style).
+"""
+
+from __future__ import annotations
+
+from deepspeed_tpu.config.config_utils import ConfigModel
+
+
+class DeepSpeedActivationCheckpointingConfig(ConfigModel):
+    partition_activations: bool = False
+    contiguous_memory_optimization: bool = False
+    cpu_checkpointing: bool = False
+    number_checkpoints: int | None = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False

@@ -1,0 +1,66 @@
+"""Data-efficiency (curriculum learning + random-LTD) config.
+
+Reference parity: ``deepspeed/runtime/data_pipeline/config.py`` and
+``constants.py`` — returns plain nested dicts keyed like the reference JSON
+schema so user configs port over unchanged.
+"""
+
+from __future__ import annotations
+
+import copy
+
+DATA_EFFICIENCY = "data_efficiency"
+DATA_SAMPLING = "data_sampling"
+CURRICULUM_LEARNING = "curriculum_learning"
+DATA_ROUTING = "data_routing"
+RANDOM_LTD = "random_ltd"
+
+
+DEFAULT_DATA_EFFICIENCY = {
+    "enabled": False,
+    "seed": 1234,
+    DATA_SAMPLING: {
+        "enabled": False,
+        "num_epochs": 1000,
+        "num_workers": 0,
+        CURRICULUM_LEARNING: {
+            "enabled": False,
+        },
+    },
+    DATA_ROUTING: {
+        "enabled": False,
+        RANDOM_LTD: {
+            "enabled": False,
+        },
+    },
+}
+
+
+def _deep_update(base: dict, override: dict) -> dict:
+    out = copy.deepcopy(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_update(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def get_data_efficiency_config(param_dict: dict) -> dict:
+    return _deep_update(DEFAULT_DATA_EFFICIENCY, param_dict.get(DATA_EFFICIENCY, {}))
+
+
+def get_data_sampling(param_dict: dict) -> dict:
+    return get_data_efficiency_config(param_dict)[DATA_SAMPLING]
+
+
+def get_curriculum_learning(param_dict: dict) -> dict:
+    return get_data_sampling(param_dict)[CURRICULUM_LEARNING]
+
+
+def get_data_routing(param_dict: dict) -> dict:
+    return get_data_efficiency_config(param_dict)[DATA_ROUTING]
+
+
+def get_random_ltd(param_dict: dict) -> dict:
+    return get_data_routing(param_dict)[RANDOM_LTD]

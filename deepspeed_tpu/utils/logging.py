@@ -1,0 +1,89 @@
+"""Rank-aware logging utilities.
+
+Capability parity with the reference's ``deepspeed/utils/logging.py`` (logger
+factory, ``log_dist`` rank-filtered logging, ``should_log_le``), rebuilt for a
+JAX multi-process world: rank discovery goes through ``jax.process_index()``
+instead of ``torch.distributed``.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class LoggerFactory:
+
+    @staticmethod
+    def create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(filename)s:%(lineno)d:%(funcName)s] %(message)s")
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            ch = logging.StreamHandler(stream=sys.stdout)
+            ch.setLevel(level)
+            ch.setFormatter(formatter)
+            logger_.addHandler(ch)
+        return logger_
+
+
+logger = LoggerFactory.create_logger(
+    name="deepspeed_tpu", level=log_levels.get(os.environ.get("DS_TPU_LOG_LEVEL", "info"), logging.INFO))
+
+
+def _get_rank() -> int:
+    """Process index of this host, without forcing distributed init."""
+    # Environment first: works before jax.distributed.initialize and in launchers.
+    for var in ("RANK", "PROCESS_ID", "JAX_PROCESS_ID"):
+        if var in os.environ:
+            try:
+                return int(os.environ[var])
+            except ValueError:
+                pass
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks=None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process ranks (``[-1]`` or None = all)."""
+    my_rank = _get_rank()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        final_message = f"[Rank {my_rank}] {message}"
+        logger.log(level, final_message)
+
+
+def print_rank_0(message: str) -> None:
+    if _get_rank() == 0:
+        print(message, flush=True)
+
+
+@functools.lru_cache(None)
+def warn_once(message: str) -> None:
+    logger.warning(message)
+
+
+def should_log_le(max_log_level_str: str) -> bool:
+    """True if the logger's current level is <= the named level."""
+    if not isinstance(max_log_level_str, str):
+        raise ValueError("max_log_level_str must be a string")
+    max_log_level_str = max_log_level_str.lower()
+    if max_log_level_str not in log_levels:
+        raise ValueError(f"{max_log_level_str} is not one of the `log_levels` keys: {list(log_levels)}")
+    return logger.getEffectiveLevel() <= log_levels[max_log_level_str]

@@ -1,0 +1,41 @@
+"""Test harness setup.
+
+The unit suite runs on a virtual 8-device CPU mesh (the TPU analogue of the
+reference's multi-process single-node NCCL harness, tests/unit/common.py).
+This must happen before any backend initializes: we append
+``--xla_force_host_platform_device_count=8`` and force the cpu platform even
+if a TPU plugin was registered at interpreter start.
+"""
+
+import os
+
+os.environ.setdefault("DS_ACCELERATOR", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual cpu devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def mesh_1d(devices):
+    from jax.sharding import Mesh
+    return Mesh(np.array(devices[:8]), ("dp",))
+
+
+@pytest.fixture
+def mesh_2d(devices):
+    from jax.sharding import Mesh
+    return Mesh(np.array(devices[:8]).reshape(4, 2), ("dp", "tp"))

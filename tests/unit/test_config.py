@@ -1,0 +1,150 @@
+"""Config-system semantics tests.
+
+Mirrors the reference's ``tests/unit/runtime/test_ds_config_dict.py`` and
+batch-triad coverage in ``tests/unit/runtime/test_ds_initialize.py``.
+"""
+
+import pytest
+
+from deepspeed_tpu.config.core import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.runtime.zero.config import ZeroConfig
+
+
+class TestBatchTriad:
+
+    def test_all_given_consistent(self):
+        cfg = DeepSpeedConfig(
+            {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 4},
+            world_size=4)
+        assert cfg.train_batch_size == 64
+
+    def test_all_given_inconsistent_raises(self):
+        with pytest.raises(AssertionError):
+            DeepSpeedConfig(
+                {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2},
+                world_size=4)
+
+    def test_derive_gas(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4}, world_size=4)
+        assert cfg.gradient_accumulation_steps == 4
+
+    def test_derive_micro(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 64, "gradient_accumulation_steps": 4}, world_size=4)
+        assert cfg.train_micro_batch_size_per_gpu == 4
+
+    def test_derive_train(self):
+        cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 4}, world_size=4)
+        assert cfg.train_batch_size == 64
+
+    def test_only_train_batch(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 64}, world_size=4)
+        assert cfg.train_micro_batch_size_per_gpu == 16
+        assert cfg.gradient_accumulation_steps == 1
+
+    def test_only_micro_batch(self):
+        cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2}, world_size=4)
+        assert cfg.train_batch_size == 8
+
+    def test_none_given_raises(self):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({}, world_size=4)
+
+
+class TestPrecision:
+
+    def test_fp16_dynamic_scale(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True, "initial_scale_power": 8}},
+                              world_size=1)
+        assert cfg.fp16_enabled
+        assert cfg.initial_dynamic_scale == 256.0
+        assert cfg.dynamic_loss_scale_args["init_scale"] == 256
+
+    def test_fp16_static_scale(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True, "loss_scale": 128}}, world_size=1)
+        assert cfg.loss_scale == 128
+        assert cfg.dynamic_loss_scale_args is None
+
+    def test_bf16(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8, "bf16": {"enabled": True}}, world_size=1)
+        assert cfg.bfloat16_enabled and not cfg.fp16_enabled
+
+    def test_bf16_old_key(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8, "bfloat16": {"enabled": True}}, world_size=1)
+        assert cfg.bfloat16_enabled
+
+    def test_fp16_and_bf16_conflict(self):
+        with pytest.raises(AssertionError):
+            DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True}, "bf16": {"enabled": True}},
+                            world_size=1)
+
+
+class TestZeroConfig:
+
+    def test_defaults(self):
+        z = ZeroConfig()
+        assert z.stage == 0
+        assert z.overlap_comm is False
+
+    def test_stage3_overlap_default(self):
+        z = ZeroConfig(stage=3)
+        assert z.overlap_comm is True
+
+    def test_aliases(self):
+        z = ZeroConfig(**{"stage": 3, "stage3_max_live_parameters": 123, "stage3_prefetch_bucket_size": 456})
+        assert z.max_live_parameters == 123
+        assert z.prefetch_bucket_size == 456
+
+    def test_deprecated_cpu_offload(self):
+        z = ZeroConfig(stage=2, cpu_offload=True)
+        assert z.offload_optimizer is not None
+        assert z.offload_optimizer.device == "cpu"
+
+    def test_bool_zero_section(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8, "zero_optimization": True}, world_size=1)
+        assert cfg.zero_optimization_stage == 1
+
+    def test_offload_devices(self):
+        cfg = DeepSpeedConfig(
+            {
+                "train_batch_size": 8,
+                "zero_optimization": {
+                    "stage": 3,
+                    "offload_optimizer": {"device": "cpu"},
+                    "offload_param": {"device": "nvme", "nvme_path": "/tmp/nvme"},
+                },
+            },
+            world_size=1)
+        assert cfg.zero_config.offload_optimizer_device == "cpu"
+        assert cfg.zero_config.offload_param_device == "nvme"
+
+    def test_stage_out_of_range(self):
+        with pytest.raises(Exception):
+            ZeroConfig(stage=5)
+
+
+class TestMisc:
+
+    def test_duplicate_keys_rejected(self, tmp_path):
+        p = tmp_path / "ds.json"
+        p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+        with pytest.raises(ValueError):
+            DeepSpeedConfig(str(p), world_size=1)
+
+    def test_config_from_file(self, tmp_path):
+        p = tmp_path / "ds.json"
+        p.write_text('{"train_batch_size": 32, "gradient_clipping": 1.0}')
+        cfg = DeepSpeedConfig(str(p), world_size=4)
+        assert cfg.gradient_clipping == 1.0
+        assert cfg.train_micro_batch_size_per_gpu == 8
+
+    def test_monitor_config(self):
+        cfg = DeepSpeedConfig(
+            {"train_batch_size": 8, "tensorboard": {"enabled": True, "output_path": "/tmp/tb"}}, world_size=1)
+        assert cfg.monitor_config.tensorboard.enabled
+        assert not cfg.monitor_config.wandb.enabled
+
+    def test_checkpoint_tag_validation(self):
+        cfg = DeepSpeedConfig({"train_batch_size": 8, "checkpoint": {"tag_validation": "Fail"}}, world_size=1)
+        assert cfg.checkpoint_tag_validation_enabled and cfg.checkpoint_tag_validation_fail
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"train_batch_size": 8, "checkpoint": {"tag_validation": "bogus"}}, world_size=1)
