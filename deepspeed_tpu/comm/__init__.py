@@ -1,0 +1,24 @@
+"""deepspeed_tpu.comm — collective communication facade over mesh axes.
+
+Usage mirrors the reference's ``deepspeed.comm``::
+
+    import deepspeed_tpu.comm as dist
+    dist.init_distributed()
+    dist.init_mesh({"dp": -1, "tp": 2})
+    y = dist.all_reduce(x, group="dp")
+"""
+
+from deepspeed_tpu.comm.comm import (ReduceOp, all_gather, all_gather_into_tensor, all_reduce, all_to_all_single,
+                                     axis_index, barrier, broadcast, comms_logger, configure, get_local_rank,
+                                     get_mesh, get_rank, get_world_size, has_mesh, inference_all_reduce,
+                                     init_distributed, init_mesh, is_initialized, log_summary, monitored_barrier,
+                                     recv, reduce_scatter, reduce_scatter_tensor, ring_send_recv, send, set_mesh)
+from deepspeed_tpu.comm.mesh import axis_size, build_hybrid_mesh, build_mesh, data_parallel_axes
+
+__all__ = [
+    "ReduceOp", "all_gather", "all_gather_into_tensor", "all_reduce", "all_to_all_single", "axis_index", "barrier",
+    "broadcast", "comms_logger", "configure", "get_local_rank", "get_mesh", "get_rank", "get_world_size", "has_mesh",
+    "inference_all_reduce", "init_distributed", "init_mesh", "is_initialized", "log_summary", "monitored_barrier",
+    "recv", "reduce_scatter", "reduce_scatter_tensor", "ring_send_recv", "send", "set_mesh", "axis_size",
+    "build_hybrid_mesh", "build_mesh", "data_parallel_axes",
+]

@@ -201,10 +201,11 @@ class DeepSpeedConfig:
         self.data_efficiency_enabled = self.data_efficiency_config.get("enabled", False)
 
         checkpoint_params = param_dict.get(C.CHECKPOINT, {})
-        validation_mode = checkpoint_params.get(C.CHECKPOINT_TAG_VALIDATION, C.CHECKPOINT_TAG_VALIDATION_DEFAULT)
+        validation_mode = checkpoint_params.get(C.CHECKPOINT_TAG_VALIDATION,
+                                                C.CHECKPOINT_TAG_VALIDATION_DEFAULT).title()
         self.checkpoint_tag_validation_enabled = validation_mode != "Ignore"
         self.checkpoint_tag_validation_fail = validation_mode == "Fail"
-        if validation_mode.title() not in C.CHECKPOINT_TAG_VALIDATION_MODES:
+        if validation_mode not in C.CHECKPOINT_TAG_VALIDATION_MODES:
             raise DeepSpeedConfigError(f"Checkpoint config contains invalid tag_validation value: {validation_mode}")
         self.load_universal_checkpoint = checkpoint_params.get(C.LOAD_UNIVERSAL_CHECKPOINT,
                                                                C.LOAD_UNIVERSAL_CHECKPOINT_DEFAULT)
