@@ -1,0 +1,80 @@
+"""Benchmark: GPT-2 125M causal-LM training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's single-GPU fused-kernel result — BERT-large at
+>50% of V100 peak (docs/_posts/2020-05-28-fastest-bert-training.md, see
+BASELINE.md). vs_baseline = achieved MFU / 0.50, i.e. >1.0 means this
+framework exceeds the reference's best published hardware efficiency class.
+"""
+
+import json
+import os
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models import gpt2
+
+    BATCH = int(os.environ.get("BENCH_BATCH", 8))
+    SEQ = int(os.environ.get("BENCH_SEQ", 1024))
+    STEPS = int(os.environ.get("BENCH_STEPS", 10))
+
+    model = gpt2("125m")
+    params = model.init_params(jax.random.key(0))
+
+    dist.set_mesh(None)
+    config = {
+        "train_micro_batch_size_per_gpu": BATCH,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 6e-4, "weight_decay": 0.1}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "mesh": {"dp": -1},
+        "steps_per_print": 0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=config)
+
+    rng = np.random.default_rng(0)
+
+    def batch(seed):
+        return {"input_ids": rng.integers(0, 50257, size=(BATCH, SEQ)).astype(np.int32)}
+
+    # warmup/compile
+    engine.train_batch(batch(0))
+    jax.effects_barrier()
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        loss = engine.train_batch(batch(i + 1))
+    jax.effects_barrier()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = BATCH * SEQ * STEPS / dt
+    flops_per_token = model.flops_per_token(SEQ)
+    achieved_tflops = tokens_per_sec * flops_per_token / 1e12
+
+    # peak bf16 TFLOPs for the chip we are on
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "unknown").lower()
+    peak = 197.0 if ("v5" in kind and "lite" in kind) or "v5e" in kind else \
+           459.0 if "v5p" in kind else 275.0 if "v4" in kind else 197.0
+    mfu = achieved_tflops / peak
+
+    print(json.dumps({
+        "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": f"tokens/s (bf16, bs{BATCH}xseq{SEQ}, ZeRO-1, {kind}, "
+                f"{achieved_tflops:.1f} TFLOPs, MFU {mfu:.3f}, loss {float(loss):.3f})",
+        "vs_baseline": round(mfu / 0.50, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""deepspeed_tpu — a TPU-native large-model training & inference framework.
+
+Public API mirrors the reference (``deepspeed/__init__.py``):
+
+    import deepspeed_tpu
+
+    engine, optimizer, dataloader, lr_scheduler = deepspeed_tpu.initialize(
+        model=my_model, model_parameters=params, config="ds_config.json")
+    for batch in data:
+        loss = engine.train_batch(batch)
+
+    infer_engine = deepspeed_tpu.init_inference(model, tensor_parallel={"tp_size": 8})
+"""
+
+from deepspeed_tpu.version import __version__, git_branch, git_hash
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               mesh=None,
+               config_params=None):
+    """Initialize the training engine (reference deepspeed/__init__.py:52).
+
+    Returns ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+    """
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.utils.logging import log_dist
+
+    log_dist(f"deepspeed_tpu info: version={__version__}", ranks=[0])
+
+    if config is None:
+        config = config_params
+    if config is None and args is not None and hasattr(args, "deepspeed_config") and args.deepspeed_config:
+        config = args.deepspeed_config
+
+    if model is None:
+        raise ValueError("deepspeed_tpu.initialize: model is required")
+
+    engine = DeepSpeedEngine(model=model,
+                             config=config,
+                             model_parameters=model_parameters,
+                             optimizer=optimizer,
+                             lr_scheduler=lr_scheduler,
+                             mesh=mesh,
+                             mpu=mpu,
+                             training_data=training_data,
+                             collate_fn=collate_fn)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model, config=None, **kwargs):
+    """Initialize the inference engine (reference deepspeed/__init__.py:214)."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    if config is None:
+        config = kwargs
+    elif kwargs:
+        config = {**config, **kwargs}
+    ds_inference_config = DeepSpeedInferenceConfig(**config)
+    return InferenceEngine(model, config=ds_inference_config)
+
+
+def add_config_arguments(parser):
+    """Add --deepspeed / --deepspeed_config CLI args (reference :191)."""
+    group = parser.add_argument_group("DeepSpeed-TPU", "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag to ease transition)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the framework json config file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated alias of --deepspeed")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated alias of --deepspeed_config")
+    return parser

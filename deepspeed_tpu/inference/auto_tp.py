@@ -1,0 +1,57 @@
+"""Automatic tensor-parallel sharding for arbitrary parameter pytrees.
+
+Reference parity: ``deepspeed/module_inject/auto_tp.py`` + ``replace_wo_policy``
+(``replace_module.py:357``) — the policy-free path that inspects the module
+graph to decide which linears to row/column-shard and where the all-reduce
+goes. The SPMD analogue inspects parameter names/shapes and emits
+PartitionSpecs; XLA places the collectives.
+
+Heuristics (Megatron layout):
+- names containing q/k/v/query/key/value/up/gate/fc1/w_up/wi → column shard
+  (last dim over ``tp``)
+- names containing o_proj/out/down/fc2/w_down/wo/dense_4h → row shard
+  (first non-batch dim over ``tp``) — XLA inserts the psum after it
+- embeddings → vocab shard; norms/biases of row-sharded layers → replicate
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+COLUMN_PAT = ("wq", "wk", "wv", "q_proj", "k_proj", "v_proj", "query", "key", "value", "w_up", "up_proj", "w_gate",
+              "gate_proj", "fc1", "wi", "c_fc", "dense_h_to_4h")
+ROW_PAT = ("wo", "o_proj", "out_proj", "w_down", "down_proj", "fc2", "wo_proj", "c_proj", "dense_4h_to_h",
+           "attention.dense")
+EMBED_PAT = ("embed", "wte", "word_embeddings", "tok_embeddings")
+
+
+def _spec_for(path: str, shape) -> P:
+    ndim = len(shape)
+    lower = path.lower()
+    if ndim < 2:
+        return P(*([None] * ndim))
+    if any(p in lower for p in EMBED_PAT):
+        return P(*(["tp"] + [None] * (ndim - 1)))
+    if any(p in lower for p in COLUMN_PAT):
+        spec = [None] * ndim
+        spec[-1] = "tp"
+        return P(*spec)
+    if any(p in lower for p in ROW_PAT):
+        spec = [None] * ndim
+        spec[-2] = "tp"
+        return P(*spec)
+    return P(*([None] * ndim))
+
+
+def auto_tp_specs(params) -> Any:
+    """PartitionSpec pytree congruent with ``params`` chosen by name."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree.structure(params)
+    specs = []
+    for keypath, leaf in flat:
+        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in keypath)
+        specs.append(_spec_for(path, getattr(leaf, "shape", ())))
+    return jax.tree.unflatten(treedef, specs)

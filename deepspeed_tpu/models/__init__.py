@@ -1,0 +1,18 @@
+"""Model zoo: TPU-first implementations of the architectures the reference's
+inference policies cover (``deepspeed/module_inject/containers/``: GPT-2,
+GPT-J/Neo/NeoX, OPT, BLOOM, Megatron-GPT, BERT/DistilBERT) plus Llama.
+
+Every model is a thin preset over ``deepspeed_tpu.models.transformer``:
+``CausalLM(config)`` exposes ``init_params(rng)``, ``loss(params, batch)``,
+``forward(params, tokens)``, and ``tp_specs()`` so it plugs directly into
+``deepspeed_tpu.initialize`` and the inference engine.
+"""
+
+from deepspeed_tpu.models.causal_lm import CausalLM
+from deepspeed_tpu.models.presets import (MODEL_PRESETS, bloom, get_model, gpt2, gpt2_large,
+                                          gpt2_medium, gpt2_xl, gpt_neox, llama_7b, opt)
+
+__all__ = [
+    "CausalLM", "MODEL_PRESETS", "get_model", "gpt2", "gpt2_medium", "gpt2_large", "gpt2_xl", "llama_7b",
+    "bloom", "opt", "gpt_neox",
+]

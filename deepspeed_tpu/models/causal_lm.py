@@ -1,0 +1,57 @@
+"""Generic causal-LM wrapper over the shared transformer backbone."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import transformer as T
+
+
+class CausalLM:
+    """A causal language model ready for ``deepspeed_tpu.initialize``.
+
+    batch: dict(input_ids[B,S] int32, optional labels, attention_mask).
+    """
+
+    def __init__(self, config: T.TransformerConfig, param_dtype=jnp.float32):
+        self.config = config
+        self.param_dtype = param_dtype
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        return T.init_params(self.config, rng, dtype=self.param_dtype)
+
+    def forward(self, params, tokens, attn_mask=None):
+        return T.forward(self.config, params, tokens, attn_mask)
+
+    def __call__(self, params, tokens, attn_mask=None):
+        return self.forward(params, tokens, attn_mask)
+
+    def loss(self, params, batch):
+        return T.lm_loss(self.config, params, batch)
+
+    def tp_specs(self) -> Dict[str, Any]:
+        return T.tp_specs(self.config)
+
+    @property
+    def num_parameters(self) -> int:
+        cfg = self.config
+        embed = cfg.vocab_size * cfg.d_model + (cfg.max_seq * cfg.d_model if cfg.pos_embedding == "learned" else 0)
+        attn = cfg.d_model * cfg.head_dim * (cfg.n_head + 2 * cfg.kv_heads) + cfg.n_head * cfg.head_dim * cfg.d_model
+        if cfg.activation == "swiglu":
+            mlp = 3 * cfg.d_model * cfg.ff_dim
+        else:
+            mlp = 2 * cfg.d_model * cfg.ff_dim + cfg.ff_dim + cfg.d_model
+        norms = (4 if cfg.norm == "layernorm" else 2) * cfg.d_model
+        final_norm = (2 if cfg.norm == "layernorm" else 1) * cfg.d_model
+        head = 0 if cfg.tie_embeddings else cfg.d_model * cfg.vocab_size
+        return embed + cfg.n_layer * (attn + mlp + norms) + final_norm + head
+
+    def flops_per_token(self, seq_len: Optional[int] = None) -> float:
+        """Approximate training FLOPs/token (6N + attention term)."""
+        cfg = self.config
+        s = seq_len or cfg.max_seq
+        n = self.num_parameters
+        return 6.0 * n + 12.0 * cfg.n_layer * cfg.d_model * s

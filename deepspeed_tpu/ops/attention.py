@@ -1,0 +1,43 @@
+"""Attention ops.
+
+The XLA einsum path below is the default; ``deepspeed_tpu.ops.flash_attention``
+(Pallas, TPU) replaces it for long sequences when available. This mirrors the
+reference's split between its CUDA softmax/attention kernels
+(``csrc/transformer/softmax_kernels.cu``, inference ``softmax_context``) and
+the torch fallbacks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def mha_attention(q, k, v, mask_bias=None, causal: bool = True, alibi_slopes=None, scale: Optional[float] = None):
+    """q,k,v: [B, S, H, Hd] → [B, S, H, Hd].
+
+    Computed in fp32 accumulators (softmax in fp32) with inputs in compute
+    dtype; XLA fuses scale+bias+mask+softmax into the attention matmuls.
+    """
+    B, S, H, Hd = q.shape
+    scale = scale if scale is not None else Hd**-0.5
+
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+
+    if alibi_slopes is not None:
+        # additive linear biases per head: slope * -(q_pos - k_pos)
+        qpos = jnp.arange(S)[:, None]
+        kpos = jnp.arange(S)[None, :]
+        dist = (kpos - qpos).astype(jnp.float32)  # <= 0 in causal region
+        logits = logits + alibi_slopes[None, :, None, None] * dist[None, None, :, :]
+
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(causal_mask[None, None, :, :], logits, -1e9)
+    if mask_bias is not None:
+        logits = logits + mask_bias  # [B,1,1,S] broadcast
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,0 +1,101 @@
+"""Pluggable checkpoint engines.
+
+Reference parity: ``deepspeed/runtime/checkpoint_engine/checkpoint_engine.py``
+(the create/save/load/commit ABC) and ``torch_checkpoint_engine.py`` /
+``nebula_checkpoint_engine.py``.
+
+TPU-native implementations:
+
+- ``OrbaxCheckpointEngine`` — the default. Orbax natively understands
+  ``jax.Array`` shardings, writes each process's addressable shards
+  (multi-host safe), and restores with the target sharding — this subsumes
+  both the reference's per-rank ZeRO checkpoint files
+  (``_save_zero_checkpoint``) and its TP/PP-aware merge logic at load.
+- ``AsyncCheckpointEngine`` — Nebula-equivalent tiered/async save: snapshot
+  to host memory, write in a background thread, ``commit`` waits.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+from typing import Any, Optional
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+class CheckpointEngine:
+
+    def __init__(self, config_params=None):
+        pass
+
+    def create(self, tag: str):
+        """Notify start of a checkpoint under ``tag``."""
+
+    def save(self, state_dict: Any, path: str):
+        raise NotImplementedError
+
+    def load(self, path: str, map_location=None, template: Any = None):
+        raise NotImplementedError
+
+    def commit(self, tag: str) -> bool:
+        """Flush/publish everything saved under ``tag``."""
+        return True
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """Synchronous orbax-backed save/load of jax pytrees."""
+
+    def __init__(self, config_params=None):
+        super().__init__(config_params)
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def create(self, tag: str):
+        log_dist(f"[Orbax] Saving checkpoint under tag {tag}", ranks=[0])
+
+    def save(self, state_dict: Any, path: str):
+        path = os.path.abspath(path)
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        self._ckptr.save(path, state_dict)
+        self._ckptr.wait_until_finished()
+
+    def load(self, path: str, map_location=None, template: Any = None):
+        path = os.path.abspath(path)
+        if template is not None:
+            return self._ckptr.restore(path, target=template)
+        return self._ckptr.restore(path)
+
+    def commit(self, tag: str) -> bool:
+        self._ckptr.wait_until_finished()
+        return True
+
+
+class AsyncCheckpointEngine(OrbaxCheckpointEngine):
+    """Nebula-style async tiered save (reference nebula_checkpoint_engine.py):
+    the device→host snapshot happens synchronously, the disk write in a
+    background thread; ``commit`` joins all pending writes."""
+
+    def __init__(self, config_params=None):
+        super().__init__(config_params)
+        self._pending: list = []
+
+    def save(self, state_dict: Any, path: str):
+        import jax
+
+        # snapshot to host memory synchronously so training can proceed
+        host_state = jax.tree.map(lambda x: jax.device_get(x) if hasattr(x, "addressable_shards") else x,
+                                  state_dict)
+        t = threading.Thread(target=super().save, args=(host_state, path), daemon=True)
+        t.start()
+        self._pending.append(t)
+
+    def commit(self, tag: str) -> bool:
+        for t in self._pending:
+            t.join()
+        self._pending.clear()
+        return super().commit(tag)

@@ -1,0 +1,92 @@
+"""Data loading helpers.
+
+Reference parity: ``deepspeed/runtime/dataloader.py`` —
+``DeepSpeedDataLoader`` (distributed sampling + batching) and
+``RepeatingLoader``. Works with torch datasets/dataloaders, plain sequences,
+or generators of numpy arrays; yields host numpy pytrees the engine shards
+onto the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart from the beginning when exhausted
+    (reference dataloader.py:9)."""
+
+    def __init__(self, loader: Iterable):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            batch = next(self.data_iter)
+        return batch
+
+
+def _default_collate(samples):
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(np.stack([s[i] for s in samples]) for i in range(len(first)))
+    return np.stack(samples)
+
+
+class DeepSpeedDataLoader:
+    """Batches a dataset for this process's data-parallel shard.
+
+    In the single-controller JAX model every process loads its slice of the
+    global batch; with one process (TPU slice per host), that is the whole
+    per-host batch and the engine shards it over the mesh.
+    """
+
+    def __init__(self,
+                 dataset,
+                 batch_size: int,
+                 collate_fn: Optional[Callable] = None,
+                 drop_last: bool = False,
+                 shuffle: bool = False,
+                 seed: int = 0,
+                 num_local_io_workers: int = 0,
+                 data_sampler=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or _default_collate
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.seed = seed
+        self.data_sampler = data_sampler
+        self.epoch = 0
+
+    def __len__(self):
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.data_sampler is not None:
+            order = list(iter(self.data_sampler))
+        elif self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            order = rng.permutation(n).tolist()
+        else:
+            order = list(range(n))
+        self.epoch += 1
+        for start in range(0, n, self.batch_size):
+            idx = order[start:start + self.batch_size]
+            if len(idx) < self.batch_size and self.drop_last:
+                break
+            yield self.collate_fn([self.dataset[i] for i in idx])

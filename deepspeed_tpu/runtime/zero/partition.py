@@ -1,0 +1,177 @@
+"""ZeRO stages as sharding-spec programs.
+
+This replaces the reference's hook-driven partitioning machinery
+(``runtime/zero/stage_1_and_2.py:575`` round-robin partitioning,
+``stage3.py`` parameter partitioning + ``partitioned_param_coordinator.py``
+fetch/release) with declarative ``NamedSharding`` rules. XLA's SPMD
+partitioner then inserts and schedules the all-gathers/reduce-scatters the
+reference issues by hand — including the overlap the reference implements
+with side streams (``overlap_comm``) and the prefetch machinery
+(``prefetch_bucket_sz``), both of which fall out of XLA's latency-hiding
+scheduler.
+
+Mapping:
+
+- **stage 0** (plain DP): params/grads/opt-state replicated; grad psum.
+- **stage 1**: optimizer state + fp32 master params sharded over the dp axis;
+  grads replicated (allreduce); params replicated.
+- **stage 2**: + gradients sharded over dp (XLA turns the grad psum +
+  slice-for-update into a reduce-scatter).
+- **stage 3**: + compute params sharded over dp; XLA all-gathers each
+  parameter just before use and frees it after (gather-on-use). The
+  reference's persistence threshold (``stage3_param_persistence_threshold``)
+  maps to "small params stay replicated".
+
+Sharding choice per array: shard the *largest* dimension divisible by the
+partition-axis size; fall back to replication when nothing divides (the
+reference pads flat buffers instead — unnecessary here since each array is
+partitioned independently and XLA handles ragged layouts per-dim).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.runtime.zero.config import ZeroConfig
+
+
+def _partition_axes(mesh: Mesh, zero_config: ZeroConfig) -> Tuple[str, ...]:
+    """Mesh axes ZeRO partitions over: the configured axis plus fsdp if present."""
+    axes = []
+    for ax in (zero_config.partition_axis, "fsdp"):
+        if ax in mesh.shape and mesh.shape[ax] > 1 and ax not in axes:
+            axes.append(ax)
+    return tuple(axes)
+
+
+class ZeroShardingRules:
+    """Produces NamedShardings for params / master params / grads / opt state.
+
+    TP-sharded models compose transparently: a param that already carries a
+    TP PartitionSpec keeps its TP dims; ZeRO sharding picks among the
+    remaining dims. (Reference analogue: ZeRO groups are orthogonal to the
+    model-parallel group, ``utils/groups.py``.)
+    """
+
+    def __init__(self, mesh: Mesh, zero_config: Optional[ZeroConfig] = None):
+        self.mesh = mesh
+        self.config = zero_config or ZeroConfig()
+        self.stage = self.config.stage
+        self.axes = _partition_axes(mesh, self.config)
+        import math
+        self.axis_size = math.prod(mesh.shape[a] for a in self.axes) if self.axes else 1
+
+    # -------------------- per-array spec builders -------------------- #
+
+    def _sanitize_tp(self, arr_shape: Tuple[int, ...], tp_spec: Optional[P]) -> Optional[P]:
+        """Drop TP axis entries whose dim isn't divisible by the axis size
+        (e.g. an odd vocab over tp=2 falls back to replication on that dim)."""
+        import math
+        if tp_spec is None:
+            return None
+        out = []
+        for i, entry in enumerate(tp_spec):
+            if entry is None or i >= len(arr_shape):
+                out.append(None if i >= len(arr_shape) else entry)
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            if any(a not in self.mesh.shape for a in axes):
+                out.append(None)  # axis absent from this mesh (e.g. no tp)
+                continue
+            size = math.prod(self.mesh.shape[a] for a in axes)
+            out.append(entry if arr_shape[i] % size == 0 else None)
+        return P(*out)
+
+    def _zero_spec(self, arr_shape: Tuple[int, ...], tp_spec: Optional[P], threshold: int) -> P:
+        """Shard over the ZeRO axes, avoiding dims already taken by TP."""
+        import math
+        tp_spec = self._sanitize_tp(arr_shape, tp_spec)
+        if not self.axes or self.axis_size <= 1:
+            return tp_spec or P()
+        numel = math.prod(arr_shape) if arr_shape else 1
+        if numel < threshold or not arr_shape:
+            return tp_spec or P()
+        taken = set()
+        base = list(tp_spec) if tp_spec is not None else [None] * len(arr_shape)
+        while len(base) < len(arr_shape):
+            base.append(None)
+        for i, s in enumerate(base):
+            if s is not None:
+                taken.add(i)
+        # shard the largest free, divisible dim
+        free = [i for i in range(len(arr_shape)) if i not in taken]
+        free.sort(key=lambda i: -arr_shape[i])
+        ax = self.axes if len(self.axes) > 1 else self.axes[0]
+        for i in free:
+            if arr_shape[i] % self.axis_size == 0 and arr_shape[i] >= self.axis_size:
+                base[i] = ax
+                return P(*base)
+        return tp_spec or P()
+
+    def param_spec(self, arr, tp_spec: Optional[P] = None) -> P:
+        """Compute-parameter sharding: stage 3 shards (gather-on-use), lower
+        stages replicate (modulo TP)."""
+        if self.stage < 3:
+            return self._sanitize_tp(arr.shape, tp_spec) or P()
+        return self._zero_spec(arr.shape, tp_spec, int(self.config.param_persistence_threshold))
+
+    def master_spec(self, arr, tp_spec: Optional[P] = None) -> P:
+        """fp32 master param + optimizer state sharding: stages >= 1 shard."""
+        if self.stage < 1:
+            return self._sanitize_tp(arr.shape, tp_spec) or P()
+        return self._zero_spec(arr.shape, tp_spec, 0)
+
+    def grad_spec(self, arr, tp_spec: Optional[P] = None) -> P:
+        """Gradient (accumulation buffer) sharding: stages >= 2 shard, which
+        makes XLA lower the DP reduction as reduce-scatter."""
+        if self.stage < 2:
+            return self._sanitize_tp(arr.shape, tp_spec) or P()
+        return self._zero_spec(arr.shape, tp_spec, 0)
+
+    # -------------------- pytree-level API -------------------- #
+
+    def _tree_specs(self, tree, spec_fn, tp_specs=None) -> Any:
+        if tp_specs is None:
+            return jax.tree.map(lambda a: spec_fn(a, None), tree)
+        return jax.tree.map(spec_fn, tree, tp_specs)
+
+    def param_shardings(self, params, tp_specs=None):
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                            self._tree_specs(params, self.param_spec, tp_specs))
+
+    def master_shardings(self, params, tp_specs=None):
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                            self._tree_specs(params, self.master_spec, tp_specs))
+
+    def grad_shardings(self, params, tp_specs=None):
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                            self._tree_specs(params, self.grad_spec, tp_specs))
+
+    def opt_state_shardings(self, opt_state, params, tp_specs=None):
+        """Optimizer-state sharding: any state leaf with the same shape as a
+        parameter gets that parameter's master sharding; scalars replicate.
+
+        Works for optax-style states where moments mirror the param tree."""
+        master = self._tree_specs(params, self.master_spec, tp_specs)
+        flat_master = {a.shape: s for a, s in
+                       zip(jax.tree.leaves(params), jax.tree.leaves(master))}
+
+        def leaf_spec(leaf):
+            if hasattr(leaf, "shape") and leaf.shape in flat_master:
+                return NamedSharding(self.mesh, flat_master[leaf.shape])
+            return NamedSharding(self.mesh, P())
+
+        # moments are pytrees congruent with params: map param-wise when shapes match
+        def state_leaf(leaf):
+            return leaf_spec(leaf)
+
+        return jax.tree.map(state_leaf, opt_state)
+
+    def describe(self) -> str:
+        return (f"ZeRO stage {self.stage} over axes {self.axes} (size {self.axis_size}); "
+                f"params {'sharded' if self.stage >= 3 else 'replicated'}, "
+                f"grads {'sharded' if self.stage >= 2 else 'replicated'}, "
+                f"optimizer+master {'sharded' if self.stage >= 1 else 'replicated'}")

@@ -1,0 +1,58 @@
+"""Toy models for unit tests (mirrors reference tests/unit/simple_model.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel:
+    """Two-layer MLP regression model; loss = MSE.
+
+    Batch: dict(x=[B, dim], y=[B, dim]).
+    """
+
+    def __init__(self, hidden_dim: int = 16, nlayers: int = 2):
+        self.hidden_dim = hidden_dim
+        self.nlayers = nlayers
+
+    def init_params(self, rng):
+        keys = jax.random.split(rng, self.nlayers)
+        params = {}
+        for i, k in enumerate(keys):
+            params[f"layer_{i}"] = {
+                "w": jax.random.normal(k, (self.hidden_dim, self.hidden_dim), jnp.float32) * 0.1,
+                "b": jnp.zeros((self.hidden_dim,), jnp.float32),
+            }
+        return params
+
+    def forward(self, params, x):
+        h = x
+        for i in range(self.nlayers):
+            layer = params[f"layer_{i}"]
+            h = h @ layer["w"] + layer["b"]
+            if i < self.nlayers - 1:
+                h = jax.nn.relu(h)
+        return h
+
+    def loss(self, params, batch):
+        pred = self.forward(params, batch["x"])
+        return jnp.mean((pred - batch["y"])**2)
+
+
+def _w_true(hidden_dim: int):
+    # one fixed ground-truth mapping shared by every batch/seed
+    rng = np.random.default_rng(1234)
+    return rng.normal(size=(hidden_dim, hidden_dim)).astype(np.float32) * 0.3
+
+
+def random_dataset(n_samples: int, hidden_dim: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n_samples, hidden_dim)).astype(np.float32)
+    y = x @ _w_true(hidden_dim)
+    return [{"x": x[i], "y": y[i]} for i in range(n_samples)]
+
+
+def random_batch(batch_size: int, hidden_dim: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(batch_size, hidden_dim)).astype(np.float32)
+    return {"x": x, "y": x @ _w_true(hidden_dim)}

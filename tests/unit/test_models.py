@@ -1,0 +1,114 @@
+"""Model-zoo tests: forward shapes, loss behavior, TP specs, engine training
+on a tiny transformer (the analogue of the reference's simple_model +
+megatron_model fixtures)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.models import CausalLM, get_model
+from deepspeed_tpu.models.transformer import TransformerConfig
+
+
+def tiny_cfg(**over):
+    base = dict(vocab_size=97, n_layer=2, n_head=4, d_model=32, d_ff=64, max_seq=16, remat=False)
+    base.update(over)
+    return TransformerConfig(**base)
+
+
+@pytest.mark.parametrize("style", ["gpt2", "llama", "bloom", "neox"])
+def test_forward_shapes_all_styles(style):
+    overrides = {
+        "gpt2": dict(pos_embedding="learned", norm="layernorm", activation="gelu", tie_embeddings=True),
+        "llama": dict(pos_embedding="rope", norm="rmsnorm", activation="swiglu", tie_embeddings=False),
+        "bloom": dict(pos_embedding="alibi", norm="layernorm", activation="gelu", tie_embeddings=True),
+        "neox": dict(pos_embedding="rope", norm="layernorm", activation="gelu", parallel_residual=True,
+                     tie_embeddings=False),
+    }[style]
+    model = CausalLM(tiny_cfg(**overrides))
+    params = model.init_params(jax.random.key(0))
+    tokens = jnp.ones((2, 8), jnp.int32)
+    logits = model.forward(params, tokens)
+    assert logits.shape == (2, 8, 97)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_causal_masking():
+    """Changing a future token must not change past logits."""
+    model = CausalLM(tiny_cfg())
+    params = model.init_params(jax.random.key(0))
+    t1 = jnp.array([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    t2 = t1.at[0, 7].set(90)
+    l1 = model.forward(params, t1)
+    l2 = model.forward(params, t2)
+    np.testing.assert_allclose(np.asarray(l1[0, :7]), np.asarray(l2[0, :7]), atol=1e-5)
+
+
+def test_loss_ignore_index():
+    model = CausalLM(tiny_cfg())
+    params = model.init_params(jax.random.key(0))
+    tokens = jnp.ones((2, 8), jnp.int32)
+    labels = jnp.full((2, 8), -100, jnp.int32)
+    labels = labels.at[:, 0].set(3)
+    loss = model.loss(params, {"input_ids": tokens, "labels": labels})
+    assert bool(jnp.isfinite(loss))
+
+
+def test_gqa_heads():
+    model = CausalLM(tiny_cfg(n_kv_head=2))
+    params = model.init_params(jax.random.key(0))
+    assert params["layers"]["attn"]["wk"].shape == (2, 32, 2 * 8)
+    logits = model.forward(params, jnp.ones((1, 8), jnp.int32))
+    assert logits.shape == (1, 8, 97)
+
+
+def test_scan_matches_unrolled():
+    cfg_s = tiny_cfg(scan_layers=True)
+    cfg_u = tiny_cfg(scan_layers=False)
+    model_s, model_u = CausalLM(cfg_s), CausalLM(cfg_u)
+    params = model_s.init_params(jax.random.key(0))
+    tokens = jnp.arange(8, dtype=jnp.int32)[None, :]
+    np.testing.assert_allclose(np.asarray(model_s.forward(params, tokens)),
+                               np.asarray(model_u.forward(params, tokens)), atol=1e-5)
+
+
+def test_tiny_transformer_trains_zero3_tp(mesh_2d):
+    """End-to-end: tiny LLaMA-style model, ZeRO-3 + TP on the 4x2 mesh."""
+    dist.set_mesh(None)
+    model = CausalLM(tiny_cfg(pos_embedding="rope", norm="rmsnorm", activation="swiglu", tie_embeddings=False))
+    params = model.init_params(jax.random.key(0))
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 0},
+        "mesh": {"dp": 4, "tp": 2},
+        "steps_per_print": 0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=cfg)
+    rng = np.random.default_rng(0)
+    # fixed tiny corpus -> loss must fall
+    data = rng.integers(0, 97, size=(8, 16)).astype(np.int32)
+    losses = []
+    for i in range(25):
+        losses.append(float(engine.train_batch({"input_ids": data})))
+    assert losses[-1] < losses[0] * 0.7, f"{losses[0]} -> {losses[-1]}"
+    # TP actually sharded the mlp: check a weight's sharding mentions tp
+    spec = engine.state.params["layers"]["mlp"]["w_up"].sharding.spec
+    assert "tp" in str(spec)
+
+
+def test_presets_construct():
+    for fam, size in (("gpt2", "125m"), ("llama", "tiny"), ("opt", "125m"), ("gpt_neox", "tiny")):
+        m = get_model(fam, size)
+        assert m.num_parameters > 0
+
+
+def test_num_parameters_exact():
+    model = CausalLM(tiny_cfg())
+    params = model.init_params(jax.random.key(0))
+    actual = sum(x.size for x in jax.tree.leaves(params))
+    assert model.num_parameters == actual
