@@ -44,15 +44,20 @@ def initialize(args=None,
     if model is None:
         raise ValueError("deepspeed_tpu.initialize: model is required")
 
-    engine = DeepSpeedEngine(model=model,
-                             config=config,
-                             model_parameters=model_parameters,
-                             optimizer=optimizer,
-                             lr_scheduler=lr_scheduler,
-                             mesh=mesh,
-                             mpu=mpu,
-                             training_data=training_data,
-                             collate_fn=collate_fn)
+    engine_cls = DeepSpeedEngine
+    if hasattr(model, "pipeline_spec"):
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+        engine_cls = PipelineEngine
+
+    engine = engine_cls(model=model,
+                        config=config,
+                        model_parameters=model_parameters,
+                        optimizer=optimizer,
+                        lr_scheduler=lr_scheduler,
+                        mesh=mesh,
+                        mpu=mpu,
+                        training_data=training_data,
+                        collate_fn=collate_fn)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 

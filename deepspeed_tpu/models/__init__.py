@@ -9,10 +9,11 @@ Every model is a thin preset over ``deepspeed_tpu.models.transformer``:
 """
 
 from deepspeed_tpu.models.causal_lm import CausalLM
+from deepspeed_tpu.models.pipeline import PipelinedCausalLM
 from deepspeed_tpu.models.presets import (MODEL_PRESETS, bloom, get_model, gpt2, gpt2_large,
                                           gpt2_medium, gpt2_xl, gpt_neox, llama_7b, opt)
 
 __all__ = [
-    "CausalLM", "MODEL_PRESETS", "get_model", "gpt2", "gpt2_medium", "gpt2_large", "gpt2_xl", "llama_7b",
-    "bloom", "opt", "gpt_neox",
+    "CausalLM", "PipelinedCausalLM", "MODEL_PRESETS", "get_model", "gpt2", "gpt2_medium", "gpt2_large",
+    "gpt2_xl", "llama_7b", "bloom", "opt", "gpt_neox",
 ]

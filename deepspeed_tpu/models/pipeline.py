@@ -1,0 +1,132 @@
+"""Pipeline-stage organisation of the causal-LM backbone.
+
+This is the homogeneous-stage model consumed by
+``deepspeed_tpu.runtime.pipe.engine.PipelineEngine``: the transformer's
+``n_layer`` blocks are grouped into ``num_stages`` stages whose parameters
+are stacked on a leading stage axis (sharded over the ``pp`` mesh axis).
+Equivalent reference pattern: building a GPT with ``PipelineModule`` +
+per-layer ``LayerSpec``s (``deepspeed/runtime/pipe/module.py:82``), with the
+embedding optionally tied to the LM head (``TiedLayerSpec``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models import transformer as T
+from deepspeed_tpu.models.causal_lm import CausalLM
+
+
+class PipelinedCausalLM(CausalLM):
+    """Causal LM with parameters organised as {"embed", "stages", "head"}.
+
+    ``stages`` leaves have shape ``[num_stages, layers_per_stage, ...]``;
+    ``n_layer`` must divide evenly. Attention masks travel with activations
+    through the pipeline (``carry_keys``); labels are consumed on the last
+    stage only.
+    """
+
+    def __init__(self, config: T.TransformerConfig, num_stages: int, param_dtype=jnp.float32):
+        super().__init__(config, param_dtype)
+        if config.n_layer % num_stages != 0:
+            raise ValueError(f"n_layer {config.n_layer} not divisible by num_stages {num_stages}")
+        self.num_stages = num_stages
+        self.layers_per_stage = config.n_layer // num_stages
+
+    # -------------------- params -------------------- #
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        p = T.init_params(self.config, rng, dtype=self.param_dtype)
+        S, Lps = self.num_stages, self.layers_per_stage
+        stages = jax.tree.map(lambda a: a.reshape((S, Lps) + a.shape[1:]), p["layers"])
+        head = {"ln_f": p["ln_f"]}
+        if not self.config.tie_embeddings:
+            head["lm_head"] = p["lm_head"]
+        return {"embed": p["embed"], "stages": stages, "head": head}
+
+    def tp_specs(self) -> Dict[str, Any]:
+        t = T.tp_specs(self.config)
+        stages = jax.tree.map(lambda s: P(*(("pp",) + tuple(s))), t["layers"],
+                              is_leaf=lambda x: isinstance(x, P))
+        head = {"ln_f": t["ln_f"]}
+        if not self.config.tie_embeddings:
+            head["lm_head"] = t["lm_head"]
+        return {"embed": t["embed"], "stages": stages, "head": head}
+
+    # -------------------- pipeline stage functions -------------------- #
+
+    def _embed(self, params, mb, rng):
+        cfg = self.config
+        tokens = mb["input_ids"]
+        B, S = tokens.shape
+        x = params["embed"]["tokens"][tokens]
+        if cfg.pos_embedding == "learned":
+            x = x + params["embed"]["positions"][:S][None, :, :]
+        return x
+
+    def _stage(self, stage_params, x, aux, rng):
+        """One pipeline stage: scan over its layers_per_stage blocks."""
+        cfg = self.config
+        B, S, D = x.shape
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+        mask_bias = None
+        if "attention_mask" in aux:
+            mask_bias = jnp.where(aux["attention_mask"][:, None, None, :] > 0, 0.0, -1e9).astype(jnp.float32)
+
+        def run_block(h, lp):
+            return T.block(cfg, h, lp, positions, mask_bias), None
+
+        if cfg.remat:
+            run_block = jax.checkpoint(run_block, prevent_cse=False)
+        x, _ = jax.lax.scan(run_block, x, stage_params)
+        return x
+
+    def _head_loss(self, params, x, mb, rng, ignore_index: int = -100):
+        cfg = self.config
+        x = T._norm(cfg, x, params["head"]["ln_f"])
+        if cfg.tie_embeddings:
+            logits = x @ params["embed"]["tokens"].T
+        else:
+            logits = x @ params["head"]["lm_head"]
+        logits = logits.astype(jnp.float32)
+        tokens = mb["input_ids"]
+        labels = mb.get("labels")
+        if labels is None:
+            labels = jnp.concatenate([tokens[:, 1:], jnp.full_like(tokens[:, :1], ignore_index)], axis=1)
+        valid = labels != ignore_index
+        safe = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+    def pipeline_spec(self) -> Dict[str, Any]:
+        return {
+            "embed_fn": self._embed,
+            "stage_fn": self._stage,
+            "head_loss_fn": self._head_loss,
+            "num_stages": self.num_stages,
+            "carry_keys": ("attention_mask",),
+        }
+
+    # -------------------- sequential path (eval / pp=1) -------------------- #
+
+    def loss(self, params, batch):
+        """Non-pipelined loss with identical math — used for eval_batch and
+        correctness tests against the pipelined path."""
+        aux = {k: batch[k] for k in ("attention_mask",) if k in batch}
+        x = self._embed(params, batch, None)
+        Lps = self.layers_per_stage
+        flat = jax.tree.map(lambda a: a.reshape((self.num_stages * Lps,) + a.shape[2:]),
+                            params["stages"])
+        for s in range(self.num_stages):
+            sp = jax.tree.map(lambda a: a[s * Lps:(s + 1) * Lps], flat)
+            x = self._stage(sp, x, aux, None)
+        return self._head_loss(params, x, batch, None)
+
+    def forward(self, params, tokens, attn_mask=None):
+        raise NotImplementedError("PipelinedCausalLM exposes loss()/pipeline_spec(); "
+                                  "use CausalLM for logits-level forward")

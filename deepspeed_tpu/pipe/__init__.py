@@ -1,0 +1,10 @@
+"""Pipeline-parallelism API re-exports (reference ``deepspeed/pipe/__init__.py``)."""
+
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
+from deepspeed_tpu.runtime.pipe.engine import PipelineEngine, spmd_pipeline_loss
+from deepspeed_tpu.runtime.pipe.topology import (PipeDataParallelTopology, PipelineParallelGrid,
+                                                 PipeModelDataParallelTopology, ProcessTopology)
+
+__all__ = ["LayerSpec", "TiedLayerSpec", "PipelineModule", "PipelineEngine", "spmd_pipeline_loss",
+           "ProcessTopology", "PipeDataParallelTopology", "PipeModelDataParallelTopology",
+           "PipelineParallelGrid"]

@@ -51,6 +51,7 @@ from deepspeed_tpu.runtime import lr_schedules
 from deepspeed_tpu.runtime.loss_scaler import LossScaleState, has_overflow, make_loss_scale_state
 from deepspeed_tpu.runtime.loss_scaler import update as scaler_update
 from deepspeed_tpu.runtime.optimizers import build_optimizer
+from deepspeed_tpu.runtime.utils import clip_grad_norm_, global_norm
 from deepspeed_tpu.runtime.zero.partition import ZeroShardingRules
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import NoopTimer, SynchronizedWallClockTimer, ThroughputTimer
@@ -297,9 +298,7 @@ class DeepSpeedEngine:
 
         clip = float(self.gradient_clipping() or 0.0)
         if clip > 0.0:
-            gnorm = optax_global_norm(grads)
-            coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-            grads = jax.tree.map(lambda g: g * coef, grads)
+            grads, _ = clip_grad_norm_(grads, clip)
 
         lr = self._lr_fn(state.global_steps)
         opt_target = state.master if state.master is not None else state.params
@@ -525,7 +524,7 @@ class DeepSpeedEngine:
 
     def get_global_grad_norm(self) -> float:
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), self.state.acc_grads)
-        return float(optax_global_norm(grads))
+        return float(global_norm(grads))
 
     @property
     def loss_scale(self) -> float:
@@ -579,10 +578,3 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime.checkpoint_engine.engine import load_engine_checkpoint
         return load_engine_checkpoint(self, load_dir, tag=tag, load_optimizer_states=load_optimizer_states,
                                       load_module_only=load_module_only)
-
-
-def optax_global_norm(tree) -> jnp.ndarray:
-    leaves = jax.tree.leaves(tree)
-    if not leaves:
-        return jnp.asarray(0.0)
-    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))

@@ -1,0 +1,6 @@
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
+from deepspeed_tpu.runtime.pipe.topology import (PipeDataParallelTopology, PipelineParallelGrid,
+                                                 PipeModelDataParallelTopology, ProcessTopology)
+
+__all__ = ["LayerSpec", "TiedLayerSpec", "PipelineModule", "ProcessTopology",
+           "PipeDataParallelTopology", "PipeModelDataParallelTopology", "PipelineParallelGrid"]

@@ -1,0 +1,315 @@
+"""Pipeline module: layer specs, stage partitioning, tied layers.
+
+Reference parity: ``deepspeed/runtime/pipe/module.py`` — ``LayerSpec`` (:26),
+``TiedLayerSpec`` (:73), ``PipelineModule`` (:82) with layer partitioning by
+``parameters | uniform | type:regex`` (:350) and per-layer checkpoint files
+(:544-603).
+
+TPU-native design: a "layer" is a pure function plus its parameter pytree —
+``init(rng) -> params`` and ``apply(params, x) -> x`` — instead of an
+``nn.Module``. The module supports two execution paths:
+
+- **sequential** (always available): compose the stage's layers in order;
+  with pp=1 this is the whole model. Used for heterogeneous stages and eval.
+- **SPMD pipelined** (``engine.py``): when the model exposes homogeneous
+  stages, the engine lowers the schedule into a single compiled program over
+  the ``pp`` mesh axis. The partitioning below decides which layers form a
+  stage in both paths.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import pickle
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.utils import partition_balanced, partition_uniform
+from deepspeed_tpu.utils.logging import logger
+
+
+class LayerSpec:
+    """Delayed layer construction (reference module.py:26): stores the builder
+    and arguments; ``build()`` instantiates. The built object must be either a
+    plain callable ``fn(x)`` (stateless) or expose ``init(rng) -> params`` and
+    ``apply(params, x)`` / be callable as ``layer(params, x)``."""
+
+    def __init__(self, typename: Callable, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        name = getattr(self.typename, "__name__", str(self.typename))
+        return f"LayerSpec({name})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """A layer whose parameters are shared with every other tied layer of the
+    same ``key`` (reference module.py:73). The first tied occurrence owns the
+    parameters; later ones reference them. ``forward_fn`` optionally overrides
+    how the tied layer is applied (e.g. embedding reused as the LM head)."""
+
+    def __init__(self, key: str, typename: Callable, *module_args,
+                 forward_fn: Optional[Callable] = None, tied_weight_attr: str = "weight",
+                 **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class _FnLayer:
+    """Adapter wrapping a parameterless callable into the layer protocol."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def init(self, rng):
+        return None
+
+    def __call__(self, params, x):
+        return self.fn(x)
+
+
+def _as_layer(obj):
+    if hasattr(obj, "init") and (hasattr(obj, "apply") or callable(obj)):
+        return obj
+    if callable(obj):
+        return _FnLayer(obj)
+    raise TypeError(f"layer {obj!r} is neither a layer object nor a callable")
+
+
+def _apply_layer(layer, params, x):
+    if hasattr(layer, "apply"):
+        return layer.apply(params, x)
+    return layer(params, x)
+
+
+class PipelineModule:
+    """Sequence of layers partitioned into pipeline stages.
+
+    Args mirror the reference: ``layers`` (specs/callables), ``num_stages``
+    or ``topology``, ``loss_fn`` applied to (output, labels),
+    ``partition_method`` in {"parameters", "uniform", "type:REGEX"},
+    ``activation_checkpoint_interval`` (remat every N layers).
+    """
+
+    def __init__(self,
+                 layers: Sequence,
+                 num_stages: Optional[int] = None,
+                 topology=None,
+                 loss_fn: Optional[Callable] = None,
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0,
+                 seed_layers: bool = False,
+                 base_seed: int = 1234):
+        if num_stages is None and topology is None:
+            num_stages = 1
+        if topology is not None and num_stages is None:
+            num_stages = topology.get_dim("pipe")
+        self.num_stages = int(num_stages)
+        self.topology = topology
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+
+        self._specs = list(layers)
+        self._layers = []
+        self._tied_keys: List[Optional[str]] = []
+        self._tied_fwd: Dict[int, Callable] = {}
+        for i, spec in enumerate(self._specs):
+            if isinstance(spec, TiedLayerSpec):
+                self._layers.append(_as_layer(spec.build()))
+                self._tied_keys.append(spec.key)
+                if spec.forward_fn is not None:
+                    self._tied_fwd[i] = spec.forward_fn
+            elif isinstance(spec, LayerSpec):
+                self._layers.append(_as_layer(spec.build()))
+                self._tied_keys.append(None)
+            else:
+                self._layers.append(_as_layer(spec))
+                self._tied_keys.append(None)
+
+        self.parts = self._partition_layers()
+        logger.info(f"PipelineModule: {len(self._layers)} layers -> {self.num_stages} stages, "
+                    f"bounds {self.parts} (method={partition_method})")
+
+    # ------------------------------------------------------------- #
+    # partitioning
+
+    def _layer_param_counts(self) -> List[int]:
+        counts = []
+        rng = jax.random.key(0)
+        for layer in self._layers:
+            try:
+                shapes = jax.eval_shape(lambda: layer.init(rng))
+            except Exception:
+                shapes = None
+            n = 0
+            if shapes is not None:
+                for leaf in jax.tree.leaves(shapes):
+                    if hasattr(leaf, "shape"):
+                        n += int(math.prod(leaf.shape))
+            counts.append(n)
+        return counts
+
+    def _partition_layers(self) -> List[int]:
+        method = self.partition_method.lower()
+        n = len(self._layers)
+        if self.num_stages > n:
+            raise ValueError(f"num_stages {self.num_stages} > num layers {n}")
+        if method == "uniform":
+            return partition_uniform(n, self.num_stages)
+        if method in ("parameters", "params"):
+            weights = [max(c, 1) for c in self._layer_param_counts()]
+            return partition_balanced(weights, self.num_stages)
+        if method.startswith("type:"):
+            pattern = method.split(":", 1)[1]
+            weights = [1 if re.search(pattern, type(l).__name__, re.IGNORECASE) else 0
+                       for l in self._layers]
+            if sum(weights) == 0:
+                raise ValueError(f"partition type:{pattern} matched no layers")
+            return partition_balanced([max(w, 0) or 0 for w in weights], self.num_stages)
+        raise NotImplementedError(f"partition_method {self.partition_method}")
+
+    def stage_of_layer(self, layer_idx: int) -> int:
+        for s in range(self.num_stages):
+            if self.parts[s] <= layer_idx < self.parts[s + 1]:
+                return s
+        raise IndexError(layer_idx)
+
+    def stage_layers(self, stage_id: int) -> List[int]:
+        return list(range(self.parts[stage_id], self.parts[stage_id + 1]))
+
+    # ------------------------------------------------------------- #
+    # params
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        """Per-layer parameter list; tied layers share one entry under
+        ``tied[key]`` (first occurrence initializes)."""
+        layer_params: List[Any] = []
+        tied: Dict[str, Any] = {}
+        for i, layer in enumerate(self._layers):
+            key = self._tied_keys[i]
+            lrng = jax.random.fold_in(rng, (self.base_seed if self.seed_layers else 0) + i)
+            if key is not None:
+                if key not in tied:
+                    tied[key] = layer.init(lrng)
+                layer_params.append(None)
+            else:
+                layer_params.append(layer.init(lrng))
+        return {"layers": layer_params, "tied": tied}
+
+    def _layer_apply(self, i: int, params: Dict[str, Any], x):
+        layer = self._layers[i]
+        key = self._tied_keys[i]
+        if key is not None:
+            p = params["tied"][key]
+            fwd = self._tied_fwd.get(i)
+            if fwd is not None:
+                return fwd(p, x)
+            return _apply_layer(layer, p, x)
+        return _apply_layer(layer, params["layers"][i], x)
+
+    # ------------------------------------------------------------- #
+    # execution (sequential; the SPMD path lives in engine.py)
+
+    def forward(self, params, x, start: Optional[int] = None, stop: Optional[int] = None):
+        start = 0 if start is None else start
+        stop = len(self._layers) if stop is None else stop
+        interval = self.activation_checkpoint_interval
+        i = start
+        while i < stop:
+            j = min(i + interval, stop) if interval > 0 else i + 1
+
+            def chunk(h, lo=i, hi=j):
+                for k in range(lo, hi):
+                    h = self._layer_apply(k, params, h)
+                return h
+
+            if interval > 0:
+                x = jax.checkpoint(chunk, prevent_cse=False)(x)
+            else:
+                x = chunk(x)
+            i = j
+        return x
+
+    def stage_forward(self, params, x, stage_id: int):
+        return self.forward(params, x, self.parts[stage_id], self.parts[stage_id + 1])
+
+    def __call__(self, params, x):
+        return self.forward(params, x)
+
+    def loss(self, params, batch):
+        """Engine-compatible loss: batch is (inputs, labels) or a dict with
+        'inputs'/'labels'."""
+        if isinstance(batch, dict):
+            inputs, labels = batch["inputs"], batch.get("labels")
+        else:
+            inputs, labels = batch
+        out = self.forward(params, inputs)
+        if self.loss_fn is None:
+            return jnp.mean(out)
+        return self.loss_fn(out, labels)
+
+    # ------------------------------------------------------------- #
+    # tied-grad bookkeeping (reference module.py:403-474): with a single
+    # params dict the tied weight exists once, so gradient sharing is
+    # automatic under jax.grad; this helper lists tied groups for parity.
+
+    def tied_comms(self) -> Dict[str, List[int]]:
+        groups: Dict[str, List[int]] = {}
+        for i, key in enumerate(self._tied_keys):
+            if key is not None:
+                groups.setdefault(key, []).append(i)
+        return groups
+
+    # ------------------------------------------------------------- #
+    # per-layer checkpoint files (reference module.py:544-603)
+
+    def ckpt_layer_path(self, ckpt_dir: str, local_layer_idx: int) -> str:
+        return os.path.join(ckpt_dir, f"layer_{local_layer_idx:02d}-model_states.pkl")
+
+    def save_state_dict(self, params, save_dir: str, stage_id: Optional[int] = None) -> None:
+        os.makedirs(save_dir, exist_ok=True)
+        layers = (self.stage_layers(stage_id) if stage_id is not None
+                  else range(len(self._layers)))
+        for i in layers:
+            entry = {"params": jax.tree.map(lambda a: jax.device_get(a), params["layers"][i]),
+                     "tied_key": self._tied_keys[i]}
+            with open(self.ckpt_layer_path(save_dir, i), "wb") as f:
+                pickle.dump(entry, f)
+        tied_path = os.path.join(save_dir, "tied-model_states.pkl")
+        with open(tied_path, "wb") as f:
+            pickle.dump(jax.tree.map(lambda a: jax.device_get(a), params["tied"]), f)
+
+    def load_state_dir(self, load_dir: str, params=None) -> Dict[str, Any]:
+        layer_params: List[Any] = []
+        for i in range(len(self._layers)):
+            path = self.ckpt_layer_path(load_dir, i)
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    entry = pickle.load(f)
+                layer_params.append(jax.tree.map(jnp.asarray, entry["params"]))
+            else:
+                layer_params.append(None if params is None else params["layers"][i])
+        tied_path = os.path.join(load_dir, "tied-model_states.pkl")
+        tied = {}
+        if os.path.exists(tied_path):
+            with open(tied_path, "rb") as f:
+                tied = jax.tree.map(jnp.asarray, pickle.load(f))
+        return {"layers": layer_params, "tied": tied}
+
+    @property
+    def num_layers(self) -> int:
+        return len(self._layers)

@@ -1,0 +1,42 @@
+"""Point-to-point helpers for pipeline stages.
+
+Reference parity: ``deepspeed/runtime/pipe/p2p.py`` — ``send``/``recv``
+between adjacent stages with shape/meta exchange.
+
+On TPU, inter-stage transfer inside the compiled pipeline is a
+CollectivePermute emitted by XLA for the stage-axis rotation
+(``engine.spmd_pipeline_loss``); shapes are static under jit so the
+reference's runtime meta exchange (``pipe/engine.py:786-903``) has no
+analogue. These eager helpers exist for the interpretive executor and tests.
+"""
+
+from __future__ import annotations
+
+import deepspeed_tpu.comm as dist
+
+_grid = None
+
+
+def init_process_groups(grid) -> None:
+    global _grid
+    _grid = grid
+
+
+def can_send_recv() -> bool:
+    return _grid is not None and _grid.pipe_parallel_size > 1
+
+
+def send_to_next(tensor, axis: str = "pp"):
+    """Rotate ``tensor`` one step forward along the pipeline axis."""
+    return dist.ring_send_recv(tensor, shift=1, group=axis)
+
+
+def recv_from_prev(tensor, axis: str = "pp"):
+    """Alias of :func:`send_to_next` — a ring shift delivers the previous
+    stage's tensor to this stage."""
+    return dist.ring_send_recv(tensor, shift=1, group=axis)
+
+
+def send_grads_to_prev(tensor, axis: str = "pp"):
+    """Rotate gradients one step backward along the pipeline axis."""
+    return dist.ring_send_recv(tensor, shift=-1, group=axis)

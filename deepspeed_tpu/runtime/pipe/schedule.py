@@ -1,0 +1,225 @@
+"""Pipeline instruction schedules.
+
+Reference parity: ``deepspeed/runtime/pipe/schedule.py`` — ``PipeSchedule``
+ABC yielding per-step instruction lists, ``TrainSchedule`` (1F1B),
+``InferenceSchedule``, ``DataParallelSchedule``, and the instruction
+dataclasses.
+
+Role in the TPU build: the compiled SPMD pipeline (``engine.py``) lowers the
+whole schedule into one XLA program (a ``lax.scan`` over pipeline clock
+ticks), so these instruction streams are not dispatched op-by-op on the hot
+path. They remain the source of truth for (a) the interpretive executor used
+by heterogeneous-stage models, (b) schedule analysis/tests (buffer counts,
+send/recv pairing), and (c) parity with the reference API.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+
+class PipeInstruction:
+    """Base instruction. Carries arbitrary kwargs as attributes."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{self.name}({args})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.kwargs == other.kwargs
+
+
+class OptimizerStep(PipeInstruction):
+    """Apply the optimizer update (all stages, at batch end)."""
+
+
+class ReduceGrads(PipeInstruction):
+    """Data-parallel gradient reduction."""
+
+
+class ReduceTiedGrads(PipeInstruction):
+    """All-reduce grads of tied layers over their replica group."""
+
+
+class BufferOpInstruction(PipeInstruction):
+    """Instruction operating on a pipeline activation buffer ``buffer_id``."""
+
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    """Load micro-batch ``micro_batch_id`` into ``buffer_id`` (first/last stage)."""
+
+
+class ForwardPass(BufferOpInstruction):
+    """Run the stage forward on buffer ``buffer_id``."""
+
+
+class BackwardPass(BufferOpInstruction):
+    """Run the stage backward for buffer ``buffer_id``."""
+
+
+class SendActivation(BufferOpInstruction):
+    """Send activations in ``buffer_id`` to the next stage."""
+
+
+class RecvActivation(BufferOpInstruction):
+    """Receive activations into ``buffer_id`` from the previous stage."""
+
+
+class SendGrad(BufferOpInstruction):
+    """Send input-activation grads for ``buffer_id`` to the previous stage."""
+
+
+class RecvGrad(BufferOpInstruction):
+    """Receive output grads into ``buffer_id`` from the next stage."""
+
+
+class PipeSchedule:
+    """Iterable of per-step instruction lists for one stage of one batch.
+
+    Subclasses implement ``steps()``. ``micro_batches`` is the number of
+    micro-batches in the batch; ``stages`` the pipeline depth; ``stage_id``
+    this stage's index.
+    """
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        if not 0 <= stage_id < stages:
+            raise ValueError(f"stage_id {stage_id} out of range for {stages} stages")
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def num_pipe_buffers(self) -> int:
+        """Number of activation buffers this stage needs."""
+        raise NotImplementedError
+
+    @property
+    def stage(self) -> int:
+        return self.stage_id
+
+    @property
+    def num_stages(self) -> int:
+        return self.stages
+
+    @property
+    def num_micro_batches(self) -> int:
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only pipelined inference: stages stream micro-batches with a
+    two-buffer rotation (reference schedule.py:132)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+    def steps(self):
+        total = self.micro_batches + self.stages - 1
+        for tick in range(total):
+            cmds: List[PipeInstruction] = []
+            mb = tick - self.stage_id  # micro-batch this stage handles at this tick
+            if 0 <= mb < self.micro_batches:
+                buf = self._buffer_idx(mb)
+                if self.is_first_stage or self.is_last_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=buf, micro_batch_id=mb))
+                if not self.is_first_stage:
+                    cmds.append(RecvActivation(buffer_id=buf))
+                cmds.append(ForwardPass(buffer_id=buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=buf))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B schedule (reference schedule.py:186): each stage runs
+    ``stages - stage_id - 1`` warmup forwards, then alternates one-forward/
+    one-backward in steady state, then drains remaining backwards. Peak live
+    activations per stage = warmup + 1, which is what bounds pipeline memory.
+    """
+
+    def num_pipe_buffers(self) -> int:
+        # in-flight forwards never exceed (stages - stage_id), capped by M
+        return max(1, min(self.stages - self.stage_id, self.micro_batches))
+
+    def _phase_sequence(self) -> List[tuple]:
+        """[('F', mb) | ('B', mb)] in execution order for this stage."""
+        M = self.micro_batches
+        warmup = min(self.stages - self.stage_id - 1, M)
+        seq: List[tuple] = [("F", i) for i in range(warmup)]
+        next_f, next_b = warmup, 0
+        # steady state: 1F1B
+        while next_f < M:
+            seq.append(("F", next_f))
+            next_f += 1
+            seq.append(("B", next_b))
+            next_b += 1
+        # drain
+        while next_b < M:
+            seq.append(("B", next_b))
+            next_b += 1
+        return seq
+
+    def steps(self):
+        for kind, mb in self._phase_sequence():
+            buf = self._buffer_idx(mb)
+            cmds: List[PipeInstruction] = []
+            if kind == "F":
+                if not self.is_first_stage:
+                    cmds.append(RecvActivation(buffer_id=buf))
+                if self.is_first_stage or self.is_last_stage:
+                    # inputs on the first stage, labels on the last — one load each
+                    cmds.append(LoadMicroBatch(buffer_id=buf, micro_batch_id=mb))
+                cmds.append(ForwardPass(buffer_id=buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=buf))
+            else:
+                if not self.is_last_stage:
+                    cmds.append(RecvGrad(buffer_id=buf))
+                cmds.append(BackwardPass(buffer_id=buf))
+                if not self.is_first_stage:
+                    cmds.append(SendGrad(buffer_id=buf))
+            yield cmds
+        # batch epilogue: reductions + optimizer step
+        yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule: forward/backward every micro-batch,
+    reduce + step at the end (reference schedule.py:298)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 1
+
+    def steps(self):
+        for mb in range(self.micro_batches):
+            yield [LoadMicroBatch(buffer_id=0, micro_batch_id=mb),
+                   ForwardPass(buffer_id=0),
+                   BackwardPass(buffer_id=0)]
+        yield [ReduceGrads(), OptimizerStep()]

@@ -1,0 +1,156 @@
+"""Runtime utilities: balanced partitioning, grad norms, memory reporting.
+
+Reference parity: ``deepspeed/runtime/utils.py`` — notably the balanced
+layer-partition algorithm (``partition_balanced`` / ``partition_uniform``,
+reference :535-614) used by pipeline-module layer placement, the MP-aware
+``clip_grad_norm_`` (:304), and ``see_memory_usage`` (:768).
+"""
+
+from __future__ import annotations
+
+import gc
+import math
+from bisect import bisect_left
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def prefix_sum_inc(weights: Sequence[float]) -> List[float]:
+    """Inclusive prefix sum."""
+    out = []
+    total = 0.0
+    for w in weights:
+        total += w
+        out.append(total)
+    return out
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Split ``num_items`` into ``num_parts`` contiguous chunks of near-equal
+    length. Returns ``num_parts + 1`` boundaries."""
+    parts = [0] * (num_parts + 1)
+    chunk, residual = divmod(num_items, num_parts)
+    for p in range(num_parts):
+        parts[p + 1] = parts[p] + chunk + (1 if p < residual else 0)
+    return parts
+
+
+def _feasible(weights_psum: List[float], num_parts: int, bottleneck: float) -> bool:
+    """Greedy check: can we split into <= num_parts contiguous parts, each with
+    weight <= bottleneck?"""
+    parts = 0
+    start_weight = 0.0
+    n = len(weights_psum)
+    i = 0
+    while i < n:
+        # furthest j with psum[j] - start_weight <= bottleneck
+        limit = start_weight + bottleneck
+        j = bisect_left(weights_psum, limit, lo=i)
+        if j < n and weights_psum[j] == limit:
+            j += 1
+        if j == i:  # single item exceeds bottleneck
+            return False
+        parts += 1
+        if parts > num_parts:
+            return False
+        start_weight = weights_psum[j - 1]
+        i = j
+    return True
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Contiguous partition of ``weights`` into ``num_parts`` parts minimizing
+    the maximum part weight (binary search over the bottleneck + greedy
+    packing). Returns ``num_parts + 1`` boundary indices.
+
+    Reference behavior: ``deepspeed/runtime/utils.py:535`` (``partition_balanced``);
+    algorithm re-derived, not ported.
+    """
+    n = len(weights)
+    if num_parts <= 0:
+        raise ValueError("num_parts must be positive")
+    if n == 0:
+        return [0] * (num_parts + 1)
+    if num_parts >= n:
+        # one item per part, trailing empty parts collapse to n
+        return list(range(n + 1)) + [n] * (num_parts - n)
+
+    psum = prefix_sum_inc([float(w) for w in weights])
+    lo = max(float(w) for w in weights)
+    hi = psum[-1]
+    # binary search on the real-valued bottleneck to tolerance, then pack
+    for _ in range(64):
+        mid = (lo + hi) / 2
+        if _feasible(psum, num_parts, mid):
+            hi = mid
+        else:
+            lo = mid
+    bottleneck = hi * (1 + 1e-9)
+
+    # greedy pack at the found bottleneck, but never leave fewer items than
+    # remaining parts (each later part can take at least one item)
+    parts = [0]
+    start_weight = 0.0
+    for p in range(num_parts - 1):
+        limit = start_weight + bottleneck
+        j = bisect_left(psum, limit, lo=parts[-1])
+        if j < n and psum[j] <= limit:
+            j += 1
+        j = max(j, parts[-1] + 1)            # at least one item
+        j = min(j, n - (num_parts - 1 - p))  # leave >=1 item per later part
+        parts.append(j)
+        start_weight = psum[j - 1]
+    parts.append(n)
+    return parts
+
+
+def global_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))
+
+
+def clip_grad_norm_(grads, max_norm: float, norm=None):
+    """Clip a grad pytree to global norm ``max_norm``; returns (clipped, norm).
+
+    Under pjit the norm is already global (XLA inserts the cross-replica
+    reduction for sharded grads) — the reference's explicit MP-group allreduce
+    (``runtime/utils.py:304``) is unnecessary.
+    """
+    norm = global_norm(grads) if norm is None else norm
+    coef = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: g * coef, grads), norm
+
+
+def see_memory_usage(message: str, force: bool = False) -> None:
+    """Log device + host memory usage (reference ``runtime/utils.py:768``)."""
+    if not force:
+        return
+    lines = [message]
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            used = stats.get("bytes_in_use", 0) / 2**30
+            peak = stats.get("peak_bytes_in_use", 0) / 2**30
+            limit = stats.get("bytes_limit", 0) / 2**30
+            lines.append(f"  {d}: in_use {used:.2f}GB peak {peak:.2f}GB limit {limit:.2f}GB")
+    try:
+        import psutil
+        vm = psutil.virtual_memory()
+        lines.append(f"  host: used {vm.used / 2**30:.2f}GB ({vm.percent}%)")
+    except Exception:
+        pass
+    logger.info("\n".join(lines))
+    gc.collect()
+
+
+def num_params(tree) -> int:
+    return sum(int(math.prod(x.shape)) if hasattr(x, "shape") else 0 for x in jax.tree.leaves(tree))
