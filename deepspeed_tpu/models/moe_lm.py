@@ -1,0 +1,172 @@
+"""MoE causal LM: transformer backbone with mixture-of-experts MLPs.
+
+The model-zoo analogue of DeepSpeed-MoE models (reference ``deepspeed/moe/``
+integrated into Megatron-style GPT). Every ``moe_freq``-th block replaces its
+dense MLP with an expert-parallel MoE; the load-balancing aux loss is
+accumulated across layers and added to the LM loss.
+
+Layers are stacked and scanned like the dense backbone; expert weights carry
+dims ``[n_moe_layers, num_experts, ...]`` sharded ``P(None, "ep", ...)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models import transformer as T
+from deepspeed_tpu.moe.sharded_moe import dispatch_combine, top1gating, top2gating
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    k: int = 1
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    aux_loss_coef: float = 0.01
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+    expert_ff_mult: int = 4
+
+
+class MoECausalLM:
+    """Causal LM where every block's MLP is an MoE layer."""
+
+    def __init__(self, config: T.TransformerConfig, moe_config: MoEConfig = MoEConfig(),
+                 param_dtype=jnp.float32, mesh=None):
+        self.config = config
+        self.moe = moe_config
+        self.param_dtype = param_dtype
+        self.mesh = mesh
+        self.num_experts = moe_config.num_experts
+
+    # -------------------- params -------------------- #
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        cfg, moe = self.config, self.moe
+        base = T.init_params(cfg, rng, dtype=self.param_dtype)
+        L, D = cfg.n_layer, cfg.d_model
+        E = moe.num_experts
+        F = moe.expert_ff_mult * D
+        k1, k2, k3 = jax.random.split(jax.random.fold_in(rng, 999), 3)
+        s_in, s_out = 0.02, 0.02 / math.sqrt(2 * L)
+        base["layers"]["mlp"] = {
+            "gate_w": (jax.random.normal(k1, (L, D, E)) / math.sqrt(D)).astype(self.param_dtype),
+            "w_up": (jax.random.normal(k2, (L, E, D, F)) * s_in).astype(self.param_dtype),
+            "b_up": jnp.zeros((L, E, F), self.param_dtype),
+            "w_down": (jax.random.normal(k3, (L, E, F, D)) * s_out).astype(self.param_dtype),
+            "b_down": jnp.zeros((L, E, D), self.param_dtype),
+        }
+        return base
+
+    def tp_specs(self) -> Dict[str, Any]:
+        specs = T.tp_specs(self.config)
+        specs["layers"]["mlp"] = {
+            "gate_w": P(None, None, None),
+            "w_up": P(None, "ep", None, "tp"),
+            "b_up": P(None, "ep", "tp"),
+            "w_down": P(None, "ep", "tp", None),
+            "b_down": P(None, "ep", None),
+        }
+        return specs
+
+    # -------------------- forward -------------------- #
+
+    def _moe_mlp(self, lp, x, rng, train: bool):
+        """x [B,S,D] → ([B,S,D], l_aux) via top-k expert routing."""
+        moe = self.moe
+        B, S, D = x.shape
+        tokens = x.reshape(-1, D)
+        if train and moe.noisy_gate_policy == "Jitter" and rng is not None:
+            tokens = tokens * jax.random.uniform(rng, tokens.shape, minval=0.99, maxval=1.01)
+        logits = tokens.astype(jnp.float32) @ lp["gate_w"].astype(jnp.float32)
+        cf = moe.capacity_factor if train else moe.eval_capacity_factor
+        if moe.k == 1:
+            l_aux, combine, dispatch, _ = top1gating(
+                logits, cf, moe.min_capacity, None,
+                moe.noisy_gate_policy if train else None, moe.drop_tokens, moe.use_rts, rng=rng)
+        else:
+            l_aux, combine, dispatch, _ = top2gating(logits, cf, moe.min_capacity,
+                                                     moe.drop_tokens, rng=rng)
+
+        def expert(p, xe):
+            h = xe @ p["w_up"] + p["b_up"]
+            return jax.nn.gelu(h, approximate=True) @ p["w_down"] + p["b_down"]
+
+        eps = {k: lp[k] for k in ("w_up", "b_up", "w_down", "b_down")}
+        combined = dispatch_combine(tokens, combine, dispatch, expert, eps, mesh=self.mesh)
+        return combined.reshape(B, S, D), l_aux
+
+    def _block(self, x, lp, positions, mask_bias, rng, train: bool):
+        cfg = self.config
+        a = T.attention(cfg, T._norm(cfg, x, lp["ln_attn"]), lp["attn"], positions, mask_bias)
+        x = x + a
+        m, l_aux = self._moe_mlp(lp["mlp"], T._norm(cfg, x, lp["ln_mlp"]), rng, train)
+        return x + m, l_aux
+
+    def forward(self, params, tokens, attn_mask=None, rng=None, train: bool = True):
+        cfg = self.config
+        B, S = tokens.shape
+        x = params["embed"]["tokens"][tokens]
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+        if cfg.pos_embedding == "learned":
+            x = x + params["embed"]["positions"][:S][None, :, :]
+        mask_bias = None
+        if attn_mask is not None:
+            mask_bias = jnp.where(attn_mask[:, None, None, :] > 0, 0.0, -1e9).astype(jnp.float32)
+        if rng is None:
+            rng = jax.random.key(0)
+
+        def run_block(carry, scan_in):
+            h, aux = carry
+            lp, i = scan_in
+            h, l_aux = self._block(h, lp, positions, mask_bias, jax.random.fold_in(rng, i), train)
+            return (h, aux + l_aux), None
+
+        if cfg.remat:
+            run_block = jax.checkpoint(run_block, prevent_cse=False)
+        (x, aux_total), _ = jax.lax.scan(run_block, (x, jnp.zeros((), jnp.float32)),
+                                         (params["layers"], jnp.arange(cfg.n_layer)))
+
+        x = T._norm(cfg, x, params["ln_f"])
+        if cfg.tie_embeddings:
+            logits = x @ params["embed"]["tokens"].T
+        else:
+            logits = x @ params["lm_head"]
+        return logits, aux_total / cfg.n_layer
+
+    def loss(self, params, batch, rng=None):
+        logits, aux = self.forward(params, batch["input_ids"], batch.get("attention_mask"),
+                                   rng=rng, train=True)
+        tokens = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.concatenate([tokens[:, 1:], jnp.full_like(tokens[:, :1], -100)], axis=1)
+        logits = logits.astype(jnp.float32)
+        valid = labels != -100
+        safe = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        lm = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+        return lm + self.moe.aux_loss_coef * aux
+
+    @property
+    def num_parameters(self) -> int:
+        cfg, moe = self.config, self.moe
+        D, E = cfg.d_model, moe.num_experts
+        F = moe.expert_ff_mult * D
+        embed = cfg.vocab_size * D + (cfg.max_seq * D if cfg.pos_embedding == "learned" else 0)
+        attn = D * cfg.head_dim * (cfg.n_head + 2 * cfg.kv_heads) + cfg.n_head * cfg.head_dim * D
+        moe_mlp = D * E + E * (2 * D * F + F + D)
+        norms = (4 if cfg.norm == "layernorm" else 2) * D
+        final_norm = (2 if cfg.norm == "layernorm" else 1) * D
+        head = 0 if cfg.tie_embeddings else D * cfg.vocab_size
+        return embed + cfg.n_layer * (attn + moe_mlp + norms) + final_norm + head

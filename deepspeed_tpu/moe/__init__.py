@@ -1,0 +1,9 @@
+"""Mixture-of-experts with expert parallelism (reference ``deepspeed/moe/``)."""
+
+from deepspeed_tpu.moe.experts import ExpertFFN
+from deepspeed_tpu.moe.layer import MoE
+from deepspeed_tpu.moe.sharded_moe import MOELayer, TopKGate, top1gating, top2gating
+from deepspeed_tpu.moe.utils import has_moe_layers, is_moe_param_path, split_moe_params
+
+__all__ = ["MoE", "ExpertFFN", "MOELayer", "TopKGate", "top1gating", "top2gating",
+           "is_moe_param_path", "split_moe_params", "has_moe_layers"]

@@ -1,0 +1,48 @@
+"""Expert bank: an expert-stacked feed-forward network.
+
+Reference parity: ``deepspeed/moe/experts.py`` — ``Experts`` holding
+``num_local_experts`` copies of the expert module. TPU-native: ONE parameter
+pytree with a leading ``num_experts`` dim (sharded over ``ep``), applied with
+``jax.vmap`` — the stacked layout XLA partitions cleanly instead of a Python
+list of modules.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class ExpertFFN:
+    """num_experts × (Linear → activation → Linear)."""
+
+    def __init__(self, num_experts: int, d_model: int, d_ff: int, activation: str = "gelu"):
+        self.num_experts = num_experts
+        self.d_model = d_model
+        self.d_ff = d_ff
+        self.activation = activation
+
+    def init(self, rng) -> Dict[str, jnp.ndarray]:
+        k1, k2 = jax.random.split(rng)
+        E, D, F = self.num_experts, self.d_model, self.d_ff
+        s_in, s_out = 1.0 / math.sqrt(D), 1.0 / math.sqrt(F)
+        return {"w_up": jax.random.normal(k1, (E, D, F)) * s_in,
+                "b_up": jnp.zeros((E, F)),
+                "w_down": jax.random.normal(k2, (E, F, D)) * s_out,
+                "b_down": jnp.zeros((E, D))}
+
+    def apply_one(self, p, x):
+        """One expert: p leaves without the leading E dim, x [C, D]."""
+        h = x @ p["w_up"] + p["b_up"]
+        h = jax.nn.gelu(h, approximate=True) if self.activation == "gelu" else jax.nn.relu(h)
+        return h @ p["w_down"] + p["b_down"]
+
+    def ep_specs(self) -> Dict[str, P]:
+        """Expert-parallel shardings: experts over ``ep``, with the ff dim
+        available for tp."""
+        return {"w_up": P("ep", None, "tp"), "b_up": P("ep", "tp"),
+                "w_down": P("ep", "tp", None), "b_down": P("ep", None)}

@@ -1,0 +1,93 @@
+"""User-facing MoE layer.
+
+Reference parity: ``deepspeed/moe/layer.py`` — ``MoE`` wrapping gate +
+experts (+ optional residual MLP with a learned mixing coefficient,
+"Residual MoE" from DeepSpeed-MoE), and the EP×DP process-group bookkeeping
+(``layer.py:84`` → ``deepspeed/utils/groups.py``). On TPU the "groups" are
+mesh axes: experts shard over ``ep``; ZeRO/data parallelism uses the
+remaining axes (see ``deepspeed_tpu/utils/groups.py``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.moe.experts import ExpertFFN
+from deepspeed_tpu.moe.sharded_moe import MOELayer, TopKGate
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class MoE:
+    """Mixture-of-experts block: ``out, l_aux, exp_counts = moe(params, x)``.
+
+    Args mirror the reference ``MoE.__init__`` (layer.py:15): hidden_size,
+    expert (an ExpertFFN or compatible bank), num_experts, ep_size (informational
+    on TPU — the mesh's ``ep`` axis size governs the actual sharding), k,
+    capacity factors, noisy gating, drop_tokens, use_rts, use_residual.
+    """
+
+    def __init__(self,
+                 hidden_size: int,
+                 expert: Optional[ExpertFFN] = None,
+                 num_experts: int = 1,
+                 ep_size: int = 1,
+                 k: int = 1,
+                 capacity_factor: float = 1.0,
+                 eval_capacity_factor: float = 1.0,
+                 min_capacity: int = 4,
+                 use_residual: bool = False,
+                 noisy_gate_policy: Optional[str] = None,
+                 drop_tokens: bool = True,
+                 use_rts: bool = True,
+                 d_ff: Optional[int] = None,
+                 mesh=None):
+        if num_experts % max(ep_size, 1) != 0:
+            raise ValueError(f"num_experts {num_experts} must be divisible by ep_size {ep_size}")
+        self.hidden_size = hidden_size
+        self.num_experts = num_experts
+        self.ep_size = ep_size
+        self.num_local_experts = num_experts // max(ep_size, 1)
+        self.use_residual = use_residual
+        self.expert = expert or ExpertFFN(num_experts, hidden_size, d_ff or 4 * hidden_size)
+        self.gate = TopKGate(hidden_size, num_experts, k, capacity_factor, eval_capacity_factor,
+                             min_capacity, noisy_gate_policy, drop_tokens, use_rts)
+        self.moe_layer = MOELayer(self.gate, self.expert.apply_one, self.num_local_experts, mesh=mesh)
+        log_dist(f"MoE: {num_experts} experts, k={k}, capacity_factor={capacity_factor}, "
+                 f"residual={use_residual}", ranks=[0])
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        kg, ke, kr, kc = jax.random.split(rng, 4)
+        params: Dict[str, Any] = {"gate": self.gate.init(kg), "experts": self.expert.init(ke)}
+        if self.use_residual:
+            D = self.hidden_size
+            F = self.expert.d_ff
+            s_in, s_out = 1.0 / math.sqrt(D), 1.0 / math.sqrt(F)
+            params["residual_mlp"] = {
+                "w_up": jax.random.normal(kr, (D, F)) * s_in, "b_up": jnp.zeros((F,)),
+                "w_down": jax.random.normal(jax.random.fold_in(kr, 1), (F, D)) * s_out,
+                "b_down": jnp.zeros((D,))}
+            params["coefficient"] = {"w": jax.random.normal(kc, (D, 2)) * 0.02, "b": jnp.zeros((2,))}
+        return params
+
+    def ep_specs(self) -> Dict[str, Any]:
+        specs: Dict[str, Any] = {"gate": {"wg": P(None, None)}, "experts": self.expert.ep_specs()}
+        if self.use_residual:
+            specs["residual_mlp"] = {"w_up": P(None, "tp"), "b_up": P("tp"),
+                                     "w_down": P("tp", None), "b_down": P(None)}
+            specs["coefficient"] = {"w": P(None, None), "b": P(None)}
+        return specs
+
+    def __call__(self, params, x, rng=None, train: bool = True):
+        out, l_aux, exp_counts = self.moe_layer(params, x, rng=rng, train=train)
+        if self.use_residual:
+            rp = params["residual_mlp"]
+            h = jax.nn.gelu(x @ rp["w_up"] + rp["b_up"], approximate=True)
+            res = h @ rp["w_down"] + rp["b_down"]
+            coef = jax.nn.softmax(x @ params["coefficient"]["w"] + params["coefficient"]["b"], axis=-1)
+            out = out * coef[..., 0:1] + res * coef[..., 1:2]
+        return out, l_aux, exp_counts

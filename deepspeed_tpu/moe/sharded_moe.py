@@ -1,0 +1,258 @@
+"""Top-k gating and the expert-parallel MoE layer.
+
+Reference parity: ``deepspeed/moe/sharded_moe.py`` — ``TopKGate`` (:176) with
+top-1/top-2 gating, capacity factor, jittered gates, load-balancing auxiliary
+loss, and random token selection; ``MOELayer`` (:417) dispatching tokens to
+experts with all-to-all over the expert-parallel group.
+
+TPU-native design: the gating math keeps the GShard einsum formulation (the
+reference's own ancestry) in pure jnp with STATIC capacity (XLA requires
+static shapes — ``drop_tokens=False`` therefore sets capacity = tokens
+instead of growing it dynamically). Expert parallelism is declarative:
+expert-stacked weights are sharded over the ``ep`` mesh axis and the
+dispatched token tensor ``[E, C, D]`` is constrained to ``P("ep")`` on the
+expert dim — the SPMD partitioner inserts the all-to-all pair the reference
+issues by hand (``sharded_moe.py:467-499``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float, min_capacity: int) -> int:
+    cap = int(math.ceil(num_tokens / num_experts * capacity_factor))
+    return max(cap, min_capacity)
+
+
+def _one_hot(idx, n):
+    return jax.nn.one_hot(idx, n, dtype=jnp.float32)
+
+
+_warned_rts = False
+
+
+def gumbel_noise(rng, shape):
+    u = jax.random.uniform(rng, shape, minval=1e-9, maxval=1.0 - 1e-9)
+    return -jnp.log(-jnp.log(u))
+
+
+def top1gating(logits: jnp.ndarray,
+               capacity_factor: float = 1.0,
+               min_capacity: int = 4,
+               used_token: Optional[jnp.ndarray] = None,
+               noisy_gate_policy: Optional[str] = None,
+               drop_tokens: bool = True,
+               use_rts: bool = True,
+               rng=None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-1 gating (reference sharded_moe.py:176-300).
+
+    Returns (l_aux, combine_weights [T,E,C], dispatch_mask [T,E,C] bool,
+    exp_counts [E]).
+
+    - ``noisy_gate_policy``: None | 'RSample' (gumbel-perturbed routing) |
+      'Jitter' (multiplicative input jitter is applied by the gate module).
+    - ``use_rts``: random token selection — capacity slots go to a random
+      subset of each expert's tokens rather than the lowest token indices,
+      debiasing drops (reference :262). Needs ``rng``: gating is a pure
+      function, so without a key there is no randomness to draw — RTS falls
+      back to positional priority (with a one-time warning) rather than
+      reusing a constant key that would re-drop the same positions every step.
+    """
+    T, E = logits.shape
+    C = T if not drop_tokens else _capacity(T, E, capacity_factor, min_capacity)
+    C = min(C, T)
+
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    route_logits = logits
+    if noisy_gate_policy == "RSample":
+        if rng is None:
+            raise ValueError("RSample gating needs an rng")
+        route_logits = logits + gumbel_noise(rng, logits.shape)
+    idx1 = jnp.argmax(route_logits, axis=-1)
+    mask1 = _one_hot(idx1, E)
+    if used_token is not None:
+        mask1 = mask1 * used_token[:, None]
+
+    # load-balancing loss: E * sum_e mean_gate_e * mean_count_e
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    # expert load BEFORE capacity truncation (reference :203) — the
+    # monitoring signal must show overflow, not the clipped counts
+    exp_counts = jnp.sum(mask1, axis=0).astype(jnp.int32)
+
+    if use_rts and rng is None:
+        global _warned_rts
+        if not _warned_rts:
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning("top1gating: use_rts=True but no rng was provided; "
+                           "falling back to positional capacity priority")
+            _warned_rts = True
+        use_rts = False
+
+    # capacity assignment priority: positional, or randomized (RTS)
+    if use_rts:
+        scores = jax.random.uniform(jax.random.fold_in(rng, 1), (T,))
+        order = jnp.argsort(scores)  # random permutation of token priority
+        mask1_prio = mask1[order]
+        loc_sorted = jnp.cumsum(mask1_prio, axis=0) - mask1_prio
+        inv = jnp.argsort(order)
+        locations1 = jnp.sum(loc_sorted[inv] * mask1, axis=1)
+    else:
+        loc = jnp.cumsum(mask1, axis=0) - mask1
+        locations1 = jnp.sum(loc * mask1, axis=1)
+
+    keep = (locations1 < C).astype(jnp.float32) * jnp.sum(mask1, axis=1)
+    mask1 = mask1 * keep[:, None]
+
+    gates1 = jnp.sum(gates * mask1, axis=1)  # selected gate value (0 if dropped)
+    combine = (gates1[:, None, None] * mask1[:, :, None] *
+               _one_hot(locations1.astype(jnp.int32), C)[:, None, :])
+    dispatch_mask = combine > 0
+    return l_aux, combine, dispatch_mask, exp_counts
+
+
+def top2gating(logits: jnp.ndarray,
+               capacity_factor: float = 1.0,
+               min_capacity: int = 4,
+               drop_tokens: bool = True,
+               rng=None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-2 gating (reference sharded_moe.py:303-415): second expert chosen
+    from gumbel-perturbed logits with the first masked out; gate values of the
+    two experts renormalized; capacity doubled vs top-1."""
+    T, E = logits.shape
+    C = T if not drop_tokens else _capacity(T, E, 2 * capacity_factor, min_capacity)
+    C = min(C, T)
+
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(idx1, E)
+
+    noise = gumbel_noise(rng, logits.shape) if rng is not None else 0.0
+    logits2 = logits.astype(jnp.float32) + noise
+    logits2 = jnp.where(mask1 > 0, -jnp.inf, logits2)
+    idx2 = jnp.argmax(logits2, axis=-1)
+    mask2 = _one_hot(idx2, E)
+
+    loc1 = jnp.cumsum(mask1, axis=0) - mask1
+    # expert-1 tokens take priority; expert-2 slots start after them
+    loc2 = jnp.cumsum(mask2, axis=0) - mask2 + jnp.sum(mask1, axis=0, keepdims=True)
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    # first-choice expert load before truncation (reference parity)
+    exp_counts = jnp.sum(mask1, axis=0).astype(jnp.int32)
+
+    locations1 = jnp.sum(loc1 * mask1, axis=1)
+    locations2 = jnp.sum(loc2 * mask2, axis=1)
+    mask1 = mask1 * (locations1 < C)[:, None]
+    mask2 = mask2 * (locations2 < C)[:, None]
+
+    g1 = jnp.sum(gates * mask1, axis=1)
+    g2 = jnp.sum(gates * mask2, axis=1)
+    denom = jnp.clip(g1 + g2, 1e-9, None)
+    g1, g2 = g1 / denom, g2 / denom
+
+    combine1 = g1[:, None, None] * mask1[:, :, None] * _one_hot(locations1.astype(jnp.int32), C)[:, None, :]
+    combine2 = g2[:, None, None] * mask2[:, :, None] * _one_hot(locations2.astype(jnp.int32), C)[:, None, :]
+    combine = combine1 + combine2
+    dispatch_mask = combine > 0
+    return l_aux, combine, dispatch_mask, exp_counts
+
+
+class TopKGate:
+    """Gate module (reference sharded_moe.py:176): a linear router + top-k
+    gating. ``params`` = {"wg": [D, E]}."""
+
+    def __init__(self, model_dim: int, num_experts: int, k: int = 1,
+                 capacity_factor: float = 1.0, eval_capacity_factor: float = 1.0,
+                 min_capacity: int = 4, noisy_gate_policy: Optional[str] = None,
+                 drop_tokens: bool = True, use_rts: bool = True):
+        if k not in (1, 2):
+            raise ValueError("TopKGate supports k=1 or k=2")
+        self.model_dim = model_dim
+        self.num_experts = num_experts
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.min_capacity = min_capacity
+        self.noisy_gate_policy = noisy_gate_policy
+        self.drop_tokens = drop_tokens
+        self.use_rts = use_rts
+
+    def init(self, rng):
+        scale = 1.0 / math.sqrt(self.model_dim)
+        return {"wg": jax.random.normal(rng, (self.model_dim, self.num_experts)) * scale}
+
+    def __call__(self, params, tokens, used_token=None, rng=None, train: bool = True):
+        """tokens [T, D] → (l_aux, combine [T,E,C], dispatch [T,E,C], counts)."""
+        x = tokens
+        if train and self.noisy_gate_policy == "Jitter" and rng is not None:
+            x = x * jax.random.uniform(rng, x.shape, minval=0.99, maxval=1.01)
+        logits = x.astype(jnp.float32) @ params["wg"].astype(jnp.float32)
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        if self.k == 1:
+            return top1gating(logits, cf, self.min_capacity, used_token,
+                              self.noisy_gate_policy if train else None,
+                              self.drop_tokens, self.use_rts, rng=rng)
+        return top2gating(logits, cf, self.min_capacity, self.drop_tokens, rng=rng)
+
+
+def dispatch_combine(tokens: jnp.ndarray,
+                     combine: jnp.ndarray,
+                     dispatch: jnp.ndarray,
+                     expert_fn: Callable,
+                     expert_params: Any,
+                     mesh=None) -> jnp.ndarray:
+    """Dispatch → expert compute → combine (shared by MOELayer and the MoE
+    model zoo). ``tokens [T,D]``, ``combine/dispatch [T,E,C]`` →  ``[T,D]``.
+
+    The dispatched tensor is constrained to ``P("ep")`` on its expert dim so
+    the SPMD partitioner inserts the all-to-all pair over the ep axis.
+    """
+    dispatched = jnp.einsum("tec,td->ecd", dispatch.astype(tokens.dtype), tokens)
+    if mesh is not None and "ep" in mesh.shape:
+        dispatched = jax.lax.with_sharding_constraint(
+            dispatched, NamedSharding(mesh, P("ep", None, None)))
+    expert_out = jax.vmap(expert_fn)(expert_params, dispatched)
+    if mesh is not None and "ep" in mesh.shape:
+        expert_out = jax.lax.with_sharding_constraint(
+            expert_out, NamedSharding(mesh, P("ep", None, None)))
+    return jnp.einsum("tec,ecd->td", combine.astype(tokens.dtype), expert_out)
+
+
+class MOELayer:
+    """Dispatch → expert compute → combine (reference sharded_moe.py:417).
+
+    ``expert_fn(expert_params_slice, x[C, D]) -> [C, D]`` is vmapped over the
+    leading expert dim; expert params and the dispatched tensor are sharded
+    over ``ep`` so each device computes only its local experts and XLA
+    inserts the all-to-all pair.
+    """
+
+    def __init__(self, gate: TopKGate, expert_fn: Callable, num_local_experts: int = 1,
+                 mesh=None):
+        self.gate = gate
+        self.expert_fn = expert_fn
+        self.num_local_experts = num_local_experts
+        self.mesh = mesh
+
+    def __call__(self, params, x, rng=None, train: bool = True):
+        """x [B, S, D] (or [T, D]) → same shape; returns (out, l_aux, exp_counts)."""
+        orig_shape = x.shape
+        D = orig_shape[-1]
+        tokens = x.reshape(-1, D)
+        l_aux, combine, dispatch, exp_counts = self.gate(params["gate"], tokens, rng=rng, train=train)
+        out = dispatch_combine(tokens, combine, dispatch, self.expert_fn, params["experts"],
+                               mesh=self.mesh)
+        return out.reshape(orig_shape), l_aux, exp_counts

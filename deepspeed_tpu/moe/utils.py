@@ -1,0 +1,42 @@
+"""MoE parameter classification helpers.
+
+Reference parity: ``deepspeed/moe/utils.py`` — ``is_moe_param`` (:14) and the
+param-group splitting used by ZeRO to give expert params their own
+(expert-data-parallel) partitioning group. On TPU the analogue is a path
+predicate over the params pytree: expert leaves live under an "experts" key
+and are sharded over ``ep``, so ZeRO's dp sharding must skip the ``ep`` dims
+— which `ZeroShardingRules` does by treating the ep spec like a TP spec.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+
+
+def is_moe_param_path(path: Tuple) -> bool:
+    """True if a pytree key-path belongs to an expert parameter."""
+    for k in path:
+        name = getattr(k, "key", getattr(k, "name", None))
+        if name == "experts":
+            return True
+    return False
+
+
+def split_moe_params(params: Any) -> Tuple[List, List]:
+    """(expert_leaves, dense_leaves) by key path."""
+    expert, dense = [], []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        (expert if is_moe_param_path(path) else dense).append(leaf)
+    return expert, dense
+
+
+def has_moe_layers(model) -> Tuple[bool, int]:
+    """(has_moe, num_experts) for an engine-visible model."""
+    moe = getattr(model, "moe", None)
+    if moe is not None:
+        return True, getattr(moe, "num_experts", 0)
+    if getattr(model, "num_experts", 0):
+        return True, model.num_experts
+    return False, 0
