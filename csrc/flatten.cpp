@@ -1,0 +1,60 @@
+// Parallel flatten/unflatten of host tensor lists.
+//
+// Reference parity: csrc/utils/flatten_unflatten.cpp (UtilsBuilder) — the
+// reference re-exports torch's _flatten_dense_tensors; here the host-offload
+// buffers are raw numpy memory, so this is a parallel gather/scatter memcpy.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// Copy `count` source buffers (byte sizes in `sizes`) back-to-back into `dst`.
+void ds_flatten(const void** srcs, const int64_t* sizes, int64_t count,
+                void* dst) {
+    std::vector<int64_t> offs(static_cast<size_t>(count));
+    int64_t off = 0;
+    for (int64_t i = 0; i < count; ++i) {
+        offs[static_cast<size_t>(i)] = off;
+        off += sizes[i];
+    }
+#pragma omp parallel for schedule(dynamic)
+    for (int64_t i = 0; i < count; ++i) {
+        std::memcpy(static_cast<char*>(dst) + offs[static_cast<size_t>(i)],
+                    srcs[i], static_cast<size_t>(sizes[i]));
+    }
+}
+
+// Scatter a flat buffer back out into `count` destination buffers.
+void ds_unflatten(void* const* dsts, const int64_t* sizes, int64_t count,
+                  const void* src) {
+    std::vector<int64_t> offs(static_cast<size_t>(count));
+    int64_t off = 0;
+    for (int64_t i = 0; i < count; ++i) {
+        offs[static_cast<size_t>(i)] = off;
+        off += sizes[i];
+    }
+#pragma omp parallel for schedule(dynamic)
+    for (int64_t i = 0; i < count; ++i) {
+        std::memcpy(dsts[i], static_cast<const char*>(src) + offs[static_cast<size_t>(i)],
+                    static_cast<size_t>(sizes[i]));
+    }
+}
+
+// Parallel single memcpy for large pinned-buffer moves
+// (reference csrc/aio/py_lib/deepspeed_py_copy.cpp deepspeed_memcpy).
+void ds_memcpy(void* dst, const void* src, int64_t nbytes) {
+    const int64_t chunk = 1 << 22;  // 4 MiB per task
+    int64_t nchunks = (nbytes + chunk - 1) / chunk;
+#pragma omp parallel for schedule(static)
+    for (int64_t c = 0; c < nchunks; ++c) {
+        int64_t off = c * chunk;
+        int64_t len = nbytes - off < chunk ? nbytes - off : chunk;
+        std::memcpy(static_cast<char*>(dst) + off,
+                    static_cast<const char*>(src) + off,
+                    static_cast<size_t>(len));
+    }
+}
+
+}  // extern "C"

@@ -122,13 +122,15 @@ class MoECausalLM:
         mask_bias = None
         if attn_mask is not None:
             mask_bias = jnp.where(attn_mask[:, None, None, :] > 0, 0.0, -1e9).astype(jnp.float32)
-        if rng is None:
-            rng = jax.random.key(0)
-
+        # No rng means no stochastic routing: RTS/Jitter would otherwise draw
+        # the same permutation every step from a constant key, silently biasing
+        # which tokens get dropped at capacity (top1gating's own rng=None path
+        # makes the same choice).
         def run_block(carry, scan_in):
             h, aux = carry
             lp, i = scan_in
-            h, l_aux = self._block(h, lp, positions, mask_bias, jax.random.fold_in(rng, i), train)
+            block_rng = None if rng is None else jax.random.fold_in(rng, i)
+            h, l_aux = self._block(h, lp, positions, mask_bias, block_rng, train)
             return (h, aux + l_aux), None
 
         if cfg.remat:

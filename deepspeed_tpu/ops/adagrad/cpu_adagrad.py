@@ -1,0 +1,51 @@
+"""DeepSpeedCPUAdagrad — host-memory Adagrad for ZeRO-Offload.
+
+Reference parity: ``deepspeed/ops/adagrad/cpu_adagrad.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.adagrad import cpu_adagrad_binding
+
+
+class DeepSpeedCPUAdagrad:
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10, weight_decay: float = 0.0):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.step_count = 0
+        self._h: Dict[str, np.ndarray] = {}
+
+    def register(self, key: str, numel: int) -> None:
+        if key not in self._h:
+            self._h[key] = np.zeros(numel, np.float32)
+        elif self._h[key].size != numel:
+            raise ValueError(f"partition '{key}' re-registered with {numel} elements "
+                             f"but optimizer state holds {self._h[key].size}; "
+                             "partitions are fixed-size once registered")
+
+    def begin_step(self, lr: Optional[float] = None) -> None:
+        self.step_count += 1
+        if lr is not None:
+            self.lr = lr
+
+    def step(self, key: str, params: np.ndarray, grads: np.ndarray,
+             param_out_bf16: Optional[np.ndarray] = None) -> None:
+        self.register(key, params.size)
+        cpu_adagrad_binding.adagrad_step(params, grads, self._h[key],
+                                         lr=self.lr, eps=self.eps,
+                                         weight_decay=self.weight_decay,
+                                         param_out_bf16=param_out_bf16)
+
+    def state_dict(self) -> dict:
+        return {"step": self.step_count, "lr": self.lr,
+                "exp_avg_sq": {k: v.copy() for k, v in self._h.items()}}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.step_count = sd["step"]
+        self.lr = sd.get("lr", self.lr)
+        self._h = {k: np.asarray(v, np.float32) for k, v in sd["exp_avg_sq"].items()}

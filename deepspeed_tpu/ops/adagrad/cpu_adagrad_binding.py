@@ -1,0 +1,46 @@
+"""ctypes signatures for the native cpu_adagrad kernels (csrc/cpu_adagrad.cpp).
+
+Reference parity: export block in ``csrc/adagrad/cpu_adagrad.cpp``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops import native
+from deepspeed_tpu.ops.native import c_f32, c_f32p, c_i64, c_u16p
+
+_configured = False
+
+
+def _lib():
+    global _configured
+    lib = native.get_lib()
+    if not _configured:
+        lib.ds_adagrad_step.argtypes = [c_f32p, c_f32p, c_f32p, c_i64, c_f32, c_f32, c_f32]
+        lib.ds_adagrad_step_plus_copy.argtypes = [c_f32p, c_f32p, c_f32p, c_u16p, c_i64,
+                                                  c_f32, c_f32, c_f32]
+        _configured = True
+    return lib
+
+
+def adagrad_step(params: np.ndarray, grads: np.ndarray, exp_avg_sq: np.ndarray,
+                 *, lr: float, eps: float, weight_decay: float,
+                 param_out_bf16: Optional[np.ndarray] = None) -> None:
+    native.check_buffer(params, np.float32, "params")
+    native.check_buffer(grads, np.float32, "grads", params.size)
+    native.check_buffer(exp_avg_sq, np.float32, "exp_avg_sq", params.size)
+    if param_out_bf16 is not None:
+        native.check_buffer(param_out_bf16, np.uint16, "param_out_bf16", params.size)
+    lib = _lib()
+    n = params.size
+    if param_out_bf16 is not None:
+        lib.ds_adagrad_step_plus_copy(native.as_f32_ptr(params), native.as_f32_ptr(grads),
+                                      native.as_f32_ptr(exp_avg_sq),
+                                      native.as_u16_ptr(param_out_bf16),
+                                      n, lr, eps, weight_decay)
+    else:
+        lib.ds_adagrad_step(native.as_f32_ptr(params), native.as_f32_ptr(grads),
+                            native.as_f32_ptr(exp_avg_sq), n, lr, eps, weight_decay)

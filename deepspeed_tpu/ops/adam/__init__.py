@@ -1,0 +1,3 @@
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+
+__all__ = ["DeepSpeedCPUAdam"]

@@ -1,0 +1,77 @@
+"""DeepSpeedCPUAdam — host-memory Adam for ZeRO-Offload.
+
+Reference parity: ``deepspeed/ops/adam/cpu_adam.py`` (``DeepSpeedCPUAdam``,
+180 LoC) wrapping the native SIMD kernel. Here state lives in numpy fp32
+arrays (one flat buffer per parameter leaf) stepped by csrc/cpu_adam.cpp;
+grads arrive as numpy views of device-to-host transfers and the updated
+params are returned as bf16 staging buffers ready for host-to-device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.adam import cpu_adam_binding
+
+
+class DeepSpeedCPUAdam:
+    """Flat-buffer Adam over host memory.
+
+    Unlike a torch optimizer there is no param-group mutation protocol: the
+    engine registers each flat fp32 master partition once by key, then calls
+    :meth:`step` with that key and the grad buffer for the partition.
+    """
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.step_count = 0
+        self._m: Dict[str, np.ndarray] = {}
+        self._v: Dict[str, np.ndarray] = {}
+
+    def register(self, key: str, numel: int) -> None:
+        if key not in self._m:
+            self._m[key] = np.zeros(numel, np.float32)
+            self._v[key] = np.zeros(numel, np.float32)
+        elif self._m[key].size != numel:
+            raise ValueError(f"partition '{key}' re-registered with {numel} elements "
+                             f"but optimizer state holds {self._m[key].size}; "
+                             "partitions are fixed-size once registered")
+
+    def begin_step(self, lr: Optional[float] = None) -> None:
+        """Advance the shared timestep once per optimizer step (all
+        partitions stepped between begin_step calls share bias correction)."""
+        self.step_count += 1
+        if lr is not None:
+            self.lr = lr
+
+    def step(self, key: str, params: np.ndarray, grads: np.ndarray,
+             param_out_bf16: Optional[np.ndarray] = None) -> None:
+        """Fused in-place update of one registered flat partition."""
+        self.register(key, params.size)
+        cpu_adam_binding.adam_step(
+            params, grads, self._m[key], self._v[key],
+            lr=self.lr, beta1=self.beta1, beta2=self.beta2, eps=self.eps,
+            weight_decay=self.weight_decay, adamw_mode=self.adamw_mode,
+            step=max(self.step_count, 1), param_out_bf16=param_out_bf16)
+
+    # --- checkpoint support -------------------------------------------- #
+    def state_dict(self) -> dict:
+        return {
+            "step": self.step_count,
+            "lr": self.lr,
+            "exp_avg": {k: v.copy() for k, v in self._m.items()},
+            "exp_avg_sq": {k: v.copy() for k, v in self._v.items()},
+        }
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.step_count = sd["step"]
+        self.lr = sd.get("lr", self.lr)
+        self._m = {k: np.asarray(v, np.float32) for k, v in sd["exp_avg"].items()}
+        self._v = {k: np.asarray(v, np.float32) for k, v in sd["exp_avg_sq"].items()}

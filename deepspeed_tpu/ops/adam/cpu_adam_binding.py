@@ -1,0 +1,64 @@
+"""ctypes signatures for the native cpu_adam kernels (csrc/cpu_adam.cpp).
+
+Reference parity: the pybind11 export block ``csrc/adam/cpu_adam.cpp:290-303``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deepspeed_tpu.ops import native
+from deepspeed_tpu.ops.native import c_f32, c_f32p, c_i64, c_int, c_u16p
+
+_configured = False
+
+
+def _lib():
+    global _configured
+    lib = native.get_lib()
+    if not _configured:
+        lib.ds_adam_step.argtypes = [c_f32p, c_f32p, c_f32p, c_f32p, c_i64,
+                                     c_f32, c_f32, c_f32, c_f32, c_f32, c_int, c_f32, c_f32]
+        lib.ds_adam_step_bf16.argtypes = [c_f32p, c_u16p, c_f32p, c_f32p, c_u16p, c_i64,
+                                          c_f32, c_f32, c_f32, c_f32, c_f32, c_int, c_f32, c_f32]
+        lib.ds_adam_step_plus_copy.argtypes = [c_f32p, c_f32p, c_f32p, c_f32p, c_u16p, c_i64,
+                                               c_f32, c_f32, c_f32, c_f32, c_f32, c_int, c_f32, c_f32]
+        _configured = True
+    return lib
+
+
+def adam_step(params: np.ndarray, grads: np.ndarray, exp_avg: np.ndarray,
+              exp_avg_sq: np.ndarray, *, lr: float, beta1: float, beta2: float,
+              eps: float, weight_decay: float, adamw_mode: bool, step: int,
+              param_out_bf16: np.ndarray | None = None) -> None:
+    """In-place fused Adam update on contiguous fp32 host buffers.
+
+    ``grads`` may be fp32 or bf16-as-uint16; if ``param_out_bf16`` is given the
+    updated params are also stored as bf16 into it (fused convert+copy for the
+    device-bound staging buffer).
+    """
+    native.check_buffer(params, np.float32, "params")
+    native.check_buffer(exp_avg, np.float32, "exp_avg", params.size)
+    native.check_buffer(exp_avg_sq, np.float32, "exp_avg_sq", params.size)
+    if grads.dtype not in (np.float32, np.uint16):
+        raise TypeError(f"grads must be float32 or bf16-as-uint16, got {grads.dtype}")
+    native.check_buffer(grads, grads.dtype.type, "grads", params.size)
+    if param_out_bf16 is not None:
+        native.check_buffer(param_out_bf16, np.uint16, "param_out_bf16", params.size)
+    n = params.size
+    bias_c1 = float(1.0 - beta1**step)
+    bias_c2 = float(1.0 - beta2**step)
+    lib = _lib()
+    common = (n, lr, beta1, beta2, eps, weight_decay, int(adamw_mode), bias_c1, bias_c2)
+    if grads.dtype == np.uint16:
+        out_ptr = native.as_u16_ptr(param_out_bf16) if param_out_bf16 is not None else None
+        lib.ds_adam_step_bf16(native.as_f32_ptr(params), native.as_u16_ptr(grads),
+                              native.as_f32_ptr(exp_avg), native.as_f32_ptr(exp_avg_sq),
+                              out_ptr, *common)
+    elif param_out_bf16 is not None:
+        lib.ds_adam_step_plus_copy(native.as_f32_ptr(params), native.as_f32_ptr(grads),
+                                   native.as_f32_ptr(exp_avg), native.as_f32_ptr(exp_avg_sq),
+                                   native.as_u16_ptr(param_out_bf16), *common)
+    else:
+        lib.ds_adam_step(native.as_f32_ptr(params), native.as_f32_ptr(grads),
+                         native.as_f32_ptr(exp_avg), native.as_f32_ptr(exp_avg_sq), *common)

@@ -1,0 +1,83 @@
+"""Native parallel flatten/unflatten/memcpy over host numpy buffers.
+
+Reference parity: ``csrc/utils/flatten_unflatten.cpp`` (UtilsBuilder) and the
+parallel ``deepspeed_memcpy`` from ``csrc/aio/py_lib/deepspeed_py_copy.cpp``.
+The jnp equivalents for device arrays live in ``deepspeed_tpu.ops.flatten``;
+these operate on pinned host staging buffers for the offload path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.ops import native
+from deepspeed_tpu.ops.native import c_i64
+
+_configured = False
+
+
+def _lib():
+    global _configured
+    lib = native.get_lib()
+    if not _configured:
+        pp = ctypes.POINTER(ctypes.c_void_p)
+        lib.ds_flatten.argtypes = [pp, ctypes.POINTER(c_i64), c_i64, ctypes.c_void_p]
+        lib.ds_unflatten.argtypes = [pp, ctypes.POINTER(c_i64), c_i64, ctypes.c_void_p]
+        lib.ds_memcpy.argtypes = [ctypes.c_void_p, ctypes.c_void_p, c_i64]
+        _configured = True
+    return lib
+
+
+def _ptr_array(arrs: Sequence[np.ndarray]):
+    arr_t = ctypes.c_void_p * len(arrs)
+    return arr_t(*[a.ctypes.data_as(ctypes.c_void_p).value for a in arrs])
+
+
+def _size_array(arrs: Sequence[np.ndarray]):
+    sz_t = c_i64 * len(arrs)
+    return sz_t(*[a.nbytes for a in arrs])
+
+
+def flatten(tensors: Sequence[np.ndarray], out: np.ndarray | None = None) -> np.ndarray:
+    """Parallel copy of ``tensors`` back-to-back into one flat buffer.
+
+    Same-dtype inputs produce a flat array of that dtype; mixed dtypes
+    produce a uint8 byte buffer.
+    """
+    if not tensors:
+        return np.zeros(0, np.uint8)
+    total = sum(t.nbytes for t in tensors)
+    if out is None:
+        dtypes = {t.dtype for t in tensors}
+        if len(dtypes) == 1:
+            out = np.empty(total // tensors[0].itemsize, tensors[0].dtype)
+        else:
+            out = np.empty(total, np.uint8)
+    if out.nbytes < total:
+        raise ValueError(f"output buffer has {out.nbytes} bytes, need {total}")
+    tensors = [np.ascontiguousarray(t) for t in tensors]
+    _lib().ds_flatten(ctypes.cast(_ptr_array(tensors), ctypes.POINTER(ctypes.c_void_p)),
+                      _size_array(tensors), len(tensors),
+                      out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def unflatten(flat: np.ndarray, tensors: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Parallel scatter of ``flat`` into (newly allocated) arrays shaped like
+    ``tensors``; writes in place when the targets are contiguous."""
+    outs = [t if t.flags["C_CONTIGUOUS"] else np.empty_like(t) for t in tensors]
+    _lib().ds_unflatten(ctypes.cast(_ptr_array(outs), ctypes.POINTER(ctypes.c_void_p)),
+                        _size_array(outs), len(outs),
+                        np.ascontiguousarray(flat).ctypes.data_as(ctypes.c_void_p))
+    return outs
+
+
+def memcpy(dst: np.ndarray, src: np.ndarray) -> None:
+    """Multi-threaded memcpy for large host-buffer moves."""
+    assert dst.nbytes == src.nbytes
+    _lib().ds_memcpy(dst.ctypes.data_as(ctypes.c_void_p),
+                     np.ascontiguousarray(src).ctypes.data_as(ctypes.c_void_p),
+                     dst.nbytes)

@@ -1,0 +1,99 @@
+"""ctypes loader for the host-side native library (csrc/ → libdstpu.so).
+
+Reference parity: ``op_builder/builder.py:436-497`` (``OpBuilder.load`` JIT
+compile + import). Here the native code is torch-free C++ with a C ABI: built
+once with ``make`` and loaded with ctypes; each op-family binding module
+declares its own argtypes on top of the handle returned by :func:`get_lib`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+_LIB: Optional[ctypes.CDLL] = None
+_LOCK = threading.Lock()
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def lib_path() -> str:
+    return os.path.join(_repo_root(), "csrc", "build", "libdstpu.so")
+
+
+def build_library(verbose: bool = False) -> str:
+    """Run ``make -C csrc`` (idempotent; cheap when up to date)."""
+    csrc = os.path.join(_repo_root(), "csrc")
+    result = subprocess.run(["make", "-C", csrc, "-j"], capture_output=True, text=True)
+    if result.returncode != 0:
+        # -march=native can fail under qemu/exotic hosts; retry portable.
+        result = subprocess.run(["make", "-C", csrc, "-j", "ARCHFLAGS="],
+                                capture_output=True, text=True)
+    if result.returncode != 0:
+        raise RuntimeError(f"native build failed:\n{result.stderr[-2000:]}")
+    if verbose:
+        logger.info(f"built native library at {lib_path()}")
+    return lib_path()
+
+
+def get_lib() -> ctypes.CDLL:
+    """Load (building if necessary) the shared library. Thread-safe."""
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    with _LOCK:
+        if _LIB is None:
+            path = lib_path()
+            if not os.path.exists(path):
+                build_library()
+            _LIB = ctypes.CDLL(path)
+    return _LIB
+
+
+def available() -> bool:
+    try:
+        get_lib()
+        return True
+    except Exception as e:  # pragma: no cover - env specific
+        logger.warning(f"native library unavailable: {e}")
+        return False
+
+
+# Common ctypes aliases used by binding modules
+c_f32p = ctypes.POINTER(ctypes.c_float)
+c_u16p = ctypes.POINTER(ctypes.c_uint16)
+c_i64 = ctypes.c_int64
+c_f32 = ctypes.c_float
+c_int = ctypes.c_int
+
+
+def as_f32_ptr(arr):
+    return arr.ctypes.data_as(c_f32p)
+
+
+def as_u16_ptr(arr):
+    return arr.ctypes.data_as(c_u16p)
+
+
+def check_buffer(arr, dtype, name: str, expect_size: int | None = None) -> None:
+    """Validate a host buffer before handing its raw pointer to native code.
+
+    ctypes ``data_as`` returns the base pointer of strided views, so anything
+    non-contiguous (or of the wrong dtype/size) would silently corrupt memory.
+    """
+    import numpy as np
+    if not isinstance(arr, np.ndarray):
+        raise TypeError(f"{name} must be a numpy array, got {type(arr)}")
+    if arr.dtype != np.dtype(dtype):
+        raise TypeError(f"{name} must be {np.dtype(dtype)}, got {arr.dtype}")
+    if not arr.flags["C_CONTIGUOUS"]:
+        raise ValueError(f"{name} must be C-contiguous")
+    if expect_size is not None and arr.size != expect_size:
+        raise ValueError(f"{name} has {arr.size} elements, expected {expect_size}")

@@ -1,0 +1,114 @@
+"""Native cpu_adam / cpu_adagrad / flatten kernels vs numpy references.
+
+Mirrors the reference's kernel-vs-torch comparisons in
+``tests/unit/ops/adam/test_cpu_adam.py``.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import native
+
+pytestmark = pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+
+
+def ref_adam(p, g, m, v, lr, b1, b2, eps, wd, adamw, steps):
+    p, m, v = p.copy(), m.copy(), v.copy()
+    for t in range(1, steps + 1):
+        grad = g if adamw or wd == 0 else g + wd * p
+        m = b1 * m + (1 - b1) * grad
+        v = b2 * v + (1 - b2) * grad * grad
+        mhat = m / (1 - b1**t)
+        vhat = v / (1 - b2**t)
+        upd = mhat / (np.sqrt(vhat) + eps)
+        if adamw and wd > 0:
+            upd = upd + wd * p
+        p = p - lr * upd
+    return p, m, v
+
+
+@pytest.mark.parametrize("adamw", [True, False])
+@pytest.mark.parametrize("n", [17, 4096])
+def test_adam_step_matches_reference(adamw, n):
+    from deepspeed_tpu.ops.adam import DeepSpeedCPUAdam
+
+    rng = np.random.default_rng(0)
+    p = rng.standard_normal(n).astype(np.float32)
+    g = (0.01 * rng.standard_normal(n)).astype(np.float32)
+
+    opt = DeepSpeedCPUAdam(lr=1e-2, betas=(0.9, 0.95), eps=1e-8,
+                           weight_decay=0.01, adamw_mode=adamw)
+    got = p.copy()
+    for _ in range(3):
+        opt.begin_step()
+        opt.step("w", got, g)
+
+    want, m_want, v_want = ref_adam(p, g, np.zeros(n, np.float32), np.zeros(n, np.float32),
+                                    1e-2, 0.9, 0.95, 1e-8, 0.01, adamw, 3)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(opt._m["w"], m_want, rtol=1e-5, atol=1e-7)
+
+
+def test_adam_bf16_grads_and_copy_out():
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.adam import DeepSpeedCPUAdam
+
+    rng = np.random.default_rng(1)
+    n = 1024
+    p = rng.standard_normal(n).astype(np.float32)
+    g32 = (0.01 * rng.standard_normal(n)).astype(np.float32)
+    # bf16 grads as uint16 words, matching a device-to-host transfer
+    g_bf16 = np.asarray(jnp.asarray(g32, jnp.bfloat16)).view(np.uint16)
+
+    opt = DeepSpeedCPUAdam(lr=1e-2)
+    got = p.copy()
+    out = np.empty(n, np.uint16)
+    opt.begin_step()
+    opt.step("w", got, g_bf16, param_out_bf16=out)
+
+    g_rounded = np.asarray(jnp.asarray(g_bf16.view(jnp.bfloat16), jnp.float32))
+    want, _, _ = ref_adam(p, g_rounded, np.zeros(n, np.float32), np.zeros(n, np.float32),
+                          1e-2, 0.9, 0.999, 1e-8, 0.0, True, 1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # copy-out must equal bf16(updated params)
+    back = np.asarray(jnp.asarray(out.view(jnp.bfloat16), jnp.float32))
+    np.testing.assert_allclose(back, want, rtol=1e-2, atol=1e-2)
+
+
+def test_adagrad_matches_reference():
+    from deepspeed_tpu.ops.adagrad import DeepSpeedCPUAdagrad
+
+    rng = np.random.default_rng(2)
+    n = 513
+    p = rng.standard_normal(n).astype(np.float32)
+    g = (0.1 * rng.standard_normal(n)).astype(np.float32)
+
+    opt = DeepSpeedCPUAdagrad(lr=1e-2, eps=1e-10)
+    got = p.copy()
+    for _ in range(2):
+        opt.begin_step()
+        opt.step("w", got, g)
+
+    want = p.copy()
+    h = np.zeros(n, np.float32)
+    for _ in range(2):
+        h = h + g * g
+        want = want - 1e-2 * g / (np.sqrt(h) + 1e-10)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_flatten_unflatten_roundtrip():
+    from deepspeed_tpu.ops import flatten_native
+
+    rng = np.random.default_rng(3)
+    tensors = [rng.standard_normal(s).astype(np.float32) for s in [(3, 4), (7,), (2, 2, 2)]]
+    flat = flatten_native.flatten(tensors)
+    assert flat.size == sum(t.size for t in tensors)
+    outs = flatten_native.unflatten(flat, [np.empty_like(t) for t in tensors])
+    for got, want in zip(outs, tensors):
+        np.testing.assert_array_equal(got, want)
+
+    dst = np.empty_like(flat)
+    flatten_native.memcpy(dst, flat)
+    np.testing.assert_array_equal(dst, flat)
