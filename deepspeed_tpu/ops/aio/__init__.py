@@ -1,0 +1,3 @@
+from deepspeed_tpu.ops.aio.aio_binding import AsyncIOHandle, aligned_array, padded_numel
+
+__all__ = ["AsyncIOHandle", "aligned_array", "padded_numel"]

@@ -1,0 +1,118 @@
+"""ctypes bindings for the native async I/O engine (csrc/aio.cpp).
+
+Reference parity: the ``aio_handle`` pybind surface
+(``csrc/aio/py_lib/py_ds_aio.cpp`` / ``deepspeed_py_aio_handle.cpp:14-40``):
+block_size/queue_depth/thread_count knobs, sync_/async_ pread/pwrite and
+``wait``. Queue depth and event overlap are subsumed by the thread pool.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops import native
+from deepspeed_tpu.ops.native import c_i64
+
+_configured = False
+ALIGN = 4096
+
+
+def _lib():
+    global _configured
+    lib = native.get_lib()
+    if not _configured:
+        lib.ds_aio_handle_new.argtypes = [c_i64, ctypes.c_int]
+        lib.ds_aio_handle_new.restype = ctypes.c_void_p
+        lib.ds_aio_handle_free.argtypes = [ctypes.c_void_p]
+        lib.ds_aio_pread.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p, c_i64]
+        lib.ds_aio_pwrite.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p, c_i64]
+        lib.ds_aio_wait.argtypes = [ctypes.c_void_p]
+        lib.ds_aio_wait.restype = c_i64
+        lib.ds_aio_inflight.argtypes = [ctypes.c_void_p]
+        lib.ds_aio_inflight.restype = c_i64
+        lib.ds_aio_last_errno.argtypes = [ctypes.c_void_p]
+        lib.ds_aio_last_errno.restype = ctypes.c_int
+        _configured = True
+    return lib
+
+
+def padded_numel(numel: int, dtype=np.float32) -> int:
+    """Element count after padding to the O_DIRECT block size."""
+    itemsize = np.dtype(dtype).itemsize
+    nbytes = numel * itemsize
+    return ((nbytes + ALIGN - 1) // ALIGN * ALIGN) // itemsize
+
+
+def aligned_array(numel: int, dtype=np.float32) -> np.ndarray:
+    """Allocate a 4096-byte-aligned numpy array padded up to the O_DIRECT
+    block size (reference pins + aligns its aio buffers,
+    ``csrc/aio/common/deepspeed_aio_utils.cpp``). The returned array holds
+    ``padded_numel(numel, dtype)`` elements; callers view ``[:numel]`` for the
+    logical tensor and hand the full array to the aio engine so transfers stay
+    block-aligned."""
+    dtype = np.dtype(dtype)
+    padded = padded_numel(numel, dtype) * dtype.itemsize
+    raw = np.zeros(padded + ALIGN, np.uint8)
+    offset = (-raw.ctypes.data) % ALIGN
+    return raw[offset:offset + padded].view(dtype)
+
+
+class AsyncIOHandle:
+    """Thread-pool async tensor I/O against a fast local SSD."""
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 32,
+                 single_submit: bool = False, overlap_events: bool = True,
+                 thread_count: int = 8):
+        self.block_size = block_size
+        self.thread_count = thread_count
+        # queue_depth/single_submit/overlap_events kept for config parity
+        self.queue_depth = queue_depth
+        self.single_submit = single_submit
+        self.overlap_events = overlap_events
+        self._h = _lib().ds_aio_handle_new(block_size, thread_count)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            try:
+                _lib().ds_aio_handle_free(h)
+            except Exception:
+                pass
+            self._h = None
+
+    def _ptr(self, arr: np.ndarray):
+        if not arr.flags["C_CONTIGUOUS"]:
+            raise ValueError("aio buffers must be C-contiguous")
+        return arr.ctypes.data_as(ctypes.c_void_p)
+
+    # --- async ---------------------------------------------------------- #
+    def async_pread(self, buffer: np.ndarray, filename: str) -> None:
+        _lib().ds_aio_pread(self._h, self._ptr(buffer), filename.encode(), buffer.nbytes)
+
+    def async_pwrite(self, buffer: np.ndarray, filename: str) -> None:
+        _lib().ds_aio_pwrite(self._h, self._ptr(buffer), filename.encode(), buffer.nbytes)
+
+    def wait(self) -> int:
+        """Block until all inflight I/O completes; raises on I/O errors."""
+        errors = _lib().ds_aio_wait(self._h)
+        if errors:
+            err = _lib().ds_aio_last_errno(self._h)
+            detail = f": {os.strerror(err)}" if err else ""
+            raise IOError(f"aio: {errors} chunk transfer(s) failed{detail}")
+        return 0
+
+    def inflight(self) -> int:
+        return _lib().ds_aio_inflight(self._h)
+
+    # --- sync ----------------------------------------------------------- #
+    def sync_pread(self, buffer: np.ndarray, filename: str) -> None:
+        self.async_pread(buffer, filename)
+        self.wait()
+
+    def sync_pwrite(self, buffer: np.ndarray, filename: str) -> None:
+        self.async_pwrite(buffer, filename)
+        self.wait()

@@ -79,6 +79,19 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None, cli
 
     ckpt_engine.save(tree, os.path.join(path, "state"))
 
+    # ZeRO-Offload: host optimizer state (fp32 masters + moments) is saved
+    # per-process as an npz next to the sharded device state (reference saves
+    # per-dp-rank zero files, engine.py:3136)
+    offload = getattr(engine, "_offload", None)
+    if offload is not None:
+        sd = offload.state_dict()
+        arrays = {}
+        for group in ("masters", "exp_avg", "exp_avg_sq"):
+            for k, v in sd.get(group, {}).items():
+                arrays[f"{group}|{k}"] = v
+        np.savez(os.path.join(path, f"offload_state_p{jax.process_index()}.npz"),
+                 step=sd.get("step", 0), lr=sd.get("lr", 0.0), **arrays)
+
     meta = {
         "tag": tag,
         "global_steps": int(state.global_steps),
@@ -166,6 +179,18 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None, loa
         leaves = [restored["opt_state_flat"][f"leaf_{i}"] for i in range(len(flat))]
         kwargs["opt_state"] = jax.tree.unflatten(treedef, leaves)
     engine.state = state._replace(**kwargs)
+
+    offload = getattr(engine, "_offload", None)
+    offload_path = os.path.join(path, f"offload_state_p{jax.process_index()}.npz")
+    if offload is not None and load_optimizer_states and not load_module_only and os.path.exists(offload_path):
+        with np.load(offload_path) as z:
+            sd = {"step": int(z["step"]), "lr": float(z["lr"]),
+                  "masters": {}, "exp_avg": {}, "exp_avg_sq": {}}
+            for name in z.files:
+                if "|" in name:
+                    group, key = name.split("|", 1)
+                    sd[group][key] = z[name]
+        offload.load_state_dict(sd)
 
     meta = {}
     meta_path = os.path.join(path, "meta.json")

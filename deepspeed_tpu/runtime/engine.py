@@ -199,9 +199,11 @@ class DeepSpeedEngine:
 
         # compiled functions, built lazily on first use
         self._train_batch_jit: Dict[Tuple, Callable] = {}
+        self._accum_batch_jit: Dict[Tuple, Callable] = {}
         self._grad_jit = None
         self._acc_jit = None
         self._apply_jit = None
+        self._reset_acc_jit = None
         self._eval_jit = None
         self._cached_grads = None
         self._losses = 0.0
@@ -233,19 +235,44 @@ class DeepSpeedEngine:
         self._param_shardings = param_sh
         self._grad_shardings = grad_sh
         self._master_shardings = master_sh
+        self._params_treedef = jax.tree.structure(model_parameters)
+
+        # ---- ZeRO-Offload: fp32 master + optimizer state live on host
+        # (or NVMe), stepped by the native cpu_adam; the device program only
+        # accumulates grads (reference stage_1_and_2.py:1030-1155, stage3
+        # PartitionedOptimizerSwapper) ----
+        self._offload = None
+        ocfg = self._config.zero_config.offload_optimizer
+        if ocfg is not None and ocfg.device != "none":
+            if self.client_optimizer is not None:
+                raise ValueError("offload_optimizer is incompatible with a client optax optimizer; "
+                                 "configure the optimizer via the config instead")
+            from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+            self._offload = HostOffloadOptimizer(
+                model_parameters,
+                optimizer_name=self._optimizer_name,
+                optimizer_params=self._config.optimizer_params,
+                device=str(ocfg.device.value if hasattr(ocfg.device, "value") else ocfg.device),
+                nvme_path=ocfg.nvme_path,
+                grad_clip=float(self.gradient_clipping() or 0.0))
+            log_dist(f"ZeRO-Offload: optimizer on {self._offload.device} "
+                     f"({len(self._offload.order)} tensors, native cpu_{self._optimizer_name})", ranks=[0])
 
         params = jax.tree.map(
             lambda a, s: jax.device_put(jnp.asarray(a, self.compute_dtype), s), model_parameters, param_sh)
-        if self.mixed_precision:
+        if self.mixed_precision and self._offload is None:
             master = jax.tree.map(
                 lambda a, s: jax.device_put(jnp.asarray(a, jnp.float32), s), model_parameters, master_sh)
         else:
             master = None
-        opt_target = master if master is not None else params
-        opt_state = self.tx.init(opt_target)
-        opt_sh = rules.opt_state_shardings(opt_state, model_parameters, tp_specs)
-        opt_state = jax.tree.map(lambda a, s: jax.device_put(a, s) if hasattr(a, "shape") else a,
-                                 opt_state, opt_sh)
+        if self._offload is None:
+            opt_target = master if master is not None else params
+            opt_state = self.tx.init(opt_target)
+            opt_sh = rules.opt_state_shardings(opt_state, model_parameters, tp_specs)
+            opt_state = jax.tree.map(lambda a, s: jax.device_put(a, s) if hasattr(a, "shape") else a,
+                                     opt_state, opt_sh)
+        else:
+            opt_state = ()
         acc_grads = jax.tree.map(
             lambda a, s: jax.device_put(jnp.zeros(a.shape, self.grad_acc_dtype), s), model_parameters, grad_sh)
 
@@ -337,6 +364,78 @@ class DeepSpeedEngine:
             global_steps=state.global_steps + 1,
             skipped_steps=state.skipped_steps + overflow.astype(jnp.int32))
 
+    def _build_accum_batch_fn(self, gas: int) -> Callable:
+        """GAS-scan only (offload path): grads accumulate on device, the
+        optimizer update happens on host in :meth:`_host_step`."""
+
+        def accum_batch_fn(state: TrainState, batch, rng):
+            scale = state.scaler.loss_scale
+
+            def micro(carry, mb):
+                acc, i = carry
+                mb_rng = jax.random.fold_in(rng, i)
+                loss, grads = self._micro_grads(state.params, mb, mb_rng, scale)
+                acc = self._accumulate(acc, grads)
+                return (acc, i + 1), loss
+
+            (acc, _), losses = jax.lax.scan(micro, (state.acc_grads, jnp.asarray(0, jnp.int32)), batch, length=gas)
+            state = state._replace(acc_grads=acc, micro_steps=state.micro_steps + gas)
+            return state, jnp.mean(losses)
+
+        return jax.jit(accum_batch_fn, donate_argnums=(0,))
+
+    def _host_step(self):
+        """Offload optimizer boundary: grads → host, native cpu_adam step,
+        updated bf16 params → device. Returns metrics."""
+        import ml_dtypes
+
+        gas = self.gradient_accumulation_steps()
+        scale = float(self.state.scaler.loss_scale) if self.fp16_enabled() else 1.0
+        denom = scale * gas
+        lr = float(self._lr_fn(self.state.global_steps))
+
+        from deepspeed_tpu.runtime.zero.offload import _leaf_key
+
+        # one tree-level D2H transfer (JAX batches/overlaps the copies)
+        host_grads_tree = jax.device_get(self.state.acc_grads)
+        grads_host: Dict[str, np.ndarray] = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(host_grads_tree)[0]:
+            arr = np.asarray(leaf).ravel()
+            if arr.dtype == ml_dtypes.bfloat16:
+                arr = arr.astype(np.float32)
+            grads_host[_leaf_key(path)] = np.ascontiguousarray(arr.astype(np.float32) / denom)
+
+        out_dtype = ml_dtypes.bfloat16 if self.compute_dtype == jnp.bfloat16 else np.float32
+        staged, overflow = self._offload.step(grads_host, lr, out_dtype=out_dtype)
+
+        if not overflow:
+            np_dtype = {jnp.bfloat16: ml_dtypes.bfloat16, jnp.float16: np.float16,
+                        jnp.float32: np.float32}[self.compute_dtype]
+            leaves = []
+            for key in self._offload.order:
+                flat = staged[key]
+                if flat.dtype == np.uint16:
+                    flat = flat.view(ml_dtypes.bfloat16)
+                leaves.append(flat.reshape(self._offload.shape(key)).astype(np_dtype, copy=False))
+            host_params = jax.tree.unflatten(self._params_treedef, leaves)
+            # one tree-level H2D transfer against the sharding tree
+            new_params = jax.device_put(host_params, self._param_shardings)
+        else:
+            new_params = self.state.params
+
+        if self._reset_acc_jit is None:
+            self._reset_acc_jit = jax.jit(lambda acc: jax.tree.map(jnp.zeros_like, acc), donate_argnums=(0,))
+        zero_acc = self._reset_acc_jit(self.state.acc_grads)
+        overflow_arr = jnp.asarray(overflow)
+        new_scaler = scaler_update(self.state.scaler, overflow_arr) if self.fp16_enabled() else self.state.scaler
+        self.state = self.state._replace(
+            params=new_params, acc_grads=zero_acc, scaler=new_scaler,
+            global_steps=self.state.global_steps + 1,
+            skipped_steps=self.state.skipped_steps + int(overflow))
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        return {"loss": self._losses, "lr": lr, "loss_scale": float(new_scaler.loss_scale)}
+
     def _build_train_batch_fn(self, gas: int) -> Callable:
         """Fused GAS-scan + update, one XLA program."""
 
@@ -394,14 +493,22 @@ class DeepSpeedEngine:
             spec = P(None, dp_axes if len(dp_axes) > 1 else dp_axes[0])
             batch = jax.tree.map(lambda x: jax.device_put(x, NamedSharding(self.mesh, spec)), batch)
 
-        fn = self._train_batch_jit.get(gas)
-        if fn is None:
-            fn = self._build_train_batch_fn(gas)
-            self._train_batch_jit[gas] = fn
-
         self.tput_timer.start()
         self._rng, step_rng = jax.random.split(self._rng)
-        self.state, metrics = fn(self.state, batch, step_rng)
+        if self._offload is not None:
+            fn = self._accum_batch_jit.get(gas)
+            if fn is None:
+                fn = self._build_accum_batch_fn(gas)
+                self._accum_batch_jit[gas] = fn
+            self.state, mean_loss = fn(self.state, batch, step_rng)
+            self._losses = mean_loss
+            metrics = self._host_step()
+        else:
+            fn = self._train_batch_jit.get(gas)
+            if fn is None:
+                fn = self._build_train_batch_fn(gas)
+                self._train_batch_jit[gas] = fn
+            self.state, metrics = fn(self.state, batch, step_rng)
         self.tput_timer.stop(global_step=True)
         self._write_monitor_events(metrics)
         self._report_progress(metrics)
@@ -457,6 +564,11 @@ class DeepSpeedEngine:
         """Apply the optimizer update at the accumulation boundary
         (no-op otherwise, matching reference engine.py:1990)."""
         if not self.is_gradient_accumulation_boundary():
+            return
+        if self._offload is not None:
+            metrics = self._host_step()
+            self._write_monitor_events(metrics)
+            self._report_progress(metrics)
             return
         if self._apply_jit is None:
             gas = self.gradient_accumulation_steps()

@@ -1,0 +1,5 @@
+from deepspeed_tpu.runtime.swap_tensor.async_swapper import AsyncTensorSwapper
+from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import PartitionedOptimizerSwapper
+from deepspeed_tpu.runtime.swap_tensor.partitioned_param_swapper import AsyncPartitionedParameterSwapper
+
+__all__ = ["AsyncTensorSwapper", "PartitionedOptimizerSwapper", "AsyncPartitionedParameterSwapper"]

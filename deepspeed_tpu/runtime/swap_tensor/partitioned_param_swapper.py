@@ -1,0 +1,65 @@
+"""NVMe swapping of parameter partitions (ZeRO-Infinity param offload).
+
+Reference parity: ``deepspeed/runtime/swap_tensor/partitioned_param_swapper.py:35``
+(``AsyncPartitionedParameterSwapper``) — bf16 parameter partitions stream
+between NVMe and host staging buffers; prefetch hides read latency behind
+compute on the layers still resident.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.runtime.swap_tensor.async_swapper import AsyncTensorSwapper
+
+
+class AsyncPartitionedParameterSwapper:
+    def __init__(self, swap_dir: str, aio_config: Optional[dict] = None):
+        aio_config = aio_config or {}
+        self.swapper = AsyncTensorSwapper(
+            swap_dir,
+            block_size=aio_config.get("block_size", 1 << 20),
+            thread_count=aio_config.get("thread_count", 8),
+        )
+        self._available: Dict[str, np.ndarray] = {}   # key -> padded host buffer
+        self._prefetching: Dict[str, np.ndarray] = {}
+
+    # ------------------------------------------------------------------ #
+    def swap_out_and_release(self, key: str, tensor: np.ndarray) -> None:
+        """Persist a param partition to NVMe and drop its host buffer."""
+        self.swapper.swap_out(key, tensor, async_op=True)
+        self._available.pop(key, None)
+
+    def prefetch(self, key: str) -> None:
+        """Kick off an async read; :meth:`get` will pick it up."""
+        if key in self._available or key in self._prefetching:
+            return
+        self._prefetching[key] = self.swapper.swap_in(key, async_op=True)
+
+    def get(self, key: str) -> np.ndarray:
+        """Return the logical tensor for ``key``, waiting on (or issuing) its
+        read as needed."""
+        if key not in self._available:
+            if key not in self._prefetching:
+                self.prefetch(key)
+            self.swapper.wait()
+            for k, buf in self._prefetching.items():
+                self._available[k] = buf
+            self._prefetching.clear()
+        return self._available[key][:self.swapper.numel(key)]
+
+    def release(self, key: str) -> None:
+        buf = self._available.pop(key, None)
+        if buf is not None:
+            self.swapper.release_buffer(buf)
+
+    def available_keys(self) -> List[str]:
+        return sorted(self._available)
+
+    def wait(self) -> None:
+        self.swapper.wait()
+        for k, buf in self._prefetching.items():
+            self._available[k] = buf
+        self._prefetching.clear()

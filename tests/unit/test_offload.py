@@ -1,0 +1,137 @@
+"""ZeRO-Offload: host cpu_adam optimizer path (cpu + nvme devices).
+
+Mirrors the reference's offload coverage in tests/unit/runtime/zero
+(cpu_offload configs) — update parity vs the in-device optimizer, loss
+descent, NVMe swapping, and checkpoint round-trip of host state.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.ops import native
+
+from .simple_model import SimpleModel, random_batch
+
+HIDDEN = 16
+
+pytestmark = pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+
+
+def make_engine(offload=None, precision=None, stage=1, gas=1, micro_bs=4, lr=1e-2):
+    dist.set_mesh(None)
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": {"lr": lr, "weight_decay": 0.01}},
+        "zero_optimization": {"stage": stage},
+        "mesh": {"dp": -1},
+        "steps_per_print": 0,
+    }
+    if offload:
+        cfg["zero_optimization"]["offload_optimizer"] = offload
+    if precision == "bf16":
+        cfg["bf16"] = {"enabled": True}
+    model = SimpleModel(hidden_dim=HIDDEN)
+    params = model.init_params(jax.random.key(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=cfg)
+    return engine
+
+
+def batch_for(engine, seed=0):
+    dp = dist.get_world_size(dist.data_parallel_axes(engine.mesh))
+    bs = engine.train_micro_batch_size_per_gpu() * engine.gradient_accumulation_steps() * dp
+    return random_batch(bs, HIDDEN, seed=seed)
+
+
+def test_cpu_offload_matches_device_optimizer():
+    """fp32 offloaded AdamW must track the in-device optax AdamW closely."""
+    e_dev = make_engine(offload=None)
+    e_off = make_engine(offload={"device": "cpu"})
+    for step in range(5):
+        b = batch_for(e_dev, seed=step)
+        e_dev.train_batch(b)
+        e_off.train_batch(b)
+    p_dev = jax.tree.leaves(jax.tree.map(np.asarray, e_dev.state.params))
+    p_off = jax.tree.leaves(jax.tree.map(np.asarray, e_off.state.params))
+    for a, b_ in zip(p_dev, p_off):
+        np.testing.assert_allclose(a, b_, rtol=2e-4, atol=2e-5)
+
+
+def test_cpu_offload_bf16_loss_descends():
+    e = make_engine(offload={"device": "cpu"}, precision="bf16", gas=2)
+    b = batch_for(e, seed=0)
+    losses = [float(e.train_batch(b)) for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.7
+    assert e.global_steps == 20
+    # offload path must not allocate device master/opt state
+    assert e.state.master is None and e.state.opt_state == ()
+
+
+def test_nvme_offload_loss_descends(tmp_path):
+    e = make_engine(offload={"device": "nvme", "nvme_path": str(tmp_path / "swap")})
+    b = batch_for(e, seed=0)
+    losses = [float(e.train_batch(b)) for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.7
+    # optimizer state actually lives on NVMe
+    import os
+    swp = [f for f in os.listdir(tmp_path / "swap") if f.endswith(".swp")]
+    assert len(swp) == 3 * len(e._offload.order)
+
+
+def test_offload_checkpoint_roundtrip(tmp_path):
+    e = make_engine(offload={"device": "cpu"}, precision="bf16")
+    for s in range(3):
+        e.train_batch(batch_for(e, seed=s))
+    masters_before = {k: v.copy() for k, v in e._offload.masters().items()}
+    e.save_checkpoint(str(tmp_path / "ckpt"))
+
+    e2 = make_engine(offload={"device": "cpu"}, precision="bf16")
+    e2.load_checkpoint(str(tmp_path / "ckpt"))
+    assert e2.global_steps == 3
+    for k, v in e2._offload.masters().items():
+        np.testing.assert_allclose(v, masters_before[k], rtol=1e-6)
+    # training continues from the restored state
+    e2.train_batch(batch_for(e2, seed=99))
+    assert e2.global_steps == 4
+
+
+def test_nvme_offload_checkpoint_roundtrip(tmp_path):
+    """Moments and masters must survive a save/load through the NVMe swap
+    files (not just the host-resident path)."""
+    e = make_engine(offload={"device": "nvme", "nvme_path": str(tmp_path / "swapA")})
+    b = batch_for(e, seed=0)
+    for _ in range(3):
+        e.train_batch(b)
+    sd_before = e._offload.state_dict()
+    assert sd_before["step"] == 3
+    # moments must be non-zero after real steps (catches aliased/zeroed saves)
+    assert any(np.abs(v).max() > 0 for v in sd_before["exp_avg"].values())
+    e.save_checkpoint(str(tmp_path / "ckpt"))
+
+    e2 = make_engine(offload={"device": "nvme", "nvme_path": str(tmp_path / "swapB")})
+    e2.load_checkpoint(str(tmp_path / "ckpt"))
+    sd_after = e2._offload.state_dict()
+    for k in sd_before["masters"]:
+        np.testing.assert_allclose(sd_after["masters"][k], sd_before["masters"][k], rtol=1e-6)
+        np.testing.assert_allclose(sd_after["exp_avg"][k], sd_before["exp_avg"][k], rtol=1e-6)
+        np.testing.assert_allclose(sd_after["exp_avg_sq"][k], sd_before["exp_avg_sq"][k], rtol=1e-6)
+    # resumed training matches continued training step-for-step
+    l1 = float(e.train_batch(b))
+    l2 = float(e2.train_batch(b))
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_offload_rejects_client_optimizer():
+    import optax
+    dist.set_mesh(None)
+    model = SimpleModel(hidden_dim=HIDDEN)
+    params = model.init_params(jax.random.key(0))
+    with pytest.raises(ValueError, match="offload_optimizer"):
+        deepspeed_tpu.initialize(
+            model=model, model_parameters=params, optimizer=optax.adam(1e-3),
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "zero_optimization": {"stage": 1, "offload_optimizer": {"device": "cpu"}},
+                    "mesh": {"dp": -1}, "steps_per_print": 0})
