@@ -119,9 +119,7 @@ class MoECausalLM:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
         if cfg.pos_embedding == "learned":
             x = x + params["embed"]["positions"][:S][None, :, :]
-        mask_bias = None
-        if attn_mask is not None:
-            mask_bias = jnp.where(attn_mask[:, None, None, :] > 0, 0.0, -1e9).astype(jnp.float32)
+        mask_bias = T.key_mask_bias(attn_mask)
         # No rng means no stochastic routing: RTS/Jitter would otherwise draw
         # the same permutation every step from a constant key, silently biasing
         # which tokens get dropped at capacity (top1gating's own rng=None path

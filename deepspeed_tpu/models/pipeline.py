@@ -73,9 +73,7 @@ class PipelinedCausalLM(CausalLM):
         cfg = self.config
         B, S, D = x.shape
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
-        mask_bias = None
-        if "attention_mask" in aux:
-            mask_bias = jnp.where(aux["attention_mask"][:, None, None, :] > 0, 0.0, -1e9).astype(jnp.float32)
+        mask_bias = T.key_mask_bias(aux.get("attention_mask"))
 
         def run_block(h, lp):
             return T.block(cfg, h, lp, positions, mask_bias), None

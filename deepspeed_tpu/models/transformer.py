@@ -50,6 +50,8 @@ class TransformerConfig:
     # memory
     remat: bool = True                   # activation checkpointing per layer
     scan_layers: bool = True
+    # sequence/context parallelism over the "sp" mesh axis
+    sequence_parallel: str = "none"      # none | ring | ulysses
     # init
     init_std: float = 0.02
 
@@ -198,6 +200,15 @@ def _alibi_slopes(n_head: int):
     return jnp.asarray([start**(i + 1) for i in range(n_head)], jnp.float32)
 
 
+def key_mask_bias(attn_mask):
+    """[B, S] 1=keep attention mask → additive key-side bias [B, S]
+    (0 keep / -1e9 drop); None passes through. Single producer for every
+    attention path (dense, ring, ulysses)."""
+    if attn_mask is None:
+        return None
+    return jnp.where(attn_mask > 0, 0.0, -1e9).astype(jnp.float32)
+
+
 def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
     """Einsum-form multi-head attention; XLA maps the batched matmuls onto
     the MXU and fuses softmax. (A Pallas flash-attention kernel can be slotted
@@ -218,11 +229,34 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
 
-    from deepspeed_tpu.ops.attention import mha_attention
-    out = mha_attention(q, k, v, mask_bias=mask_bias, causal=cfg.causal,
-                        alibi_slopes=_alibi_slopes(H) if cfg.pos_embedding == "alibi" else None)
+    slopes = _alibi_slopes(H) if cfg.pos_embedding == "alibi" else None
+
+    sp_mesh = _sp_mesh(cfg)
+    if sp_mesh is not None:
+        from deepspeed_tpu.sequence import sp_attention
+        out = sp_attention(q, k, v, mesh=sp_mesh, impl=cfg.sequence_parallel,
+                           causal=cfg.causal, mask_bias=mask_bias, alibi_slopes=slopes)
+    else:
+        from deepspeed_tpu.ops.attention import mha_attention
+        out = mha_attention(q, k, v,
+                            mask_bias=None if mask_bias is None else mask_bias[:, None, None, :],
+                            causal=cfg.causal, alibi_slopes=slopes)
     out = out.reshape(B, S, H * Hd)
     return out @ lp["wo"]
+
+
+def _sp_mesh(cfg: TransformerConfig):
+    """The active mesh when sequence parallelism is configured AND the mesh
+    carries an sp axis of size > 1; else None (dense attention)."""
+    if cfg.sequence_parallel == "none":
+        return None
+    import deepspeed_tpu.comm as dist
+    if not dist.has_mesh():
+        return None
+    mesh = dist.get_mesh()
+    if "sp" in mesh.shape and mesh.shape["sp"] > 1:
+        return mesh
+    return None
 
 
 def mlp(cfg: TransformerConfig, x, lp):
@@ -251,10 +285,7 @@ def forward(cfg: TransformerConfig, params, tokens, attn_mask=None):
     if cfg.pos_embedding == "learned":
         x = x + params["embed"]["positions"][:S][None, :, :]
 
-    mask_bias = None
-    if attn_mask is not None:
-        # [B, S] 1=keep → additive bias [B, 1, 1, S]
-        mask_bias = jnp.where(attn_mask[:, None, None, :] > 0, 0.0, -1e9).astype(jnp.float32)
+    mask_bias = key_mask_bias(attn_mask)
 
     layer_params = params["layers"]
 

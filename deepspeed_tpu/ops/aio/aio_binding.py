@@ -74,6 +74,7 @@ class AsyncIOHandle:
         self.single_submit = single_submit
         self.overlap_events = overlap_events
         self._h = _lib().ds_aio_handle_new(block_size, thread_count)
+        self._pinned: list = []  # buffers referenced by inflight C++ I/O
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -91,14 +92,18 @@ class AsyncIOHandle:
 
     # --- async ---------------------------------------------------------- #
     def async_pread(self, buffer: np.ndarray, filename: str) -> None:
+        # retain the buffer until wait(): worker threads hold raw pointers
+        self._pinned.append(buffer)
         _lib().ds_aio_pread(self._h, self._ptr(buffer), filename.encode(), buffer.nbytes)
 
     def async_pwrite(self, buffer: np.ndarray, filename: str) -> None:
+        self._pinned.append(buffer)
         _lib().ds_aio_pwrite(self._h, self._ptr(buffer), filename.encode(), buffer.nbytes)
 
     def wait(self) -> int:
         """Block until all inflight I/O completes; raises on I/O errors."""
         errors = _lib().ds_aio_wait(self._h)
+        self._pinned.clear()
         if errors:
             err = _lib().ds_aio_last_errno(self._h)
             detail = f": {os.strerror(err)}" if err else ""

@@ -401,9 +401,10 @@ class DeepSpeedEngine:
         grads_host: Dict[str, np.ndarray] = {}
         for path, leaf in jax.tree_util.tree_flatten_with_path(host_grads_tree)[0]:
             arr = np.asarray(leaf).ravel()
-            if arr.dtype == ml_dtypes.bfloat16:
-                arr = arr.astype(np.float32)
-            grads_host[_leaf_key(path)] = np.ascontiguousarray(arr.astype(np.float32) / denom)
+            # one conversion, one divide: .astype copies, then /= is in-place
+            arr = arr.astype(np.float32)
+            arr /= denom
+            grads_host[_leaf_key(path)] = np.ascontiguousarray(arr)
 
         out_dtype = ml_dtypes.bfloat16 if self.compute_dtype == jnp.bfloat16 else np.float32
         staged, overflow = self._offload.step(grads_host, lr, out_dtype=out_dtype)
@@ -490,8 +491,21 @@ class DeepSpeedEngine:
         # shard the batch over the data axes
         dp_axes = tuple(dist.data_parallel_axes(self.mesh))
         if dp_axes:
-            spec = P(None, dp_axes if len(dp_axes) > 1 else dp_axes[0])
-            batch = jax.tree.map(lambda x: jax.device_put(x, NamedSharding(self.mesh, spec)), batch)
+            bat = dp_axes if len(dp_axes) > 1 else dp_axes[0]
+            sp = "sp" if ("sp" in self.mesh.shape and self.mesh.shape["sp"] > 1) else None
+
+            sp_size = self.mesh.shape["sp"] if sp else 0
+
+            def shard_leaf(x):
+                # [gas, B, ...]; seq dim (2) additionally sharded over sp
+                # when it divides evenly (non-sequence leaves fall back to dp-only)
+                if sp and x.ndim >= 3 and x.shape[2] % sp_size == 0:
+                    spec = P(None, bat, sp)
+                else:
+                    spec = P(None, bat)
+                return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+            batch = jax.tree.map(shard_leaf, batch)
 
         self.tput_timer.start()
         self._rng, step_rng = jax.random.split(self._rng)

@@ -112,7 +112,9 @@ class HostOffloadOptimizer:
         overflow = False
         for g in grads.values():
             gf = g.view(ml_dtypes.bfloat16) if g.dtype == np.uint16 else g
-            if not np.isfinite(np.sum(gf.astype(np.float32))):
+            # float64 accumulator: no copy of gf, and no fp32-sum overflow
+            # false-positives on large tensors
+            if not np.isfinite(np.sum(gf, dtype=np.float64)):
                 overflow = True
                 break
         if overflow:

@@ -1,0 +1,51 @@
+"""Sequence / context parallelism (long-context training & inference).
+
+The reference at this version has no sequence-parallel path (verified in
+SURVEY.md §2.3: no Ulysses/ring/context-parallel in ``deepspeed/``) and
+serves long sequences with block-sparse attention and activation-checkpoint
+offload instead. The TPU build provides SP as a first-class mesh axis
+(``sp``) with two interchangeable attention programs:
+
+- :func:`ring_attention` — blockwise flash attention whose K/V blocks rotate
+  around the ``sp`` ring with ``lax.ppermute`` (communication hidden behind
+  each block's matmuls). Memory per chip is O(S/sp); no single device ever
+  materialises the full sequence. This is the TPU-idiomatic equivalent of
+  the later reference versions' ring/"DistributedAttention" designs and of
+  the blocksparse "scale to long sequences" capability
+  (``deepspeed/ops/sparse_attention/``).
+- :func:`ulysses_attention` — all-to-all head↔sequence re-sharding around a
+  dense local attention (DeepSpeed-Ulysses style): seq-sharded activations
+  become head-sharded just for the attention core, so each chip computes
+  full-sequence attention for H/sp heads.
+
+Both are pure ``shard_map`` programs over the global mesh: batch/head dims
+stay auto-sharded (dp/tp compose transparently via partial-auto mode).
+"""
+
+from deepspeed_tpu.sequence.ring import ring_attention, ring_attention_local
+from deepspeed_tpu.sequence.ulysses import ulysses_attention, ulysses_attention_local
+
+__all__ = [
+    "ring_attention",
+    "ring_attention_local",
+    "ulysses_attention",
+    "ulysses_attention_local",
+    "sp_attention",
+]
+
+
+def sp_attention(q, k, v, *, mesh, impl: str = "ring", axis: str = "sp", causal: bool = True,
+                 mask_bias=None, alibi_slopes=None, scale=None):
+    """Dispatch to the configured sequence-parallel attention implementation.
+
+    q, k, v: GLOBAL-shaped [B, S, H, Hd] arrays (under jit, logically sharded
+    over ``axis`` on the sequence dim). mask_bias: optional additive [B, S]
+    key-side bias (0 keep / -1e9 drop).
+    """
+    if impl == "ring":
+        return ring_attention(q, k, v, mesh=mesh, axis=axis, causal=causal,
+                              mask_bias=mask_bias, alibi_slopes=alibi_slopes, scale=scale)
+    if impl in ("ulysses", "all_to_all", "alltoall"):
+        return ulysses_attention(q, k, v, mesh=mesh, axis=axis, causal=causal,
+                                 mask_bias=mask_bias, alibi_slopes=alibi_slopes, scale=scale)
+    raise ValueError(f"Unknown sequence-parallel impl {impl!r} (expected 'ring' or 'ulysses')")

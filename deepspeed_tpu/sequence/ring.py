@@ -1,0 +1,121 @@
+"""Ring attention over the ``sp`` mesh axis.
+
+Flash-style streaming softmax over K/V blocks that rotate around the ring
+with ``lax.ppermute``: at ring step ``s`` a device holding query block ``i``
+attends to key/value block ``(i - s) mod sp``. The running (max, sum, out)
+accumulators make the result exactly equal to full softmax attention while
+every chip only ever holds S/sp keys — O(S/sp) memory and ppermute traffic
+that XLA overlaps with each step's matmuls on the MXU.
+
+Causality is handled per block-pair from *global* positions (query block i,
+key block j: j>i fully masked, j==i triangular, j<i dense), so the math
+matches :func:`deepspeed_tpu.ops.attention.mha_attention` bit-for-bit in
+fp32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e9  # matches ops.attention masking constant
+
+
+def ring_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=None,
+                         alibi_slopes=None, scale: Optional[float] = None):
+    """Per-shard body (call inside ``shard_map`` over ``axis``).
+
+    q, k, v: LOCAL [B, Sq, H, Hd] / [B, Sk, H, Hd] blocks; mask_bias: local
+    additive key mask [B, Sk] or None. Returns local [B, Sq, H, Hd].
+    """
+    B, Sq, H, Hd = q.shape
+    Sk = k.shape[1]
+    sp = jax.lax.axis_size(axis)
+    my_block = jax.lax.axis_index(axis)
+    scale = scale if scale is not None else Hd**-0.5
+
+    q32 = q.astype(jnp.float32)
+    qpos = my_block * Sq + jnp.arange(Sq)  # global query positions
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(carry, s):
+        kb, vb, maskb, m, l, o = carry
+        kv_block = (my_block - s) % sp
+        kvpos = kv_block * Sk + jnp.arange(Sk)
+
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, kb.astype(jnp.float32),
+                            preferred_element_type=jnp.float32) * scale
+        if alibi_slopes is not None:
+            dist = (kvpos[None, :] - qpos[:, None]).astype(jnp.float32)
+            logits = logits + alibi_slopes[None, :, None, None] * dist[None, None, :, :]
+        if causal:
+            logits = jnp.where((qpos[:, None] >= kvpos[None, :])[None, None], logits, _NEG_INF)
+        if maskb is not None:
+            logits = logits + maskb[:, None, None, :]
+
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[..., None])
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vb.astype(jnp.float32),
+                                                  preferred_element_type=jnp.float32)
+
+        kb = jax.lax.ppermute(kb, axis, perm)
+        vb = jax.lax.ppermute(vb, axis, perm)
+        if maskb is not None:
+            maskb = jax.lax.ppermute(maskb, axis, perm)
+        return (kb, vb, maskb, m_new, l_new, o_new), None
+
+    m0 = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    o0 = jnp.zeros((B, H, Sq, Hd), jnp.float32)
+    (_, _, _, m, l, o), _ = jax.lax.scan(step, (k, v, mask_bias, m0, l0, o0),
+                                         jnp.arange(sp))
+
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_program(mesh, axis: str, causal: bool, has_mask: bool, has_alibi: bool,
+                  scale: Optional[float]):
+    """Build + jit the shard_map program once per (mesh, static-arg) combo so
+    eager callers hit the jit cache instead of recompiling per call."""
+    qkv_spec = P(None, axis, None, None)
+    in_specs = [qkv_spec, qkv_spec, qkv_spec]
+    if has_mask:
+        in_specs.append(P(None, axis))
+    if has_alibi:
+        in_specs.append(P(None))  # replicated [H] slopes
+
+    def body(*xs):
+        qq, kk, vv = xs[:3]
+        rest = list(xs[3:])
+        mb = rest.pop(0) if has_mask else None
+        slopes = rest.pop(0) if has_alibi else None
+        return ring_attention_local(qq, kk, vv, axis=axis, causal=causal, mask_bias=mb,
+                                    alibi_slopes=slopes, scale=scale)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs), out_specs=qkv_spec,
+                       axis_names={axis}, check_vma=False)
+    # partial-auto shard_map must run under jit; nested jit inlines when traced
+    return jax.jit(fn)
+
+
+def ring_attention(q, k, v, *, mesh, axis: str = "sp", causal: bool = True, mask_bias=None,
+                   alibi_slopes=None, scale: Optional[float] = None):
+    """Global-view ring attention: shard_map over ``axis`` (seq dim), all
+    other dims (batch→dp, heads→tp) stay auto-sharded."""
+    args = [q, k, v]
+    if mask_bias is not None:
+        args.append(mask_bias)
+    if alibi_slopes is not None:
+        args.append(jnp.asarray(alibi_slopes))
+    fn = _ring_program(mesh, axis, causal, mask_bias is not None, alibi_slopes is not None,
+                       scale)
+    return fn(*args)

@@ -1,0 +1,98 @@
+"""DeepSpeed-Ulysses-style sequence parallelism: all-to-all head↔seq.
+
+Around the attention core, seq-sharded q/k/v [B, S/sp, H, Hd] are re-sharded
+with ``lax.all_to_all`` into head-sharded [B, S, H/sp, Hd]; each chip then
+runs ordinary dense attention for its H/sp heads over the FULL sequence, and
+a second all-to-all restores sequence sharding. Communication volume is
+O(B·S·D/sp) per direction — the all-to-alls ride ICI on the innermost mesh
+axes.
+
+Reference analogue: none at this version (SURVEY.md §2.3 — SP absent);
+this implements the capability the reference later shipped as
+``DistributedAttention``, expressed as XLA collectives instead of NCCL
+all-to-alls.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=None,
+                            alibi_slopes=None, scale: Optional[float] = None):
+    """Per-shard body (inside ``shard_map`` over ``axis``).
+
+    q [B, Sq_loc, H, Hd], k/v [B, Sk_loc, H_or_KV, Hd], mask_bias local
+    [B, Sk_loc] additive. H must be divisible by the axis size.
+    """
+    sp = jax.lax.axis_size(axis)
+    H = q.shape[2]
+    if H % sp != 0:
+        raise ValueError(f"Ulysses SP needs heads ({H}) divisible by sp axis size ({sp})")
+
+    # seq-sharded -> head-sharded (gather seq, scatter heads)
+    def to_heads(x):
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    if mask_bias is not None:
+        mask_bias = jax.lax.all_gather(mask_bias, axis, axis=1, tiled=True)  # [B, S]
+
+    my = jax.lax.axis_index(axis)
+    slopes = None
+    if alibi_slopes is not None:
+        h_loc = H // sp
+        slopes = jax.lax.dynamic_slice_in_dim(alibi_slopes, my * h_loc, h_loc)
+
+    from deepspeed_tpu.ops.attention import mha_attention
+    out = mha_attention(qh, kh, vh,
+                        mask_bias=None if mask_bias is None else mask_bias[:, None, None, :],
+                        causal=causal, alibi_slopes=slopes, scale=scale)
+
+    # head-sharded -> seq-sharded (gather heads, scatter seq)
+    return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+@functools.lru_cache(maxsize=64)
+def _ulysses_program(mesh, axis: str, causal: bool, has_mask: bool, has_alibi: bool,
+                     scale: Optional[float]):
+    """Build + jit the shard_map program once per (mesh, static-arg) combo so
+    eager callers hit the jit cache instead of recompiling per call."""
+    qkv_spec = P(None, axis, None, None)
+    in_specs = [qkv_spec, qkv_spec, qkv_spec]
+    if has_mask:
+        in_specs.append(P(None, axis))
+    if has_alibi:
+        in_specs.append(P(None))  # replicated [H] slopes
+
+    def body(*xs):
+        qq, kk, vv = xs[:3]
+        rest = list(xs[3:])
+        mb = rest.pop(0) if has_mask else None
+        slopes = rest.pop(0) if has_alibi else None
+        return ulysses_attention_local(qq, kk, vv, axis=axis, causal=causal, mask_bias=mb,
+                                       alibi_slopes=slopes, scale=scale)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs), out_specs=qkv_spec,
+                       axis_names={axis}, check_vma=False)
+    # partial-auto shard_map must run under jit; nested jit inlines when traced
+    return jax.jit(fn)
+
+
+def ulysses_attention(q, k, v, *, mesh, axis: str = "sp", causal: bool = True, mask_bias=None,
+                      alibi_slopes=None, scale: Optional[float] = None):
+    """Global-view Ulysses attention: shard_map over ``axis`` only; batch and
+    head dims stay auto-sharded (dp/tp compose via partial-auto)."""
+    args = [q, k, v]
+    if mask_bias is not None:
+        args.append(mask_bias)
+    if alibi_slopes is not None:
+        args.append(jnp.asarray(alibi_slopes))
+    fn = _ulysses_program(mesh, axis, causal, mask_bias is not None, alibi_slopes is not None,
+                          scale)
+    return fn(*args)
