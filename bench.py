@@ -22,11 +22,12 @@ def main():
     import deepspeed_tpu.comm as dist
     from deepspeed_tpu.models import gpt2
 
-    BATCH = int(os.environ.get("BENCH_BATCH", 8))
+    BATCH = int(os.environ.get("BENCH_BATCH", 32))
     SEQ = int(os.environ.get("BENCH_SEQ", 1024))
     STEPS = int(os.environ.get("BENCH_STEPS", 10))
 
-    model = gpt2("125m")
+    REMAT = os.environ.get("BENCH_REMAT", "1") == "1"
+    model = gpt2("125m", remat=REMAT)
     params = model.init_params(jax.random.key(0))
 
     dist.set_mesh(None)
@@ -46,14 +47,15 @@ def main():
     def batch(seed):
         return {"input_ids": rng.integers(0, 50257, size=(BATCH, SEQ)).astype(np.int32)}
 
-    # warmup/compile
-    engine.train_batch(batch(0))
-    jax.effects_barrier()
+    # warmup/compile; float() forces a host fetch — the only reliable sync
+    # point over remote-tunnel device transports (block_until_ready/
+    # effects_barrier return before remote execution finishes)
+    float(engine.train_batch(batch(0)))
 
     t0 = time.perf_counter()
     for i in range(STEPS):
         loss = engine.train_batch(batch(i + 1))
-    jax.effects_barrier()
+    loss_val = float(loss)  # chained state => this syncs every step
     dt = time.perf_counter() - t0
 
     tokens_per_sec = BATCH * SEQ * STEPS / dt
@@ -71,7 +73,7 @@ def main():
         "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": f"tokens/s (bf16, bs{BATCH}xseq{SEQ}, ZeRO-1, {kind}, "
-                f"{achieved_tflops:.1f} TFLOPs, MFU {mfu:.3f}, loss {float(loss):.3f})",
+                f"{achieved_tflops:.1f} TFLOPs, MFU {mfu:.3f}, loss {loss_val:.3f})",
         "vs_baseline": round(mfu / 0.50, 3),
     }))
 

@@ -52,6 +52,8 @@ class TransformerConfig:
     scan_layers: bool = True
     # sequence/context parallelism over the "sp" mesh axis
     sequence_parallel: str = "none"      # none | ring | ulysses
+    # attention kernel: auto = Pallas flash on TPU, XLA einsum elsewhere
+    attention_backend: str = "auto"      # auto | flash | xla
     # init
     init_std: float = 0.02
 
@@ -236,6 +238,10 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
         from deepspeed_tpu.sequence import sp_attention
         out = sp_attention(q, k, v, mesh=sp_mesh, impl=cfg.sequence_parallel,
                            causal=cfg.causal, mask_bias=mask_bias, alibi_slopes=slopes)
+    elif _use_flash(cfg):
+        from deepspeed_tpu.ops.pallas import flash_attention
+        out = flash_attention(q, k, v, mask_bias=mask_bias, causal=cfg.causal,
+                              alibi_slopes=slopes)
     else:
         from deepspeed_tpu.ops.attention import mha_attention
         out = mha_attention(q, k, v,
@@ -243,6 +249,28 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
                             causal=cfg.causal, alibi_slopes=slopes)
     out = out.reshape(B, S, H * Hd)
     return out @ lp["wo"]
+
+
+def _use_flash(cfg: TransformerConfig) -> bool:
+    """Pallas flash attention is a per-shard kernel: XLA cannot partition a
+    pallas_call inside a multi-device auto-sharded program, so fall back to
+    the einsum form whenever the active mesh spans >1 device. (Multi-device
+    long-context runs should use ``sequence_parallel`` — sharded streaming
+    attention via shard_map.)"""
+    if cfg.attention_backend not in ("flash", "auto"):
+        return False
+    import deepspeed_tpu.comm as dist
+    if dist.has_mesh() and dist.get_mesh().devices.size > 1:
+        if cfg.attention_backend == "flash":
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning("attention_backend='flash' on a >1-device mesh: "
+                           "falling back to XLA einsum attention (pallas_call "
+                           "is not partitionable; use sequence_parallel='ring' "
+                           "for sharded O(S/sp)-memory attention)")
+        return False
+    if cfg.attention_backend == "flash":
+        return True
+    return jax.default_backend() == "tpu"
 
 
 def _sp_mesh(cfg: TransformerConfig):

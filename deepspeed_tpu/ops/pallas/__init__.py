@@ -1,0 +1,15 @@
+"""Pallas TPU kernels — the device-side native-op tranche.
+
+TPU-native replacements for the reference's CUDA kernel families
+(SURVEY.md §2.2): attention/softmax (``csrc/transformer/softmax_kernels.cu``,
+inference ``softmax_context``) → :mod:`flash_attention`; quantization with
+stochastic rounding (``csrc/quantization/``) → :mod:`quantization`; fused
+optimizer step (``csrc/adam/multi_tensor_adam.cu``) → :mod:`fused_adam`.
+
+Every kernel runs compiled on TPU and in interpreter mode on CPU (that is
+what the unit suite exercises); the wrappers pick automatically.
+"""
+
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,328 @@
+"""Pallas flash attention for TPU (training: forward + custom-VJP backward).
+
+Blockwise streaming-softmax attention that never materialises the [S, S]
+score matrix: HBM traffic is O(S·Hd) instead of O(S²), q/k/v blocks are
+DMA'd into VMEM by the pallas pipeline and every matmul lands on the MXU.
+Replaces the reference's fused CUDA attention/softmax kernels
+(``csrc/transformer/softmax_kernels.cu``, training layer
+``csrc/transformer/ds_transformer_cuda.cpp``; inference ``softmax_context``
+in ``csrc/transformer/inference/csrc/pt_binding.cpp``) with the
+TPU-idiomatic design.
+
+Grid layout (forward): ``(B, H, Sq/bq, Sk/bk)`` — the kv dimension is
+innermost, so the (m, l, acc) running-softmax state lives in VMEM scratch
+across kv steps and the output block is written once on the last step.
+Backward recomputes p from the saved logsumexp (no S² residuals): one
+kernel accumulates dq over kv blocks, a second accumulates dk/dv over q
+blocks.
+
+Supports causal masking, an additive key-side mask bias [B, S], and ALiBi
+slopes. Runs compiled on TPU, interpreted elsewhere (CPU unit tests).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_MASKED = -1e30  # large-negative for masked logits (exp underflows to 0)
+
+
+def _block_bias(qoff, koff, bq, bk, seq_len, causal, slope, mask_blk):
+    """Additive bias for a (bq, bk) score block from GLOBAL positions:
+    alibi + causal/pad masking + user key mask."""
+    qpos = qoff + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = koff + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    bias = slope * (kpos - qpos).astype(jnp.float32)  # slope==0 → no-op
+    valid = kpos < seq_len
+    if causal:
+        valid = valid & (qpos >= kpos)
+    bias = jnp.where(valid, bias, _MASKED)
+    return bias + mask_blk[None, :]
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, slope_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, seq_len, bq, bk):
+    # refs (leading dims squeezed): q/o (bq, Hd); k/v (bk, Hd); mask (bk,);
+    # lse (bq,); slope (1, 1) in SMEM
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+    i = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _MASKED)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    qoff, koff = i * bq, j * bk
+    # causal: skip blocks strictly above the diagonal
+    needed = True if not causal else (koff <= qoff + bq - 1)
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[:].astype(jnp.float32)
+        k = k_ref[:].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = s + _block_bias(qoff, koff, bq, bk, seq_len, causal,
+                            slope_ref[0, 0], mask_ref[0].astype(jnp.float32))
+
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            p, v_ref[:].astype(jnp.float32), preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nk - 1)
+    def _():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l > 0, l, 1.0)
+        o_ref[:] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        # "safe" logsumexp: +big for fully-masked rows so bwd p=exp(s-lse)=0
+        lse_ref[0] = jnp.where(l[:, 0] > 0, m_scr[:, 0] + jnp.log(safe_l[:, 0]), -_MASKED)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, slope_ref,
+               dq_ref, dq_scr, *, scale, causal, seq_len, bq, bk):
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+    i = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    qoff, koff = i * bq, j * bk
+    needed = True if not causal else (koff <= qoff + bq - 1)
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[:].astype(jnp.float32)
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = s + _block_bias(qoff, koff, bq, bk, seq_len, causal,
+                            slope_ref[0, 0], mask_ref[0].astype(jnp.float32))
+        p = jnp.exp(s - lse_ref[0][:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dq_scr[:] = dq_scr[:] + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, slope_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, seq_len, bq, bk):
+    # grid (B, H, nk, nq): q blocks are innermost
+    i = pl.program_id(3)
+    nq = pl.num_programs(3)
+    j = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    qoff, koff = i * bq, j * bk
+    needed = True if not causal else (koff <= qoff + bq - 1)
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[:].astype(jnp.float32)
+        k = k_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = s + _block_bias(qoff, koff, bq, bk, seq_len, causal,
+                            slope_ref[0, 0], mask_ref[0].astype(jnp.float32))
+        p = jnp.exp(s - lse_ref[0][:, None])
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[:].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _q_spec(bq, Hd):
+    return pl.BlockSpec((None, None, bq, Hd), lambda b, h, i, j: (b, h, i, 0))
+
+
+def _kv_spec(bk, Hd):
+    return pl.BlockSpec((None, None, bk, Hd), lambda b, h, i, j: (b, h, j, 0))
+
+
+def _row_spec(bq):
+    # rows ride as [B, H, 1, Sp] so the trailing block dims (1, bq) tile
+    return pl.BlockSpec((None, None, 1, bq), lambda b, h, i, j: (b, h, 0, i))
+
+
+def _mask_spec(bk):
+    # mask rides as [B, 1, Sp]
+    return pl.BlockSpec((None, 1, bk), lambda b, h, i, j: (b, 0, j))
+
+
+def _slope_spec():
+    # slopes ride as [H, 8, 128] (value broadcast) so each head's block
+    # meets the (8, 128) tile minimum; kernels read slope_ref[0, 0]
+    return pl.BlockSpec((None, 8, 128), lambda b, h, i, j: (h, 0, 0))
+
+
+@functools.lru_cache(maxsize=32)
+def _build(causal: bool, scale: float, bq: int, bk: int, seq_len: int, interpret: bool):
+    """Build the custom-VJP flash function for one static configuration.
+
+    Operates on padded [B, H, Sp, Hd] inputs, mask [B, Sp] additive f32,
+    slopes [H, 1] f32 (zeros ⇒ no alibi).
+    """
+
+    def fwd_call(q, k, v, mask, slopes):
+        B, H, Sp, Hd = q.shape
+        nq, nk = Sp // bq, Sp // bk
+        kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                                   seq_len=seq_len, bq=bq, bk=bk)
+        o, lse = pl.pallas_call(
+            kernel,
+            grid=(B, H, nq, nk),
+            in_specs=[_q_spec(bq, Hd), _kv_spec(bk, Hd), _kv_spec(bk, Hd),
+                      _mask_spec(bk), _slope_spec()],
+            out_specs=[_q_spec(bq, Hd), _row_spec(bq)],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, H, Sp, Hd), q.dtype),
+                jax.ShapeDtypeStruct((B, H, 1, Sp), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, Hd), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v, mask, slopes)
+        return o, lse
+
+    @jax.custom_vjp
+    def flash(q, k, v, mask, slopes):
+        return fwd_call(q, k, v, mask, slopes)[0]
+
+    def flash_fwd(q, k, v, mask, slopes):
+        o, lse = fwd_call(q, k, v, mask, slopes)
+        return o, (q, k, v, mask, slopes, o, lse)
+
+    def flash_bwd(res, g):
+        q, k, v, mask, slopes, o, lse = res
+        B, H, Sp, Hd = q.shape
+        nq, nk = Sp // bq, Sp // bk
+        delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[:, :, None, :]
+
+        dq_kernel = functools.partial(_dq_kernel, scale=scale, causal=causal,
+                                      seq_len=seq_len, bq=bq, bk=bk)
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid=(B, H, nq, nk),
+            in_specs=[_q_spec(bq, Hd), _kv_spec(bk, Hd), _kv_spec(bk, Hd),
+                      _q_spec(bq, Hd), _row_spec(bq), _row_spec(bq),
+                      _mask_spec(bk), _slope_spec()],
+            out_specs=_q_spec(bq, Hd),
+            out_shape=jax.ShapeDtypeStruct((B, H, Sp, Hd), q.dtype),
+            scratch_shapes=[pltpu.VMEM((bq, Hd), jnp.float32)],
+            interpret=interpret,
+        )(q, k, v, g, lse, delta, mask, slopes)
+
+        # grid (B, H, nk, nq): swap the roles of the last two grid axes
+        kq_spec = pl.BlockSpec((None, None, bq, Hd), lambda b, h, j, i: (b, h, i, 0))
+        kk_spec = pl.BlockSpec((None, None, bk, Hd), lambda b, h, j, i: (b, h, j, 0))
+        krow_spec = pl.BlockSpec((None, None, 1, bq), lambda b, h, j, i: (b, h, 0, i))
+        kmask_spec = pl.BlockSpec((None, 1, bk), lambda b, h, j, i: (b, 0, j))
+        kslope_spec = pl.BlockSpec((None, 8, 128), lambda b, h, j, i: (h, 0, 0))
+
+        dkv_kernel = functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                                       seq_len=seq_len, bq=bq, bk=bk)
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            grid=(B, H, nk, nq),
+            in_specs=[kq_spec, kk_spec, kk_spec, kq_spec, krow_spec, krow_spec,
+                      kmask_spec, kslope_spec],
+            out_specs=[kk_spec, kk_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, H, Sp, Hd), q.dtype),
+                jax.ShapeDtypeStruct((B, H, Sp, Hd), q.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, Hd), jnp.float32),
+                pltpu.VMEM((bk, Hd), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v, g, lse, delta, mask, slopes)
+
+        return dq, dk, dv, jnp.zeros_like(mask), jnp.zeros_like(slopes)
+
+    flash.defvjp(flash_fwd, flash_bwd)
+    return flash
+
+
+def flash_attention(q, k, v, mask_bias=None, causal: bool = True, alibi_slopes=None,
+                    scale: Optional[float] = None, block_q: int = 512, block_k: int = 512,
+                    interpret: Optional[bool] = None):
+    """Flash attention on [B, S, H, Hd] q/k/v (same contract as
+    :func:`deepspeed_tpu.ops.attention.mha_attention`; mask_bias is the
+    additive key-side [B, S] bias). Pads S up to the block size internally.
+    """
+    B, S, H, Hd = q.shape
+    scale = float(scale if scale is not None else Hd**-0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    bq = min(block_q, max(8, S))
+    bk = min(block_k, max(8, S))
+    # pad S to a common multiple of both block sizes
+    lcm = bq * bk // _gcd(bq, bk)
+    Sp = -(-S // lcm) * lcm
+
+    def pad_s(x, axis):
+        if Sp == S:
+            return x
+        widths = [(0, 0)] * x.ndim
+        widths[axis] = (0, Sp - S)
+        return jnp.pad(x, widths)
+
+    qt = pad_s(jnp.transpose(q, (0, 2, 1, 3)), 2)
+    kt = pad_s(jnp.transpose(k, (0, 2, 1, 3)), 2)
+    vt = pad_s(jnp.transpose(v, (0, 2, 1, 3)), 2)
+
+    mask = (jnp.zeros((B, 1, Sp), jnp.float32) if mask_bias is None
+            else pad_s(mask_bias.astype(jnp.float32), 1)[:, None, :])
+    slopes = (jnp.zeros((H,), jnp.float32) if alibi_slopes is None
+              else jnp.asarray(alibi_slopes, jnp.float32).reshape(H))
+    slopes = jnp.broadcast_to(slopes[:, None, None], (H, 8, 128))
+
+    fn = _build(causal, scale, bq, bk, S, interpret)
+    out = fn(qt, kt, vt, mask, slopes)
+    return jnp.transpose(out[:, :, :S, :], (0, 2, 1, 3))
+
+
+def _gcd(a: int, b: int) -> int:
+    while b:
+        a, b = b, a % b
+    return a

@@ -15,12 +15,12 @@ fp32.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.sequence._program import run_sp_program
 
 _NEG_INF = -1e9  # matches ops.attention masking constant
 
@@ -43,8 +43,8 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=N
 
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
-    def step(carry, s):
-        kb, vb, maskb, m, l, o = carry
+    def accumulate(kb, vb, maskb, m, l, o, s):
+        """One flash-softmax update against kv block (my_block - s) mod sp."""
         kv_block = (my_block - s) % sp
         kvpos = kv_block * Sk + jnp.arange(Sk)
 
@@ -64,58 +64,36 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=N
         l_new = l * alpha + p.sum(axis=-1)
         o_new = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vb.astype(jnp.float32),
                                                   preferred_element_type=jnp.float32)
-
-        kb = jax.lax.ppermute(kb, axis, perm)
-        vb = jax.lax.ppermute(vb, axis, perm)
-        if maskb is not None:
-            maskb = jax.lax.ppermute(maskb, axis, perm)
-        return (kb, vb, maskb, m_new, l_new, o_new), None
+        return m_new, l_new, o_new
 
     m0 = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, H, Sq), jnp.float32)
     o0 = jnp.zeros((B, H, Sq, Hd), jnp.float32)
-    (_, _, _, m, l, o), _ = jax.lax.scan(step, (k, v, mask_bias, m0, l0, o0),
-                                         jnp.arange(sp))
+
+    # step 0 on the resident block, then permute-then-accumulate for the
+    # remaining sp-1 steps (no dead permute after the last accumulate)
+    m, l, o = accumulate(k, v, mask_bias, m0, l0, o0, 0)
+
+    def step(carry, s):
+        kb, vb, maskb, m, l, o = carry
+        kb = jax.lax.ppermute(kb, axis, perm)
+        vb = jax.lax.ppermute(vb, axis, perm)
+        if maskb is not None:
+            maskb = jax.lax.ppermute(maskb, axis, perm)
+        m, l, o = accumulate(kb, vb, maskb, m, l, o, s)
+        return (kb, vb, maskb, m, l, o), None
+
+    (_, _, _, m, l, o), _ = jax.lax.scan(step, (k, v, mask_bias, m, l, o),
+                                         jnp.arange(1, sp))
 
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
-
-
-@functools.lru_cache(maxsize=64)
-def _ring_program(mesh, axis: str, causal: bool, has_mask: bool, has_alibi: bool,
-                  scale: Optional[float]):
-    """Build + jit the shard_map program once per (mesh, static-arg) combo so
-    eager callers hit the jit cache instead of recompiling per call."""
-    qkv_spec = P(None, axis, None, None)
-    in_specs = [qkv_spec, qkv_spec, qkv_spec]
-    if has_mask:
-        in_specs.append(P(None, axis))
-    if has_alibi:
-        in_specs.append(P(None))  # replicated [H] slopes
-
-    def body(*xs):
-        qq, kk, vv = xs[:3]
-        rest = list(xs[3:])
-        mb = rest.pop(0) if has_mask else None
-        slopes = rest.pop(0) if has_alibi else None
-        return ring_attention_local(qq, kk, vv, axis=axis, causal=causal, mask_bias=mb,
-                                    alibi_slopes=slopes, scale=scale)
-
-    fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs), out_specs=qkv_spec,
-                       axis_names={axis}, check_vma=False)
-    # partial-auto shard_map must run under jit; nested jit inlines when traced
-    return jax.jit(fn)
 
 
 def ring_attention(q, k, v, *, mesh, axis: str = "sp", causal: bool = True, mask_bias=None,
                    alibi_slopes=None, scale: Optional[float] = None):
     """Global-view ring attention: shard_map over ``axis`` (seq dim), all
     other dims (batch→dp, heads→tp) stay auto-sharded."""
-    args = [q, k, v]
-    if mask_bias is not None:
-        args.append(mask_bias)
-    if alibi_slopes is not None:
-        args.append(jnp.asarray(alibi_slopes))
-    fn = _ring_program(mesh, axis, causal, mask_bias is not None, alibi_slopes is not None,
-                       scale)
-    return fn(*args)
+    return run_sp_program(ring_attention_local, q, k, v, mesh=mesh, axis=axis,
+                          causal=causal, mask_bias=mask_bias,
+                          alibi_slopes=alibi_slopes, scale=scale)

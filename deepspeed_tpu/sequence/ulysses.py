@@ -15,12 +15,12 @@ all-to-alls.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.sequence._program import run_sp_program
 
 
 def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=None,
@@ -58,41 +58,10 @@ def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bia
     return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
 
 
-@functools.lru_cache(maxsize=64)
-def _ulysses_program(mesh, axis: str, causal: bool, has_mask: bool, has_alibi: bool,
-                     scale: Optional[float]):
-    """Build + jit the shard_map program once per (mesh, static-arg) combo so
-    eager callers hit the jit cache instead of recompiling per call."""
-    qkv_spec = P(None, axis, None, None)
-    in_specs = [qkv_spec, qkv_spec, qkv_spec]
-    if has_mask:
-        in_specs.append(P(None, axis))
-    if has_alibi:
-        in_specs.append(P(None))  # replicated [H] slopes
-
-    def body(*xs):
-        qq, kk, vv = xs[:3]
-        rest = list(xs[3:])
-        mb = rest.pop(0) if has_mask else None
-        slopes = rest.pop(0) if has_alibi else None
-        return ulysses_attention_local(qq, kk, vv, axis=axis, causal=causal, mask_bias=mb,
-                                       alibi_slopes=slopes, scale=scale)
-
-    fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs), out_specs=qkv_spec,
-                       axis_names={axis}, check_vma=False)
-    # partial-auto shard_map must run under jit; nested jit inlines when traced
-    return jax.jit(fn)
-
-
 def ulysses_attention(q, k, v, *, mesh, axis: str = "sp", causal: bool = True, mask_bias=None,
                       alibi_slopes=None, scale: Optional[float] = None):
     """Global-view Ulysses attention: shard_map over ``axis`` only; batch and
     head dims stay auto-sharded (dp/tp compose via partial-auto)."""
-    args = [q, k, v]
-    if mask_bias is not None:
-        args.append(mask_bias)
-    if alibi_slopes is not None:
-        args.append(jnp.asarray(alibi_slopes))
-    fn = _ulysses_program(mesh, axis, causal, mask_bias is not None, alibi_slopes is not None,
-                          scale)
-    return fn(*args)
+    return run_sp_program(ulysses_attention_local, q, k, v, mesh=mesh, axis=axis,
+                          causal=causal, mask_bias=mask_bias,
+                          alibi_slopes=alibi_slopes, scale=scale)

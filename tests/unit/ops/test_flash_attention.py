@@ -1,0 +1,142 @@
+"""Pallas flash attention vs the XLA einsum reference (interpret mode on CPU).
+
+Analogue of the reference's kernel-vs-torch comparisons in
+tests/unit/ops/transformer/.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention import mha_attention
+from deepspeed_tpu.ops.pallas import flash_attention
+
+
+def _qkv(key, B=1, S=256, H=2, Hd=64):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (B, S, H, Hd)
+    return (jax.random.normal(kq, shape, jnp.float32),
+            jax.random.normal(kk, shape, jnp.float32),
+            jax.random.normal(kv, shape, jnp.float32))
+
+
+class TestFlashForward:
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, causal):
+        q, k, v = _qkv(jax.random.key(0))
+        ref = mha_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_unaligned_seq_pads(self):
+        q, k, v = _qkv(jax.random.key(1), S=200)
+        ref = mha_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_mask(self):
+        q, k, v = _qkv(jax.random.key(2))
+        keep = jax.random.uniform(jax.random.key(3), (1, 256)) > 0.3
+        keep = keep.at[:, 0].set(True)  # row 0 must see key 0 (else degenerate)
+        bias = jnp.where(keep, 0.0, -1e9).astype(jnp.float32)
+        ref = mha_attention(q, k, v, mask_bias=bias[:, None, None, :], causal=True)
+        out = flash_attention(q, k, v, mask_bias=bias, causal=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_alibi(self):
+        q, k, v = _qkv(jax.random.key(4))
+        slopes = jnp.asarray([0.5, 0.0625], jnp.float32)
+        ref = mha_attention(q, k, v, causal=True, alibi_slopes=slopes)
+        out = flash_attention(q, k, v, causal=True, alibi_slopes=slopes, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_bf16(self):
+        q, k, v = _qkv(jax.random.key(5))
+        q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+        ref = mha_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref, np.float32),
+                                   rtol=3e-2, atol=3e-2)
+
+
+class TestFlashBackward:
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grads_match_dense(self, causal):
+        q, k, v = _qkv(jax.random.key(6), S=128)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(mha_attention(q, k, v, causal=causal) ** 2)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal, interpret=True) ** 2)
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4,
+                                       err_msg=f"d{name} mismatch")
+
+    def test_grads_with_mask_alibi(self):
+        q, k, v = _qkv(jax.random.key(7), S=128)
+        keep = jax.random.uniform(jax.random.key(8), (1, 128)) > 0.25
+        keep = keep.at[:, 0].set(True)  # row 0 must see key 0 (else degenerate)
+        bias = jnp.where(keep, 0.0, -1e9).astype(jnp.float32)
+        slopes = jnp.asarray([0.25, 0.125], jnp.float32)
+
+        def loss_ref(q, k, v):
+            out = mha_attention(q, k, v, mask_bias=bias[:, None, None, :], causal=True,
+                                alibi_slopes=slopes)
+            return jnp.sum(out ** 2)
+
+        def loss_flash(q, k, v):
+            out = flash_attention(q, k, v, mask_bias=bias, causal=True, alibi_slopes=slopes,
+                                  interpret=True)
+            return jnp.sum(out ** 2)
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4,
+                                       err_msg=f"d{name} mismatch")
+
+    def test_grads_unaligned_seq(self):
+        q, k, v = _qkv(jax.random.key(9), S=100, H=1)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True, interpret=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(mha_attention(q, k, v, causal=True) ** 2)
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+class TestModelFlashBackend:
+
+    def test_causal_lm_flash_matches_xla(self):
+        """attention_backend='flash' (interpret on CPU) == 'xla' loss + grads."""
+        from deepspeed_tpu.models import CausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+
+        base = dict(vocab_size=64, n_layer=1, n_head=2, d_model=32, d_ff=64,
+                    max_seq=32, pos_embedding="rope", norm="rmsnorm",
+                    activation="swiglu", remat=False)
+        xla = CausalLM(TransformerConfig(**base, attention_backend="xla"))
+        flash = CausalLM(TransformerConfig(**base, attention_backend="flash"))
+        params = xla.init_params(jax.random.key(0))
+        batch = {"input_ids": jax.random.randint(jax.random.key(1), (2, 32), 0, 64)}
+
+        lr, gr = jax.value_and_grad(xla.loss)(params, batch)
+        lf, gf = jax.value_and_grad(flash.loss)(params, batch)
+        np.testing.assert_allclose(float(lf), float(lr), rtol=1e-5)
+        flat_r = jax.tree.leaves(gr)
+        flat_f = jax.tree.leaves(gf)
+        for a, b in zip(flat_f, flat_r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)

@@ -142,7 +142,7 @@ class TestModelSP:
         }
         engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
                                                    config=ds_config)
-        batch = {"input_ids": np.random.randint(0, 128, (4, 32)).astype(np.int32)}
+        batch = {"input_ids": np.random.default_rng(0).integers(0, 128, (4, 32)).astype(np.int32)}
         l0 = engine.train_batch(batch)
         l1 = engine.train_batch(batch)
         assert np.isfinite(l0) and np.isfinite(l1)
