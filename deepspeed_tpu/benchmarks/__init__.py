@@ -1,0 +1,1 @@
+"""Benchmark suites (reference ``benchmarks/`` + ``bin/ds_bench``)."""

@@ -1,0 +1,70 @@
+"""``dscli`` — the framework's command-line front door (reference ``bin/``).
+
+Subcommands mirror the reference's script family:
+
+- ``dscli run <script> [args...]``  — the ``deepspeed`` launcher CLI
+- ``dscli report``                  — ``ds_report`` environment/op report
+- ``dscli bench``                   — ``ds_bench`` collective micro-benchmarks
+- ``dscli elastic <config>``        — ``ds_elastic`` elastic-config inspector
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def _run(argv):
+    from deepspeed_tpu.launcher import runner
+    runner.main(argv)
+
+
+def _report(argv):
+    from deepspeed_tpu import env_report
+    env_report.main()
+
+
+def _bench(argv):
+    from deepspeed_tpu.benchmarks.comm_bench import main as bench_main
+    bench_main(argv)
+
+
+def _elastic(argv):
+    import argparse
+    import json
+
+    from deepspeed_tpu.elasticity import compute_elastic_config
+
+    parser = argparse.ArgumentParser(description="elastic batch-size planner")
+    parser.add_argument("config", type=str, help="ds_config json path")
+    parser.add_argument("-w", "--world-size", type=int, default=0)
+    args = parser.parse_args(argv)
+    with open(args.config) as fd:
+        ds_config = json.load(fd)
+    if args.world_size:
+        batch, micro, gas = compute_elastic_config(ds_config, world_size=args.world_size)
+        print(f"world_size={args.world_size}: train_batch={batch}, "
+              f"micro_batch={micro}, gradient_accumulation_steps={gas}")
+    else:
+        batch, valid_worlds = compute_elastic_config(ds_config)
+        print(f"valid world sizes: {valid_worlds}")
+        print(f"max train_batch:   {batch}")
+
+
+_COMMANDS = {"run": _run, "report": _report, "bench": _bench, "elastic": _elastic}
+
+
+def main():
+    if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
+        print(__doc__)
+        print("usage: dscli {run|report|bench|elastic} [args...]")
+        return 0
+    cmd = sys.argv[1]
+    if cmd not in _COMMANDS:
+        print(f"unknown command {cmd!r}; expected one of {sorted(_COMMANDS)}")
+        return 2
+    _COMMANDS[cmd](sys.argv[2:])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,16 @@
+"""Elasticity: batch-size planning valid across changing chip counts
+(reference ``deepspeed/elasticity/``)."""
+
+from deepspeed_tpu.elasticity.config import (ElasticityConfig, ElasticityConfigError,
+                                             ElasticityError,
+                                             ElasticityIncompatibleWorldSize)
+from deepspeed_tpu.elasticity.elasticity import (compute_elastic_config,
+                                                 ensure_immutable_elastic_config,
+                                                 get_candidate_batch_sizes,
+                                                 get_valid_gpus)
+
+__all__ = [
+    "ElasticityConfig", "ElasticityError", "ElasticityConfigError",
+    "ElasticityIncompatibleWorldSize", "compute_elastic_config",
+    "ensure_immutable_elastic_config", "get_candidate_batch_sizes", "get_valid_gpus",
+]

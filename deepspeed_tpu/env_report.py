@@ -1,0 +1,80 @@
+"""``ds_report`` equivalent (reference ``env_report.py``): op compatibility
+matrix + framework/platform versions."""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+OKAY = f"{GREEN}[OKAY]{END}"
+NO = f"{RED}[NO]{END}"
+
+
+def op_report(verbose: bool = True):
+    from deepspeed_tpu.ops.registry import all_builder_names, get_builder_class
+
+    max_dots = 23
+    print("-" * 64)
+    print("deepspeed_tpu op availability report")
+    print("-" * 64)
+    print("op name" + "." * (max_dots - len("op name")) + " compatible")
+    print("-" * 64)
+    rows = []
+    for name in all_builder_names():
+        builder = get_builder_class(name)()
+        compatible = builder.is_compatible(verbose=False)
+        status = OKAY if compatible else NO
+        print(name + "." * (max_dots - len(name)) + f" {status}")
+        rows.append((name, compatible))
+    return rows
+
+
+def version_report():
+    print("-" * 64)
+    print("framework / platform versions")
+    print("-" * 64)
+    import deepspeed_tpu
+    print(f"deepspeed_tpu ........ {deepspeed_tpu.__version__}")
+    print(f"python ............... {sys.version.split()[0]}")
+    for mod in ("jax", "jaxlib", "flax", "optax", "numpy"):
+        try:
+            m = importlib.import_module(mod)
+            print(f"{mod} {'.' * (18 - len(mod))} {getattr(m, '__version__', '?')}")
+        except ImportError:
+            print(f"{mod} {'.' * (18 - len(mod))} {YELLOW}not installed{END}")
+
+
+def device_report():
+    print("-" * 64)
+    print("devices")
+    print("-" * 64)
+    try:
+        import jax
+        devs = jax.devices()
+        print(f"backend .............. {jax.default_backend()}")
+        print(f"device count ......... {len(devs)}")
+        for d in devs[:8]:
+            print(f"  {d}")
+        if len(devs) > 8:
+            print(f"  ... and {len(devs) - 8} more")
+    except Exception as e:  # backend may be unavailable in some environments
+        print(f"{YELLOW}device query failed: {e}{END}")
+
+
+def main(hide_operator_status: bool = False, hide_errors_and_warnings: bool = False):
+    if not hide_operator_status:
+        op_report(verbose=not hide_errors_and_warnings)
+    version_report()
+    device_report()
+
+
+def cli_main():
+    main()
+
+
+if __name__ == "__main__":
+    main()

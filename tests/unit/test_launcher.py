@@ -1,0 +1,234 @@
+"""Launcher / CLI tests (reference tests/unit/launcher/: arg parsing,
+hostfile, filters, multinode cmd construction — all hardware-free), plus a
+real 2-process local launch smoke test and elasticity planning tests."""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+from deepspeed_tpu.elasticity import (ElasticityIncompatibleWorldSize, compute_elastic_config,
+                                      get_candidate_batch_sizes, get_valid_gpus)
+from deepspeed_tpu.launcher import launch as ds_launch
+from deepspeed_tpu.launcher import runner as ds_runner
+from deepspeed_tpu.launcher.multinode_runner import (MPICHRunner, OpenMPIRunner, PDSHRunner,
+                                                     SlurmRunner)
+
+
+def _hostfile(tmp_path, text):
+    p = tmp_path / "hostfile"
+    p.write_text(text)
+    return str(p)
+
+
+class TestHostfile:
+
+    def test_parse(self, tmp_path):
+        hf = _hostfile(tmp_path, "worker-0 slots=4\nworker-1 slots=8\n# comment\n\n")
+        pool = ds_runner.fetch_hostfile(hf)
+        assert pool == {"worker-0": 4, "worker-1": 8}
+
+    def test_bad_format(self, tmp_path):
+        hf = _hostfile(tmp_path, "worker-0 gpus=4\n")
+        with pytest.raises(ValueError):
+            ds_runner.fetch_hostfile(hf)
+
+    def test_duplicate_host(self, tmp_path):
+        hf = _hostfile(tmp_path, "w slots=4\nw slots=2\n")
+        with pytest.raises(ValueError):
+            ds_runner.fetch_hostfile(hf)
+
+    def test_missing_returns_none(self):
+        assert ds_runner.fetch_hostfile("/nonexistent/hostfile") is None
+
+
+class TestResourceFilter:
+
+    POOL = {"worker-0": 4, "worker-1": 4}
+
+    def test_no_filter(self):
+        out = ds_runner.parse_resource_filter(self.POOL)
+        assert out == {"worker-0": [0, 1, 2, 3], "worker-1": [0, 1, 2, 3]}
+
+    def test_include_host(self):
+        out = ds_runner.parse_resource_filter(self.POOL, include_str="worker-1")
+        assert out == {"worker-1": [0, 1, 2, 3]}
+
+    def test_include_slots(self):
+        out = ds_runner.parse_resource_filter(self.POOL, include_str="worker-0:0,2")
+        assert out == {"worker-0": [0, 2]}
+
+    def test_exclude_host(self):
+        out = ds_runner.parse_resource_filter(self.POOL, exclude_str="worker-0")
+        assert out == {"worker-1": [0, 1, 2, 3]}
+
+    def test_exclude_slots(self):
+        out = ds_runner.parse_resource_filter(self.POOL, exclude_str="worker-1:1,3")
+        assert out == {"worker-0": [0, 1, 2, 3], "worker-1": [0, 2]}
+
+    def test_mutually_exclusive(self):
+        with pytest.raises(ValueError):
+            ds_runner.parse_resource_filter(self.POOL, include_str="worker-0",
+                                            exclude_str="worker-1")
+
+    def test_unknown_host(self):
+        with pytest.raises(ValueError):
+            ds_runner.parse_resource_filter(self.POOL, include_str="worker-9")
+
+
+class TestWorldInfo:
+
+    def test_roundtrip(self):
+        info = {"worker-0": [0, 1], "worker-1": [0, 1, 2]}
+        enc = ds_runner.encode_world_info(info)
+        assert ds_runner.decode_world_info(enc) == info
+
+    def test_rank_env(self):
+        info = {"a": [0, 1], "b": [0, 1]}
+        env = ds_launch.build_rank_env(info, node_rank=1, local_rank_idx=1,
+                                       master_addr="10.0.0.1", master_port=29500)
+        assert env["RANK"] == "3"
+        assert env["LOCAL_RANK"] == "1"
+        assert env["WORLD_SIZE"] == "4"
+        assert env["COORDINATOR_ADDRESS"] == "10.0.0.1:29500"
+        assert env["PROCESS_ID"] == "3"
+
+
+class _Args:
+    def __init__(self, **kw):
+        self.hostfile = kw.get("hostfile", "/job/hostfile")
+        self.master_addr = kw.get("master_addr", "worker-0")
+        self.master_port = kw.get("master_port", 29500)
+        self.include = kw.get("include", "")
+        self.exclude = kw.get("exclude", "")
+        self.num_nodes = kw.get("num_nodes", -1)
+        self.user_script = kw.get("user_script", "train.py")
+        self.user_args = kw.get("user_args", ["--foo", "bar"])
+        self.launcher_args = ""
+
+
+class TestMultinodeRunners:
+
+    RESOURCES = {"worker-0": [0, 1], "worker-1": [0, 1]}
+
+    def test_pdsh_cmd(self):
+        runner = PDSHRunner(_Args(), "WORLDINFO")
+        runner.add_export("JAX_FOO", "1")
+        env = {}
+        cmd = runner.get_cmd(env, self.RESOURCES)
+        assert cmd[0] == "pdsh"
+        assert "worker-0,worker-1" in cmd
+        assert env["PDSH_RCMD_TYPE"] == "ssh"
+        joined = " ".join(cmd)
+        assert "--world_info=WORLDINFO" in joined
+        assert "deepspeed_tpu.launcher.launch" in joined
+        assert "export JAX_FOO=1" in joined
+        assert "train.py" in cmd and "--foo" in cmd
+
+    def test_openmpi_cmd(self):
+        runner = OpenMPIRunner(_Args(), "WORLDINFO")
+        runner.add_export("DS_X", "y")
+        cmd = runner.get_cmd({}, self.RESOURCES)
+        assert cmd[:3] == ["mpirun", "-n", "4"]
+        assert "-x" in cmd and "DS_X=y" in cmd
+        assert cmd[-4:] == ["-u", "train.py", "--foo", "bar"]
+
+    def test_mpich_cmd(self):
+        runner = MPICHRunner(_Args(), "WORLDINFO")
+        cmd = runner.get_cmd({}, self.RESOURCES)
+        assert cmd[:5] == ["mpirun", "-n", "4", "-ppn", "2"]
+
+    def test_slurm_cmd(self):
+        runner = SlurmRunner(_Args(num_nodes=2), "WORLDINFO")
+        runner.add_export("A", "b")
+        cmd = runner.get_cmd({}, self.RESOURCES)
+        assert cmd[:3] == ["srun", "-n", "4"]
+        assert "--nodes" in cmd
+        assert "--export" in cmd and "ALL,A=b" in cmd
+
+
+class TestLocalLaunch:
+    """Real 2-process spawn (the reference's DistributedTest analogue for the
+    launcher itself)."""
+
+    def test_two_process_launch(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os, json, sys\n"
+            "out = {k: os.environ[k] for k in ('RANK','LOCAL_RANK','WORLD_SIZE','MASTER_ADDR')}\n"
+            "open(os.path.join(os.path.dirname(__file__), f'out_{os.environ[\"RANK\"]}.json'), 'w')"
+            ".write(json.dumps(out))\n")
+        info = ds_runner.encode_world_info({"localhost": [0, 1]})
+        env = os.environ.copy()
+        env["PYTHONPATH"] = "/root/repo"
+        # workers must not grab the TPU or spin up jax
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+             f"--world_info={info}", "--node_rank=0",
+             "--master_addr=127.0.0.1", "--master_port=29511", str(script)],
+            env=env, capture_output=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr.decode()
+        for rank in (0, 1):
+            data = json.loads((tmp_path / f"out_{rank}.json").read_text())
+            assert data["WORLD_SIZE"] == "2"
+            assert data["RANK"] == str(rank)
+
+    def test_failing_rank_kills_job(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "if os.environ['RANK'] == '1': sys.exit(3)\n"
+            "time.sleep(30)\n")
+        info = ds_runner.encode_world_info({"localhost": [0, 1]})
+        env = os.environ.copy()
+        env["PYTHONPATH"] = "/root/repo"
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+             f"--world_info={info}", "--node_rank=0",
+             "--master_addr=127.0.0.1", "--master_port=29512", str(script)],
+            env=env, capture_output=True, timeout=60)
+        assert proc.returncode == 3
+
+
+class TestElasticity:
+
+    CONFIG = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 2000,
+            "micro_batch_sizes": [2, 4, 6],
+            "min_gpus": 1,
+            "max_gpus": 10000,
+            "version": 0.1,
+        }
+    }
+
+    def test_candidates(self):
+        c = get_candidate_batch_sizes([2, 4, 6], 32)
+        assert c == [2, 4, 6, 8, 12, 16, 24, 32]
+
+    def test_valid_gpus(self):
+        assert get_valid_gpus(24, [2, 4, 6], 1, 12) == [1, 2, 3, 4, 6, 12]
+
+    def test_compute_plan(self):
+        batch, valid = compute_elastic_config(self.CONFIG)
+        assert batch <= 2000
+        assert len(valid) > 0
+        # every valid world size must evenly decompose the batch
+        for g in valid[:20]:
+            assert any(batch % (g * m) == 0 for m in [2, 4, 6])
+
+    def test_world_size_resolution(self):
+        batch, micro, gas = compute_elastic_config(self.CONFIG, world_size=4)
+        assert batch == micro * gas * 4
+
+    def test_incompatible_world_size(self):
+        cfg = {"elasticity": {**self.CONFIG["elasticity"], "micro_batch_sizes": [2],
+                              "max_train_batch_size": 4}}
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            compute_elastic_config(cfg, world_size=3)
