@@ -1,0 +1,71 @@
+"""Checkpoint reshaping helpers (reference ``checkpoint/reshape_meg_2d.py`` /
+``reshape_3d_utils.py`` / ``merge`` logic in ``state_dict_factory.py``).
+
+The reference reshapes Megatron-DS checkpoints between TP/PP degrees by
+concatenating or splitting each weight along its sharded dim. Here the live
+engine reshards natively via the mesh, so these helpers exist for IMPORT/
+EXPORT interop: merging externally TP-sharded checkpoints (one file per
+rank) into full logical arrays, splitting full arrays back out to a target
+TP degree, and the qkv-aware variants that keep per-head blocks contiguous
+(reference ``module_inject/replace_module.py:42-119`` ``qkv_copy``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def merge_tp_shards(shards: Sequence[np.ndarray], dim: int) -> np.ndarray:
+    """Concatenate per-rank shards along the sharded dim (column-parallel:
+    dim=last; row-parallel: dim=0)."""
+    if len(shards) == 1:
+        return np.asarray(shards[0])
+    return np.concatenate([np.asarray(s) for s in shards], axis=dim)
+
+
+def split_tp_shards(full: np.ndarray, dim: int, tp_degree: int) -> List[np.ndarray]:
+    """Split a full array into tp_degree equal shards along ``dim``."""
+    if full.shape[dim] % tp_degree != 0:
+        raise ValueError(f"dim {dim} of shape {full.shape} not divisible by tp={tp_degree}")
+    return [np.ascontiguousarray(s) for s in np.split(full, tp_degree, axis=dim)]
+
+
+def merge_qkv_shards(shards: Sequence[np.ndarray], dim: int, num_splits: int = 3) -> np.ndarray:
+    """Merge TP shards of a FUSED qkv weight.
+
+    Each rank's shard holds [q_i | k_i | v_i] stacked along ``dim``; the
+    merged fused weight must be [q_0..q_n | k_0..k_n | v_0..v_n] — plain
+    concatenation would interleave q/k/v (reference ``qkv_copy``,
+    ``replace_module.py:42``)."""
+    if len(shards) == 1:
+        return np.asarray(shards[0])
+    per_rank = [np.split(np.asarray(s), num_splits, axis=dim) for s in shards]
+    merged_each = [np.concatenate([r[i] for r in per_rank], axis=dim) for i in range(num_splits)]
+    return np.concatenate(merged_each, axis=dim)
+
+
+def split_qkv_shards(full: np.ndarray, dim: int, tp_degree: int,
+                     num_splits: int = 3) -> List[np.ndarray]:
+    """Inverse of :func:`merge_qkv_shards`: shard a fused qkv weight so each
+    rank gets its contiguous [q_i | k_i | v_i] block."""
+    parts = np.split(full, num_splits, axis=dim)  # [q, k, v]
+    rank_shards = []
+    for rank in range(tp_degree):
+        pieces = []
+        for part in parts:
+            if part.shape[dim] % tp_degree != 0:
+                raise ValueError(f"qkv split dim {dim} of {part.shape} not divisible by tp={tp_degree}")
+            pieces.append(np.split(part, tp_degree, axis=dim)[rank])
+        rank_shards.append(np.ascontiguousarray(np.concatenate(pieces, axis=dim)))
+    return rank_shards
+
+
+def partition_data(data: List, num_partitions: int) -> List[List]:
+    """Even partitioning of a list (reference ``checkpoint/reshape_utils.py:
+    partition_data``)."""
+    if len(data) % num_partitions != 0:
+        raise ValueError(f"cannot partition {len(data)} items into {num_partitions}")
+    size = len(data) // num_partitions
+    return [data[i * size:(i + 1) * size] for i in range(num_partitions)]

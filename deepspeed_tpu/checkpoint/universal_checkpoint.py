@@ -1,0 +1,117 @@
+"""Universal checkpoint (reference ``checkpoint/universal_checkpoint.py`` +
+``ds_to_universal.py``): a topology-independent per-parameter layout.
+
+``ds_to_universal`` explodes an engine checkpoint into one directory per
+parameter holding its fp32 weight plus optimizer moments — the reference's
+"param fragment" files (``universal_checkpoint.py:10-93``). A universal
+checkpoint can be loaded into an engine running at ANY dp/tp/pp/world size:
+each process reads the full logical arrays and ``jax.device_put`` shards
+them to its own layout (where the reference needs explicit fragment
+remapping via ``tensor_fragment.py``, the mesh resharding is native here).
+
+Layout::
+
+    <out_dir>/
+      meta.json                     # step counters, source config
+      params/<dotted.path>.npz      # param (fp32), exp_avg, exp_avg_sq
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.checkpoint.zero_to_fp32 import (_leaf_paths, _resolve_tag,
+                                                   get_fp32_state_dict_from_zero_checkpoint)
+
+def ds_to_universal(checkpoint_dir: str, out_dir: str, tag: Optional[str] = None) -> None:
+    """Convert an engine checkpoint tag into the universal layout."""
+    import orbax.checkpoint as ocp
+
+    checkpoint_dir = os.path.abspath(checkpoint_dir)
+    tag = _resolve_tag(checkpoint_dir, tag)
+    state_path = os.path.join(checkpoint_dir, tag, "state")
+    with ocp.StandardCheckpointer() as ckptr:
+        tree = ckptr.restore(state_path)
+
+    fp32 = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+
+    # optimizer moments: the optax adam-family state was saved flattened in
+    # deterministic tree order — [count, mu..., nu..., ...] — so the first
+    # two runs of len(params) non-scalar leaves whose shapes match the param
+    # tree are exp_avg and exp_avg_sq
+    moments: Dict[str, Dict[str, np.ndarray]] = {p: {} for p in fp32}
+    opt_flat = tree.get("opt_state_flat")
+    if opt_flat:
+        param_items = list(_leaf_paths(tree["params"]).items())
+        n = len(param_items)
+        param_shapes = [np.asarray(p).shape for _, p in param_items]
+        leaves = [np.asarray(opt_flat[k])
+                  for k in sorted(opt_flat, key=lambda s: int(s.split("_")[1]))]
+        arrays = [a for a in leaves if a.shape != ()]
+        runs = []
+        i = 0
+        while i + n <= len(arrays) and len(runs) < 2:
+            if [a.shape for a in arrays[i:i + n]] == param_shapes:
+                runs.append(arrays[i:i + n])
+                i += n
+            else:
+                i += 1
+        for name, run in zip(["exp_avg", "exp_avg_sq"], runs):
+            for (pname, _), arr in zip(param_items, run):
+                moments[pname][name] = arr.astype(np.float32)
+
+    params_dir = os.path.join(out_dir, "params")
+    os.makedirs(params_dir, exist_ok=True)
+    for pname, arr in fp32.items():
+        payload = {"param": arr}
+        payload.update(moments.get(pname, {}))
+        np.savez(os.path.join(params_dir, f"{pname}.npz"), **payload)
+
+    meta_src = os.path.join(checkpoint_dir, tag, "meta.json")
+    meta: Dict[str, Any] = {"source_tag": tag, "format": "universal", "version": 1}
+    if os.path.isfile(meta_src):
+        with open(meta_src) as f:
+            meta["source_meta"] = json.load(f)
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2, default=str)
+    print(f"Universal checkpoint with {len(fp32)} params written to {out_dir}")
+
+
+def load_universal_state_dict(universal_dir: str) -> Dict[str, Dict[str, np.ndarray]]:
+    """{dotted.path: {param, exp_avg?, exp_avg_sq?}} from a universal dir."""
+    params_dir = os.path.join(universal_dir, "params")
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    for fname in sorted(os.listdir(params_dir)):
+        if not fname.endswith(".npz"):
+            continue
+        dotted = fname[:-4]
+        with np.load(os.path.join(params_dir, fname)) as z:
+            out[dotted] = {k: z[k] for k in z.files}
+    return out
+
+
+def load_universal_into_params(universal_dir: str, params: Any, dtype=None) -> Any:
+    """Map a universal checkpoint onto an existing (possibly sharded) param
+    pytree: each leaf is replaced by the stored fp32 weight cast to the
+    leaf's dtype and placed with the leaf's sharding."""
+    import jax
+    import jax.numpy as jnp
+
+    sd = load_universal_state_dict(universal_dir)
+
+    def replace(path_tuple, leaf):
+        dotted = ".".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path_tuple)
+        if dotted not in sd:
+            raise KeyError(f"universal checkpoint missing parameter {dotted}")
+        arr = sd[dotted]["param"].astype(dtype or leaf.dtype)
+        if arr.shape != leaf.shape:
+            raise ValueError(f"shape mismatch for {dotted}: ckpt {arr.shape} vs model {leaf.shape}")
+        if hasattr(leaf, "sharding"):
+            return jax.device_put(jnp.asarray(arr, dtype=leaf.dtype), leaf.sharding)
+        return jnp.asarray(arr, dtype=leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(replace, params)

@@ -1,0 +1,100 @@
+"""Offline fp32 state-dict reconstruction (reference ``utils/zero_to_fp32.py``).
+
+Reads a saved engine checkpoint tag (the orbax ``state`` tree plus any
+per-process ZeRO-Offload host-state npz files) WITHOUT building an engine,
+consolidates the highest-precision copy of every parameter (fp32 masters
+when present, else the stored params upcast), and writes a single
+``.npz`` file keyed by parameter path — loadable anywhere with plain numpy.
+
+CLI::
+
+    python -m deepspeed_tpu.checkpoint.zero_to_fp32 <checkpoint_dir> <output.npz> [--tag TAG]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _leaf_paths(tree: Any, prefix: str = "") -> Dict[str, Any]:
+    """Flatten a nested dict tree into {'a.b.c': leaf}."""
+    out: Dict[str, Any] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_leaf_paths(v, prefix + str(k) + "."))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def _resolve_tag(checkpoint_dir: str, tag: Optional[str]) -> str:
+    if tag is not None:
+        return str(tag)
+    latest = os.path.join(checkpoint_dir, "latest")
+    if not os.path.isfile(latest):
+        raise FileNotFoundError(f"No 'latest' file in {checkpoint_dir}; pass --tag")
+    with open(latest) as f:
+        return f.read().strip()
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
+                                             tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """The reference's same-named API (``zero_to_fp32.py``): a dict of fp32
+    numpy arrays keyed by dotted parameter path."""
+    import orbax.checkpoint as ocp
+
+    checkpoint_dir = os.path.abspath(checkpoint_dir)
+    tag = _resolve_tag(checkpoint_dir, tag)
+    state_path = os.path.join(checkpoint_dir, tag, "state")
+    if not os.path.isdir(state_path):
+        raise FileNotFoundError(f"checkpoint state not found at {state_path}")
+
+    with ocp.StandardCheckpointer() as ckptr:
+        tree = ckptr.restore(state_path)
+
+    params = _leaf_paths(tree["params"])
+    masters = _leaf_paths(tree["master"]) if tree.get("master") is not None else {}
+
+    # ZeRO-Offload: host masters live in per-process npz files
+    offload_masters: Dict[str, np.ndarray] = {}
+    for npz_path in sorted(glob.glob(os.path.join(checkpoint_dir, tag, "offload_state_p*.npz"))):
+        with np.load(npz_path) as z:
+            for key in z.files:
+                if key.startswith("masters|"):
+                    offload_masters[key.split("|", 1)[1]] = z[key]
+
+    out: Dict[str, np.ndarray] = {}
+    for path, leaf in params.items():
+        arr = np.asarray(leaf)
+        if path in masters:
+            arr = np.asarray(masters[path])
+        elif path in offload_masters:
+            arr = offload_masters[path].reshape(arr.shape)
+        out[path] = np.ascontiguousarray(arr.astype(np.float32))
+    return out
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str, output_file: str,
+                                               tag: Optional[str] = None) -> None:
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    np.savez(output_file, **sd)
+    total = sum(v.size for v in sd.values())
+    print(f"Saved {len(sd)} fp32 tensors ({total:,} params) to {output_file}")
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("checkpoint_dir", type=str)
+    parser.add_argument("output_file", type=str)
+    parser.add_argument("--tag", type=str, default=None)
+    args = parser.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir, args.output_file, args.tag)
+
+
+if __name__ == "__main__":
+    main()

@@ -175,8 +175,23 @@ def init_distributed(dist_backend: Optional[str] = None,
     nproc = world_size if world_size > 0 else int(os.environ.get("WORLD_SIZE", os.environ.get("NUM_PROCESSES", 1)))
     proc_id = rank if rank >= 0 else int(os.environ.get("RANK", os.environ.get("PROCESS_ID", 0)))
 
+    # MPI / SLURM rank discovery (reference comm/comm.py:595 mpi_discovery):
+    # mpirun/srun set their own env instead of RANK/WORLD_SIZE
+    if auto_mpi_discovery and nproc <= 1:
+        if "OMPI_COMM_WORLD_SIZE" in os.environ:
+            nproc = int(os.environ["OMPI_COMM_WORLD_SIZE"])
+            proc_id = int(os.environ.get("OMPI_COMM_WORLD_RANK", 0))
+        elif "SLURM_NTASKS" in os.environ:
+            nproc = int(os.environ["SLURM_NTASKS"])
+            proc_id = int(os.environ.get("SLURM_PROCID", 0))
+        elif "PMI_SIZE" in os.environ:
+            nproc = int(os.environ["PMI_SIZE"])
+            proc_id = int(os.environ.get("PMI_RANK", 0))
+
     if coord is None and "MASTER_ADDR" in os.environ and nproc > 1:
         coord = f"{os.environ['MASTER_ADDR']}:{os.environ.get('MASTER_PORT', distributed_port)}"
+    if coord is None and nproc > 1 and "SLURM_LAUNCH_NODE_IPADDR" in os.environ:
+        coord = f"{os.environ['SLURM_LAUNCH_NODE_IPADDR']}:{distributed_port}"
 
     if nproc > 1:
         if verbose:

@@ -15,7 +15,7 @@ NO = f"{RED}[NO]{END}"
 
 
 def op_report(verbose: bool = True):
-    from deepspeed_tpu.ops.registry import all_builder_names, get_builder_class
+    from deepspeed_tpu.ops import registry
 
     max_dots = 23
     print("-" * 64)
@@ -24,9 +24,8 @@ def op_report(verbose: bool = True):
     print("op name" + "." * (max_dots - len("op name")) + " compatible")
     print("-" * 64)
     rows = []
-    for name in all_builder_names():
-        builder = get_builder_class(name)()
-        compatible = builder.is_compatible(verbose=False)
+    # registry.op_report is the single source of truth for availability
+    for name, compatible in sorted(registry.op_report().items()):
         status = OKAY if compatible else NO
         print(name + "." * (max_dots - len(name)) + f" {status}")
         rows.append((name, compatible))

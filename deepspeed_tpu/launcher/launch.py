@@ -68,6 +68,20 @@ def main(args=None):
     local_slots = world_info[node_host]
 
     processes: List[subprocess.Popen] = []
+
+    # install forwarding handlers BEFORE spawning so an interrupt mid-spawn
+    # cannot orphan already-started ranks (reference launch.py:292)
+    def sig_handler(signum, frame):
+        for p in processes:
+            try:
+                p.send_signal(signum)
+            except ProcessLookupError:
+                pass
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGINT, sig_handler)
+    signal.signal(signal.SIGTERM, sig_handler)
+
     log_dir = args.enable_each_rank_log
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
@@ -90,18 +104,6 @@ def main(args=None):
         with open(pidfile, "w") as fd:
             json.dump([p.pid for p in processes], fd)
         logger.info(f"pids saved to {pidfile}")
-
-    # forward signals to children (reference launch.py:292)
-    def sig_handler(signum, frame):
-        for p in processes:
-            try:
-                p.send_signal(signum)
-            except ProcessLookupError:
-                pass
-        sys.exit(128 + signum)
-
-    signal.signal(signal.SIGINT, sig_handler)
-    signal.signal(signal.SIGTERM, sig_handler)
 
     # monitor: any failure kills the tree (reference launch.py:103-117)
     alive = {p.pid: p for p in processes}

@@ -66,6 +66,10 @@ class PDSHRunner(MultiNodeRunner):
             f"--master_addr={self.args.master_addr}",
             f"--master_port={self.args.master_port}",
         ]
+        if getattr(self.args, "save_pid", False):
+            deepspeed_launch.append("--save_pid")
+        if getattr(self.args, "enable_each_rank_log", None):
+            deepspeed_launch.append(f"--enable_each_rank_log={self.args.enable_each_rank_log}")
         return (["pdsh", "-S", "-f", str(PDSH_MAX_FAN_OUT), "-w", active_workers] + deepspeed_launch
                 + [self.user_script] + self.user_arguments)
 
@@ -82,9 +86,12 @@ class OpenMPIRunner(MultiNodeRunner):
             "mpirun", "-n", f"{total_process_count}",
             "-hostfile", self.args.hostfile,
             "--mca", "btl", "^openib",
-            "--mca", "btl_tcp_if_include", "eth0",
-        ]
+        ] + shlex.split(getattr(self.args, "launcher_args", "") or "")
         export_cmd = []
+        # workers discover rank/size from OMPI_* env (comm.init_distributed);
+        # the coordinator address must ride along explicitly
+        self.add_export("MASTER_ADDR", str(self.args.master_addr))
+        self.add_export("MASTER_PORT", str(self.args.master_port))
         for k, v in self.exports.items():
             export_cmd += ["-x", f"{k}={v}"]
         python_exec = [sys.executable, "-u"]
@@ -98,8 +105,11 @@ class MPICHRunner(MultiNodeRunner):
     def get_cmd(self, environment, active_resources):
         total_process_count = sum(len(v) for v in active_resources.values())
         mpirun_cmd = ["mpirun", "-n", f"{total_process_count}", "-ppn",
-                      f"{len(next(iter(active_resources.values())))}"]
+                      f"{len(next(iter(active_resources.values())))}"] + \
+            shlex.split(getattr(self.args, "launcher_args", "") or "")
         export_cmd = []
+        self.add_export("MASTER_ADDR", str(self.args.master_addr))
+        self.add_export("MASTER_PORT", str(self.args.master_port))
         for k, v in self.exports.items():
             export_cmd += ["-genv", k, str(v)]
         return mpirun_cmd + export_cmd + [sys.executable, "-u", self.user_script] + self.user_arguments
@@ -111,7 +121,10 @@ class SlurmRunner(MultiNodeRunner):
 
     def get_cmd(self, environment, active_resources):
         total_process_count = sum(len(v) for v in active_resources.values())
-        srun_cmd = ["srun", "-n", f"{total_process_count}"]
+        srun_cmd = ["srun", "-n", f"{total_process_count}"] + \
+            shlex.split(getattr(self.args, "launcher_args", "") or "")
+        self.add_export("MASTER_ADDR", str(self.args.master_addr))
+        self.add_export("MASTER_PORT", str(self.args.master_port))
         if getattr(self.args, "include", ""):
             srun_cmd += ["--include", f"{self.args.include}"]
         if getattr(self.args, "exclude", ""):

@@ -1,0 +1,159 @@
+"""Checkpoint conversion tools: zero_to_fp32, universal checkpoint,
+TP reshaping, state-dict factory (reference tests/unit/checkpoint/)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.checkpoint import (convert_zero_checkpoint_to_fp32_state_dict, ds_to_universal,
+                                      get_fp32_state_dict_from_zero_checkpoint,
+                                      load_universal_into_params, load_universal_state_dict,
+                                      merge_qkv_shards, merge_tp_shards, split_qkv_shards,
+                                      split_tp_shards)
+from deepspeed_tpu.checkpoint.state_dict_factory import MegatronSDLoader, SDLoaderFactory
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.models.transformer import TransformerConfig
+
+
+def _tiny_engine(tmp_path, stage=1):
+    cfg = TransformerConfig(vocab_size=64, n_layer=2, n_head=2, d_model=32, d_ff=64,
+                            max_seq=16, remat=False)
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.key(0))
+    dist.set_mesh(None)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+        "mesh": {"dp": -1},
+        "steps_per_print": 0,
+    })
+    return engine, model
+
+
+@pytest.fixture
+def saved_checkpoint(tmp_path, devices):
+    engine, model = _tiny_engine(tmp_path)
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 64, (8, 16)).astype(np.int32)}
+    engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path / "ckpt"), tag="step1")
+    return tmp_path / "ckpt", engine, model
+
+
+class TestZeroToFp32:
+
+    def test_consolidate(self, saved_checkpoint, tmp_path):
+        ckpt_dir, engine, model = saved_checkpoint
+        sd = get_fp32_state_dict_from_zero_checkpoint(str(ckpt_dir), tag="step1")
+        assert "embed.tokens" in sd
+        assert all(v.dtype == np.float32 for v in sd.values())
+        total = sum(v.size for v in sd.values())
+        assert total == model.num_parameters
+
+        out = tmp_path / "fp32.npz"
+        convert_zero_checkpoint_to_fp32_state_dict(str(ckpt_dir), str(out), tag="step1")
+        with np.load(out) as z:
+            assert set(z.files) == set(sd.keys())
+
+    def test_latest_tag_resolution(self, saved_checkpoint):
+        ckpt_dir, _, _ = saved_checkpoint
+        sd = get_fp32_state_dict_from_zero_checkpoint(str(ckpt_dir))  # uses 'latest'
+        assert "embed.tokens" in sd
+
+    def test_masters_preferred(self, saved_checkpoint):
+        """fp32 values must come from the master copy, not the bf16 params."""
+        ckpt_dir, engine, _ = saved_checkpoint
+        sd = get_fp32_state_dict_from_zero_checkpoint(str(ckpt_dir), tag="step1")
+        if engine.state.master is not None:
+            ref = np.asarray(jax.device_get(engine.state.master["embed"]["tokens"]), np.float32)
+            np.testing.assert_allclose(sd["embed.tokens"], ref, rtol=1e-6)
+
+
+class TestUniversalCheckpoint:
+
+    def test_roundtrip(self, saved_checkpoint, tmp_path):
+        ckpt_dir, engine, model = saved_checkpoint
+        uni = tmp_path / "universal"
+        ds_to_universal(str(ckpt_dir), str(uni), tag="step1")
+
+        sd = load_universal_state_dict(str(uni))
+        assert "embed.tokens" in sd
+        # adam moments recovered for every param
+        assert all("exp_avg" in v and "exp_avg_sq" in v for v in sd.values())
+
+        # load back into a fresh param tree
+        params2 = model.init_params(jax.random.key(1))
+        restored = load_universal_into_params(str(uni), params2)
+        ref = get_fp32_state_dict_from_zero_checkpoint(str(ckpt_dir), tag="step1")
+        got = np.asarray(restored["embed"]["tokens"], np.float32)
+        np.testing.assert_allclose(got, ref["embed.tokens"], rtol=1e-6, atol=1e-6)
+
+    def test_missing_param_raises(self, saved_checkpoint, tmp_path):
+        ckpt_dir, _, model = saved_checkpoint
+        uni = tmp_path / "universal"
+        ds_to_universal(str(ckpt_dir), str(uni), tag="step1")
+        os.remove(os.path.join(uni, "params", "embed.tokens.npz"))
+        with pytest.raises(KeyError):
+            load_universal_into_params(str(uni), model.init_params(jax.random.key(0)))
+
+
+class TestReshapeUtils:
+
+    def test_tp_roundtrip(self):
+        full = np.arange(24.0).reshape(4, 6)
+        shards = split_tp_shards(full, dim=1, tp_degree=3)
+        assert all(s.shape == (4, 2) for s in shards)
+        np.testing.assert_array_equal(merge_tp_shards(shards, dim=1), full)
+
+    def test_qkv_roundtrip(self):
+        # fused qkv [D, 3*H]: q|k|v along dim 1
+        full = np.arange(48.0).reshape(2, 24)
+        shards = split_qkv_shards(full, dim=1, tp_degree=2)
+        assert all(s.shape == (2, 12) for s in shards)
+        np.testing.assert_array_equal(merge_qkv_shards(shards, dim=1), full)
+        # rank 0's shard must be [q_0|k_0|v_0], NOT the first half of fused
+        q, k, v = np.split(full, 3, axis=1)
+        expected_rank0 = np.concatenate(
+            [np.split(q, 2, axis=1)[0], np.split(k, 2, axis=1)[0], np.split(v, 2, axis=1)[0]], axis=1)
+        np.testing.assert_array_equal(shards[0], expected_rank0)
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            split_tp_shards(np.zeros((4, 5)), dim=1, tp_degree=3)
+
+
+class TestSDLoader:
+
+    def test_meta_json(self, tmp_path):
+        meta = {"type": "BLOOM", "checkpoints": ["a.pt", "b.pt"], "version": 2.0,
+                "base_dir": "/models/x"}
+        p = tmp_path / "meta.json"
+        p.write_text(json.dumps(meta))
+        sd_type, paths, version = SDLoaderFactory.get_sd_loader_json(str(p))
+        assert sd_type == "BLOOM"
+        assert paths == ["/models/x/a.pt", "/models/x/b.pt"]
+        assert version == 2.0
+
+    def test_merge_and_reslice(self, tmp_path):
+        import torch
+        full_col = np.arange(32.0).reshape(4, 8).astype(np.float32)
+        full_rep = np.ones((4,), np.float32)
+        for rank in range(2):
+            shard = {
+                "attn.qkv.weight": torch.tensor(np.split(full_col, 2, axis=1)[rank]),
+                "ln.weight": torch.tensor(full_rep),
+            }
+            torch.save(shard, tmp_path / f"mp_rank_{rank:02d}.pt")
+        loader = MegatronSDLoader([str(tmp_path / "mp_rank_00.pt"), str(tmp_path / "mp_rank_01.pt")])
+        strategies = {"qkv": 1}
+        merged = loader.load(merge_strategies=strategies)
+        np.testing.assert_array_equal(merged["attn.qkv.weight"], full_col)
+        # reslice to tp=4
+        r1 = loader.load(mp_world_size=4, mp_rank=1, merge_strategies=strategies)
+        np.testing.assert_array_equal(r1["attn.qkv.weight"], np.split(full_col, 4, axis=1)[1])
+        np.testing.assert_array_equal(r1["ln.weight"], full_rep)

@@ -18,6 +18,9 @@ from deepspeed_tpu.launcher.multinode_runner import (MPICHRunner, OpenMPIRunner,
                                                      SlurmRunner)
 
 
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
 def _hostfile(tmp_path, text):
     p = tmp_path / "hostfile"
     p.write_text(text)
@@ -147,7 +150,10 @@ class TestMultinodeRunners:
         cmd = runner.get_cmd({}, self.RESOURCES)
         assert cmd[:3] == ["srun", "-n", "4"]
         assert "--nodes" in cmd
-        assert "--export" in cmd and "ALL,A=b" in cmd
+        assert "--export" in cmd
+        export_val = cmd[cmd.index("--export") + 1]
+        assert export_val.startswith("ALL,") and "A=b" in export_val
+        assert "MASTER_ADDR=worker-0" in export_val  # coordinator rides along
 
 
 class TestLocalLaunch:
@@ -163,7 +169,7 @@ class TestLocalLaunch:
             ".write(json.dumps(out))\n")
         info = ds_runner.encode_world_info({"localhost": [0, 1]})
         env = os.environ.copy()
-        env["PYTHONPATH"] = "/root/repo"
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
         # workers must not grab the TPU or spin up jax
         env["JAX_PLATFORMS"] = "cpu"
         proc = subprocess.run(
@@ -185,7 +191,7 @@ class TestLocalLaunch:
             "time.sleep(30)\n")
         info = ds_runner.encode_world_info({"localhost": [0, 1]})
         env = os.environ.copy()
-        env["PYTHONPATH"] = "/root/repo"
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
         env["JAX_PLATFORMS"] = "cpu"
         proc = subprocess.run(
             [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
