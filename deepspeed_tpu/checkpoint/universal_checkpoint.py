@@ -37,7 +37,7 @@ def ds_to_universal(checkpoint_dir: str, out_dir: str, tag: Optional[str] = None
     with ocp.StandardCheckpointer() as ckptr:
         tree = ckptr.restore(state_path)
 
-    fp32 = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    fp32 = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag, _tree=tree)
 
     # optimizer moments: the optax adam-family state was saved flattened in
     # deterministic tree order — [count, mu..., nu..., ...] — so the first
@@ -103,15 +103,18 @@ def load_universal_into_params(universal_dir: str, params: Any, dtype=None) -> A
 
     sd = load_universal_state_dict(universal_dir)
 
+    from deepspeed_tpu.utils.pytree import leaf_key
+
     def replace(path_tuple, leaf):
-        dotted = ".".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path_tuple)
+        dotted = leaf_key(path_tuple)
         if dotted not in sd:
             raise KeyError(f"universal checkpoint missing parameter {dotted}")
-        arr = sd[dotted]["param"].astype(dtype or leaf.dtype)
+        out_dtype = dtype or leaf.dtype
+        arr = sd[dotted]["param"]
         if arr.shape != leaf.shape:
             raise ValueError(f"shape mismatch for {dotted}: ckpt {arr.shape} vs model {leaf.shape}")
         if hasattr(leaf, "sharding"):
-            return jax.device_put(jnp.asarray(arr, dtype=leaf.dtype), leaf.sharding)
-        return jnp.asarray(arr, dtype=leaf.dtype)
+            return jax.device_put(jnp.asarray(arr, dtype=out_dtype), leaf.sharding)
+        return jnp.asarray(arr, dtype=out_dtype)
 
     return jax.tree_util.tree_map_with_path(replace, params)

@@ -21,15 +21,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 
-def _leaf_paths(tree: Any, prefix: str = "") -> Dict[str, Any]:
-    """Flatten a nested dict tree into {'a.b.c': leaf}."""
-    out: Dict[str, Any] = {}
-    if isinstance(tree, dict):
-        for k, v in tree.items():
-            out.update(_leaf_paths(v, prefix + str(k) + "."))
-    else:
-        out[prefix[:-1]] = tree
-    return out
+from deepspeed_tpu.utils.pytree import leaf_paths as _leaf_paths
 
 
 def _resolve_tag(checkpoint_dir: str, tag: Optional[str]) -> str:
@@ -43,19 +35,22 @@ def _resolve_tag(checkpoint_dir: str, tag: Optional[str]) -> str:
 
 
 def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
-                                             tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+                                             tag: Optional[str] = None,
+                                             _tree: Any = None) -> Dict[str, np.ndarray]:
     """The reference's same-named API (``zero_to_fp32.py``): a dict of fp32
-    numpy arrays keyed by dotted parameter path."""
-    import orbax.checkpoint as ocp
-
+    numpy arrays keyed by dotted parameter path. ``_tree``: optionally pass
+    an already-restored state tree to avoid a second disk read."""
     checkpoint_dir = os.path.abspath(checkpoint_dir)
     tag = _resolve_tag(checkpoint_dir, tag)
     state_path = os.path.join(checkpoint_dir, tag, "state")
     if not os.path.isdir(state_path):
         raise FileNotFoundError(f"checkpoint state not found at {state_path}")
 
-    with ocp.StandardCheckpointer() as ckptr:
-        tree = ckptr.restore(state_path)
+    tree = _tree
+    if tree is None:
+        import orbax.checkpoint as ocp
+        with ocp.StandardCheckpointer() as ckptr:
+            tree = ckptr.restore(state_path)
 
     params = _leaf_paths(tree["params"])
     masters = _leaf_paths(tree["master"]) if tree.get("master") is not None else {}

@@ -181,8 +181,10 @@ def init_distributed(dist_backend: Optional[str] = None,
         if "OMPI_COMM_WORLD_SIZE" in os.environ:
             nproc = int(os.environ["OMPI_COMM_WORLD_SIZE"])
             proc_id = int(os.environ.get("OMPI_COMM_WORLD_RANK", 0))
-        elif "SLURM_NTASKS" in os.environ:
-            nproc = int(os.environ["SLURM_NTASKS"])
+        elif int(os.environ.get("SLURM_STEP_NUM_TASKS", 0)) > 1:
+            # srun sets step-level task counts; plain sbatch scripts (where a
+            # single python process must NOT join a phantom world) do not
+            nproc = int(os.environ["SLURM_STEP_NUM_TASKS"])
             proc_id = int(os.environ.get("SLURM_PROCID", 0))
         elif "PMI_SIZE" in os.environ:
             nproc = int(os.environ["PMI_SIZE"])

@@ -1,0 +1,152 @@
+"""Activation checkpointing (reference
+``runtime/activation_checkpointing/checkpointing.py`` — the Megatron-derived
+``CheckpointFunction`` with activation partitioning, CPU checkpointing, RNG
+fork tracking and ``configure()``).
+
+TPU mapping: manual save/recompute becomes ``jax.checkpoint`` (remat).
+
+- default → full remat (save only inputs, like the reference's checkpoint)
+- ``partition_activations`` → residuals carry a sharding constraint over the
+  tp/sp axes instead of being gathered (the reference splits saved
+  activations across TP ranks, ``:366``); under SPMD saved residuals are
+  already sharded like the forward values, so this is the default behavior
+  and the flag simply keeps the constraint explicit
+- ``cpu_checkpointing`` → remat policy that offloads saved dots to pinned
+  host memory (``save_and_offload_only_these_names`` family)
+- ``CudaRNGStatesTracker`` → named JAX PRNG streams forked per checkpoint
+  region (``get_rng_tracker``/``model_parallel_seed``)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+_config: Dict[str, Any] = {
+    "partition_activations": False,
+    "contiguous_memory_optimization": False,
+    "cpu_checkpointing": False,
+    "number_checkpoints": None,
+    "synchronize_checkpoint_boundary": False,
+    "profile": False,
+    "configured": False,
+}
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None, checkpoint_in_cpu=None,
+              synchronize=None, profile=None) -> None:
+    """Reference ``configure()`` (``checkpointing.py:789``)."""
+    cfg = None
+    if deepspeed_config is not None:
+        if hasattr(deepspeed_config, "activation_checkpointing_config"):
+            cfg = deepspeed_config.activation_checkpointing_config
+        elif isinstance(deepspeed_config, dict):
+            from deepspeed_tpu.runtime.activation_checkpointing.config import (
+                DeepSpeedActivationCheckpointingConfig)
+            cfg = DeepSpeedActivationCheckpointingConfig(
+                **deepspeed_config.get("activation_checkpointing", {}))
+    if cfg is not None:
+        _config.update(
+            partition_activations=cfg.partition_activations,
+            contiguous_memory_optimization=cfg.contiguous_memory_optimization,
+            cpu_checkpointing=cfg.cpu_checkpointing,
+            number_checkpoints=cfg.number_checkpoints,
+            synchronize_checkpoint_boundary=cfg.synchronize_checkpoint_boundary,
+            profile=cfg.profile)
+    for key, val in (("partition_activations", partition_activations),
+                     ("contiguous_memory_optimization", contiguous_checkpointing),
+                     ("number_checkpoints", num_checkpoints),
+                     ("cpu_checkpointing", checkpoint_in_cpu),
+                     ("synchronize_checkpoint_boundary", synchronize),
+                     ("profile", profile)):
+        if val is not None:
+            _config[key] = val
+    _config["configured"] = True
+
+
+def is_configured() -> bool:
+    return _config["configured"]
+
+
+def reset() -> None:
+    for key in _config:
+        _config[key] = False if isinstance(_config[key], bool) else None
+    _config["configured"] = False
+
+
+def _policy():
+    """Map config → jax.checkpoint policy."""
+    if _config["cpu_checkpointing"]:
+        pols = jax.checkpoint_policies
+        # offload matmul results to pinned host memory instead of recompute
+        if hasattr(pols, "offload_dot_with_no_batch_dims"):
+            return pols.offload_dot_with_no_batch_dims("device", "pinned_host")
+    return None  # full remat: save nothing but the inputs
+
+
+def checkpoint(function: Callable, *args):
+    """Reference ``checkpoint(function, *args)`` (``CheckpointFunction``,
+    ``checkpointing.py:474``): run ``function`` saving only its inputs (or
+    the configured policy's residuals); recompute in backward."""
+    policy = _policy()
+    wrapped = jax.checkpoint(function, policy=policy, prevent_cse=False)
+    return wrapped(*args)
+
+
+def checkpoint_wrapper(function: Callable) -> Callable:
+    """Decorator form used by model code (``lax.scan`` bodies)."""
+    policy = _policy()
+    return jax.checkpoint(function, policy=policy, prevent_cse=False)
+
+
+# ------------------------------------------------------------------ #
+# RNG tracking (reference CudaRNGStatesTracker, checkpointing.py:121)
+
+_MODEL_PARALLEL_RNG = "model-parallel-rng"
+
+
+class RNGStatesTracker:
+    """Named PRNG streams; ``fork`` yields a fresh key per call within a
+    name, deterministically — the JAX analogue of forked CUDA RNG states."""
+
+    def __init__(self):
+        self.states: Dict[str, jax.Array] = {}
+
+    def reset(self) -> None:
+        self.states = {}
+
+    def get_states(self) -> Dict[str, jax.Array]:
+        return dict(self.states)
+
+    def set_states(self, states: Dict[str, jax.Array]) -> None:
+        self.states = dict(states)
+
+    def add(self, name: str, seed: int) -> None:
+        if name in self.states:
+            raise ValueError(f"rng state {name} already exists")
+        self.states[name] = jax.random.key(seed)
+
+    def fork(self, name: str = _MODEL_PARALLEL_RNG) -> jax.Array:
+        """Split the named stream and return a fresh key."""
+        if name not in self.states:
+            raise ValueError(f"rng state {name} was never seeded")
+        self.states[name], out = jax.random.split(self.states[name])
+        return out
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    return _RNG_TRACKER
+
+
+def model_parallel_seed(seed: int, tp_rank: int = 0) -> None:
+    """Reference ``model_parallel_cuda_manual_seed`` (``:198``): the model-
+    parallel stream is offset per TP rank so dropout differs across ranks
+    while the default stream stays identical."""
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add(_MODEL_PARALLEL_RNG, seed + 2718 + tp_rank)

@@ -1,0 +1,5 @@
+from deepspeed_tpu.runtime.data_pipeline.data_routing.basic_layer import (RandomLayerTokenDrop,
+                                                                          gather_tokens,
+                                                                          scatter_tokens,
+                                                                          token_sample)
+from deepspeed_tpu.runtime.data_pipeline.data_routing.scheduler import RandomLTDScheduler

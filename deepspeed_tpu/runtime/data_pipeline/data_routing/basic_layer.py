@@ -1,0 +1,63 @@
+"""Random layerwise token dropping — random-LTD (reference
+``runtime/data_pipeline/data_routing/basic_layer.py`` + the CUDA token
+sort/gather/scatter kernels in ``csrc/random_ltd/``).
+
+TPU-native: the comparison-free token sort + gather/scatter become
+``jax.random.permutation`` + ``jnp.take``/scatter — static shapes per
+(seq_len, keep_count) pair so everything stays jittable. The wrapper drops
+tokens before a layer and scatters the layer's outputs back into the full
+sequence (the skipped tokens pass through the residual stream unchanged).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def token_sample(rng, seq_len: int, keep: int):
+    """Sorted random subset of ``keep`` token indices (reference
+    ``token_sort.cu``: comparison-free sort so kept tokens stay in order)."""
+    perm = jax.random.permutation(rng, seq_len)
+    return jnp.sort(perm[:keep])
+
+
+def gather_tokens(x, indices):
+    """x [B, S, D] → [B, keep, D] (reference ``gather_scatter.cu``)."""
+    return jnp.take(x, indices, axis=1)
+
+
+def scatter_tokens(full, part, indices):
+    """Scatter ``part`` [B, keep, D] back over ``full`` [B, S, D]."""
+    return full.at[:, indices, :].set(part)
+
+
+def slice_attention_mask(mask_bias, indices):
+    """Key-side additive mask [B, S] → [B, keep] (reference
+    ``slice_attn_masks.cu``)."""
+    if mask_bias is None:
+        return None
+    return jnp.take(mask_bias, indices, axis=1)
+
+
+class RandomLayerTokenDrop:
+    """Wrap a transformer layer so it runs on a random token subset.
+
+    ``layer_fn(x_subset, mask_subset, *args) -> y_subset``; dropped tokens
+    ride the residual stream untouched.
+    """
+
+    def __init__(self, layer_fn: Callable):
+        self.layer_fn = layer_fn
+
+    def __call__(self, x, rng, keep: int, mask_bias=None, *args):
+        B, S, D = x.shape
+        if keep >= S:
+            return self.layer_fn(x, mask_bias, *args)
+        idx = token_sample(rng, S, keep)
+        sub = gather_tokens(x, idx)
+        sub_mask = slice_attention_mask(mask_bias, idx)
+        out = self.layer_fn(sub, sub_mask, *args)
+        return scatter_tokens(x, out, idx)

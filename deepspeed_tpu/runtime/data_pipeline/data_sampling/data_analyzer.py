@@ -1,0 +1,92 @@
+"""Offline difficulty analysis (reference
+``runtime/data_pipeline/data_sampling/data_analyzer.py``).
+
+Runs user metric functions over a dataset (optionally in parallel worker
+shards), writes per-sample metric values plus a difficulty→sample-ids index
+— the files :class:`DeepSpeedDataSampler` consumes for curriculum sampling.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder)
+
+
+def _metric_value_path(save_path: str, metric_name: str) -> str:
+    return os.path.join(save_path, f"{metric_name}_values")
+
+
+def _metric_index_path(save_path: str, metric_name: str) -> str:
+    return os.path.join(save_path, f"{metric_name}_index.json")
+
+
+class DataAnalyzer:
+
+    def __init__(self, dataset, metric_names: Sequence[str],
+                 metric_functions: Sequence[Callable], save_path: str,
+                 num_workers: int = 1, worker_id: int = 0,
+                 batch_size: int = 1024):
+        self.dataset = dataset
+        self.metric_names = list(metric_names)
+        self.metric_functions = list(metric_functions)
+        self.save_path = save_path
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.batch_size = batch_size
+
+    def _worker_range(self):
+        n = len(self.dataset)
+        per = (n + self.num_workers - 1) // self.num_workers
+        start = self.worker_id * per
+        return start, min(n, start + per)
+
+    def run_map(self) -> None:
+        """Compute metric values for this worker's shard and persist them."""
+        os.makedirs(self.save_path, exist_ok=True)
+        start, end = self._worker_range()
+        for name, fn in zip(self.metric_names, self.metric_functions):
+            values = np.asarray([int(fn(self.dataset[i])) for i in range(start, end)],
+                                dtype=np.int64)
+            np.save(os.path.join(self.save_path, f"{name}_worker{self.worker_id}.npy"), values)
+
+    def run_reduce(self) -> None:
+        """Merge all workers' shards into the value file + difficulty index."""
+        for name in self.metric_names:
+            parts = []
+            for w in range(self.num_workers):
+                path = os.path.join(self.save_path, f"{name}_worker{w}.npy")
+                parts.append(np.load(path))
+            values = np.concatenate(parts)
+
+            builder = MMapIndexedDatasetBuilder(_metric_value_path(self.save_path, name),
+                                                dtype=np.int64)
+            builder.add_item(values)
+            builder.finalize()
+
+            index: Dict[int, List[int]] = {}
+            for sample_id, v in enumerate(values.tolist()):
+                index.setdefault(v, []).append(sample_id)
+            with open(_metric_index_path(self.save_path, name), "w") as f:
+                json.dump({str(k): v for k, v in sorted(index.items())}, f)
+
+    def run(self) -> None:
+        self.run_map()
+        if self.worker_id == 0 and self.num_workers == 1:
+            self.run_reduce()
+
+
+def load_metric_values(save_path: str, metric_name: str) -> np.ndarray:
+    ds = MMapIndexedDataset(_metric_value_path(save_path, metric_name))
+    return np.asarray(ds[0])
+
+
+def load_metric_index(save_path: str, metric_name: str) -> Dict[int, List[int]]:
+    with open(_metric_index_path(save_path, metric_name)) as f:
+        raw = json.load(f)
+    return {int(k): v for k, v in raw.items()}

@@ -1,0 +1,104 @@
+"""Memory-mapped indexed dataset (reference
+``runtime/data_pipeline/data_sampling/indexed_dataset.py`` — the
+Megatron-derived ``MMapIndexedDataset``).
+
+Binary layout (``.bin`` = concatenated sample payloads, ``.idx`` = header +
+per-sample dtype/sizes/offsets) with zero-copy ``np.memmap`` reads — the
+host-side data path that feeds TPU input pipelines without materialising
+the dataset in RAM.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+           6: np.float32, 7: np.float64, 8: np.uint16}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer: ``add_item`` per sample, then ``finalize``."""
+
+    def __init__(self, out_prefix: str, dtype=np.int32):
+        self._prefix = out_prefix
+        self._dtype = np.dtype(dtype)
+        self._data_file = open(data_file_path(out_prefix), "wb")
+        self._sizes: List[int] = []
+
+    def add_item(self, tokens: Sequence[int]) -> None:
+        arr = np.asarray(tokens, dtype=self._dtype)
+        self._data_file.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    def merge_file_(self, another_prefix: str) -> None:
+        other = MMapIndexedDataset(another_prefix)
+        for i in range(len(other)):
+            self.add_item(other[i])
+
+    def finalize(self) -> None:
+        self._data_file.close()
+        sizes = np.asarray(self._sizes, dtype=np.int64)
+        offsets = np.concatenate([[0], np.cumsum(sizes)])[:-1] * self._dtype.itemsize
+        with open(index_file_path(self._prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<QBQ", _VERSION, _DTYPE_CODES[self._dtype], len(sizes)))
+            f.write(sizes.tobytes())
+            f.write(offsets.astype(np.int64).tobytes())
+
+
+class MMapIndexedDataset:
+    """Zero-copy random access over a built dataset."""
+
+    def __init__(self, prefix: str):
+        self._prefix = prefix
+        with open(index_file_path(prefix), "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(f"{index_file_path(prefix)}: bad magic {magic!r}")
+            version, dtype_code, count = struct.unpack("<QBQ", f.read(17))
+            if version != _VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            self._dtype = np.dtype(_DTYPES[dtype_code])
+            self._sizes = np.frombuffer(f.read(8 * count), dtype=np.int64)
+            self._offsets = np.frombuffer(f.read(8 * count), dtype=np.int64)
+        self._data = np.memmap(data_file_path(prefix), dtype=self._dtype, mode="r")
+
+    def __len__(self) -> int:
+        return len(self._sizes)
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return self._sizes
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return [self[i] for i in range(*idx.indices(len(self)))]
+        size = self._sizes[idx]
+        start = self._offsets[idx] // self._dtype.itemsize
+        return self._data[start:start + size]
+
+    def get(self, idx: int, offset: int = 0, length: Optional[int] = None) -> np.ndarray:
+        sample = self[idx]
+        if length is None:
+            length = len(sample) - offset
+        return sample[offset:offset + length]
+
+    @staticmethod
+    def exists(prefix: str) -> bool:
+        return os.path.exists(index_file_path(prefix)) and os.path.exists(data_file_path(prefix))

@@ -179,6 +179,33 @@ class DeepSpeedEngine:
         from deepspeed_tpu.monitor.monitor import MonitorMaster
         self.monitor = MonitorMaster(self._config.monitor_config)
 
+        # ---- curriculum learning (reference engine.py:1691 legacy path +
+        # data_efficiency data_sampling.curriculum_learning) ----
+        self.curriculum_scheduler = None
+        self._curriculum_metric = None
+        raw = self._config._param_dict
+        legacy = raw.get("curriculum_learning", {})
+        from deepspeed_tpu.runtime.data_pipeline.config import (get_data_efficiency_config,
+                                                                get_data_sampling)
+        de = get_data_efficiency_config(raw)
+        sampling = get_data_sampling(raw)
+        de_curr = sampling["curriculum_learning"]
+        curr_cfg = None
+        if isinstance(legacy, dict) and legacy.get("enabled", False):
+            curr_cfg = legacy
+        elif de["enabled"] and sampling["enabled"] and de_curr.get("enabled", False):
+            # the parent data_efficiency/data_sampling switches gate the
+            # feature (reference runtime/data_pipeline/config.py semantics)
+            curr_cfg = de_curr
+        if curr_cfg is not None:
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+                CurriculumScheduler)
+            self.curriculum_scheduler = CurriculumScheduler(dict(curr_cfg))
+            self._curriculum_metric = curr_cfg.get("curriculum_type", "seqlen")
+        # host-side step counter for curriculum (avoids a device sync per
+        # train_batch just to read state.global_steps)
+        self._host_global_steps = 0
+
         # ---- dataloader ----
         self.training_dataloader = None
         if training_data is not None:
@@ -488,6 +515,15 @@ class DeepSpeedEngine:
         else:
             batch = jax.tree.map(lambda x: jnp.reshape(jnp.asarray(x), (gas, -1) + tuple(x.shape[1:])), batch)
 
+        # curriculum learning: truncate the sequence dim to the scheduled
+        # difficulty (reference engine.py:1691-1694 legacy seqlen curriculum)
+        if self.curriculum_scheduler is not None and self._curriculum_metric == "seqlen":
+            self._host_global_steps += 1
+            difficulty = self.curriculum_scheduler.update_difficulty(self._host_global_steps)
+            batch = jax.tree.map(
+                lambda x: x[:, :, :difficulty] if x.ndim >= 3 and x.shape[2] > difficulty else x,
+                batch)
+
         # shard the batch over the data axes
         dp_axes = tuple(dist.data_parallel_axes(self.mesh))
         if dp_axes:
@@ -702,5 +738,9 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
         from deepspeed_tpu.runtime.checkpoint_engine.engine import load_engine_checkpoint
-        return load_engine_checkpoint(self, load_dir, tag=tag, load_optimizer_states=load_optimizer_states,
-                                      load_module_only=load_module_only)
+        result = load_engine_checkpoint(self, load_dir, tag=tag,
+                                        load_optimizer_states=load_optimizer_states,
+                                        load_module_only=load_module_only)
+        # resync the host-side curriculum counter with the restored step
+        self._host_global_steps = int(self.global_steps)
+        return result

@@ -26,9 +26,7 @@ import numpy as np
 from deepspeed_tpu.utils.logging import logger
 
 
-def _leaf_key(path) -> str:
-    # "." separator: keys double as NVMe swap file names, so no os.sep
-    return ".".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+from deepspeed_tpu.utils.pytree import leaf_key as _leaf_key
 
 
 class HostOffloadOptimizer:

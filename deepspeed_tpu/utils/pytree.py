@@ -1,0 +1,25 @@
+"""Pytree path/key helpers shared by offload, checkpoint tools, and the
+universal-checkpoint loader — ONE naming scheme for dotted leaf keys so
+checkpoint files, swap files, and lookups always line up."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+def leaf_key(path) -> str:
+    """jax tree path → dotted key. "." separator: keys double as NVMe swap
+    file names, so no os.sep."""
+    return ".".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def leaf_paths(tree: Any, prefix: str = "") -> Dict[str, Any]:
+    """Flatten a nested dict tree into {'a.b.c': leaf} (same naming as
+    :func:`leaf_key` for dict-only trees)."""
+    out: Dict[str, Any] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(leaf_paths(v, prefix + str(k) + "."))
+    else:
+        out[prefix[:-1]] = tree
+    return out

@@ -1,0 +1,262 @@
+"""Data efficiency pipeline + activation checkpointing tests (reference
+tests/unit/runtime/test_data_efficiency.py and
+tests/unit/runtime/activation_checkpointing/)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ckpt
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.data_pipeline.data_routing import (RandomLayerTokenDrop,
+                                                              RandomLTDScheduler, gather_tokens,
+                                                              scatter_tokens, token_sample)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling import (DataAnalyzer,
+                                                               DeepSpeedDataSampler,
+                                                               MMapIndexedDataset,
+                                                               MMapIndexedDatasetBuilder)
+
+
+class TestCurriculumScheduler:
+
+    def test_fixed_linear(self):
+        s = CurriculumScheduler({
+            "curriculum_type": "seqlen", "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+        assert s.update_difficulty(0) == 8
+        mid = s.update_difficulty(50)
+        assert 32 <= mid <= 40
+        assert s.update_difficulty(100) == 64
+        assert s.update_difficulty(500) == 64
+        # once max is reached the state is sticky (update_difficulty no-ops)
+        assert s.update_difficulty(50) == 64
+        assert s.get_difficulty(50) == mid  # pure query still schedule-based
+        # difficulty is always a multiple of the step
+        for step in (10, 30, 70):
+            assert s.get_difficulty(step) % 8 == 0
+
+    def test_fixed_root(self):
+        s = CurriculumScheduler({
+            "curriculum_type": "seqlen", "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_root",
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8,
+                                "root_degree": 2}})
+        # sqrt ramp rises faster early than linear
+        assert s.get_difficulty(25) >= 8 + (64 - 8) * 0.25
+        assert s.get_difficulty(100) == 64
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler({
+            "curriculum_type": "seqlen", "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_discrete",
+            "schedule_config": {"difficulty": [8, 16, 64], "max_step": [10, 20]}})
+        assert s.get_difficulty(5) == 8
+        assert s.get_difficulty(15) == 16
+        assert s.get_difficulty(25) == 64
+
+    def test_state_roundtrip(self):
+        s = CurriculumScheduler({
+            "curriculum_type": "seqlen", "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+        s.update_difficulty(50)
+        state = s.get_state()
+        s2 = CurriculumScheduler({
+            "curriculum_type": "seqlen", "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+        s2.set_state(state)
+        assert s2.get_current_difficulty() == s.get_current_difficulty()
+
+
+class TestIndexedDataset:
+
+    def test_roundtrip(self, tmp_path):
+        prefix = str(tmp_path / "data")
+        builder = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+        samples = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [10]]
+        for s in samples:
+            builder.add_item(s)
+        builder.finalize()
+
+        ds = MMapIndexedDataset(prefix)
+        assert len(ds) == 4
+        assert list(ds.sizes) == [3, 2, 4, 1]
+        for i, s in enumerate(samples):
+            np.testing.assert_array_equal(ds[i], np.asarray(s, np.int32))
+        np.testing.assert_array_equal(ds.get(2, offset=1, length=2), [7, 8])
+        assert MMapIndexedDataset.exists(prefix)
+        assert not MMapIndexedDataset.exists(prefix + "_nope")
+
+
+class TestDataAnalyzer:
+
+    def test_analyze_and_sample(self, tmp_path):
+        rng = np.random.default_rng(0)
+        dataset = [rng.integers(0, 100, size=rng.integers(4, 33)).tolist() for _ in range(64)]
+        analyzer = DataAnalyzer(dataset, ["seqlen"], [len], str(tmp_path / "idx"))
+        analyzer.run()
+
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+            load_metric_index, load_metric_values)
+        values = load_metric_values(str(tmp_path / "idx"), "seqlen")
+        assert list(values) == [len(s) for s in dataset]
+        index = load_metric_index(str(tmp_path / "idx"), "seqlen")
+        for difficulty, ids in index.items():
+            assert all(len(dataset[i]) == difficulty for i in ids)
+
+        sched = CurriculumScheduler({
+            "curriculum_type": "seqlen", "min_difficulty": 8, "max_difficulty": 32,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10, "difficulty_step": 8}})
+        sampler = DeepSpeedDataSampler(
+            total_samples=64, micro_batch_size=4, data_parallel_rank=0,
+            data_parallel_size=2, curriculum_scheduler=sched, difficulties=values)
+        it = iter(sampler)
+        first = next(it)
+        assert len(first) == 4
+        # early batches must respect the low difficulty cap (or be the easiest)
+        assert all(values[i] <= 8 for i in first) or len([v for v in values if v <= 8]) < 16
+
+    def test_sampler_dp_disjoint(self):
+        samplers = [DeepSpeedDataSampler(total_samples=32, micro_batch_size=4,
+                                         data_parallel_rank=r, data_parallel_size=2, seed=7)
+                    for r in range(2)]
+        b0, b1 = next(iter(samplers[0])), next(iter(samplers[1]))
+        assert set(b0).isdisjoint(set(b1))
+
+    def test_sampler_state(self):
+        s = DeepSpeedDataSampler(total_samples=32, micro_batch_size=4,
+                                 data_parallel_rank=0, data_parallel_size=1)
+        it = iter(s)
+        next(it), next(it)
+        sd = s.state_dict()
+        assert sd["consumed_samples"] == 8
+        s2 = DeepSpeedDataSampler(total_samples=32, micro_batch_size=4,
+                                  data_parallel_rank=0, data_parallel_size=1)
+        s2.load_state_dict(sd)
+        assert s2.consumed_samples == 8
+
+
+class TestRandomLTD:
+
+    def test_token_ops(self):
+        x = jnp.arange(2 * 16 * 4, dtype=jnp.float32).reshape(2, 16, 4)
+        idx = token_sample(jax.random.key(0), 16, 8)
+        assert idx.shape == (8,)
+        assert bool(jnp.all(idx[1:] > idx[:-1]))  # sorted, order-preserving
+        sub = gather_tokens(x, idx)
+        assert sub.shape == (2, 8, 4)
+        back = scatter_tokens(jnp.zeros_like(x), sub, idx)
+        np.testing.assert_array_equal(np.asarray(back[:, idx, :]), np.asarray(sub))
+
+    def test_layer_wrapper_passthrough(self):
+        """Dropped tokens ride the residual; kept tokens get layer output."""
+        def layer_fn(x, mask):
+            return x + 100.0
+
+        wrapped = RandomLayerTokenDrop(layer_fn)
+        x = jnp.zeros((1, 16, 2))
+        out = wrapped(x, jax.random.key(1), keep=4)
+        changed = np.asarray((out[0, :, 0] == 100.0))
+        assert changed.sum() == 4
+        # keep >= S short-circuits to the plain layer
+        out_full = wrapped(x, jax.random.key(1), keep=16)
+        assert bool(jnp.all(out_full == 100.0))
+
+    def test_scheduler_ramp(self):
+        s = RandomLTDScheduler({
+            "random_ltd_schedule": {"min_value": 64, "max_value": 512,
+                                    "schedule_type": "fixed_linear",
+                                    "schedule_config": {"total_curriculum_step": 100,
+                                                        "seq_per_step": 16}}})
+        assert s.update_seq(0) == 64
+        assert s.update_seq(50) in range(64, 513, 16)
+        assert s.update_seq(100) == 512
+        sd = s.state_dict()
+        s2 = RandomLTDScheduler({"random_ltd_schedule": {"min_value": 64, "max_value": 512}})
+        s2.load_state_dict(sd)
+        assert s2.get_current_seq() == 512
+
+
+class TestActivationCheckpointing:
+
+    def test_checkpoint_matches_plain(self):
+        def fn(x, w):
+            return jnp.tanh(x @ w).sum()
+
+        x = jax.random.normal(jax.random.key(0), (8, 16))
+        w = jax.random.normal(jax.random.key(1), (16, 16))
+        plain_v, plain_g = jax.value_and_grad(fn, argnums=(0, 1))(x, w)
+        ck_v, ck_g = jax.value_and_grad(lambda x, w: ckpt.checkpoint(fn, x, w),
+                                        argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(float(ck_v), float(plain_v), rtol=1e-6)
+        for a, b in zip(ck_g, plain_g):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    def test_configure_and_reset(self):
+        ckpt.reset()
+        assert not ckpt.is_configured()
+        ckpt.configure(deepspeed_config={"activation_checkpointing": {
+            "partition_activations": True, "cpu_checkpointing": False}})
+        assert ckpt.is_configured()
+        assert ckpt._config["partition_activations"]
+        ckpt.reset()
+        assert not ckpt.is_configured()
+
+    def test_rng_tracker(self):
+        ckpt.model_parallel_seed(1234, tp_rank=0)
+        t = ckpt.get_rng_tracker()
+        k1 = t.fork()
+        k2 = t.fork()
+        assert not np.array_equal(jax.random.key_data(k1), jax.random.key_data(k2))
+        # per-rank streams differ
+        ckpt.model_parallel_seed(1234, tp_rank=1)
+        k1_rank1 = ckpt.get_rng_tracker().fork()
+        assert not np.array_equal(jax.random.key_data(k1), jax.random.key_data(k1_rank1))
+        # reseeding reproduces the stream
+        ckpt.model_parallel_seed(1234, tp_rank=0)
+        k1_again = ckpt.get_rng_tracker().fork()
+        np.testing.assert_array_equal(jax.random.key_data(k1), jax.random.key_data(k1_again))
+
+
+class TestEngineCurriculum:
+
+    def test_seqlen_truncation(self, devices):
+        from deepspeed_tpu.models import CausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+
+        cfg = TransformerConfig(vocab_size=64, n_layer=1, n_head=2, d_model=32, d_ff=64,
+                                max_seq=32, remat=False)
+        model = CausalLM(cfg)
+        dist.set_mesh(None)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=model.init_params(jax.random.key(0)), config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "mesh": {"dp": -1},
+                "steps_per_print": 0,
+                "curriculum_learning": {
+                    "enabled": True, "curriculum_type": "seqlen",
+                    "min_difficulty": 8, "max_difficulty": 32,
+                    "schedule_type": "fixed_linear",
+                    "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 8},
+                },
+            })
+        assert engine.curriculum_scheduler is not None
+        batch = {"input_ids": np.random.default_rng(0).integers(0, 64, (8, 32)).astype(np.int32)}
+        l0 = engine.train_batch(batch)   # step 1: difficulty 16 (step/4*24...) truncated
+        assert np.isfinite(l0)
+        # after enough steps, difficulty reaches max and full seq is used
+        for _ in range(5):
+            l = engine.train_batch(batch)
+        assert engine.curriculum_scheduler.get_current_difficulty() == 32
+        assert np.isfinite(l)
+        dist.set_mesh(None)
