@@ -1,0 +1,17 @@
+"""Compression (reference ``deepspeed/compression/``): QAT quantization,
+structured/unstructured pruning, schedule-gated activation, and
+redundancy_clean for deployment."""
+
+from deepspeed_tpu.compression.compress import (CompressedModel, init_compression,
+                                                redundancy_clean)
+from deepspeed_tpu.compression.config import get_compression_config
+from deepspeed_tpu.compression.functional import (channel_mask, fake_quantize, head_mask,
+                                                  prune, quantize_activation, row_mask,
+                                                  sparse_mask)
+from deepspeed_tpu.compression.scheduler import CompressionScheduler
+
+__all__ = [
+    "init_compression", "redundancy_clean", "CompressedModel", "CompressionScheduler",
+    "get_compression_config", "fake_quantize", "quantize_activation", "prune",
+    "sparse_mask", "row_mask", "channel_mask", "head_mask",
+]

@@ -1,0 +1,123 @@
+"""Compression primitives (reference ``compression/basic_layer.py`` +
+``compression/utils.py`` re-designed functionally).
+
+The reference implements compression as stateful ``nn.Module`` subclasses
+(``LinearLayer_Compress`` etc., ``basic_layer.py:118-860``). In a functional
+param-tree world the same math becomes pure transforms:
+
+- QAT fake quantization (symmetric/asymmetric, per-tensor or grouped) with a
+  straight-through-estimator gradient (``custom_vjp``: identity backward)
+- magnitude pruning masks: unstructured (sparse), row, channel (column),
+  and attention-head granularity
+
+All are jittable; XLA fuses the quant/dequant into adjacent matmuls on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# ------------------------------------------------------------------ #
+# fake quantization (QAT) with straight-through estimator
+
+def _quant_dequant(w, bits: int, symmetric: bool, groups: int):
+    """Quantize → dequantize in fp32 (the non-differentiable core)."""
+    orig_shape = w.shape
+    flat = w.astype(jnp.float32).reshape(groups, -1)
+    qmax = 2.0 ** (bits - 1) - 1  # symmetric range
+    if symmetric:
+        scale = jnp.max(jnp.abs(flat), axis=1, keepdims=True) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.clip(jnp.round(flat / scale), -qmax - 1, qmax)
+        out = q * scale
+    else:
+        lo = jnp.min(flat, axis=1, keepdims=True)
+        hi = jnp.max(flat, axis=1, keepdims=True)
+        levels = 2.0**bits - 1
+        scale = jnp.where(hi > lo, (hi - lo) / levels, 1.0)
+        q = jnp.clip(jnp.round((flat - lo) / scale), 0, levels)
+        out = q * scale + lo
+    return out.reshape(orig_shape)
+
+
+import functools
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def fake_quantize(w, bits: int = 8, symmetric: bool = True, groups: int = 1):
+    return _quant_dequant(w, bits, symmetric, groups).astype(w.dtype)
+
+
+def _fq_fwd(w, bits, symmetric, groups):
+    return fake_quantize(w, bits, symmetric, groups), None
+
+
+def _fq_bwd(bits, symmetric, groups, _, g):
+    # straight-through estimator: gradient passes through the rounding
+    return (g,)
+
+
+fake_quantize.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quantize_activation(x, bits: int = 8, symmetric: bool = True):
+    """Dynamic-range activation fake-quant (per-tensor)."""
+    return fake_quantize(x, bits, symmetric, 1)
+
+
+# ------------------------------------------------------------------ #
+# pruning masks (all return same-shape 0/1 masks; "l1" = magnitude)
+
+def sparse_mask(w, dense_ratio: float) -> jnp.ndarray:
+    """Unstructured magnitude mask keeping the top ``dense_ratio`` fraction."""
+    flat = jnp.abs(w).ravel()
+    k = max(1, int(flat.size * dense_ratio))
+    threshold = jnp.sort(flat)[-k]
+    return (jnp.abs(w) >= threshold).astype(w.dtype)
+
+
+def row_mask(w, dense_ratio: float) -> jnp.ndarray:
+    """Keep the top rows (output neurons) by L1 norm; w [in, out] → mask over
+    dim 1 broadcast to w's shape (reference row pruning prunes weight rows
+    feeding the next layer)."""
+    norms = jnp.sum(jnp.abs(w), axis=0)
+    k = max(1, int(norms.size * dense_ratio))
+    threshold = jnp.sort(norms)[-k]
+    keep = (norms >= threshold).astype(w.dtype)
+    return jnp.broadcast_to(keep[None, :], w.shape)
+
+
+def channel_mask(w, dense_ratio: float) -> jnp.ndarray:
+    """Keep the top input channels by L1 norm; mask over dim 0."""
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(1, w.ndim)))
+    k = max(1, int(norms.size * dense_ratio))
+    threshold = jnp.sort(norms)[-k]
+    keep = (norms >= threshold).astype(w.dtype)
+    return jnp.broadcast_to(keep.reshape((-1,) + (1,) * (w.ndim - 1)), w.shape)
+
+
+def head_mask(w, num_heads: int, dense_ratio: float) -> jnp.ndarray:
+    """Keep the top attention heads by L1 norm of their output-projection
+    slices; w [H*Hd, D] (attention output weight) → per-head mask."""
+    in_dim = w.shape[0]
+    head_dim = in_dim // num_heads
+    per_head = jnp.sum(jnp.abs(w.reshape(num_heads, head_dim, -1)), axis=(1, 2))
+    k = max(1, int(num_heads * dense_ratio))
+    threshold = jnp.sort(per_head)[-k]
+    keep = (per_head >= threshold).astype(w.dtype)
+    return jnp.broadcast_to(keep[:, None, None], (num_heads, head_dim, w.shape[1])).reshape(w.shape)
+
+
+_MASK_FNS = {"sparse": sparse_mask, "row": row_mask, "channel": channel_mask}
+
+
+def prune(w, method: str, dense_ratio: float, num_heads: Optional[int] = None):
+    """Apply a pruning mask (STE-free: masks are recomputed each call during
+    training, then frozen by redundancy_clean)."""
+    if method == "head":
+        return w * head_mask(w, num_heads, dense_ratio)
+    return w * _MASK_FNS[method](w, dense_ratio)

@@ -127,3 +127,17 @@ class pp_int(int):
         if self.custom_print_str:
             return self.custom_print_str
         return f"{self.real:.1e}"
+
+
+def deep_update(base: dict, override: dict) -> dict:
+    """Recursive dict merge returning a new dict (shared by the nested-dict
+    config schemas: data_pipeline, compression)."""
+    import copy
+
+    out = copy.deepcopy(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_update(out[k], v)
+        else:
+            out[k] = v
+    return out

@@ -1,0 +1,3 @@
+from deepspeed_tpu.profiling.flops_profiler.profiler import (FlopsProfiler, flops_from_jaxpr,
+                                                             get_model_profile,
+                                                             number_to_string)

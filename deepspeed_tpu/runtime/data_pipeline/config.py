@@ -36,14 +36,7 @@ DEFAULT_DATA_EFFICIENCY = {
 }
 
 
-def _deep_update(base: dict, override: dict) -> dict:
-    out = copy.deepcopy(base)
-    for k, v in override.items():
-        if isinstance(v, dict) and isinstance(out.get(k), dict):
-            out[k] = _deep_update(out[k], v)
-        else:
-            out[k] = v
-    return out
+from deepspeed_tpu.config.config_utils import deep_update as _deep_update
 
 
 def get_data_efficiency_config(param_dict: dict) -> dict:

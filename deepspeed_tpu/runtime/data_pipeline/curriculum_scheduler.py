@@ -29,9 +29,14 @@ class CurriculumScheduler:
                     config["curriculum_type"] = "seqlen"
                 elif key not in config:
                     raise ValueError(f"Curriculum learning requires the config '{key}'")
-        self.state["min_difficulty"] = config["min_difficulty"]
-        self.state["max_difficulty"] = config["max_difficulty"]
-        self.state["current_difficulty"] = config["min_difficulty"]
+        if config.get("schedule_type") == CUSTOM:
+            # custom schedules may omit the bounds (the callable is in charge)
+            self.state["min_difficulty"] = config.get("min_difficulty", 0)
+            self.state["max_difficulty"] = config.get("max_difficulty", float("inf"))
+        else:
+            self.state["min_difficulty"] = config["min_difficulty"]
+            self.state["max_difficulty"] = config["max_difficulty"]
+        self.state["current_difficulty"] = self.state["min_difficulty"]
         self.state["schedule_type"] = config["schedule_type"]
         self.custom_get_difficulty: Optional[Callable[[int], int]] = None
 

@@ -71,7 +71,7 @@ class DeepSpeedDataSampler:
             self.curriculum.set_state(sd["curriculum_state"])
 
     def __iter__(self) -> Iterator[List[int]]:
-        while True:
+        for _ in range(len(self)):
             eligible = self._eligible_indices()
             rng = np.random.default_rng(self.seed + self.global_steps)
             batch = rng.choice(eligible, size=self.global_batch_size,
@@ -84,5 +84,3 @@ class DeepSpeedDataSampler:
                 chunk = batch[lo:lo + self.micro_batch_size * self.dp_size]
                 mine = chunk[self.dp_rank::self.dp_size]
                 yield mine.tolist()
-            if self.consumed_samples >= self.total_samples and self.drop_last:
-                return

@@ -185,10 +185,9 @@ class DeepSpeedEngine:
         self._curriculum_metric = None
         raw = self._config._param_dict
         legacy = raw.get("curriculum_learning", {})
-        from deepspeed_tpu.runtime.data_pipeline.config import (get_data_efficiency_config,
-                                                                get_data_sampling)
+        from deepspeed_tpu.runtime.data_pipeline.config import get_data_efficiency_config
         de = get_data_efficiency_config(raw)
-        sampling = get_data_sampling(raw)
+        sampling = de["data_sampling"]
         de_curr = sampling["curriculum_learning"]
         curr_cfg = None
         if isinstance(legacy, dict) and legacy.get("enabled", False):
@@ -515,14 +514,38 @@ class DeepSpeedEngine:
         else:
             batch = jax.tree.map(lambda x: jnp.reshape(jnp.asarray(x), (gas, -1) + tuple(x.shape[1:])), batch)
 
+        self._host_global_steps += 1
+
+        # flops profiler (reference engine.py:1664,2060): one-shot profile of
+        # the loss computation at the configured step
+        fp_cfg = self._config.flops_profiler_config
+        if fp_cfg.enabled and self._host_global_steps == fp_cfg.profile_step:
+            from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+            prof = FlopsProfiler(model=self.module, ds_engine=self,
+                                 recompute_fwd_factor=fp_cfg.recompute_fwd_factor)
+            micro = jax.tree.map(lambda x: x[0][:self.train_micro_batch_size_per_gpu()], batch)
+            prof.profile_fn(lambda p, b: self.loss_fn(p, b, jax.random.key(0)),
+                            self.state.params, micro)
+            prof.print_model_profile(profile_step=self._host_global_steps,
+                                     output_file=fp_cfg.output_file)
+            self.flops_profiler = prof
+
         # curriculum learning: truncate the sequence dim to the scheduled
-        # difficulty (reference engine.py:1691-1694 legacy seqlen curriculum)
+        # difficulty (reference engine.py:1691-1694 legacy seqlen curriculum).
+        # Only dims equal to the batch's sequence length are sliced, so 2-D
+        # masks [.., S, S] truncate on BOTH key/query dims and non-sequence
+        # feature dims stay intact.
         if self.curriculum_scheduler is not None and self._curriculum_metric == "seqlen":
-            self._host_global_steps += 1
             difficulty = self.curriculum_scheduler.update_difficulty(self._host_global_steps)
-            batch = jax.tree.map(
-                lambda x: x[:, :, :difficulty] if x.ndim >= 3 and x.shape[2] > difficulty else x,
-                batch)
+            leaves = jax.tree.leaves(batch)
+            seq = max((x.shape[2] for x in leaves if x.ndim >= 3), default=0)
+            if difficulty < seq:
+                def trunc(x):
+                    for dim in range(2, x.ndim):
+                        if x.shape[dim] == seq:
+                            x = jax.lax.slice_in_dim(x, 0, difficulty, axis=dim)
+                    return x
+                batch = jax.tree.map(trunc, batch)
 
         # shard the batch over the data axes
         dp_axes = tuple(dist.data_parallel_axes(self.mesh))

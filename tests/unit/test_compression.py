@@ -1,0 +1,132 @@
+"""Compression tests (reference tests/unit/compression/test_compression.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.compression import (CompressionScheduler, fake_quantize, head_mask,
+                                       init_compression, prune, redundancy_clean, row_mask,
+                                       sparse_mask)
+
+
+class TestFakeQuant:
+
+    def test_symmetric_levels(self):
+        w = jnp.asarray([[-1.0, -0.5, 0.0, 0.5, 1.0]])
+        q = fake_quantize(w, 8, True, 1)
+        # values land on the 8-bit symmetric grid and stay close
+        np.testing.assert_allclose(np.asarray(q), np.asarray(w), atol=1.0 / 127)
+
+    def test_asymmetric(self):
+        w = jnp.linspace(0.0, 1.0, 64).reshape(1, 64)
+        q = fake_quantize(w, 4, False, 1)
+        assert len(np.unique(np.asarray(q))) <= 16
+        np.testing.assert_allclose(np.asarray(q), np.asarray(w), atol=1.0 / 15 + 1e-6)
+
+    def test_grouped(self):
+        w = jnp.concatenate([jnp.ones((1, 8)) * 0.01, jnp.ones((1, 8)) * 100.0], axis=1)
+        q_grouped = fake_quantize(w.reshape(2, 8), 8, True, 2).reshape(1, 16)
+        # per-group scales keep the small group exact-ish
+        np.testing.assert_allclose(np.asarray(q_grouped[0, :8]), 0.01, rtol=1e-2)
+
+    def test_ste_gradient(self):
+        w = jax.random.normal(jax.random.key(0), (4, 4))
+
+        def loss(w):
+            return jnp.sum(fake_quantize(w, 8, True, 1) ** 2)
+
+        g = jax.grad(loss)(w)
+        # STE: gradient flows (≈ 2*q, nonzero and finite)
+        assert np.all(np.isfinite(np.asarray(g)))
+        assert float(jnp.abs(g).sum()) > 0
+
+    def test_bits_reduce_levels(self):
+        w = jax.random.normal(jax.random.key(1), (1, 256))
+        q2 = fake_quantize(w, 2, True, 1)
+        assert len(np.unique(np.asarray(q2))) <= 4
+
+
+class TestPruning:
+
+    def test_sparse_mask_ratio(self):
+        w = jax.random.normal(jax.random.key(0), (16, 16))
+        m = sparse_mask(w, 0.25)
+        assert abs(float(m.mean()) - 0.25) < 0.05
+        # largest magnitudes survive
+        kept = np.abs(np.asarray(w))[np.asarray(m) == 1]
+        dropped = np.abs(np.asarray(w))[np.asarray(m) == 0]
+        assert kept.min() >= dropped.max() - 1e-6
+
+    def test_row_mask(self):
+        w = jnp.stack([jnp.ones(8) * (i + 1) for i in range(4)], axis=0).T  # [8,4] cols scaled
+        m = row_mask(w.T.T, 0.5)  # w [in=8, out=4]
+        keep_cols = np.asarray(m[0])
+        assert keep_cols.sum() == 2 and keep_cols[-1] == 1 and keep_cols[-2] == 1
+
+    def test_head_mask(self):
+        H, Hd, D = 4, 8, 16
+        w = jnp.concatenate([jnp.ones((Hd, D)) * (h + 1) for h in range(H)], axis=0)
+        m = head_mask(w, H, 0.5)
+        mh = np.asarray(m).reshape(H, Hd, D)
+        assert mh[0].sum() == 0 and mh[3].sum() == Hd * D
+
+    def test_prune_dispatch(self):
+        w = jax.random.normal(jax.random.key(2), (8, 8))
+        assert float(jnp.sum(prune(w, "sparse", 0.5) == 0)) >= 28
+
+
+class TestCompressedTraining:
+
+    CONFIG = {
+        "compression_training": {
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 2,
+                                      "quantization_type": "symmetric"},
+                "different_groups": {
+                    "wq1": {"params": {"start_bits": 8, "target_bits": 8},
+                            "modules": ["mlp"]},
+                },
+            },
+        }
+    }
+
+    def test_wrapped_model_trains_and_scheduler_gates(self, devices):
+        from deepspeed_tpu.models import CausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+
+        cfg = TransformerConfig(vocab_size=64, n_layer=1, n_head=2, d_model=32, d_ff=64,
+                                max_seq=16, remat=False)
+        model = init_compression(CausalLM(cfg), self.CONFIG)
+        assert len(model.rules) == 1
+        scheduler = CompressionScheduler(model)
+        # schedule_offset=2: inactive at step 0
+        assert not model._active[id(model.rules[0])]
+        scheduler.step(); scheduler.step()
+        assert model._active[id(model.rules[0])]
+
+        params = model.init_params(jax.random.key(0))
+        batch = {"input_ids": np.random.default_rng(0).integers(0, 64, (2, 16)).astype(np.int32)}
+        l_and_g = jax.value_and_grad(model.loss)(params, batch)
+        assert np.isfinite(float(l_and_g[0]))
+        # mlp grads flow through the STE
+        g_mlp = jax.tree.leaves(l_and_g[1]["layers"]["mlp"])
+        assert all(float(jnp.abs(g).sum()) > 0 for g in g_mlp)
+
+    def test_redundancy_clean(self):
+        from deepspeed_tpu.models import CausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+
+        cfg = TransformerConfig(vocab_size=64, n_layer=1, n_head=2, d_model=32, d_ff=64,
+                                max_seq=16, remat=False)
+        params = CausalLM(cfg).init_params(jax.random.key(0))
+        cleaned = redundancy_clean(params, self.CONFIG)
+        w = np.asarray(cleaned["layers"]["mlp"]["w_up"][0], np.float32)
+        orig = np.asarray(params["layers"]["mlp"]["w_up"][0], np.float32)
+        assert not np.array_equal(w, orig)          # actually quantized
+        assert len(np.unique(w)) <= 256             # 8-bit grid
+        # non-matching params untouched
+        np.testing.assert_array_equal(np.asarray(cleaned["embed"]["tokens"]),
+                                      np.asarray(params["embed"]["tokens"]))

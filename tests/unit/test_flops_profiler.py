@@ -1,0 +1,136 @@
+"""FLOPS profiler tests (reference tests/unit/profiling/flops_profiler/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.profiling.flops_profiler import (FlopsProfiler, flops_from_jaxpr,
+                                                    get_model_profile, number_to_string)
+
+
+class TestJaxprFlops:
+
+    def test_matmul_exact(self):
+        M, K, N = 32, 64, 16
+
+        def fn(a, b):
+            return a @ b
+
+        closed = jax.make_jaxpr(fn)(jnp.zeros((M, K)), jnp.zeros((K, N)))
+        assert flops_from_jaxpr(closed.jaxpr) == 2 * M * K * N
+
+    def test_batched_einsum(self):
+        B, M, K, N = 4, 8, 16, 8
+
+        def fn(a, b):
+            return jnp.einsum("bmk,bkn->bmn", a, b)
+
+        closed = jax.make_jaxpr(fn)(jnp.zeros((B, M, K)), jnp.zeros((B, K, N)))
+        assert flops_from_jaxpr(closed.jaxpr) == 2 * B * M * K * N
+
+    def test_scan_multiplies(self):
+        def layer(x, w):
+            return jnp.tanh(x @ w)
+
+        def fn(x, ws):
+            def body(h, w):
+                return layer(h, w), None
+            out, _ = jax.lax.scan(body, x, ws)
+            return out
+
+        L, D = 5, 16
+        closed = jax.make_jaxpr(fn)(jnp.zeros((4, D)), jnp.zeros((L, D, D)))
+        flops = flops_from_jaxpr(closed.jaxpr)
+        assert flops >= L * 2 * 4 * D * D  # L scan iterations counted
+
+    def test_breakdown(self):
+        def fn(a, b):
+            return jnp.exp(a @ b)
+
+        closed = jax.make_jaxpr(fn)(jnp.zeros((8, 8)), jnp.zeros((8, 8)))
+        breakdown = {}
+        flops_from_jaxpr(closed.jaxpr, breakdown)
+        assert "dot_general" in breakdown and "exp" in breakdown
+
+
+class TestGetModelProfile:
+
+    def test_model_profile(self):
+        from deepspeed_tpu.models import CausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+
+        cfg = TransformerConfig(vocab_size=64, n_layer=2, n_head=2, d_model=32, d_ff=64,
+                                max_seq=16, remat=False)
+        model = CausalLM(cfg)
+        params = model.init_params(jax.random.key(0))
+        tokens = jnp.ones((2, 16), jnp.int32)
+        flops, macs, n_params = get_model_profile(model=model, args=(params, tokens),
+                                                  print_profile=False, as_string=False)
+        assert n_params == model.num_parameters
+        # flops should be within 3x of the analytic 2N-per-token estimate
+        est = 2.0 * model.num_parameters * 2 * 16
+        assert est / 3 < flops < est * 3
+
+    def test_as_string(self):
+        f, m, p = get_model_profile(fn=lambda a: a @ a, args=(jnp.zeros((64, 64)),),
+                                    print_profile=False, as_string=True)
+        assert isinstance(f, str) and "K" in f or "M" in f
+
+    def test_number_to_string(self):
+        assert number_to_string(2_500_000) == "2.50 M"
+        assert number_to_string(1.5e12) == "1.50 T"
+        assert number_to_string(42) == "42.00"
+
+
+class TestFlopsProfilerClass:
+
+    def test_profile_fn(self):
+        prof = FlopsProfiler()
+        prof.start_profile()
+        x = jnp.zeros((16, 32))
+        w = jnp.zeros((32, 8))
+        prof.profile_fn(lambda x, w: x @ w, x, w)
+        assert prof.get_total_flops() >= 2 * 16 * 32 * 8
+        assert prof.get_total_macs() == prof.get_total_flops() / 2
+        assert prof.get_total_params() == 16 * 32
+        prof.print_model_profile()
+        prof.end_profile()
+        assert not prof.started
+
+    def test_recompute_factor(self):
+        prof = FlopsProfiler(recompute_fwd_factor=1.0)
+        prof.profile_fn(lambda a: a @ a, jnp.zeros((8, 8)))
+        base = prof.flops
+        assert prof.get_total_flops() == 2 * base
+
+
+class TestEngineFlopsProfiler:
+
+    def test_profile_step_fires(self, devices, capsys):
+        import deepspeed_tpu
+        import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.models import CausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+
+        cfg = TransformerConfig(vocab_size=64, n_layer=1, n_head=2, d_model=32, d_ff=64,
+                                max_seq=16, remat=False)
+        model = CausalLM(cfg)
+        dist.set_mesh(None)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=model.init_params(jax.random.key(0)), config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "mesh": {"dp": -1},
+                "steps_per_print": 0,
+                "flops_profiler": {"enabled": True, "profile_step": 2},
+            })
+        batch = {"input_ids": np.zeros((8, 16), np.int32)}
+        engine.train_batch(batch)
+        assert not hasattr(engine, "flops_profiler")
+        engine.train_batch(batch)
+        assert engine.flops_profiler.get_total_flops() > 0
+        out = capsys.readouterr().out
+        assert "flops profile at step 2" in out
+        dist.set_mesh(None)
