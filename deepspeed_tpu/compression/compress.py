@@ -42,6 +42,13 @@ class _GroupRule:
 
 def _collect_rules(compression_config: Dict) -> List[_GroupRule]:
     rules: List[_GroupRule] = []
+    act = compression_config.get(ACTIVATION_QUANTIZATION, {})
+    if act.get(SHARED_PARAMETERS, act).get("enabled", False):
+        logger.warning(
+            "activation_quantization is configured but not applied: functional "
+            "param-tree compression cannot inject activation hooks from outside "
+            "the model. Call compression.functional.quantize_activation inside "
+            "the model's forward (or request it via TransformerConfig) instead.")
     for technique in _TECHNIQUES:
         tcfg = compression_config.get(technique, {})
         shared = tcfg.get(SHARED_PARAMETERS, tcfg)
@@ -145,8 +152,8 @@ def init_compression(model, deepspeed_config, mpu=None):
 
 def redundancy_clean(model_or_params, deepspeed_config, mpu=None):
     """Reference ``redundancy_clean`` (``compress.py:120``): burn the
-    transforms into the params for deployment. Accepts a CompressedModel +
-    params, or raw params + config."""
+    transforms into the params for deployment. Takes the raw param tree +
+    the ds config (NOT a CompressedModel — pass ``engine.state.params``)."""
     import json
     if isinstance(deepspeed_config, str):
         with open(deepspeed_config) as f:

@@ -46,8 +46,10 @@ def _block_bias(qoff, koff, bq, bk, seq_len, causal, slope, mask_blk):
     return bias + mask_blk[None, :]
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, slope_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, seq_len, bq, bk):
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, slope_ref, *rest,
+                scale, causal, seq_len, bq, bk, has_layout):
+    layout_ref = rest[0] if has_layout else None
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = rest[1 if has_layout else 0:]
     # refs (leading dims squeezed): q/o (bq, Hd); k/v (bk, Hd); mask (bk,);
     # lse (bq,); slope (1, 1) in SMEM
     j = pl.program_id(3)
@@ -61,10 +63,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, slope_ref, o_ref, lse_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     qoff, koff = i * bq, j * bk
-    # causal: skip blocks strictly above the diagonal
+    # skip blocks above the causal diagonal AND blocks the sparsity layout
+    # zeroes out (block-sparse attention, reference ops/sparse_attention/)
     needed = True if not causal else (koff <= qoff + bq - 1)
+    run = needed if layout_ref is None else jnp.logical_and(needed, layout_ref[0, 0] > 0)
 
-    @pl.when(needed)
+    @pl.when(run)
     def _():
         q = q_ref[:].astype(jnp.float32)
         k = k_ref[:].astype(jnp.float32)
@@ -94,7 +98,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, slope_ref, o_ref, lse_ref,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, slope_ref,
-               dq_ref, dq_scr, *, scale, causal, seq_len, bq, bk):
+               *rest, scale, causal, seq_len, bq, bk, has_layout):
+    layout_ref = rest[0] if has_layout else None
+    dq_ref, dq_scr = rest[1 if has_layout else 0:]
     j = pl.program_id(3)
     nk = pl.num_programs(3)
     i = pl.program_id(2)
@@ -105,8 +111,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, slope_
 
     qoff, koff = i * bq, j * bk
     needed = True if not causal else (koff <= qoff + bq - 1)
+    run = needed if layout_ref is None else jnp.logical_and(needed, layout_ref[0, 0] > 0)
 
-    @pl.when(needed)
+    @pl.when(run)
     def _():
         q = q_ref[:].astype(jnp.float32)
         k = k_ref[:].astype(jnp.float32)
@@ -128,7 +135,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, slope_
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, slope_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, seq_len, bq, bk):
+                *rest, scale, causal, seq_len, bq, bk, has_layout):
+    layout_ref = rest[0] if has_layout else None
+    dk_ref, dv_ref, dk_scr, dv_scr = rest[1 if has_layout else 0:]
     # grid (B, H, nk, nq): q blocks are innermost
     i = pl.program_id(3)
     nq = pl.num_programs(3)
@@ -141,8 +150,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, slope
 
     qoff, koff = i * bq, j * bk
     needed = True if not causal else (koff <= qoff + bq - 1)
+    run = needed if layout_ref is None else jnp.logical_and(needed, layout_ref[0, 0] > 0)
 
-    @pl.when(needed)
+    @pl.when(run)
     def _():
         q = q_ref[:].astype(jnp.float32)
         k = k_ref[:].astype(jnp.float32)
@@ -190,24 +200,33 @@ def _slope_spec():
     return pl.BlockSpec((None, 8, 128), lambda b, h, i, j: (h, 0, 0))
 
 
+def _layout_spec():
+    # block layout rides as [H, nq*8, nk*128] f32 (each (h,i,j) entry
+    # broadcast over an (8,128) tile); kernels read layout_ref[0, 0]
+    return pl.BlockSpec((None, 8, 128), lambda b, h, i, j: (h, i, j))
+
+
 @functools.lru_cache(maxsize=32)
-def _build(causal: bool, scale: float, bq: int, bk: int, seq_len: int, interpret: bool):
+def _build(causal: bool, scale: float, bq: int, bk: int, seq_len: int, interpret: bool,
+           has_layout: bool = False):
     """Build the custom-VJP flash function for one static configuration.
 
     Operates on padded [B, H, Sp, Hd] inputs, mask [B, Sp] additive f32,
     slopes [H, 1] f32 (zeros ⇒ no alibi).
     """
 
-    def fwd_call(q, k, v, mask, slopes):
+    maybe_layout = [_layout_spec()] if has_layout else []
+
+    def fwd_call(q, k, v, mask, slopes, *layout):
         B, H, Sp, Hd = q.shape
         nq, nk = Sp // bq, Sp // bk
         kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                                   seq_len=seq_len, bq=bq, bk=bk)
+                                   seq_len=seq_len, bq=bq, bk=bk, has_layout=has_layout)
         o, lse = pl.pallas_call(
             kernel,
             grid=(B, H, nq, nk),
             in_specs=[_q_spec(bq, Hd), _kv_spec(bk, Hd), _kv_spec(bk, Hd),
-                      _mask_spec(bk), _slope_spec()],
+                      _mask_spec(bk), _slope_spec()] + maybe_layout,
             out_specs=[_q_spec(bq, Hd), _row_spec(bq)],
             out_shape=[
                 jax.ShapeDtypeStruct((B, H, Sp, Hd), q.dtype),
@@ -219,36 +238,36 @@ def _build(causal: bool, scale: float, bq: int, bk: int, seq_len: int, interpret
                 pltpu.VMEM((bq, Hd), jnp.float32),
             ],
             interpret=interpret,
-        )(q, k, v, mask, slopes)
+        )(q, k, v, mask, slopes, *layout)
         return o, lse
 
     @jax.custom_vjp
-    def flash(q, k, v, mask, slopes):
-        return fwd_call(q, k, v, mask, slopes)[0]
+    def flash(q, k, v, mask, slopes, *layout):
+        return fwd_call(q, k, v, mask, slopes, *layout)[0]
 
-    def flash_fwd(q, k, v, mask, slopes):
-        o, lse = fwd_call(q, k, v, mask, slopes)
-        return o, (q, k, v, mask, slopes, o, lse)
+    def flash_fwd(q, k, v, mask, slopes, *layout):
+        o, lse = fwd_call(q, k, v, mask, slopes, *layout)
+        return o, (q, k, v, mask, slopes, layout, o, lse)
 
     def flash_bwd(res, g):
-        q, k, v, mask, slopes, o, lse = res
+        q, k, v, mask, slopes, layout, o, lse = res
         B, H, Sp, Hd = q.shape
         nq, nk = Sp // bq, Sp // bk
         delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[:, :, None, :]
 
         dq_kernel = functools.partial(_dq_kernel, scale=scale, causal=causal,
-                                      seq_len=seq_len, bq=bq, bk=bk)
+                                      seq_len=seq_len, bq=bq, bk=bk, has_layout=has_layout)
         dq = pl.pallas_call(
             dq_kernel,
             grid=(B, H, nq, nk),
             in_specs=[_q_spec(bq, Hd), _kv_spec(bk, Hd), _kv_spec(bk, Hd),
                       _q_spec(bq, Hd), _row_spec(bq), _row_spec(bq),
-                      _mask_spec(bk), _slope_spec()],
+                      _mask_spec(bk), _slope_spec()] + maybe_layout,
             out_specs=_q_spec(bq, Hd),
             out_shape=jax.ShapeDtypeStruct((B, H, Sp, Hd), q.dtype),
             scratch_shapes=[pltpu.VMEM((bq, Hd), jnp.float32)],
             interpret=interpret,
-        )(q, k, v, g, lse, delta, mask, slopes)
+        )(q, k, v, g, lse, delta, mask, slopes, *layout)
 
         # grid (B, H, nk, nq): swap the roles of the last two grid axes
         kq_spec = pl.BlockSpec((None, None, bq, Hd), lambda b, h, j, i: (b, h, i, 0))
@@ -256,14 +275,16 @@ def _build(causal: bool, scale: float, bq: int, bk: int, seq_len: int, interpret
         krow_spec = pl.BlockSpec((None, None, 1, bq), lambda b, h, j, i: (b, h, 0, i))
         kmask_spec = pl.BlockSpec((None, 1, bk), lambda b, h, j, i: (b, 0, j))
         kslope_spec = pl.BlockSpec((None, 8, 128), lambda b, h, j, i: (h, 0, 0))
+        kmaybe_layout = ([pl.BlockSpec((None, 8, 128), lambda b, h, j, i: (h, i, j))]
+                         if has_layout else [])
 
         dkv_kernel = functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                                       seq_len=seq_len, bq=bq, bk=bk)
+                                       seq_len=seq_len, bq=bq, bk=bk, has_layout=has_layout)
         dk, dv = pl.pallas_call(
             dkv_kernel,
             grid=(B, H, nk, nq),
             in_specs=[kq_spec, kk_spec, kk_spec, kq_spec, krow_spec, krow_spec,
-                      kmask_spec, kslope_spec],
+                      kmask_spec, kslope_spec] + kmaybe_layout,
             out_specs=[kk_spec, kk_spec],
             out_shape=[
                 jax.ShapeDtypeStruct((B, H, Sp, Hd), q.dtype),
@@ -274,9 +295,10 @@ def _build(causal: bool, scale: float, bq: int, bk: int, seq_len: int, interpret
                 pltpu.VMEM((bk, Hd), jnp.float32),
             ],
             interpret=interpret,
-        )(q, k, v, g, lse, delta, mask, slopes)
+        )(q, k, v, g, lse, delta, mask, slopes, *layout)
 
-        return dq, dk, dv, jnp.zeros_like(mask), jnp.zeros_like(slopes)
+        return (dq, dk, dv, jnp.zeros_like(mask), jnp.zeros_like(slopes),
+                *(jnp.zeros_like(l) for l in layout))
 
     flash.defvjp(flash_fwd, flash_bwd)
     return flash
@@ -284,15 +306,31 @@ def _build(causal: bool, scale: float, bq: int, bk: int, seq_len: int, interpret
 
 def flash_attention(q, k, v, mask_bias=None, causal: bool = True, alibi_slopes=None,
                     scale: Optional[float] = None, block_q: int = 512, block_k: int = 512,
-                    interpret: Optional[bool] = None):
+                    block_layout=None, interpret: Optional[bool] = None):
     """Flash attention on [B, S, H, Hd] q/k/v (same contract as
     :func:`deepspeed_tpu.ops.attention.mha_attention`; mask_bias is the
     additive key-side [B, S] bias). Pads S up to the block size internally.
+
+    ``block_layout``: optional [H, nb, nb] 0/1 block-sparsity layout (from
+    :mod:`deepspeed_tpu.ops.sparse_attention`); the kernel block size then
+    follows the layout's block size S/nb, and zero blocks are skipped in
+    forward AND backward — true block-sparse flash attention.
     """
     B, S, H, Hd = q.shape
     scale = float(scale if scale is not None else Hd**-0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+
+    if block_layout is not None:
+        nb = block_layout.shape[-1]
+        if S % nb != 0:
+            raise ValueError(f"seq len {S} not divisible by layout blocks {nb}")
+        lb = S // nb
+        if lb < 8 or lb % 8 != 0:
+            raise ValueError(
+                f"layout block size {lb} (= S/{nb}) must be a multiple of 8 for "
+                f"TPU tiling; use a coarser SparsityConfig block")
+        block_q = block_k = lb
 
     bq = min(block_q, max(8, S))
     bk = min(block_k, max(8, S))
@@ -317,8 +355,20 @@ def flash_attention(q, k, v, mask_bias=None, causal: bool = True, alibi_slopes=N
               else jnp.asarray(alibi_slopes, jnp.float32).reshape(H))
     slopes = jnp.broadcast_to(slopes[:, None, None], (H, 8, 128))
 
-    fn = _build(causal, scale, bq, bk, S, interpret)
-    out = fn(qt, kt, vt, mask, slopes)
+    extra = ()
+    if block_layout is not None:
+        nq, nk = Sp // bq, Sp // bk
+        layout = jnp.asarray(block_layout, jnp.float32)
+        if layout.ndim == 2:
+            layout = jnp.broadcast_to(layout[None], (H,) + layout.shape)
+        # pad blocks (attend nowhere / never attended)
+        layout = jnp.pad(layout, ((0, 0), (0, nq - layout.shape[1]), (0, nk - layout.shape[2])))
+        # each (h,i,j) entry broadcast over an (8,128) tile for BlockSpec tiling
+        layout = jnp.repeat(jnp.repeat(layout, 8, axis=1), 128, axis=2)
+        extra = (layout,)
+
+    fn = _build(causal, scale, bq, bk, S, interpret, block_layout is not None)
+    out = fn(qt, kt, vt, mask, slopes, *extra)
     return jnp.transpose(out[:, :, :S, :], (0, 2, 1, 3))
 
 

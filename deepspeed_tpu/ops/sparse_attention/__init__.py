@@ -1,0 +1,24 @@
+"""Block-sparse attention (reference ``deepspeed/ops/sparse_attention/``).
+
+The reference implements block-sparse attention with Triton kernels driven
+by a C++ LUT builder (``csrc/sparse_attention/utils.cpp``); the TPU build
+expresses the same sparsity structures as block layouts consumed by the
+Pallas block-sparse flash kernel (splash-attention style) with a dense-mask
+fallback for CPU.
+"""
+
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (SparseSelfAttention,
+                                                                      layout_to_token_bias)
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (BigBirdSparsityConfig,
+                                                                BSLongformerSparsityConfig,
+                                                                DenseSparsityConfig,
+                                                                FixedSparsityConfig,
+                                                                LocalSlidingWindowSparsityConfig,
+                                                                SparsityConfig,
+                                                                VariableSparsityConfig)
+
+__all__ = [
+    "SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
+    "VariableSparsityConfig", "BigBirdSparsityConfig", "BSLongformerSparsityConfig",
+    "LocalSlidingWindowSparsityConfig", "SparseSelfAttention", "layout_to_token_bias",
+]

@@ -67,22 +67,27 @@ def _conv_flops(eqn) -> float:
 
 
 def flops_from_jaxpr(jaxpr, breakdown: Optional[Dict[str, float]] = None) -> float:
-    """Analytic flop count by walking a (closed) jaxpr recursively."""
+    """Analytic flop count by walking a (closed) jaxpr recursively. The
+    per-primitive ``breakdown`` attributes nested flops to the INNER
+    primitives only (wrapper eqns like pjit/scan contribute their own
+    direct compute, which is zero), so it sums to the returned total."""
     total = 0.0
-    top = breakdown is None
     breakdown = breakdown if breakdown is not None else {}
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
         if prim == "dot_general":
-            f = _dot_general_flops(eqn)
+            own = _dot_general_flops(eqn)
         elif prim in ("conv_general_dilated",):
-            f = _conv_flops(eqn)
+            own = _conv_flops(eqn)
         elif prim in _ELEMENTWISE_PRIMS:
-            f = float(math.prod(eqn.outvars[0].aval.shape)) if eqn.outvars[0].aval.shape else 1.0
+            own = float(math.prod(eqn.outvars[0].aval.shape)) if eqn.outvars[0].aval.shape else 1.0
         elif prim == "reduce_sum" or prim.startswith("reduce_"):
-            f = float(math.prod(eqn.invars[0].aval.shape)) if eqn.invars[0].aval.shape else 1.0
+            own = float(math.prod(eqn.invars[0].aval.shape)) if eqn.invars[0].aval.shape else 1.0
         else:
-            f = 0.0
+            own = 0.0
+        if own:
+            breakdown[prim] = breakdown.get(prim, 0.0) + own
+        total += own
         # recurse into sub-jaxprs (jit/remat/scan bodies); scan multiplies by
         # length — in the total AND the per-primitive breakdown
         for name, val in eqn.params.items():
@@ -91,12 +96,9 @@ def flops_from_jaxpr(jaxpr, breakdown: Optional[Dict[str, float]] = None) -> flo
                 sub_bd: Dict[str, float] = {}
                 inner = flops_from_jaxpr(sub, sub_bd)
                 mult = eqn.params.get("length", 1) if prim == "scan" else 1
-                f += inner * mult
+                total += inner * mult
                 for k, v in sub_bd.items():
                     breakdown[k] = breakdown.get(k, 0.0) + v * mult
-        total += f
-        if f:
-            breakdown[prim] = breakdown.get(prim, 0.0) + f
     return total
 
 

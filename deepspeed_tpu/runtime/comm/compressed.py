@@ -1,0 +1,75 @@
+"""Error-compensated 1-bit compressed allreduce (reference
+``runtime/comm/nccl.py:53`` ``NcclBackend.compressed_allreduce`` and the
+cupy/MPI variant ``mpi.py:131``).
+
+The algorithm (NeurIPS'21 1-bit Adam) in mesh-collective form, run inside
+``shard_map`` over the dp axis:
+
+1. worker compensates its local tensor with its error feedback, compresses
+   to (sign, per-worker scale), and updates the worker error
+2. each rank acts as "server" for its 1/n chunk: the sign*scale averages
+   arrive via a reduce-scatter, get compensated with the server error and
+   re-compressed to (sign, per-chunk scale)
+3. the twice-compressed chunks are all-gathered — every rank ends with the
+   same full tensor
+
+The wire math (what gets reduced/gathered is exactly the ±scale tensors) is
+identical to the reference; on TPU the collectives ride ICI. Both error
+states are carried functionally (returned, not mutated).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _sign_scale(x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Compress to sign(x) * mean(|x|) (the reference's scaled-sign:
+    nccl.py:70-90). Returns (compressed, scale)."""
+    scale = jnp.mean(jnp.abs(x))
+    signs = jnp.where(x >= 0, 1.0, -1.0).astype(x.dtype)
+    return signs * scale, scale
+
+
+def compressed_allreduce(tensor, worker_error, server_error, axis: str = "dp"):
+    """Per-shard body (inside shard_map over ``axis``).
+
+    tensor: LOCAL flat [numel] fp32 (this worker's unsynced value, e.g. its
+    momentum update); worker_error/server_error: error-feedback states
+    ([numel] and [numel / n]). Returns (averaged tensor, new_worker_error,
+    new_server_error).
+    """
+    n = jax.lax.axis_size(axis)
+    numel = tensor.shape[0]
+    if numel % n != 0:
+        raise ValueError(f"compressed_allreduce needs numel ({numel}) divisible by group ({n})")
+
+    # 1. worker compression with error feedback
+    compensated = tensor + worker_error
+    compressed, _ = _sign_scale(compensated)
+    new_worker_error = compensated - compressed
+
+    # 2. server stage: average my chunk across workers (reduce-scatter ≙ the
+    # reference's igather + local mean), compensate, re-compress
+    chunk = jax.lax.psum_scatter(compressed, axis, scatter_dimension=0, tiled=True) / n
+    server_comp = chunk + server_error
+    server_compressed, _ = _sign_scale(server_comp)
+    new_server_error = server_comp - server_compressed
+
+    # 3. allgather the twice-compressed chunks
+    out = jax.lax.all_gather(server_compressed, axis, axis=0, tiled=True)
+    return out, new_worker_error, new_server_error
+
+
+class CompressedBackend:
+    """Object surface mirroring the reference backend classes."""
+
+    def __init__(self, axis: str = "dp"):
+        self.axis = axis
+
+    def compressed_allreduce(self, tensor, worker_error, server_error, local_rank=None):
+        return compressed_allreduce(tensor, worker_error, server_error, self.axis)

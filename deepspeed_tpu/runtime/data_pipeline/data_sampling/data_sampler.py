@@ -71,7 +71,10 @@ class DeepSpeedDataSampler:
             self.curriculum.set_state(sd["curriculum_state"])
 
     def __iter__(self) -> Iterator[List[int]]:
-        for _ in range(len(self)):
+        # resume-aware: a checkpoint-restored sampler only yields the
+        # REMAINING global batches of the epoch
+        done = self.consumed_samples // self.global_batch_size
+        for _ in range(max(0, len(self) - done)):
             eligible = self._eligible_indices()
             rng = np.random.default_rng(self.seed + self.global_steps)
             batch = rng.choice(eligible, size=self.global_batch_size,

@@ -1,0 +1,4 @@
+"""fp16 optimizer family (reference ``deepspeed/runtime/fp16/``). The fused/
+unfused fp16 master-weight machinery lives in the engine's compiled step
+(loss_scaler.py + engine TrainState); this package hosts the 1-bit
+communication-compressed optimizers."""

@@ -1,0 +1,5 @@
+from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdam
+from deepspeed_tpu.runtime.fp16.onebit.lamb import OnebitLamb
+from deepspeed_tpu.runtime.fp16.onebit.zoadam import ZeroOneAdam
+
+__all__ = ["OnebitAdam", "OnebitLamb", "ZeroOneAdam"]

@@ -1,0 +1,122 @@
+"""1-bit Adam (reference ``runtime/fp16/onebit/adam.py`` ``OnebitAdam``).
+
+Two phases:
+- **warmup** (steps < freeze_step): exact Adam with full-precision gradient
+  averaging (psum) — variance statistics stabilize
+- **compression** (steps ≥ freeze_step): the VARIANCE IS FROZEN; only the
+  momentum is communicated, through the error-compensated 1-bit compressed
+  allreduce — 32× less traffic on the dp axis
+
+Functional design for the compiled SPMD step: the optimizer is a pair of
+pure functions ``init(params) → state`` and
+``update(local_grads, state, params) → (new_params, new_state)`` meant to
+run INSIDE ``shard_map`` over the dp axis with UN-synced local grads —
+gradient averaging is the optimizer's job here, exactly like the reference
+(which skips the engine allreduce and communicates inside ``step``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
+
+
+class OnebitAdamState(NamedTuple):
+    step: jnp.ndarray        # i32
+    exp_avg: Any             # momentum pytree
+    exp_avg_sq: Any          # (frozen after freeze_step) variance pytree
+    worker_error: Any        # per-leaf error feedback [numel]
+    server_error: Any        # per-leaf error feedback [numel / n]
+
+
+class OnebitAdam:
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, freeze_step: int = 100, axis: str = "dp",
+                 comm_group_size: int = 1):
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.freeze_step = freeze_step
+        self.axis = axis
+        self.n = comm_group_size
+
+    def _pad(self, numel: int) -> int:
+        return -(-numel // self.n) * self.n
+
+    def init(self, params) -> OnebitAdamState:
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return OnebitAdamState(
+            step=jnp.zeros((), jnp.int32),
+            exp_avg=jax.tree.map(zeros, params),
+            exp_avg_sq=jax.tree.map(zeros, params),
+            worker_error=jax.tree.map(lambda p: jnp.zeros((self._pad(p.size),), jnp.float32), params),
+            server_error=jax.tree.map(lambda p: jnp.zeros((self._pad(p.size) // self.n,), jnp.float32),
+                                      params),
+        )
+
+    def update(self, grads, state: OnebitAdamState, params, lr=None):
+        """Run inside shard_map over ``self.axis`` with LOCAL grads."""
+        lr = self.lr if lr is None else lr
+        beta1, beta2 = self.betas
+        step = state.step + 1
+        warm = state.step < self.freeze_step
+
+        def leaf_update(g, m, v, we, se, p):
+            g = g.astype(jnp.float32)
+
+            def warmup(_):
+                g_avg = jax.lax.pmean(g, self.axis)
+                m_new = beta1 * m + (1 - beta1) * g_avg
+                v_new = beta2 * v + (1 - beta2) * jnp.square(g_avg)
+                return m_new, v_new, we, se
+
+            def compressed(_):
+                # momentum updated from LOCAL grad, then 1-bit-averaged
+                m_local = beta1 * m + (1 - beta1) * g
+                flat = m_local.ravel()
+                pad = we.shape[0] - flat.shape[0]
+                flat = jnp.pad(flat, (0, pad))
+                m_avg, we_new, se_new = compressed_allreduce(flat, we, se, self.axis)
+                m_new = m_avg[:m.size].reshape(m.shape)
+                return m_new, v, we_new, se_new  # variance FROZEN
+
+            m_new, v_new, we_new, se_new = jax.lax.cond(warm, warmup, compressed, None)
+
+            bias1 = 1 - beta1 ** step.astype(jnp.float32)
+            # the variance is frozen after freeze_step, so its bias
+            # correction must freeze too — otherwise 1/sqrt(bias2) shrinks
+            # the denom and the effective lr grows without bound
+            eff_step = jnp.minimum(step, self.freeze_step).astype(jnp.float32)
+            bias2 = 1 - beta2 ** eff_step
+            denom = jnp.sqrt(v_new) / jnp.sqrt(bias2) + self.eps
+            upd = (m_new / bias1) / denom
+            if self.weight_decay > 0:
+                upd = upd + self.weight_decay * p.astype(jnp.float32)
+            p_new = p.astype(jnp.float32) - lr * upd
+            return p_new.astype(p.dtype), m_new, v_new, we_new, se_new
+
+        leaves_p, treedef = jax.tree.flatten(params)
+        leaves_g = treedef.flatten_up_to(grads)
+        leaves_m = treedef.flatten_up_to(state.exp_avg)
+        leaves_v = treedef.flatten_up_to(state.exp_avg_sq)
+        leaves_we = treedef.flatten_up_to(state.worker_error)
+        leaves_se = treedef.flatten_up_to(state.server_error)
+
+        outs = [leaf_update(g, m, v, we, se, p)
+                for g, m, v, we, se, p in zip(leaves_g, leaves_m, leaves_v, leaves_we,
+                                              leaves_se, leaves_p)]
+        new_params = treedef.unflatten([o[0] for o in outs])
+        new_state = OnebitAdamState(
+            step=step,
+            exp_avg=treedef.unflatten([o[1] for o in outs]),
+            exp_avg_sq=treedef.unflatten([o[2] for o in outs]),
+            worker_error=treedef.unflatten([o[3] for o in outs]),
+            server_error=treedef.unflatten([o[4] for o in outs]),
+        )
+        return new_params, new_state

@@ -1,0 +1,59 @@
+"""1-bit LAMB (reference ``runtime/fp16/onebit/lamb.py`` ``OnebitLamb``):
+1-bit Adam's compressed-momentum machinery plus LAMB's layerwise trust
+ratio. During compression the trust ratio is clamped into the range
+established during warmup (the reference's scaling_coeff freeze)."""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdam, OnebitAdamState
+
+
+class OnebitLambState(NamedTuple):
+    adam: OnebitAdamState
+    scaling_coeffs: Any  # per-leaf frozen trust-ratio bounds
+
+
+class OnebitLamb(OnebitAdam):
+
+    def __init__(self, *args, min_coeff: float = 0.01, max_coeff: float = 10.0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.min_coeff = min_coeff
+        self.max_coeff = max_coeff
+
+    def init(self, params) -> OnebitLambState:
+        return OnebitLambState(
+            adam=super().init(params),
+            scaling_coeffs=jax.tree.map(lambda p: jnp.ones((), jnp.float32), params),
+        )
+
+    def update(self, grads, state: OnebitLambState, params, lr=None):
+        lr = self.lr if lr is None else lr
+        # reuse the (possibly compressed) Adam direction with unit lr, then
+        # apply the trust ratio per layer
+        adam_params, adam_state = super().update(grads, state.adam, params, lr=1.0)
+
+        def trust(p, p_adam, coeff):
+            upd = p.astype(jnp.float32) - p_adam.astype(jnp.float32)  # lr=1 step direction
+            w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+            u_norm = jnp.linalg.norm(upd)
+            ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+            ratio = jnp.clip(ratio, self.min_coeff, self.max_coeff)
+            # freeze the coefficient once compression starts
+            frozen = state.adam.step >= self.freeze_step
+            ratio = jnp.where(frozen, jnp.minimum(ratio, coeff * 2.0), ratio)
+            new_coeff = jnp.where(frozen, coeff, ratio)
+            p_new = p.astype(jnp.float32) - lr * ratio * upd
+            return p_new.astype(p.dtype), new_coeff
+
+        leaves_p, treedef = jax.tree.flatten(params)
+        leaves_pa = treedef.flatten_up_to(adam_params)
+        leaves_c = treedef.flatten_up_to(state.scaling_coeffs)
+        outs = [trust(p, pa, c) for p, pa, c in zip(leaves_p, leaves_pa, leaves_c)]
+        new_params = treedef.unflatten([o[0] for o in outs])
+        new_coeffs = treedef.unflatten([o[1] for o in outs])
+        return new_params, OnebitLambState(adam=adam_state, scaling_coeffs=new_coeffs)

@@ -1,0 +1,117 @@
+"""0/1 Adam (reference ``runtime/fp16/onebit/zoadam.py`` ``ZeroOneAdam``):
+adaptive variance freezing + local-step intervals — gradients are averaged
+only every ``local_step`` steps (the interval doubles up to a cap), with
+1-bit compression for the synchronized momentum in between."""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
+
+
+class ZeroOneAdamState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: Any
+    exp_avg_sq: Any
+    worker_error: Any
+    server_error: Any
+    local_step_interval: jnp.ndarray  # current sync interval
+
+
+class ZeroOneAdam:
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, var_freeze_step: int = 100,
+                 local_step_scaler: int = 32768, local_step_clipper: int = 16,
+                 cuda_aware: bool = False, comm_backend_name: str = "mesh",
+                 axis: str = "dp", comm_group_size: int = 1):
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.var_freeze_step = var_freeze_step
+        self.local_step_clipper = local_step_clipper
+        self.axis = axis
+        self.n = comm_group_size
+
+    def _pad(self, numel: int) -> int:
+        return -(-numel // self.n) * self.n
+
+    def init(self, params) -> ZeroOneAdamState:
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return ZeroOneAdamState(
+            step=jnp.zeros((), jnp.int32),
+            exp_avg=jax.tree.map(zeros, params),
+            exp_avg_sq=jax.tree.map(zeros, params),
+            worker_error=jax.tree.map(lambda p: jnp.zeros((self._pad(p.size),), jnp.float32), params),
+            server_error=jax.tree.map(lambda p: jnp.zeros((self._pad(p.size) // self.n,), jnp.float32),
+                                      params),
+            local_step_interval=jnp.ones((), jnp.int32),
+        )
+
+    def update(self, grads, state: ZeroOneAdamState, params, lr=None):
+        """Run inside shard_map over ``self.axis`` with LOCAL grads."""
+        lr = self.lr if lr is None else lr
+        beta1, beta2 = self.betas
+        step = state.step + 1
+        var_frozen = state.step >= self.var_freeze_step
+        sync_now = (step % state.local_step_interval) == 0
+
+        def leaf_update(g, m, v, we, se, p):
+            g = g.astype(jnp.float32)
+            # variance frozen after var_freeze_step; before that, exact avg
+            g_avg = jax.lax.pmean(g, self.axis)
+            v_new = jnp.where(var_frozen, v, beta2 * v + (1 - beta2) * jnp.square(g_avg))
+            m_local = beta1 * m + (1 - beta1) * jnp.where(var_frozen, g, g_avg)
+
+            def synced(_):
+                flat = jnp.pad(m_local.ravel(), (0, we.shape[0] - m_local.size))
+                m_avg, we_new, se_new = compressed_allreduce(flat, we, se, self.axis)
+                return m_avg[:m_local.size].reshape(m_local.shape), we_new, se_new
+
+            def local(_):
+                return m_local, we, se
+
+            do_sync = jnp.logical_and(var_frozen, sync_now)
+            # before the variance freeze, momentum is already exact (g_avg)
+            m_new, we_new, se_new = jax.lax.cond(do_sync, synced, local, None)
+
+            bias1 = 1 - beta1 ** step.astype(jnp.float32)
+            # bias correction frozen together with the variance (see adam.py)
+            eff_step = jnp.minimum(step, self.var_freeze_step).astype(jnp.float32)
+            bias2 = 1 - beta2 ** eff_step
+            denom = jnp.sqrt(v_new) / jnp.sqrt(bias2) + self.eps
+            upd = (m_new / bias1) / denom
+            if self.weight_decay > 0:
+                upd = upd + self.weight_decay * p.astype(jnp.float32)
+            p_new = p.astype(jnp.float32) - lr * upd
+            return p_new.astype(p.dtype), m_new, v_new, we_new, se_new
+
+        leaves_p, treedef = jax.tree.flatten(params)
+        outs = [leaf_update(g, m, v, we, se, p)
+                for g, m, v, we, se, p in zip(
+                    treedef.flatten_up_to(grads), treedef.flatten_up_to(state.exp_avg),
+                    treedef.flatten_up_to(state.exp_avg_sq),
+                    treedef.flatten_up_to(state.worker_error),
+                    treedef.flatten_up_to(state.server_error), leaves_p)]
+
+        # interval doubles after each sync round, capped (reference schedule)
+        interval = jnp.where(
+            jnp.logical_and(var_frozen, sync_now),
+            jnp.minimum(state.local_step_interval * 2, self.local_step_clipper),
+            state.local_step_interval)
+
+        new_params = treedef.unflatten([o[0] for o in outs])
+        new_state = ZeroOneAdamState(
+            step=step,
+            exp_avg=treedef.unflatten([o[1] for o in outs]),
+            exp_avg_sq=treedef.unflatten([o[2] for o in outs]),
+            worker_error=treedef.unflatten([o[3] for o in outs]),
+            server_error=treedef.unflatten([o[4] for o in outs]),
+            local_step_interval=interval,
+        )
+        return new_params, new_state

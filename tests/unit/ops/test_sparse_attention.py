@@ -1,0 +1,148 @@
+"""Sparse attention tests (reference tests/unit/ops/sparse_attention/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention import mha_attention
+from deepspeed_tpu.ops.pallas import flash_attention
+from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                BSLongformerSparsityConfig,
+                                                DenseSparsityConfig, FixedSparsityConfig,
+                                                LocalSlidingWindowSparsityConfig,
+                                                SparseSelfAttention, VariableSparsityConfig,
+                                                layout_to_token_bias)
+
+
+class TestSparsityConfigs:
+
+    def test_dense(self):
+        lay = DenseSparsityConfig(num_heads=2, block=16).make_layout(64)
+        assert lay.shape == (2, 4, 4)
+        assert lay.sum() == 2 * 16
+
+    def test_fixed_bidirectional(self):
+        cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                                  num_global_blocks=1)
+        lay = cfg.make_layout(128)  # 8 blocks
+        assert lay.shape == (2, 8, 8)
+        # local window: block row 0 attends to blocks 0..1
+        assert lay[0, 0, 0] == 1 and lay[0, 0, 1] == 1
+        # heads identical when not different_layout_per_head
+        np.testing.assert_array_equal(lay[0], lay[1])
+
+    def test_fixed_unidirectional_is_lower_triangular(self):
+        cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=2,
+                                  attention="unidirectional")
+        lay = cfg.make_layout(128)
+        assert np.array_equal(lay[0], np.tril(lay[0]))
+        # diagonal always attends (each block row attends to itself)
+        assert all(lay[0, i, i] == 1 for i in range(8))
+
+    def test_variable(self):
+        cfg = VariableSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                     local_window_blocks=[1, 2],
+                                     global_block_indices=[0])
+        lay = cfg.make_layout(128)
+        assert (lay[0, :, 0] == 1).all()  # global col 0
+        assert lay[0].sum() > 8
+
+    def test_bigbird(self):
+        cfg = BigBirdSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                    num_sliding_window_blocks=3, num_global_blocks=1)
+        lay = cfg.make_layout(128)
+        for r in range(8):  # sliding window
+            assert lay[0, r, r] == 1
+        assert (lay[0, 0, :] == 1).all() and (lay[0, :, 0] == 1).all()  # global
+
+    def test_bslongformer(self):
+        cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                         num_sliding_window_blocks=3,
+                                         global_block_indices=[0, 2])
+        lay = cfg.make_layout(128)
+        assert (lay[0, :, 2] == 1).all() and (lay[0, 2, :] == 1).all()
+
+    def test_local_sliding_window(self):
+        cfg = LocalSlidingWindowSparsityConfig(num_heads=1, block=16,
+                                               num_sliding_window_blocks=3)
+        lay = cfg.make_layout(128)
+        assert lay[0, 5, 4] == 1 and lay[0, 5, 5] == 1
+        assert lay[0, 5, 7] == 0  # beyond the causal window
+        assert np.array_equal(lay[0], np.tril(lay[0]))
+
+    def test_indivisible_seq_raises(self):
+        with pytest.raises(ValueError):
+            DenseSparsityConfig(num_heads=1, block=16).make_layout(100)
+
+
+class TestSparseSelfAttention:
+
+    def _qkv(self, S=128, H=2, Hd=32, B=1):
+        ks = jax.random.split(jax.random.key(0), 3)
+        return tuple(jax.random.normal(k, (B, S, H, Hd), jnp.float32) for k in ks)
+
+    def test_dense_config_matches_full_attention(self):
+        q, k, v = self._qkv()
+        sa = SparseSelfAttention(DenseSparsityConfig(num_heads=2, block=16), backend="dense")
+        out = sa(q, k, v)
+        ref = mha_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_sparse_respects_layout(self):
+        """Tokens outside the layout support must not influence the output."""
+        q, k, v = self._qkv(S=64)
+        cfg = LocalSlidingWindowSparsityConfig(num_heads=2, block=16,
+                                               num_sliding_window_blocks=1)
+        sa = SparseSelfAttention(cfg, backend="dense")
+        out1 = sa(q, k, v)
+        # perturb keys in block 0; outputs for queries in block 3 (window=own
+        # block only) must be unchanged
+        k2 = k.at[:, :16].set(jax.random.normal(jax.random.key(9), k[:, :16].shape))
+        out2 = sa(q, k2, v)
+        np.testing.assert_allclose(np.asarray(out1[:, 48:]), np.asarray(out2[:, 48:]),
+                                   rtol=1e-6)
+        assert not np.allclose(np.asarray(out1[:, :16]), np.asarray(out2[:, :16]))
+
+    def test_pallas_blocksparse_matches_dense_path(self):
+        q, k, v = self._qkv(S=256, H=2, Hd=64)
+        cfg = BigBirdSparsityConfig(num_heads=2, block=64, num_random_blocks=0,
+                                    num_sliding_window_blocks=3, num_global_blocks=1,
+                                    attention="unidirectional")
+        sa_dense = SparseSelfAttention(cfg, backend="dense")
+        ref = sa_dense(q, k, v)
+        layout = cfg.make_layout(256)
+        out = flash_attention(q, k, v, causal=True,
+                              block_layout=jnp.asarray(layout, jnp.float32), interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_pallas_blocksparse_grads(self):
+        q, k, v = self._qkv(S=128, H=1, Hd=32)
+        cfg = LocalSlidingWindowSparsityConfig(num_heads=1, block=32,
+                                               num_sliding_window_blocks=3)
+        layout = jnp.asarray(cfg.make_layout(128), jnp.float32)
+
+        def loss_sparse(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True, block_layout=layout,
+                                           interpret=True) ** 2)
+
+        sa = SparseSelfAttention(cfg, backend="dense")
+
+        def loss_dense(q, k, v):
+            return jnp.sum(sa(q, k, v) ** 2)
+
+        gs = jax.grad(loss_sparse, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b, n in zip(gs, gd, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4,
+                                       err_msg=f"d{n}")
+
+    def test_key_padding_mask(self):
+        q, k, v = self._qkv(S=64)
+        # int dtype => 1/0 keep-mask; float dtype would mean additive bias
+        keep = jnp.ones((1, 64), jnp.int32).at[:, 48:].set(0)
+        sa = SparseSelfAttention(DenseSparsityConfig(num_heads=2, block=16), backend="dense")
+        out = sa(q, k, v, key_padding_mask=keep)
+        bias = jnp.where(keep > 0, 0.0, -1e9)[:, None, None, :]
+        ref = mha_attention(q, k, v, mask_bias=bias, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)

@@ -1,0 +1,169 @@
+"""1-bit optimizer + compressed collective tests (reference tests/onebit/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
+from deepspeed_tpu.runtime.fp16.onebit import OnebitAdam, OnebitLamb, ZeroOneAdam
+
+
+@pytest.fixture
+def dp_mesh(devices):
+    return Mesh(np.array(devices[:8]), ("dp",))
+
+
+def _smap(mesh, fn, in_specs, out_specs):
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                                 check_vma=False))
+
+
+class TestCompressedAllreduce:
+
+    def test_all_ranks_identical_and_signal_preserved(self, dp_mesh):
+        n, numel = 8, 256
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(n, numel)), jnp.float32)
+        true_mean = np.asarray(x).mean(axis=0)
+
+        def body(x):
+            out, we, se = compressed_allreduce(
+                x[0], jnp.zeros((numel,)), jnp.zeros((numel // n,)), "dp")
+            return out[None]
+
+        out = _smap(dp_mesh, body, in_specs=(P("dp"),), out_specs=P("dp"))(x)
+        # every rank identical
+        for r in range(1, n):
+            np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(out[r]))
+        # sign agreement with the exact mean on large entries
+        big = np.abs(true_mean) > np.abs(true_mean).mean()
+        agree = np.mean(np.sign(np.asarray(out[0])[big]) == np.sign(true_mean[big]))
+        assert agree > 0.8
+
+    def test_error_feedback_is_exact_residual(self, dp_mesh):
+        """worker compression + its error feedback must reconstruct the
+        compensated tensor exactly (lossless bookkeeping)."""
+        n, numel = 8, 128
+        x = jnp.asarray(np.random.default_rng(2).normal(size=(n, numel)), jnp.float32)
+
+        def body(x):
+            local = x[0]
+            out, we, se = compressed_allreduce(
+                local, jnp.zeros((numel,)), jnp.zeros((numel // n,)), "dp")
+            scale = jnp.mean(jnp.abs(local))
+            comp = jnp.where(local >= 0, 1.0, -1.0) * scale
+            return (we - (local - comp))[None]
+
+        resid = _smap(dp_mesh, body, in_specs=(P("dp"),), out_specs=P("dp"))(x)
+        np.testing.assert_allclose(np.asarray(resid), 0.0, atol=1e-6)
+
+    def test_indivisible_raises(self, dp_mesh):
+        def body(x):
+            out, _, _ = compressed_allreduce(x, jnp.zeros((130,)), jnp.zeros((16,)), "dp")
+            return out
+
+        with pytest.raises(ValueError, match="divisible"):
+            _smap(dp_mesh, body, in_specs=(P(),), out_specs=P())(jnp.zeros((130,)))
+
+
+def _quadratic_setup(n=8, dim=64, seed=0):
+    rng = np.random.default_rng(seed)
+    target = jnp.asarray(rng.normal(size=(dim,)), jnp.float32)
+    noise = jnp.asarray(rng.normal(size=(n, dim)) * 0.3, jnp.float32)
+    return target, target[None] + noise  # per-worker targets
+
+
+class TestOnebitAdam:
+
+    def test_converges_through_compression_phase(self, dp_mesh):
+        """Distributed quadratic: each worker only sees its own noisy target
+        (LOCAL grads); the optimizer's internal (compressed) communication
+        must still drive params to the MEAN target."""
+        n, dim = 8, 64
+        target, targets = _quadratic_setup(n, dim)
+        opt = OnebitAdam(lr=0.05, freeze_step=10, comm_group_size=n)
+
+        def run(tgts):
+            params = {"w": jnp.zeros((dim,), jnp.float32)}
+            state = opt.init(params)
+
+            def body(carry, _):
+                p, s = carry
+                grads = {"w": p["w"] - tgts[0]}  # local, unsynced
+                p, s = opt.update(grads, s, p)
+                return (p, s), None
+
+            (p, s), _ = jax.lax.scan(body, (params, state), None, length=300)
+            return p["w"], s.step
+
+        w, steps = _smap(dp_mesh, run, in_specs=(P("dp"),), out_specs=(P(), P()))(targets)
+        assert int(steps) == 300 > opt.freeze_step
+        # 1-bit compression noise floor ~ lr * scale: sign-style steps close
+        # in on the target but carry per-coordinate quantization noise
+        err = np.abs(np.asarray(w) - np.asarray(target))
+        assert err.mean() < 0.2, err.mean()
+        assert err.max() < 0.8, err.max()
+
+    def test_warmup_matches_exact_adam(self, dp_mesh):
+        """Before freeze_step the trajectory equals plain Adam on the exact
+        mean gradient."""
+        n, dim = 8, 32
+        _, targets = _quadratic_setup(n, dim, seed=5)
+        opt = OnebitAdam(lr=0.1, freeze_step=1000, comm_group_size=n)
+
+        def run(tgts):
+            params = {"w": jnp.zeros((dim,), jnp.float32)}
+            state = opt.init(params)
+
+            def body(carry, _):
+                p, s = carry
+                grads = {"w": p["w"] - tgts[0]}
+                p, s = opt.update(grads, s, p)
+                return (p, s), None
+
+            (p, _), _ = jax.lax.scan(body, (params, state), None, length=10)
+            return p["w"]
+
+        w = _smap(dp_mesh, run, in_specs=(P("dp"),), out_specs=P())(targets)
+
+        # host-side exact Adam on the mean target
+        import optax
+        mean_target = np.asarray(targets).mean(axis=0)
+        tx = optax.adam(0.1, 0.9, 0.999, 1e-8)
+        wp = jnp.zeros((dim,))
+        st = tx.init(wp)
+        for _ in range(10):
+            upd, st = tx.update(wp - mean_target, st, wp)
+            wp = optax.apply_updates(wp, upd)
+        np.testing.assert_allclose(np.asarray(w), np.asarray(wp), atol=1e-4)
+
+
+class TestOnebitVariants:
+
+    @pytest.mark.parametrize("opt_cls", ["lamb", "zoadam"])
+    def test_step_and_progress(self, dp_mesh, opt_cls):
+        n, dim = 8, 32
+        _, targets = _quadratic_setup(n, dim, seed=3)
+        opt = (OnebitLamb(lr=0.02, freeze_step=5, comm_group_size=n) if opt_cls == "lamb"
+               else ZeroOneAdam(lr=0.02, var_freeze_step=5, comm_group_size=n))
+
+        def run(tgts):
+            params = {"w": jnp.ones((dim,), jnp.float32)}
+            state = opt.init(params)
+
+            def body(carry, _):
+                p, s = carry
+                grads = {"w": p["w"] - tgts[0]}
+                p, s = opt.update(grads, s, p)
+                return (p, s), None
+
+            (p, _), _ = jax.lax.scan(body, (params, state), None, length=20)
+            return p["w"]
+
+        w = _smap(dp_mesh, run, in_specs=(P("dp"),), out_specs=P())(targets)
+        assert np.all(np.isfinite(np.asarray(w)))
+        # moved from the all-ones init toward the mean target
+        mean_target = np.asarray(targets).mean(axis=0)
+        assert (np.linalg.norm(np.asarray(w) - mean_target)
+                < np.linalg.norm(np.ones(dim) - mean_target))
