@@ -80,16 +80,36 @@ class MegatronSDLoader:
         self.ckpt_list = ckpt_list
         self.version = version
 
+    @staticmethod
+    def _strategy_for(name: str, merge_strategies: Dict[str, object]):
+        """(dim, kind) for the first matching pattern; kind is 'plain' or
+        'qkv'. A strategy value may be an int dim, or a (dim, 'qkv') tuple
+        for FUSED query_key_value weights, which must merge/split via the
+        q/k/v-aware path (reference ``qkv_copy``, ``state_dict_factory.py``
+        ``merge_query_key_value``) — plain concat would interleave the q/k/v
+        blocks and silently produce wrong weights."""
+        for pat, strat in merge_strategies.items():
+            if pat in name:
+                if isinstance(strat, (tuple, list)):
+                    dim, kind = strat
+                    return int(dim), str(kind)
+                return int(strat), "plain"
+        return None, None
+
     def load(self, mp_world_size: int = 1, mp_rank: int = 0,
-             merge_strategies: Dict[str, int] = None) -> Dict[str, np.ndarray]:
+             merge_strategies: Dict[str, object] = None) -> Dict[str, np.ndarray]:
         """Merge all ranks' files into full arrays, then (optionally) slice
         for (mp_world_size, mp_rank).
 
-        ``merge_strategies``: {substring: dim} — weights whose name contains
-        the substring are sharded along ``dim`` (e.g. {"qkv": -1,
-        "dense_4h_to_h": 0}); unmatched weights must be identical replicas.
+        ``merge_strategies``: {substring: strategy} — weights whose name
+        contains the substring are sharded along the strategy's dim. A
+        strategy is an int dim (e.g. {"dense_4h_to_h": 0}) or a
+        ``(dim, "qkv")`` tuple for fused qkv weights (each rank's shard is
+        [q_i|k_i|v_i]; merging must be q/k/v-aware). Unmatched weights must
+        be identical replicas.
         """
-        from deepspeed_tpu.checkpoint.reshape_utils import merge_tp_shards, split_tp_shards
+        from deepspeed_tpu.checkpoint.reshape_utils import (
+            merge_qkv_shards, merge_tp_shards, split_qkv_shards, split_tp_shards)
 
         shards = [load_state_dict_file(p) for p in self.ckpt_list]
         merge_strategies = merge_strategies or {}
@@ -97,9 +117,11 @@ class MegatronSDLoader:
         full: Dict[str, np.ndarray] = {}
         for name in shards[0]:
             parts = [s[name] for s in shards]
-            dim = next((d for pat, d in merge_strategies.items() if pat in name), None)
+            dim, kind = self._strategy_for(name, merge_strategies)
             if dim is None or len(parts) == 1:
                 full[name] = parts[0]
+            elif kind == "qkv":
+                full[name] = merge_qkv_shards(parts, dim)
             else:
                 full[name] = merge_tp_shards(parts, dim)
 
@@ -108,9 +130,11 @@ class MegatronSDLoader:
 
         out: Dict[str, np.ndarray] = {}
         for name, arr in full.items():
-            dim = next((d for pat, d in merge_strategies.items() if pat in name), None)
+            dim, kind = self._strategy_for(name, merge_strategies)
             if dim is None:
                 out[name] = arr
+            elif kind == "qkv":
+                out[name] = split_qkv_shards(arr, dim, mp_world_size)[mp_rank]
             else:
                 out[name] = split_tp_shards(arr, dim, mp_world_size)[mp_rank]
         return out

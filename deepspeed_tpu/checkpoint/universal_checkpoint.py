@@ -39,13 +39,28 @@ def ds_to_universal(checkpoint_dir: str, out_dir: str, tag: Optional[str] = None
 
     fp32 = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag, _tree=tree)
 
-    # optimizer moments: the optax adam-family state was saved flattened in
-    # deterministic tree order — [count, mu..., nu..., ...] — so the first
-    # two runs of len(params) non-scalar leaves whose shapes match the param
-    # tree are exp_avg and exp_avg_sq
     moments: Dict[str, Dict[str, np.ndarray]] = {p: {} for p in fp32}
     opt_flat = tree.get("opt_state_flat")
-    if opt_flat:
+    labels = None
+    meta_path = os.path.join(checkpoint_dir, tag, "meta.json")
+    if os.path.isfile(meta_path):
+        with open(meta_path) as f:
+            labels = json.load(f).get("opt_state_labels")
+
+    if opt_flat and labels:
+        # structured metadata written at save time (checkpoint_engine):
+        # each flat leaf is labelled with its moment kind + param path —
+        # no shape guessing, extra optimizer state is simply skipped
+        kind_map = {"mu": "exp_avg", "nu": "exp_avg_sq"}
+        for i, lab in enumerate(labels):
+            kind = kind_map.get(lab.get("moment"))
+            pname = lab.get("param")
+            if kind and pname in moments:
+                moments[pname][kind] = np.asarray(opt_flat[f"leaf_{i}"]).astype(np.float32)
+    elif opt_flat:
+        # legacy checkpoints without labels: infer by runs of param-shaped
+        # leaves — [count, mu..., nu...] for adam-family chains; refuse to
+        # guess if the structure is ambiguous
         param_items = list(_leaf_paths(tree["params"]).items())
         n = len(param_items)
         param_shapes = [np.asarray(p).shape for _, p in param_items]
@@ -60,6 +75,15 @@ def ds_to_universal(checkpoint_dir: str, out_dir: str, tag: Optional[str] = None
                 i += n
             else:
                 i += 1
+        leftovers = len(arrays) - 2 * n
+        if len(runs) != 2 or leftovers != 0:
+            import warnings
+            warnings.warn(
+                f"ds_to_universal: optimizer state is ambiguous without labels "
+                f"({len(runs)} shape-matched runs, {leftovers} leftover "
+                f"non-scalar leaves); omitting moments — re-save the checkpoint "
+                f"with this version to get labelled optimizer state")
+            runs = []
         for name, run in zip(["exp_avg", "exp_avg_sq"], runs):
             for (pname, _), arr in zip(param_items, run):
                 moments[pname][name] = arr.astype(np.float32)

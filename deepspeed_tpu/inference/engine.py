@@ -66,6 +66,8 @@ class InferenceEngine:
         self.params = jax.tree.map(lambda a, s: jax.device_put(jnp.asarray(a, self.dtype), s), params, shardings)
 
         self._fwd_jit = None
+        self._prefill_jit = None
+        self._decode_jit = None
         log_dist(f"InferenceEngine ready: dtype={self.dtype.__name__}, tp={tp_size}, "
                  f"mesh={dict(self.mesh.shape)}", ranks=[0])
 
@@ -101,22 +103,92 @@ class InferenceEngine:
                              f"reduce max_new_tokens (reference max_out_tokens check)")
 
         rng = jax.random.key(seed)
+        if hasattr(self.module, "forward_cached") and hasattr(self.module, "init_cache"):
+            return self._generate_cached(input_ids, max_new, temperature, top_k, rng, eos_token_id)
+
+        # fallback for models without a cached forward: full-prefix recompute
         tokens = input_ids
         for _ in range(max_new):
             logits = self.forward(tokens)[:, -1, :].astype(jnp.float32)
-            if temperature > 0.0:
-                logits = logits / temperature
-                if top_k > 0:
-                    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-                    logits = jnp.where(logits < kth, -jnp.inf, logits)
-                rng, sub = jax.random.split(rng)
-                nxt = jax.random.categorical(sub, logits, axis=-1)
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
+            nxt = self._sample_host(logits, temperature, top_k, rng)
+            rng, _ = jax.random.split(rng)
             tokens = jnp.concatenate([tokens, nxt[:, None].astype(jnp.int32)], axis=1)
             if eos_token_id is not None and bool((nxt == eos_token_id).all()):
                 break
         return tokens
+
+    @staticmethod
+    def _sample_host(logits, temperature, top_k, rng):
+        if temperature > 0.0:
+            logits = logits / temperature
+            if top_k > 0:
+                kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            return jax.random.categorical(rng, logits, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    # ------------------------------------------------------------------ #
+    # KV-cache generation: prefill + fixed-shape decode, no per-token
+    # recompilation (reference workspace/KV design: inference_context.h:49,
+    # softmax_context pt_binding.cpp:1668-1793)
+
+    def _generate_cached(self, input_ids, max_new, temperature, top_k, rng, eos_token_id):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        B, prompt_len = input_ids.shape
+        max_len = prompt_len + max_new
+        cache = self.module.init_cache(B, max_len, dtype=self.dtype)
+        # KV heads ride the tp axis like the attention weights that feed them
+        kv_spec = (P(None, None, None, "tp", None)
+                   if self.mesh.shape.get("tp", 1) > 1 else P())
+        cache = jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(self.mesh, kv_spec)), cache)
+
+        if self._prefill_jit is None:
+            def prefill(params, toks, cache):
+                logits, cache = self.module.forward_cached(params, toks, cache, jnp.int32(0))
+                return logits[:, -1, :].astype(jnp.float32), cache
+
+            def decode(params, tok, cache, pos, rng, temperature, top_k):
+                logits, cache = self.module.forward_cached(params, tok, cache, pos)
+                logits = logits[:, -1, :].astype(jnp.float32)
+                nxt = jax.lax.cond(
+                    temperature > 0.0,
+                    lambda: self._sample_jit(logits, temperature, top_k, rng),
+                    lambda: jnp.argmax(logits, axis=-1))
+                return nxt, cache
+
+            self._prefill_jit = jax.jit(prefill, donate_argnums=(2,))
+            self._decode_jit = jax.jit(decode, donate_argnums=(2,))
+
+        logits0, cache = self._prefill_jit(self.params, input_ids, cache)
+        rng, sub = jax.random.split(rng)
+        nxt = self._sample_host(logits0, temperature, top_k, sub)
+
+        out = [nxt]
+        pos = prompt_len
+        t = jnp.float32(temperature)
+        k = jnp.int32(top_k)
+        for _ in range(max_new - 1):
+            if eos_token_id is not None and bool((nxt == eos_token_id).all()):
+                break
+            rng, sub = jax.random.split(rng)
+            nxt, cache = self._decode_jit(self.params, nxt[:, None].astype(jnp.int32),
+                                          cache, jnp.int32(pos), sub, t, k)
+            out.append(nxt)
+            pos += 1
+        gen = jnp.stack(out, axis=1).astype(jnp.int32)
+        return jnp.concatenate([input_ids, gen], axis=1)
+
+    @staticmethod
+    def _sample_jit(logits, temperature, top_k, rng):
+        """Sampling with traced temperature/top_k (so the decode step compiles
+        once): logits below the top_k-th value are masked when top_k > 0."""
+        logits = logits / jnp.maximum(temperature, 1e-6)
+        idx = jnp.clip(top_k - 1, 0, logits.shape[-1] - 1)
+        thresh = jnp.sort(logits, axis=-1)[..., ::-1][..., idx][..., None]
+        logits = jnp.where((top_k > 0) & (logits < thresh), -jnp.inf, logits)
+        return jax.random.categorical(rng, logits, axis=-1)
 
     @property
     def config(self):

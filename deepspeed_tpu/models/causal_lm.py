@@ -35,6 +35,15 @@ class CausalLM:
     def tp_specs(self) -> Dict[str, Any]:
         return T.tp_specs(self.config)
 
+    # ---- KV-cache inference (see transformer.forward_cached) ----
+
+    def init_cache(self, batch_size: int, max_len: Optional[int] = None,
+                   dtype=jnp.bfloat16) -> Dict[str, Any]:
+        return T.init_kv_cache(self.config, batch_size, max_len, dtype)
+
+    def forward_cached(self, params, tokens, cache, pos, pad_bias=None):
+        return T.forward_cached(self.config, params, tokens, cache, pos, pad_bias)
+
     @property
     def num_parameters(self) -> int:
         cfg = self.config

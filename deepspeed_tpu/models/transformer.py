@@ -47,13 +47,20 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dropout: float = 0.0
-    # memory
-    remat: bool = True                   # activation checkpointing per layer
+    # memory: activation checkpointing per layer. False/"none" = save all
+    # activations; True/"full" = save only layer inputs (reference
+    # CheckpointFunction semantics); "dots" = save matmul outputs, recompute
+    # the cheap elementwise/attention parts (best MFU when it fits HBM);
+    # "offload_dots" = save matmul outputs to pinned host memory.
+    remat: Any = True
     scan_layers: bool = True
     # sequence/context parallelism over the "sp" mesh axis
     sequence_parallel: str = "none"      # none | ring | ulysses
     # attention kernel: auto = Pallas flash on TPU, XLA einsum elsewhere
     attention_backend: str = "auto"      # auto | flash | xla
+    # cross-entropy in sequence chunks of this many tokens: never
+    # materialises the full [B, S, vocab] logits (0 = unchunked)
+    loss_chunk: int = 0
     # init
     init_std: float = 0.02
 
@@ -218,9 +225,10 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
     B, S, D = x.shape
     H, KV, Hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
 
-    q = (x @ lp["wq"]).reshape(B, S, H, Hd)
-    k = (x @ lp["wk"]).reshape(B, S, KV, Hd)
-    v = (x @ lp["wv"]).reshape(B, S, KV, Hd)
+    from jax.ad_checkpoint import checkpoint_name
+    q = checkpoint_name((x @ lp["wq"]).reshape(B, S, H, Hd), "q_proj")
+    k = checkpoint_name((x @ lp["wk"]).reshape(B, S, KV, Hd), "k_proj")
+    v = checkpoint_name((x @ lp["wv"]).reshape(B, S, KV, Hd), "v_proj")
 
     if cfg.pos_embedding == "rope":
         q = _rope(q, positions, cfg.rope_theta)
@@ -234,6 +242,7 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
     slopes = _alibi_slopes(H) if cfg.pos_embedding == "alibi" else None
 
     sp_mesh = _sp_mesh(cfg)
+    out = None
     if sp_mesh is not None:
         from deepspeed_tpu.sequence import sp_attention
         out = sp_attention(q, k, v, mesh=sp_mesh, impl=cfg.sequence_parallel,
@@ -243,34 +252,89 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
         out = flash_attention(q, k, v, mask_bias=mask_bias, causal=cfg.causal,
                               alibi_slopes=slopes)
     else:
+        fmesh = _flash_mesh(cfg)
+        if fmesh is not None:
+            out = _flash_sharded(cfg, q, k, v, mask_bias, slopes, fmesh)
+    if out is None:
         from deepspeed_tpu.ops.attention import mha_attention
         out = mha_attention(q, k, v,
                             mask_bias=None if mask_bias is None else mask_bias[:, None, None, :],
                             causal=cfg.causal, alibi_slopes=slopes)
-    out = out.reshape(B, S, H * Hd)
-    return out @ lp["wo"]
+    out = checkpoint_name(out.reshape(B, S, H * Hd), "attn_out")
+    return checkpoint_name(out @ lp["wo"], "wo_out")
 
 
 def _use_flash(cfg: TransformerConfig) -> bool:
-    """Pallas flash attention is a per-shard kernel: XLA cannot partition a
-    pallas_call inside a multi-device auto-sharded program, so fall back to
-    the einsum form whenever the active mesh spans >1 device. (Multi-device
-    long-context runs should use ``sequence_parallel`` — sharded streaming
-    attention via shard_map.)"""
+    """Direct (unwrapped) Pallas flash attention: single-device meshes only —
+    a bare pallas_call is not partitionable by XLA. Multi-device meshes go
+    through :func:`_flash_sharded` (shard_map over batch/head axes) instead."""
     if cfg.attention_backend not in ("flash", "auto"):
         return False
     import deepspeed_tpu.comm as dist
     if dist.has_mesh() and dist.get_mesh().devices.size > 1:
-        if cfg.attention_backend == "flash":
-            from deepspeed_tpu.utils.logging import logger
-            logger.warning("attention_backend='flash' on a >1-device mesh: "
-                           "falling back to XLA einsum attention (pallas_call "
-                           "is not partitionable; use sequence_parallel='ring' "
-                           "for sharded O(S/sp)-memory attention)")
         return False
     if cfg.attention_backend == "flash":
         return True
     return jax.default_backend() == "tpu"
+
+
+def _flash_mesh(cfg: TransformerConfig):
+    """The active mesh when the shard_map-wrapped flash kernel applies:
+    every axis of size > 1 must be one the kernel can shard without
+    communication — batch over dp/fsdp, heads over tp. Pipeline / expert /
+    sequence axes fall back to the einsum form (sp has its own path)."""
+    if cfg.attention_backend not in ("flash", "auto"):
+        return None
+    if cfg.attention_backend == "auto" and jax.default_backend() != "tpu":
+        return None
+    import deepspeed_tpu.comm as dist
+    if not dist.has_mesh():
+        return None
+    mesh = dist.get_mesh()
+    if mesh.devices.size == 1:
+        return None
+    for name, size in mesh.shape.items():
+        if size > 1 and name not in ("dp", "fsdp", "tp"):
+            return None
+    return mesh
+
+
+def _flash_sharded(cfg: TransformerConfig, q, k, v, mask_bias, slopes, mesh):
+    """Flash attention under a dp/fsdp×tp mesh: shard_map over the batch and
+    head axes (no cross-shard communication — attention is pointwise in batch
+    and head), so the Pallas kernel runs per-shard instead of silently
+    falling back to O(S²) einsum attention on multi-chip meshes.
+    Returns None when the shard sizes don't divide (caller falls back)."""
+    from jax.experimental.shard_map import shard_map
+
+    B, S, H, Hd = q.shape
+    batch_axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+    head_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
+    nb = 1
+    for a in batch_axes:
+        nb *= mesh.shape[a]
+    nh = mesh.shape["tp"] if head_axis else 1
+    if B % nb != 0 or H % nh != 0:
+        return None
+
+    qspec = P(batch_axes or None, None, head_axis, None)
+    mspec = P(batch_axes or None, None)
+    sspec = P(head_axis)
+    mask = (jnp.zeros((B, S), jnp.float32) if mask_bias is None
+            else mask_bias.astype(jnp.float32))
+    slope_arr = (jnp.zeros((H,), jnp.float32) if slopes is None
+                 else jnp.asarray(slopes, jnp.float32).reshape(H))
+
+    from deepspeed_tpu.ops.pallas import flash_attention
+
+    def inner(qs, ks, vs, ms, ss):
+        return flash_attention(qs, ks, vs, mask_bias=ms, causal=cfg.causal,
+                               alibi_slopes=ss)
+
+    wrapped = shard_map(inner, mesh=mesh,
+                        in_specs=(qspec, qspec, qspec, mspec, sspec),
+                        out_specs=qspec, check_rep=False)
+    return wrapped(q, k, v, mask, slope_arr)
 
 
 def _sp_mesh(cfg: TransformerConfig):
@@ -287,12 +351,34 @@ def _sp_mesh(cfg: TransformerConfig):
     return None
 
 
+def _remat_policy(remat):
+    """Map the config's remat setting to a jax.checkpoint policy (None =
+    full remat, the reference's save-only-inputs CheckpointFunction)."""
+    if remat is True or remat == "full":
+        return None
+    pols = jax.checkpoint_policies
+    if remat == "dots":
+        return pols.dots_with_no_batch_dims_saveable
+    if remat == "selective":
+        # save only the [tokens, D]-sized projections (cheap to store), and
+        # recompute the d_ff-sized up/gate activations + attention internals
+        # in backward — ~4 bytes·tokens·D/layer instead of ~(5·D+2·F)
+        return pols.save_only_these_names(
+            "q_proj", "k_proj", "v_proj", "attn_out", "wo_out", "ff_down")
+    if remat == "offload_dots":
+        return pols.offload_dot_with_no_batch_dims("device", "pinned_host")
+    raise ValueError(f"unknown remat policy {remat!r} (expected True/'full', "
+                     "'dots', 'selective', 'offload_dots', False/'none')")
+
+
 def mlp(cfg: TransformerConfig, x, lp):
+    from jax.ad_checkpoint import checkpoint_name
     if cfg.activation == "swiglu":
-        return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        out = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        return checkpoint_name(out, "ff_down")
     h = x @ lp["w_up"] + lp["b_up"]
     h = jax.nn.gelu(h, approximate=True) if cfg.activation == "gelu" else jax.nn.relu(h)
-    return h @ lp["w_down"] + lp["b_down"]
+    return checkpoint_name(h @ lp["w_down"] + lp["b_down"], "ff_down")
 
 
 def block(cfg: TransformerConfig, x, lp, positions, mask_bias):
@@ -307,6 +393,102 @@ def block(cfg: TransformerConfig, x, lp, positions, mask_bias):
 
 def forward(cfg: TransformerConfig, params, tokens, attn_mask=None):
     """tokens [B, S] int32 → logits [B, S, vocab]."""
+    x = hidden_states(cfg, params, tokens, attn_mask)
+    return x @ _head_weight(cfg, params)
+
+
+# --------------------------------------------------------------------- #
+# KV-cache inference path (reference: preallocated workspace + KV append,
+# csrc/transformer/inference/includes/inference_context.h:49, softmax_context
+# csrc/transformer/inference/csrc/pt_binding.cpp:1668-1793, layer-past
+# handling deepspeed/model_implementations/transformers/ds_transformer.py:18).
+# TPU design: a donated fixed-shape [L, B, Smax, KV, Hd] cache updated with
+# dynamic_update_slice inside one jitted program per (prefill, decode) shape
+# — no per-token recompilation, O(Smax) attention per generated token.
+
+def init_kv_cache(cfg: TransformerConfig, batch_size: int, max_len: Optional[int] = None,
+                  dtype=jnp.bfloat16) -> Dict[str, Any]:
+    """Preallocated KV cache: k/v [n_layer, B, max_len, kv_heads, head_dim]."""
+    Smax = max_len or cfg.max_seq
+    shape = (cfg.n_layer, batch_size, Smax, cfg.kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad_bias):
+    """Attention for T new tokens against the (updated) KV cache.
+
+    x [B, T, D]; positions [B, T] global positions of the new tokens;
+    pos [] int32 tokens already cached; ck/cv [B, Smax, KV, Hd].
+    Returns (out [B, T, D], new ck, new cv)."""
+    B, T, D = x.shape
+    H, KV, Hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
+    Smax = ck.shape[1]
+
+    q = (x @ lp["wq"]).reshape(B, T, H, Hd)
+    k = (x @ lp["wk"]).reshape(B, T, KV, Hd)
+    v = (x @ lp["wv"]).reshape(B, T, KV, Hd)
+    if cfg.pos_embedding == "rope":
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
+
+    kk, vv = ck, cv
+    if KV != H:
+        rep = H // KV
+        kk = jnp.repeat(kk, rep, axis=2)
+        vv = jnp.repeat(vv, rep, axis=2)
+
+    scale = Hd**-0.5
+    scores = jnp.einsum("bthd,bshd->bhts", q, kk,
+                        preferred_element_type=jnp.float32) * scale
+    kpos = jnp.arange(Smax, dtype=jnp.int32)[None, None, None, :]      # [1,1,1,S]
+    qpos = positions[:, None, :, None]                                 # [B,1,T,1]
+    valid = kpos <= qpos                                               # causal + cache bound
+    if cfg.pos_embedding == "alibi":
+        slopes = _alibi_slopes(H)
+        scores = scores + slopes[None, :, None, None] * (kpos - qpos).astype(jnp.float32)
+    scores = jnp.where(valid, scores, -1e30)
+    if pad_bias is not None:
+        scores = scores + pad_bias[:, None, None, :]
+    probs = jax.nn.softmax(scores, axis=-1).astype(vv.dtype)
+    out = jnp.einsum("bhts,bshd->bthd", probs, vv)
+    out = out.reshape(B, T, H * Hd) @ lp["wo"]
+    return out, ck, cv
+
+
+def forward_cached(cfg: TransformerConfig, params, tokens, cache, pos, pad_bias=None):
+    """tokens [B, T] (T static: prompt chunk or 1) attended against + appended
+    to ``cache`` at offset ``pos`` ([] int32). Returns (logits [B, T, vocab],
+    new cache). ``pad_bias`` [B, Smax] additive f32 masks cache slots of
+    left-padded prompts."""
+    B, T = tokens.shape
+    x = params["embed"]["tokens"][tokens].astype(cache["k"].dtype)
+    positions = pos + jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    if cfg.pos_embedding == "learned":
+        x = x + params["embed"]["positions"][positions].astype(x.dtype)
+
+    def run_block(h, xs):
+        lp, ck, cv = xs
+        a, nck, ncv = _cached_attention(cfg, _norm(cfg, h, lp["ln_attn"]), lp["attn"],
+                                        positions, pos, ck, cv, pad_bias)
+        if cfg.parallel_residual:
+            m = mlp(cfg, _norm(cfg, h, lp["ln_mlp"]), lp["mlp"])
+            return h + a + m, (nck, ncv)
+        h = h + a
+        m = mlp(cfg, _norm(cfg, h, lp["ln_mlp"]), lp["mlp"])
+        return h + m, (nck, ncv)
+
+    x, (nk, nv) = jax.lax.scan(run_block, x, (params["layers"], cache["k"], cache["v"]))
+    x = _norm(cfg, x, params["ln_f"])
+    logits = x @ _head_weight(cfg, params)
+    return logits, {"k": nk, "v": nv}
+
+
+def hidden_states(cfg: TransformerConfig, params, tokens, attn_mask=None):
+    """tokens [B, S] int32 → final normed hidden states [B, S, D] (the
+    forward body without the vocab projection)."""
     B, S = tokens.shape
     x = params["embed"]["tokens"][tokens]
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
@@ -314,15 +496,15 @@ def forward(cfg: TransformerConfig, params, tokens, attn_mask=None):
         x = x + params["embed"]["positions"][:S][None, :, :]
 
     mask_bias = key_mask_bias(attn_mask)
-
     layer_params = params["layers"]
 
     def run_block(h, lp):
         out = block(cfg, h, lp, positions, mask_bias)
         return out, None
 
-    if cfg.remat:
-        run_block = jax.checkpoint(run_block, prevent_cse=False)
+    if cfg.remat and cfg.remat != "none":
+        run_block = jax.checkpoint(run_block, policy=_remat_policy(cfg.remat),
+                                   prevent_cse=False)
 
     if cfg.scan_layers:
         x, _ = jax.lax.scan(run_block, x, layer_params)
@@ -331,25 +513,62 @@ def forward(cfg: TransformerConfig, params, tokens, attn_mask=None):
             lp = jax.tree.map(lambda a: a[i], layer_params)
             x, _ = run_block(x, lp)
 
-    x = _norm(cfg, x, params["ln_f"])
+    return _norm(cfg, x, params["ln_f"])
+
+
+def _head_weight(cfg: TransformerConfig, params):
+    """[D, vocab] projection (tied embedding transpose or lm_head)."""
     if cfg.tie_embeddings:
-        logits = x @ params["embed"]["tokens"].T
-    else:
-        logits = x @ params["lm_head"]
-    return logits
+        return params["embed"]["tokens"].T
+    return params["lm_head"]
+
+
+def _token_ce(logits, labels, valid):
+    """Per-token nll and valid count from [N, V] f32 logits."""
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.sum((lse - gold) * valid), jnp.sum(valid)
 
 
 def lm_loss(cfg: TransformerConfig, params, batch, ignore_index: int = -100):
     """Next-token cross-entropy. batch: dict(input_ids[B,S], optional
-    labels[B,S], optional attention_mask[B,S])."""
+    labels[B,S], optional attention_mask[B,S]).
+
+    With ``cfg.loss_chunk > 0`` the vocab projection + CE run over sequence
+    chunks inside a rematerialised scan, so the [B, S, vocab] logits are
+    never materialised in fp32 — the TPU analogue of the reference's fused
+    softmax-xent kernels (HBM traffic O(B·S·D) instead of O(B·S·V))."""
     tokens = batch["input_ids"]
     labels = batch.get("labels")
     if labels is None:
         labels = jnp.concatenate([tokens[:, 1:], jnp.full_like(tokens[:, :1], ignore_index)], axis=1)
-    logits = forward(cfg, params, tokens, batch.get("attention_mask"))
-    logits = logits.astype(jnp.float32)
-    valid = labels != ignore_index
+    x = hidden_states(cfg, params, tokens, batch.get("attention_mask"))
+    w = _head_weight(cfg, params)
+    B, S, D = x.shape
+
+    valid = (labels != ignore_index)
     safe_labels = jnp.where(valid, labels, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
-    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+    chunk = cfg.loss_chunk
+    if chunk <= 0 or (B * S) % chunk != 0:
+        logits = (x @ w).astype(jnp.float32)
+        nll, n = _token_ce(logits.reshape(B * S, -1),
+                           safe_labels.reshape(-1), valid.reshape(-1).astype(jnp.float32))
+        return nll / jnp.maximum(n, 1)
+
+    nc = (B * S) // chunk
+    xf = x.reshape(nc, chunk, D)
+    lf = safe_labels.reshape(nc, chunk)
+    vf = valid.reshape(nc, chunk).astype(jnp.float32)
+
+    def body(carry, inp):
+        xc, lc, vc = inp
+        logits = (xc @ w).astype(jnp.float32)
+        nll, n = _token_ce(logits, lc, vc)
+        s_nll, s_n = carry
+        return (s_nll + nll, s_n + n), None
+
+    # full remat: the chunk logits are recomputed in backward, never stored
+    body = jax.checkpoint(body, prevent_cse=False)
+    (nll, n), _ = jax.lax.scan(body, (jnp.float32(0), jnp.float32(0)), (xf, lf, vf))
+    return nll / jnp.maximum(n, 1)

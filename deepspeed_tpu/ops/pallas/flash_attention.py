@@ -70,9 +70,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, slope_ref, *rest,
 
     @pl.when(run)
     def _():
-        q = q_ref[:].astype(jnp.float32)
-        k = k_ref[:].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        # keep q/k in their storage dtype (bf16) for the MXU dot — f32
+        # operands run at a fraction of the MXU's bf16 rate; f32 accumulate
+        s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         s = s + _block_bias(qoff, koff, bq, bk, seq_len, causal,
                             slope_ref[0, 0], mask_ref[0].astype(jnp.float32))
@@ -83,8 +83,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, slope_ref, *rest,
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
-            p, v_ref[:].astype(jnp.float32), preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -115,19 +116,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, slope_
 
     @pl.when(run)
     def _():
-        q = q_ref[:].astype(jnp.float32)
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
-        do = do_ref[:].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         s = s + _block_bias(qoff, koff, bq, bk, seq_len, causal,
                             slope_ref[0, 0], mask_ref[0].astype(jnp.float32))
         p = jnp.exp(s - lse_ref[0][:, None])
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
-        dq_scr[:] = dq_scr[:] + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0][:, None]) * scale).astype(k_ref.dtype)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k_ref[:], (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     @pl.when(j == nk - 1)
     def _():
@@ -154,21 +152,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, slope
 
     @pl.when(run)
     def _():
-        q = q_ref[:].astype(jnp.float32)
-        k = k_ref[:].astype(jnp.float32)
-        do = do_ref[:].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        s = jax.lax.dot_general(q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         s = s + _block_bias(qoff, koff, bq, bk, seq_len, causal,
                             slope_ref[0, 0], mask_ref[0].astype(jnp.float32))
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - lse_ref[0][:, None]).astype(do_ref.dtype)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v_ref[:].astype(jnp.float32),
+            p, do_ref[:], (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do_ref[:], v_ref[:],
                                  (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = (p.astype(jnp.float32) * (dp - delta_ref[0][:, None]) * scale).astype(q_ref.dtype)
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds, q_ref[:], (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     @pl.when(i == nq - 1)
     def _():
@@ -332,8 +327,19 @@ def flash_attention(q, k, v, mask_bias=None, causal: bool = True, alibi_slopes=N
                 f"TPU tiling; use a coarser SparsityConfig block")
         block_q = block_k = lb
 
-    bq = min(block_q, max(8, S))
-    bk = min(block_k, max(8, S))
+    # block sizes: multiples of 8 (TPU sublane tiling) — unaligned S gets a
+    # single rounded-up block absorbed by the padding below
+    s8 = -(-max(8, S) // 8) * 8
+    bq = min(block_q, s8)
+    bk = min(block_k, s8)
+    if block_layout is None:
+        # when the sequence spans multiple blocks, the (1, bq)/(1, bk) row
+        # and mask blocks tile the lane dim and must be 128-aligned (the
+        # layout path instead requires bq == the layout's block size)
+        if s8 > bq and bq % 128:
+            bq = -(-bq // 128) * 128
+        if s8 > bk and bk % 128:
+            bk = -(-bk // 128) * 128
     # pad S to a common multiple of both block sizes
     lcm = bq * bk // _gcd(bq, bk)
     Sp = -(-S // lcm) * lcm

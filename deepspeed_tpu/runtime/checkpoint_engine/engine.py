@@ -24,6 +24,31 @@ def _tag_dir(save_dir: str, tag: str) -> str:
     return os.path.join(os.path.abspath(save_dir), str(tag))
 
 
+def _opt_state_labels(opt_state):
+    """One label per flattened opt_state leaf (flatten order):
+    {"moment": "mu"|"nu"|None, "param": dotted-path-or-"", "path": keystr}.
+    Adam-family optax states expose first/second moments as ``mu``/``nu``
+    namedtuple fields over the param tree; anything else gets moment=None so
+    downstream tools treat it as opaque extra state instead of guessing."""
+    from jax.tree_util import GetAttrKey, tree_flatten_with_path
+
+    from deepspeed_tpu.utils.pytree import leaf_key
+
+    flat, _ = tree_flatten_with_path(opt_state)
+    labels = []
+    for path, _leaf in flat:
+        moment = None
+        param = ""
+        for i, entry in enumerate(path):
+            if isinstance(entry, GetAttrKey) and entry.name in ("mu", "nu"):
+                moment = entry.name
+                param = leaf_key(path[i + 1:])
+                break
+        labels.append({"moment": moment, "param": param,
+                       "path": jax.tree_util.keystr(path)})
+    return labels
+
+
 def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_state=None,
                            save_latest: bool = True) -> bool:
     if tag is None:
@@ -72,10 +97,12 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None, cli
     }
     if state.master is not None:
         tree["master"] = state.master
+    opt_labels = None
     if state.opt_state is not None:
         # flatten the optax state to a dict orbax can store without the types
         flat, treedef = jax.tree.flatten(state.opt_state)
         tree["opt_state_flat"] = {f"leaf_{i}": leaf for i, leaf in enumerate(flat)}
+        opt_labels = _opt_state_labels(state.opt_state)
 
     ckpt_engine.save(tree, os.path.join(path, "state"))
 
@@ -102,6 +129,10 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None, cli
         "client_state": client_state or {},
         "framework_version": 1,
     }
+    if opt_labels is not None:
+        # structured identity of every opt_state_flat leaf, so tools
+        # (ds_to_universal) never have to guess moments by shape matching
+        meta["opt_state_labels"] = opt_labels
     if jax.process_index() == 0:
         with open(os.path.join(path, "meta.json"), "w") as f:
             json.dump(meta, f, indent=2, default=str)

@@ -132,8 +132,15 @@ class DeepSpeedEngine:
             self.compute_dtype = jnp.float32
         self.mixed_precision = self.compute_dtype != jnp.float32
         acc_dtype_name = self._config.gradient_accumulation_dtype
-        self.grad_acc_dtype = {None: jnp.float32, "fp32": jnp.float32, "fp16": jnp.float16,
-                               "bf16": jnp.bfloat16}[acc_dtype_name]
+        # default: with no accumulation (gas=1) grads pass straight through to
+        # the update, so keep them in compute dtype — the persistent fp32
+        # accumulator would cost 4 bytes/param for nothing; with gas>1 the
+        # reference accumulates in fp32 (bf16_optimizer.py) and so do we
+        if acc_dtype_name is None and self.gradient_accumulation_steps() == 1:
+            self.grad_acc_dtype = self.compute_dtype
+        else:
+            self.grad_acc_dtype = {None: jnp.float32, "fp32": jnp.float32, "fp16": jnp.float16,
+                                   "bf16": jnp.bfloat16}[acc_dtype_name]
 
         # ---- optimizer ----
         self.client_optimizer = optimizer
@@ -541,9 +548,14 @@ class DeepSpeedEngine:
             seq = max((x.shape[2] for x in leaves if x.ndim >= 3), default=0)
             if difficulty < seq:
                 def trunc(x):
-                    for dim in range(2, x.ndim):
-                        if x.shape[dim] == seq:
-                            x = jax.lax.slice_in_dim(x, 0, difficulty, axis=dim)
+                    # leaves are [gas, B, S, ...]: the sequence dim is dim 2;
+                    # dim 3 is sliced ONLY for square [.., S, S] attention
+                    # masks — a feature dim that merely equals S (e.g.
+                    # one-hot labels with vocab == S) must stay intact
+                    if x.ndim >= 3 and x.shape[2] == seq:
+                        x = jax.lax.slice_in_dim(x, 0, difficulty, axis=2)
+                        if x.ndim == 4 and x.shape[3] == seq:
+                            x = jax.lax.slice_in_dim(x, 0, difficulty, axis=3)
                     return x
                 batch = jax.tree.map(trunc, batch)
 

@@ -157,3 +157,29 @@ class TestSDLoader:
         r1 = loader.load(mp_world_size=4, mp_rank=1, merge_strategies=strategies)
         np.testing.assert_array_equal(r1["attn.qkv.weight"], np.split(full_col, 4, axis=1)[1])
         np.testing.assert_array_equal(r1["ln.weight"], full_rep)
+
+    def test_fused_qkv_merge_strategy(self, tmp_path):
+        """A genuinely fused qkv weight: each rank holds [q_i|k_i|v_i], so
+        plain concat interleaves blocks and differs from the correct
+        [q_0 q_1|k_0 k_1|v_0 v_1] merge (advisor finding: the loader must
+        route 'qkv' entries through merge_qkv_shards)."""
+        import torch
+        from deepspeed_tpu.checkpoint.reshape_utils import split_qkv_shards
+        D, H3 = 4, 12
+        full = np.arange(D * H3, dtype=np.float32).reshape(D, H3)
+        rank_shards = split_qkv_shards(full, 1, 2)  # each [q_i|k_i|v_i]
+        for rank, shard in enumerate(rank_shards):
+            torch.save({"attn.query_key_value.weight": torch.tensor(shard)},
+                       tmp_path / f"mp_rank_{rank:02d}.pt")
+        loader = MegatronSDLoader([str(tmp_path / f"mp_rank_{r:02d}.pt") for r in range(2)])
+
+        plain = loader.load(merge_strategies={"query_key_value": 1})
+        fused = loader.load(merge_strategies={"query_key_value": (1, "qkv")})
+        # sanity: this fixture genuinely distinguishes the two paths
+        assert not np.array_equal(plain["attn.query_key_value.weight"], full)
+        np.testing.assert_array_equal(fused["attn.query_key_value.weight"], full)
+
+        # reslice to tp=2 must return each rank's own fused block
+        r0 = loader.load(mp_world_size=2, mp_rank=0,
+                         merge_strategies={"query_key_value": (1, "qkv")})
+        np.testing.assert_array_equal(r0["attn.query_key_value.weight"], rank_shards[0])

@@ -72,3 +72,68 @@ def test_client_optax_optimizer_descends():
                                                optimizer=optax.adam(1e-2))
     losses = [float(engine.train_batch(random_batch(32, 16, seed=i))) for i in range(35)]
     assert losses[-1] < losses[0] * 0.5, f"{losses[0]} -> {losses[-1]}"
+
+
+class TestKVCacheDecode:
+    """KV-cache decode path (reference: inference_context.h:49 workspace,
+    softmax_context KV append pt_binding.cpp:1668-1793)."""
+
+    def _model(self, **over):
+        base = dict(vocab_size=64, n_layer=2, n_head=4, d_model=32, d_ff=64,
+                    max_seq=32, remat=False)
+        base.update(over)
+        return CausalLM(TransformerConfig(**base))
+
+    @pytest.mark.parametrize("style", ["gpt2", "llama", "alibi", "gqa"])
+    def test_decode_logits_match_full_forward(self, style):
+        over = {
+            "gpt2": {},
+            "llama": dict(pos_embedding="rope", norm="rmsnorm", activation="swiglu",
+                          tie_embeddings=False),
+            "alibi": dict(pos_embedding="alibi"),
+            "gqa": dict(pos_embedding="rope", n_kv_head=2),
+        }[style]
+        model = self._model(**over)
+        params = model.init_params(jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (2, 10), 0, 64)
+
+        full = model.forward(params, toks).astype(jnp.float32)
+
+        cache = model.init_cache(2, 16, dtype=jnp.float32)
+        lp, cache = model.forward_cached(params, toks[:, :6], cache, jnp.int32(0))
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(full[:, :6]),
+                                   rtol=2e-4, atol=2e-4)
+        for i in range(6, 10):
+            ld, cache = model.forward_cached(params, toks[:, i:i + 1], cache, jnp.int32(i))
+            np.testing.assert_allclose(np.asarray(ld[:, 0]), np.asarray(full[:, i]),
+                                       rtol=2e-4, atol=2e-4, err_msg=f"step {i}")
+
+    def test_cached_generate_matches_recompute(self):
+        model = self._model()
+        engine = deepspeed_tpu.init_inference(model, dtype="fp32")
+        prompt = jnp.array([[1, 2, 3, 4]], jnp.int32)
+        out = engine.generate(prompt, max_new_tokens=6)
+
+        # reference: the old full-prefix recompute loop
+        toks = prompt
+        for _ in range(6):
+            logits = engine.forward(toks)[:, -1, :].astype(jnp.float32)
+            nxt = jnp.argmax(logits, axis=-1)
+            toks = jnp.concatenate([toks, nxt[:, None].astype(jnp.int32)], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
+
+    def test_decode_compiles_once(self):
+        model = self._model()
+        engine = deepspeed_tpu.init_inference(model, dtype="fp32")
+        prompt = jnp.array([[1, 2, 3]], jnp.int32)
+        engine.generate(prompt, max_new_tokens=8)
+        assert engine._decode_jit._cache_size() == 1, (
+            "decode step recompiled during generation")
+
+    def test_sampled_generation_shapes(self):
+        model = self._model()
+        engine = deepspeed_tpu.init_inference(model, dtype="fp32")
+        prompt = jnp.array([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        out = engine.generate(prompt, max_new_tokens=5, temperature=0.8, top_k=10, seed=3)
+        assert out.shape == (2, 8)
+        assert int(out.min()) >= 0 and int(out.max()) < 64
