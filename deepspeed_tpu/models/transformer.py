@@ -305,7 +305,7 @@ def _flash_sharded(cfg: TransformerConfig, q, k, v, mask_bias, slopes, mesh):
     and head), so the Pallas kernel runs per-shard instead of silently
     falling back to O(S²) einsum attention on multi-chip meshes.
     Returns None when the shard sizes don't divide (caller falls back)."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     B, S, H, Hd = q.shape
     batch_axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
@@ -320,21 +320,30 @@ def _flash_sharded(cfg: TransformerConfig, q, k, v, mask_bias, slopes, mesh):
     qspec = P(batch_axes or None, None, head_axis, None)
     mspec = P(batch_axes or None, None)
     sspec = P(head_axis)
-    mask = (jnp.zeros((B, S), jnp.float32) if mask_bias is None
-            else mask_bias.astype(jnp.float32))
-    slope_arr = (jnp.zeros((H,), jnp.float32) if slopes is None
-                 else jnp.asarray(slopes, jnp.float32).reshape(H))
 
     from deepspeed_tpu.ops.pallas import flash_attention
 
-    def inner(qs, ks, vs, ms, ss):
+    # None mask/slopes stay None INSIDE the shard_map (instead of zero
+    # arrays) so the kernel's plain-causal fast path engages per shard
+    operands = [q, k, v]
+    specs = [qspec, qspec, qspec]
+    if mask_bias is not None:
+        operands.append(mask_bias.astype(jnp.float32))
+        specs.append(mspec)
+    if slopes is not None:
+        operands.append(jnp.asarray(slopes, jnp.float32).reshape(H))
+        specs.append(sspec)
+
+    def inner(qs, ks, vs, *rest):
+        rest = list(rest)
+        ms = rest.pop(0) if mask_bias is not None else None
+        ss = rest.pop(0) if slopes is not None else None
         return flash_attention(qs, ks, vs, mask_bias=ms, causal=cfg.causal,
                                alibi_slopes=ss)
 
-    wrapped = shard_map(inner, mesh=mesh,
-                        in_specs=(qspec, qspec, qspec, mspec, sspec),
-                        out_specs=qspec, check_rep=False)
-    return wrapped(q, k, v, mask, slope_arr)
+    wrapped = shard_map(inner, mesh=mesh, in_specs=tuple(specs),
+                       out_specs=qspec, check_vma=False)
+    return wrapped(*operands)
 
 
 def _sp_mesh(cfg: TransformerConfig):
@@ -360,11 +369,13 @@ def _remat_policy(remat):
     if remat == "dots":
         return pols.dots_with_no_batch_dims_saveable
     if remat == "selective":
-        # save only the [tokens, D]-sized projections (cheap to store), and
-        # recompute the d_ff-sized up/gate activations + attention internals
-        # in backward — ~4 bytes·tokens·D/layer instead of ~(5·D+2·F)
+        # save only the [tokens, D]-sized projections (cheap to store) plus
+        # the flash kernel's (o, lse) residuals — so backward runs the flash
+        # backward kernels WITHOUT re-running the forward kernel — and
+        # recompute the d_ff-sized up/gate activations in backward
         return pols.save_only_these_names(
-            "q_proj", "k_proj", "v_proj", "attn_out", "wo_out", "ff_down")
+            "q_proj", "k_proj", "v_proj", "attn_out", "wo_out", "ff_down",
+            "flash_o", "flash_lse")
     if remat == "offload_dots":
         return pols.offload_dot_with_no_batch_dims("device", "pinned_host")
     raise ValueError(f"unknown remat policy {remat!r} (expected True/'full', "

@@ -348,11 +348,20 @@ class DeepSpeedEngine:
         # constrain to ZeRO grad shardings: stage>=2 => XLA reduce-scatters
         return jax.lax.with_sharding_constraint(acc, self._grad_shardings)
 
-    def _apply_update(self, state: TrainState, gas: int) -> TrainState:
-        """Unscale, clip, (maybe skip on overflow), optimizer update."""
+    def _apply_update(self, state: TrainState, gas: int, acc=None) -> TrainState:
+        """Unscale, clip, (maybe skip on overflow), optimizer update.
+
+        ``acc``: gradient tree to consume; defaults to ``state.acc_grads``
+        (the GAS-scan buffers). The gas==1 fast path passes the micro-step
+        grads directly so no accumulation buffers are read, written, or
+        re-zeroed — and with no scan barrier XLA's scheduler is free to
+        overlap per-param optimizer updates with the rest of the backward."""
+        from_buffers = acc is None
+        if from_buffers:
+            acc = state.acc_grads
         scale = state.scaler.loss_scale
         denom = scale * gas
-        grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom, state.acc_grads)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32) / denom, acc)
 
         overflow = has_overflow(grads) if self.fp16_enabled() else jnp.asarray(False)
 
@@ -391,7 +400,9 @@ class DeepSpeedEngine:
             new_params = jax.lax.with_sharding_constraint(new_target, self._param_shardings)
 
         new_scaler = scaler_update(state.scaler, overflow)
-        zero_acc = jax.tree.map(jnp.zeros_like, state.acc_grads)
+        # donation aliases the untouched buffers through at zero cost
+        zero_acc = (jax.tree.map(jnp.zeros_like, state.acc_grads) if from_buffers
+                    else state.acc_grads)
         return state._replace(
             params=new_params, master=new_master, opt_state=new_opt, acc_grads=zero_acc, scaler=new_scaler,
             global_steps=state.global_steps + 1,
@@ -471,7 +482,27 @@ class DeepSpeedEngine:
         return {"loss": self._losses, "lr": lr, "loss_scale": float(new_scaler.loss_scale)}
 
     def _build_train_batch_fn(self, gas: int) -> Callable:
-        """Fused GAS-scan + update, one XLA program."""
+        """Fused GAS-scan + update, one XLA program. gas == 1 skips the scan
+        and the accumulation buffers entirely: the micro-step grads feed the
+        optimizer update directly (no acc read/write/re-zero, no scan
+        barrier between backward and update)."""
+
+        if gas == 1:
+            def train_batch_fn(state: TrainState, batch, rng):
+                mb = jax.tree.map(lambda x: x[0], batch)
+                # fold_in(rng, 0) matches the scan path's micro-step-0 stream
+                loss, grads = self._micro_grads(state.params, mb,
+                                                jax.random.fold_in(rng, 0),
+                                                state.scaler.loss_scale)
+                grads = jax.lax.with_sharding_constraint(
+                    jax.tree.map(lambda g: g.astype(self.grad_acc_dtype), grads),
+                    self._grad_shardings)
+                state = state._replace(micro_steps=state.micro_steps + 1)
+                state = self._apply_update(state, 1, acc=grads)
+                return state, {"loss": loss, "lr": self._lr_fn(state.global_steps - 1),
+                               "loss_scale": state.scaler.loss_scale}
+
+            return jax.jit(train_batch_fn, donate_argnums=(0,))
 
         def train_batch_fn(state: TrainState, batch, rng):
             scale = state.scaler.loss_scale
