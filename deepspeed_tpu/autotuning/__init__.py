@@ -1,0 +1,21 @@
+"""Autotuning: search ZeRO stage x micro-batch x remat policy x loss-chunk.
+
+Reference parity: ``deepspeed/autotuning/autotuner.py`` (experiment
+generation + scheduler + grid/random tuners, ``ds_config_optimal.json``
+output). The TPU redesign collapses the reference's multi-process experiment
+scheduler into two in-process phases:
+
+1. **static prune** — every candidate config is AOT-compiled against
+   abstract inputs (``jax.jit(...).lower(...).compile()``) and its
+   ``memory_analysis()`` is checked against the per-device HBM budget.
+   No step is executed; configs that cannot fit are rejected for free
+   (the reference must actually launch and OOM to learn this).
+2. **measure** — surviving candidates run a few timed steps through the
+   real engine; the tuner ranks them by the configured metric and writes
+   ``ds_config_optimal.json``.
+"""
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner, autotune
+from deepspeed_tpu.autotuning.config import AutotuningConfig
+
+__all__ = ["Autotuner", "AutotuningConfig", "autotune"]

@@ -1,0 +1,53 @@
+"""``"autotuning"`` config section.
+
+Reference parity: ``deepspeed/autotuning/config.py``
+(``DeepSpeedAutotuningConfig``) and ``constants.py`` — same key names where
+the concept carries over (enabled/fast/metric/tuner_type/num_trials/
+early-stopping/mbs bounds/results_dir), plus the TPU-native search axes
+(remat policies, loss-chunk sizes) the reference does not have.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.config.config_utils import ConfigModel
+
+AUTOTUNING = "autotuning"
+
+METRIC_THROUGHPUT = "throughput"
+METRIC_LATENCY = "latency"
+
+TUNER_GRIDSEARCH = "gridsearch"
+TUNER_RANDOM = "random"
+
+
+class AutotuningConfig(ConfigModel):
+    enabled: bool = False
+    fast: bool = True                      # fast mode: micro-batch only, fixed policies
+    metric: str = Field(METRIC_THROUGHPUT, pattern="^(throughput|latency)$")
+    tuner_type: str = Field(TUNER_GRIDSEARCH, pattern="^(gridsearch|random)$")
+    tuner_num_trials: int = Field(50, ge=1)
+    tuner_early_stopping: int = Field(5, ge=1)
+    results_dir: str = "autotuning_results"
+    overwrite: bool = True
+
+    # measurement window (reference start/end_profile_step)
+    start_profile_step: int = Field(2, ge=0)
+    end_profile_step: int = Field(6, ge=1)
+
+    # search-space bounds
+    min_train_micro_batch_size_per_gpu: int = Field(1, ge=1)
+    max_train_micro_batch_size_per_gpu: Optional[int] = None  # None = probe upward
+    zero_stages: List[int] = [1, 2, 3]
+    remat_policies: List[str] = ["none", "dots", "selective", "full"]
+    loss_chunks: List[int] = [0, 2048]
+
+    # per-device HBM budget for the static prune; None = ask the device,
+    # fall back to 16 GiB
+    hbm_budget_bytes: Optional[int] = None
+    # fraction of the budget usable by one step's live buffers (leaves room
+    # for fragmentation + runtime overheads)
+    hbm_fraction: float = Field(0.9, gt=0, le=1)

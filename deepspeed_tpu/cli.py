@@ -6,6 +6,7 @@ Subcommands mirror the reference's script family:
 - ``dscli report``                  — ``ds_report`` environment/op report
 - ``dscli bench``                   — ``ds_bench`` collective micro-benchmarks
 - ``dscli elastic <config>``        — ``ds_elastic`` elastic-config inspector
+- ``dscli autotune <config>``       — ``deepspeed --autotuning`` config search
 """
 
 from __future__ import annotations
@@ -50,7 +51,32 @@ def _elastic(argv):
         print(f"max train_batch:   {batch}")
 
 
-_COMMANDS = {"run": _run, "report": _report, "bench": _bench, "elastic": _elastic}
+def _autotune(argv):
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(
+        description="search zero stage x micro-batch x remat x loss-chunk "
+                    "(reference: deepspeed --autotuning)")
+    parser.add_argument("config", type=str, help="ds_config json path")
+    parser.add_argument("--model", type=str, default="gpt2:125m",
+                        help="model zoo preset, e.g. gpt2:125m, llama:tiny")
+    parser.add_argument("--seq-len", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    from deepspeed_tpu.autotuning import Autotuner
+    from deepspeed_tpu.models.presets import get_model
+
+    with open(args.config) as fd:
+        ds_config = json.load(fd)
+    name, _, size = args.model.partition(":")
+    model = get_model(name, *( [size] if size else [] ))
+    best = Autotuner(model, base_config=ds_config, seq_len=args.seq_len).tune()
+    print(json.dumps(best, indent=2))
+
+
+_COMMANDS = {"run": _run, "report": _report, "bench": _bench, "elastic": _elastic,
+             "autotune": _autotune}
 
 
 def main():

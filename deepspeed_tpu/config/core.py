@@ -243,7 +243,8 @@ class DeepSpeedConfig:
         self.sparse_attention = param_dict.get(C.SPARSE_ATTENTION, None)
 
         self.nebula_config = param_dict.get("nebula", {})
-        self.autotuning_config = param_dict.get("autotuning", {})
+        from deepspeed_tpu.autotuning.config import AutotuningConfig
+        self.autotuning_config = AutotuningConfig(**param_dict.get("autotuning", {}))
 
     # ------------------------------------------------------------------ #
     # Batch triad (reference runtime/config.py:853-907)

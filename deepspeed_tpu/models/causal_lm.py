@@ -21,7 +21,14 @@ class CausalLM:
         self.param_dtype = param_dtype
 
     def init_params(self, rng) -> Dict[str, Any]:
-        return T.init_params(self.config, rng, dtype=self.param_dtype)
+        from deepspeed_tpu.runtime import zero
+        ctx = zero.active_init()
+        init = lambda r: T.init_params(self.config, r, dtype=self.param_dtype)
+        if ctx is not None:
+            # inside `with zero.Init(...)`: materialise ZeRO-3-sharded, the
+            # full tree never exists on any single device/host
+            return ctx.materialize(init, rng, tp_specs=self.tp_specs())
+        return init(rng)
 
     def forward(self, params, tokens, attn_mask=None):
         return T.forward(self.config, params, tokens, attn_mask)

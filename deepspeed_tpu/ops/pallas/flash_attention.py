@@ -86,6 +86,33 @@ def _dispatch(run, i, j, plain, causal, update, logits, tri_ref, bias):
             update(logits() + bias())
 
 
+def _make_tri(bq, bk):
+    """Precomputed (bq, bk) diagonal-block causal bias: 0 keep / -1e30 drop."""
+    r = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(r >= c, 0.0, _MASKED).astype(jnp.float32)
+
+
+def _packed_dispatch(run, i, j, causal, step, logits, tri_ref, P):
+    """Packed-kernel analogue of :func:`_dispatch`: per packed head p, run
+    ``step(p, logits(p) [+ tri])`` with the diagonal tri only where needed."""
+    if causal:
+        @pl.when(jnp.logical_and(run, i == j))
+        def _():
+            for p in range(P):
+                step(p, logits(p) + tri_ref[:])
+
+        @pl.when(jnp.logical_and(run, i != j))
+        def _():
+            for p in range(P):
+                step(p, logits(p))
+    else:
+        @pl.when(run)
+        def _():
+            for p in range(P):
+                step(p, logits(p))
+
+
 def _parse_rest(rest, plain, has_layout):
     idx = 0
     tri_ref = None
@@ -228,6 +255,230 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, slope
     def _():
         dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+# --------------------------------------------------------------------- #
+# packed-heads layout (Hd < 128): q/k/v stay [B, S, H*Hd] — the natural
+# projection output layout — and each program covers P = 128//Hd heads, so
+# every VMEM block is a full 128-lane tile (no lane padding) and NO XLA-side
+# transpose is needed on inputs or outputs in either pass. Plain-causal
+# only; masked/alibi/sparse shapes use the general [B, H, S, Hd] kernels.
+
+def _packed_fwd_kernel(q_ref, k_ref, v_ref, tri_ref, o_ref, lse_ref,
+                       m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, P, Hd):
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+    i = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _MASKED)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = True if not causal else (j * bk <= i * bq + bq - 1)
+
+    def step(p, s):
+        sl = slice(p * Hd, (p + 1) * Hd)
+        m_prev = m_scr[:, p * Hd:p * Hd + 1]
+        l_prev = l_scr[:, p * Hd:p * Hd + 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp2(m_prev - m_new)
+        pmat = jnp.exp2(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(pmat, axis=1, keepdims=True)
+        acc_scr[:, sl] = acc_scr[:, sl] * alpha + jax.lax.dot_general(
+            pmat.astype(v_ref.dtype), v_ref[:, sl], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:, sl] = jnp.broadcast_to(m_new, (m_new.shape[0], Hd))
+        l_scr[:, sl] = jnp.broadcast_to(l_new, (l_new.shape[0], Hd))
+
+    def logits(p):
+        sl = slice(p * Hd, (p + 1) * Hd)
+        return jax.lax.dot_general(q_ref[:, sl], k_ref[:, sl], (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32) * (scale * _LOG2E)
+
+    _packed_dispatch(run, i, j, causal, step, logits, tri_ref, P)
+
+    @pl.when(j == nk - 1)
+    def _():
+        l = l_scr[:]
+        safe_l = jnp.where(l > 0, l, 1.0)
+        o_ref[:] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        for p in range(P):
+            c = p * Hd
+            lse_ref[p] = jnp.where(l[:, c] > 0, m_scr[:, c] + jnp.log2(safe_l[:, c]),
+                                   -_MASKED)
+
+
+def _packed_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, tri_ref,
+                      dq_ref, dq_scr, *, scale, causal, bq, bk, P, Hd):
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+    i = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = True if not causal else (j * bk <= i * bq + bq - 1)
+
+    def logits(p):
+        sl = slice(p * Hd, (p + 1) * Hd)
+        return jax.lax.dot_general(q_ref[:, sl], k_ref[:, sl], (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32) * (scale * _LOG2E)
+
+    def step(p, s):
+        sl = slice(p * Hd, (p + 1) * Hd)
+        pmat = jnp.exp2(s - lse_ref[p][:, None])
+        dp = jax.lax.dot_general(do_ref[:, sl], v_ref[:, sl], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (pmat * (dp - delta_ref[p][:, None]) * scale).astype(k_ref.dtype)
+        dq_scr[:, sl] = dq_scr[:, sl] + jax.lax.dot_general(
+            ds, k_ref[:, sl], (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    _packed_dispatch(run, i, j, causal, step, logits, tri_ref, P)
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _packed_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, tri_ref,
+                       dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, bq, bk, P, Hd):
+    # grid (B, H2, nk, nq): q blocks innermost
+    i = pl.program_id(3)
+    nq = pl.num_programs(3)
+    j = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = True if not causal else (j * bk <= i * bq + bq - 1)
+
+    def logits(p):
+        sl = slice(p * Hd, (p + 1) * Hd)
+        return jax.lax.dot_general(q_ref[:, sl], k_ref[:, sl], (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32) * (scale * _LOG2E)
+
+    def step(p, s):
+        sl = slice(p * Hd, (p + 1) * Hd)
+        pmat = jnp.exp2(s - lse_ref[p][:, None]).astype(do_ref.dtype)
+        dv_scr[:, sl] = dv_scr[:, sl] + jax.lax.dot_general(
+            pmat, do_ref[:, sl], (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do_ref[:, sl], v_ref[:, sl], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (pmat.astype(jnp.float32) * (dp - delta_ref[p][:, None]) * scale).astype(q_ref.dtype)
+        dk_scr[:, sl] = dk_scr[:, sl] + jax.lax.dot_general(
+            ds, q_ref[:, sl], (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    _packed_dispatch(run, i, j, causal, step, logits, tri_ref, P)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_packed(causal: bool, scale: float, bq: int, bk: int, interpret: bool,
+                  P: int, Hd: int):
+    """Custom-VJP flash on [B, S, H*Hd] inputs, P heads per program."""
+    lanes = P * Hd
+
+    def xq_spec():
+        # block (bq, P*Hd) over [B, S, D] at head-group h
+        return pl.BlockSpec((None, bq, lanes), lambda b, h, i, j: (b, i, h))
+
+    def xkv_spec():
+        return pl.BlockSpec((None, bk, lanes), lambda b, h, i, j: (b, j, h))
+
+    tri_spec = pl.BlockSpec((bq, bk), lambda b, h, i, j: (0, 0))
+    row_spec = pl.BlockSpec((None, None, P, bq), lambda b, h, i, j: (b, h, 0, i))
+
+    def fwd_call(q, k, v, tri):
+        B, Sp, D = q.shape
+        H2 = D // lanes
+        nq, nk = Sp // bq, Sp // bk
+        kernel = functools.partial(_packed_fwd_kernel, scale=scale, causal=causal,
+                                   bq=bq, bk=bk, P=P, Hd=Hd)
+        o, lse = pl.pallas_call(
+            kernel,
+            grid=(B, H2, nq, nk),
+            in_specs=[xq_spec(), xkv_spec(), xkv_spec(), tri_spec],
+            out_specs=[xq_spec(), row_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, Sp, D), q.dtype),
+                jax.ShapeDtypeStruct((B, H2, P, Sp), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, lanes), jnp.float32),
+                pltpu.VMEM((bq, lanes), jnp.float32),
+                pltpu.VMEM((bq, lanes), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v, tri)
+        return checkpoint_name(o, "flash_o"), checkpoint_name(lse, "flash_lse")
+
+    @jax.custom_vjp
+    def flash(q, k, v, tri):
+        return fwd_call(q, k, v, tri)[0]
+
+    def flash_fwd(q, k, v, tri):
+        o, lse = fwd_call(q, k, v, tri)
+        return o, (q, k, v, tri, o, lse)
+
+    def flash_bwd(res, g):
+        q, k, v, tri, o, lse = res
+        B, Sp, D = q.shape
+        H2 = D // lanes
+        nq, nk = Sp // bq, Sp // bk
+        # per-head delta rows: sum g*o over each head's lane group
+        delta = (g.astype(jnp.float32) * o.astype(jnp.float32)).reshape(
+            B, Sp, H2, P, Hd).sum(-1).transpose(0, 2, 3, 1)  # [B, H2, P, Sp]
+
+        dq_kernel = functools.partial(_packed_dq_kernel, scale=scale, causal=causal,
+                                      bq=bq, bk=bk, P=P, Hd=Hd)
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid=(B, H2, nq, nk),
+            in_specs=[xq_spec(), xkv_spec(), xkv_spec(), xq_spec(),
+                      row_spec, row_spec, tri_spec],
+            out_specs=xq_spec(),
+            out_shape=jax.ShapeDtypeStruct((B, Sp, D), q.dtype),
+            scratch_shapes=[pltpu.VMEM((bq, lanes), jnp.float32)],
+            interpret=interpret,
+        )(q, k, v, g, lse, delta, tri)
+
+        kq_spec = pl.BlockSpec((None, bq, lanes), lambda b, h, j, i: (b, i, h))
+        kkv_spec = pl.BlockSpec((None, bk, lanes), lambda b, h, j, i: (b, j, h))
+        krow_spec = pl.BlockSpec((None, None, P, bq), lambda b, h, j, i: (b, h, 0, i))
+        ktri_spec = pl.BlockSpec((bq, bk), lambda b, h, j, i: (0, 0))
+
+        dkv_kernel = functools.partial(_packed_dkv_kernel, scale=scale, causal=causal,
+                                       bq=bq, bk=bk, P=P, Hd=Hd)
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            grid=(B, H2, nk, nq),
+            in_specs=[kq_spec, kkv_spec, kkv_spec, kq_spec, krow_spec, krow_spec,
+                      ktri_spec],
+            out_specs=[kkv_spec, kkv_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, Sp, D), q.dtype),
+                jax.ShapeDtypeStruct((B, Sp, D), q.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, lanes), jnp.float32),
+                pltpu.VMEM((bk, lanes), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v, g, lse, delta, tri)
+
+        return dq, dk, dv, jnp.zeros_like(tri)
+
+    flash.defvjp(flash_fwd, flash_bwd)
+    return flash
 
 
 def _q_spec(bq, Hd):
@@ -425,6 +676,17 @@ def flash_attention(q, k, v, mask_bias=None, causal: bool = True, alibi_slopes=N
     plain = (mask_bias is None and alibi_slopes is None and block_layout is None
              and Sp == S and (not causal or bq == bk))
 
+    # packed-heads fastest path: small head_dim packs P heads into one full
+    # 128-lane tile and q/k/v stay in their natural [B, S, H*Hd] layout —
+    # no transposes, no lane padding, P× fewer programs
+    if plain and Hd < 128 and 128 % Hd == 0 and H % (128 // Hd) == 0:
+        P128 = 128 // Hd
+        fn = _build_packed(causal, scale, bq, bk, interpret, P128, Hd)
+        tri = _make_tri(bq, bk)
+        out = fn(q.reshape(B, S, H * Hd), k.reshape(B, S, H * Hd),
+                 v.reshape(B, S, H * Hd), tri)
+        return out.reshape(B, S, H, Hd)
+
     def pad_s(x, axis):
         if Sp == S:
             return x
@@ -444,10 +706,7 @@ def flash_attention(q, k, v, mask_bias=None, causal: bool = True, alibi_slopes=N
 
     extra = ()
     if plain:
-        r = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        c = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        tri = jnp.where(r >= c, 0.0, _MASKED).astype(jnp.float32)
-        extra = (tri,)
+        extra = (_make_tri(bq, bk),)
     if block_layout is not None:
         nq, nk = Sp // bq, Sp // bk
         layout = jnp.asarray(block_layout, jnp.float32)

@@ -224,7 +224,19 @@ class DeepSpeedEngine:
 
         # ---- state ----
         if model_parameters is None and hasattr(model, "init_params"):
-            model_parameters = model.init_params(jax.random.key(0))
+            # key(0): decorrelated from the training rng stream (key(DS_SEED))
+            # and unchanged vs earlier releases
+            seed_key = jax.random.key(0)
+            if self.zero_optimization_stage() >= 3:
+                # zero.Init-equivalent abstract construction (reference
+                # partition_parameters.py:516): params materialise directly
+                # into their ZeRO-3 shards — the full tree never exists in
+                # one memory, so > single-device-memory models construct
+                from deepspeed_tpu.runtime.zero import Init
+                with Init(mesh=self.mesh, config=self._config.zero_config):
+                    model_parameters = model.init_params(seed_key)
+            else:
+                model_parameters = model.init_params(seed_key)
         if model_parameters is None:
             raise ValueError("model_parameters is required (or model must expose init_params(rng))")
         self.state = self._init_state(model_parameters)
@@ -306,8 +318,15 @@ class DeepSpeedEngine:
                                      opt_state, opt_sh)
         else:
             opt_state = ()
-        acc_grads = jax.tree.map(
-            lambda a, s: jax.device_put(jnp.zeros(a.shape, self.grad_acc_dtype), s), model_parameters, grad_sh)
+        if self.gradient_accumulation_steps() == 1 and self._offload is None:
+            # the gas==1 fused step feeds grads straight into the update —
+            # no accumulation buffers; the forward/backward/step trio
+            # lazily allocates them on first use (_ensure_acc_grads)
+            acc_grads = ()
+        else:
+            acc_grads = jax.tree.map(
+                lambda a, s: jax.device_put(jnp.zeros(a.shape, self.grad_acc_dtype), s),
+                model_parameters, grad_sh)
 
         if self.fp16_enabled() and self._config.fp16_config.dynamic_loss_scale:
             args = self._config.dynamic_loss_scale_args
@@ -662,6 +681,7 @@ class DeepSpeedEngine:
                 self._losses, self._cached_grads = self._grad_jit(self.state, batch, rng)
         if getattr(self, "_cached_grads", None) is None:
             raise RuntimeError("backward() called before forward(); pass batch= explicitly if needed")
+        self._ensure_acc_grads()
 
         if self._acc_jit is None:
             def acc_fn(state: TrainState, grads):
@@ -672,6 +692,15 @@ class DeepSpeedEngine:
         self.state = self._acc_jit(self.state, self._cached_grads)
         self._cached_grads = None
         return self._losses
+
+    def _ensure_acc_grads(self) -> None:
+        """Materialize the accumulation buffers the gas==1 fused path skips
+        (only the forward/backward/step trio needs them)."""
+        if self.state.acc_grads == ():
+            acc = jax.tree.map(
+                lambda p, s: jax.device_put(jnp.zeros(p.shape, self.grad_acc_dtype), s),
+                self.state.params, self._grad_shardings)
+            self.state = self.state._replace(acc_grads=acc)
 
     def is_gradient_accumulation_boundary(self) -> bool:
         return int(self.state.micro_steps) % self.gradient_accumulation_steps() == 0
@@ -751,6 +780,8 @@ class DeepSpeedEngine:
         return [float(self._lr_fn(self.state.global_steps))]
 
     def get_global_grad_norm(self) -> float:
+        if self.state.acc_grads == ():  # gas==1 fused path keeps no buffers
+            return 0.0
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), self.state.acc_grads)
         return float(global_norm(grads))
 

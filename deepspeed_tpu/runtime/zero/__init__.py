@@ -1,0 +1,152 @@
+"""ZeRO public API: ``Init`` construct-time partitioning and
+``GatheredParameters``.
+
+Reference parity: ``deepspeed/runtime/zero/partition_parameters.py`` —
+``zero.Init`` (:516, modules constructed inside the context allocate
+already-partitioned parameters, so a model larger than one device's memory
+can be built) and ``GatheredParameters`` (:1382, momentarily gather a
+partitioned parameter for user code, re-partition on exit).
+
+TPU redesign: the reference intercepts ``nn.Module.__init__`` and slices
+each tensor as it is created. Here parameter construction is a *function*
+(``init_params(rng)``), so zero.Init compiles that function with sharded
+output layouts — ``jax.eval_shape`` first (no memory), then
+``jax.jit(init_fn, out_shardings=zero3_shardings)`` so XLA materialises each
+shard directly on its own device. The full parameter tree never exists in
+any single memory; per-host cost is 1/N of the model. The model zoo's
+``init_params`` routes through the active ``Init`` context automatically,
+matching the reference's construct-inside-the-context UX.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.runtime.zero.config import ZeroConfig
+from deepspeed_tpu.runtime.zero.partition import ZeroShardingRules
+
+_local = threading.local()
+
+
+def active_init() -> Optional["Init"]:
+    """The innermost enabled ``zero.Init`` context, or None."""
+    stack = getattr(_local, "init_stack", None)
+    return stack[-1] if stack else None
+
+
+def materialize_sharded(init_fn: Callable, rng, shardings) -> Any:
+    """Run ``init_fn(rng)`` with each output leaf materialised directly into
+    its shard layout (no full-tree staging anywhere)."""
+    return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+class Init:
+    """Construct-time ZeRO-3 parameter partitioning context.
+
+    Usage (mirrors reference ``zero.Init``)::
+
+        with deepspeed_tpu.zero.Init(mesh=mesh):
+            params = model.init_params(rng)     # arrives ZeRO-3 sharded
+
+    or explicitly: ``params = Init(mesh=mesh).materialize(model.init_params,
+    rng, tp_specs=model.tp_specs())``.
+    """
+
+    def __init__(self, mesh=None, config: Optional[Any] = None, enabled: bool = True,
+                 dtype=None, tp_specs=None):
+        import deepspeed_tpu.comm as dist
+        self.enabled = enabled
+        self.mesh = mesh if mesh is not None else (dist.get_mesh() if dist.has_mesh() else None)
+        if self.mesh is None:
+            raise ValueError("zero.Init needs a device mesh (pass mesh= or dist.init_mesh first)")
+        if config is None:
+            zcfg = ZeroConfig(stage=3)
+        elif isinstance(config, ZeroConfig):
+            zcfg = config
+        else:
+            zcfg = ZeroConfig(**(config.get("zero_optimization", config) if isinstance(config, dict) else {}))
+        if zcfg.stage < 3:
+            zcfg = zcfg.model_copy(update={"stage": 3})
+        self.rules = ZeroShardingRules(self.mesh, zcfg)
+        self.dtype = dtype
+        self.tp_specs = tp_specs
+
+    # -- context management (construct-inside-the-context UX) --
+
+    def __enter__(self):
+        if self.enabled:
+            stack = getattr(_local, "init_stack", None)
+            if stack is None:
+                stack = _local.init_stack = []
+            stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        if self.enabled:
+            _local.init_stack.pop()
+        return False
+
+    # -- materialization --
+
+    def shardings(self, shapes, tp_specs=None):
+        """NamedSharding tree (ZeRO-3 param specs) for a shape/array tree."""
+        tp = tp_specs if tp_specs is not None else self.tp_specs
+        if tp is not None:
+            specs = jax.tree.map(lambda a, s: self.rules.param_spec(a, s), shapes, tp)
+        else:
+            specs = jax.tree.map(lambda a: self.rules.param_spec(a, None), shapes)
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs)
+
+    def materialize(self, init_fn: Callable, rng, tp_specs=None):
+        """``init_fn(rng)`` -> ZeRO-3-sharded parameter tree, one shard per
+        device, never staging the full tree."""
+        fn = init_fn
+        if self.dtype is not None:
+            fn = lambda r: jax.tree.map(lambda a: a.astype(self.dtype), init_fn(r))
+        shapes = jax.eval_shape(fn, rng)
+        return materialize_sharded(fn, rng, self.shardings(shapes, tp_specs))
+
+
+class GatheredParameters:
+    """Momentarily gather partitioned parameters for user code (reference
+    ``partition_parameters.py:1382``).
+
+    JAX arrays are immutable, so the context yields a *mutable host copy*
+    (numpy leaves). On exit the (possibly modified) values are re-partitioned
+    to the original shardings and exposed as ``.params``::
+
+        gp = GatheredParameters(params)
+        with gp as full:
+            full["embed"]["tokens"][0] = 0.0     # numpy, mutable
+        params = gp.params                        # re-sharded
+
+    With ``modifier_rank=None`` semantics of the reference (read-only use),
+    simply ignore ``.params``.
+    """
+
+    def __init__(self, params, shardings=None):
+        self.params = params
+        self._shardings = shardings or jax.tree.map(lambda a: a.sharding, params)
+        self._gathered = None
+
+    def __enter__(self):
+        import numpy as np
+        self._gathered = jax.tree.map(lambda a: np.array(a), jax.device_get(self.params))
+        return self._gathered
+
+    def __exit__(self, exc_type, *exc):
+        if exc_type is None:
+            self.params = jax.tree.map(
+                lambda h, s: jax.device_put(jnp.asarray(h), s),
+                self._gathered, self._shardings)
+        self._gathered = None
+        return False
+
+
+__all__ = ["Init", "GatheredParameters", "ZeroConfig", "ZeroShardingRules",
+           "active_init", "materialize_sharded"]

@@ -151,24 +151,36 @@ class ZeroShardingRules:
                             self._tree_specs(params, self.grad_spec, tp_specs))
 
     def opt_state_shardings(self, opt_state, params, tp_specs=None):
-        """Optimizer-state sharding: any state leaf with the same shape as a
-        parameter gets that parameter's master sharding; scalars replicate.
+        """Optimizer-state sharding: any subtree of the state congruent with
+        the parameter tree (optax moments like Adam's mu/nu) gets the master
+        shardings mapped param-wise BY TREE PATH — two same-shape params with
+        different TP specs keep their own specs. Everything else (counts,
+        scalars, non-congruent leaves) replicates."""
+        master = jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                              self._tree_specs(params, self.master_spec, tp_specs))
+        rep = NamedSharding(self.mesh, P())
+        pdef = jax.tree.structure(params)
+        if pdef.num_leaves <= 1 and jax.tree.structure(0) == pdef:
+            # params is a single bare array: structure matching is vacuous,
+            # fall back to shape matching
+            p = jax.tree.leaves(params)[0]
+            m = jax.tree.leaves(master)[0]
+            return jax.tree.map(
+                lambda leaf: m if getattr(leaf, "shape", None) == p.shape else rep,
+                opt_state)
 
-        Works for optax-style states where moments mirror the param tree."""
-        master = self._tree_specs(params, self.master_spec, tp_specs)
-        flat_master = {a.shape: s for a, s in
-                       zip(jax.tree.leaves(params), jax.tree.leaves(master))}
+        def is_param_tree(x):
+            try:
+                return jax.tree.structure(x) == pdef
+            except Exception:  # pragma: no cover - defensive
+                return False
 
-        def leaf_spec(leaf):
-            if hasattr(leaf, "shape") and leaf.shape in flat_master:
-                return NamedSharding(self.mesh, flat_master[leaf.shape])
-            return NamedSharding(self.mesh, P())
+        def map_node(node):
+            if is_param_tree(node):
+                return master
+            return rep  # plain leaf: count scalars etc.
 
-        # moments are pytrees congruent with params: map param-wise when shapes match
-        def state_leaf(leaf):
-            return leaf_spec(leaf)
-
-        return jax.tree.map(state_leaf, opt_state)
+        return jax.tree.map(map_node, opt_state, is_leaf=is_param_tree)
 
     def describe(self) -> str:
         return (f"ZeRO stage {self.stage} over axes {self.axes} (size {self.axis_size}); "
