@@ -36,7 +36,12 @@ class InferenceEngine:
     def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None, params=None):
         self.module = model
         self._config = config or DeepSpeedInferenceConfig()
-        self.dtype = self._config.dtype.jnp if hasattr(self._config.dtype, "jnp") else jnp.bfloat16
+        # int8 = weight-only quantisation (reference GroupQuantizer,
+        # module_inject/replace_module.py:135): activations run bf16, weight
+        # matrices are stored int8 + per-group scales (see ops/quant.py)
+        self._weight_quant = str(getattr(self._config.dtype, "value", self._config.dtype)) == "int8"
+        self.dtype = (jnp.bfloat16 if self._weight_quant else
+                      self._config.dtype.jnp if hasattr(self._config.dtype, "jnp") else jnp.bfloat16)
 
         tp_size = self._config.tensor_parallel.tp_size
         if not dist.has_mesh():
@@ -44,10 +49,30 @@ class InferenceEngine:
             dist.init_mesh(axes)
         self.mesh = dist.get_mesh()
 
+        # checkpoint loading (reference inference/engine.py:354-419
+        # _load_checkpoint): an HF checkpoint dir/file (or a model given as a
+        # path string) loads through the per-architecture policies
+        ckpt = self._config.checkpoint
+        if isinstance(model, str) and ckpt is None:
+            ckpt, model = model, None
+        if params is None and isinstance(ckpt, str):
+            from deepspeed_tpu.module_inject import load_hf_checkpoint
+            loaded_model, params = load_hf_checkpoint(ckpt)
+            if model is None:
+                model = loaded_model
+            self.module = model = model if not isinstance(model, str) else loaded_model
+            log_dist(f"InferenceEngine: loaded HF checkpoint {ckpt} "
+                     f"({loaded_model.num_parameters / 1e6:.1f}M params)", ranks=[0])
+        elif params is None and isinstance(ckpt, dict):
+            raise NotImplementedError(
+                "ds_inference meta-json checkpoints need a Megatron layout policy; "
+                "pass an HF checkpoint directory or explicit params instead")
+
         if params is None and hasattr(model, "init_params"):
             params = model.init_params(jax.random.key(0))
         if params is None:
-            raise ValueError("InferenceEngine needs params (or a model with init_params)")
+            raise ValueError("InferenceEngine needs params (or a model with init_params, "
+                             "or config.checkpoint pointing at an HF checkpoint)")
 
         tp_specs = None
         if hasattr(model, "tp_specs"):
@@ -56,14 +81,41 @@ class InferenceEngine:
             from deepspeed_tpu.inference.auto_tp import auto_tp_specs
             tp_specs = auto_tp_specs(params)
 
+        if self._weight_quant:
+            if tp_size > 1:
+                raise NotImplementedError(
+                    "int8 weight-only inference with tensor_parallel.tp_size > 1 is "
+                    "not implemented yet; use bf16/fp16 for TP or tp_size=1 for int8")
+            from deepspeed_tpu.ops.quant import quantize_params, tree_nbytes
+            groups = max(1, int(self._config.quant.weight.q_groups))
+            dense_bytes = sum(a.size * 2 for a in jax.tree.leaves(params))
+            params = quantize_params(params, groups=groups,
+                                     include_embed=not getattr(getattr(model, "config", None),
+                                                               "tie_embeddings", True))
+            log_dist(f"int8 weight-only quantisation: q_groups={groups}, "
+                     f"{dense_bytes / 2**20:.0f} MiB (bf16) -> "
+                     f"{tree_nbytes(params) / 2**20:.0f} MiB at rest", ranks=[0])
+
         from jax.sharding import NamedSharding, PartitionSpec as P
-        if tp_specs is not None:
+        if tp_specs is not None and not self._weight_quant:
             from deepspeed_tpu.runtime.zero.partition import ZeroShardingRules
             rules = ZeroShardingRules(self.mesh)  # stage 0: replicate except TP dims
             shardings = rules.param_shardings(params, tp_specs)
         else:
             shardings = jax.tree.map(lambda _: NamedSharding(self.mesh, P()), params)
-        self.params = jax.tree.map(lambda a, s: jax.device_put(jnp.asarray(a, self.dtype), s), params, shardings)
+
+        from jax.tree_util import GetAttrKey, tree_map_with_path
+
+        def put(path, a, s):
+            a = jnp.asarray(a)
+            # int8 payloads stay int8; Quantized8.scale leaves (reached via a
+            # dataclass attr, unlike dict-keyed layernorm "scale") stay f32
+            is_qscale = any(isinstance(k, GetAttrKey) and k.name == "scale" for k in path)
+            if is_qscale or not jnp.issubdtype(a.dtype, jnp.floating):
+                return jax.device_put(a, s)
+            return jax.device_put(a.astype(self.dtype), s)
+
+        self.params = tree_map_with_path(put, params, shardings)
 
         self._fwd_jit = None
         self._prefill_jit = None
@@ -132,53 +184,104 @@ class InferenceEngine:
     # recompilation (reference workspace/KV design: inference_context.h:49,
     # softmax_context pt_binding.cpp:1668-1793)
 
-    def _generate_cached(self, input_ids, max_new, temperature, top_k, rng, eos_token_id):
+    def _kv_workspace(self, B: int, need_len: int):
+        """Persistent KV workspace (reference ``inference_context.h:49``:
+        one workspace allocated once and reused across calls). Keyed by
+        batch size; grows monotonically in length; reuse is safe because
+        the causal mask hides slots beyond the current position."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        B, prompt_len = input_ids.shape
-        max_len = prompt_len + max_new
-        cache = self.module.init_cache(B, max_len, dtype=self.dtype)
-        # KV heads ride the tp axis like the attention weights that feed them
+        ws = getattr(self, "_workspace", None)
+        if ws is not None and ws[0] == B and ws[1] >= need_len:
+            leaves = jax.tree.leaves(ws[2])
+            if not any(getattr(a, "is_deleted", lambda: False)() for a in leaves):
+                return ws[2], ws[1]
+        cfg = self.module.config
+        Smax = min(cfg.max_seq, max(need_len, int(self._config.max_out_tokens)))
+        cache = self.module.init_cache(B, Smax, dtype=self.dtype)
         kv_spec = (P(None, None, None, "tp", None)
                    if self.mesh.shape.get("tp", 1) > 1 else P())
         cache = jax.tree.map(
             lambda a: jax.device_put(a, NamedSharding(self.mesh, kv_spec)), cache)
+        self._workspace = (B, Smax, cache)
+        return cache, Smax
 
-        if self._prefill_jit is None:
-            def prefill(params, toks, cache):
+    @staticmethod
+    def _bucket(n: int, cap: int) -> int:
+        """Pad prompt lengths up to multiples of 128 (one compile per bucket,
+        MXU-aligned), clamped to the model's max."""
+        return min(-(-max(n, 1) // 128) * 128, cap)
+
+    def _generate_cached(self, input_ids, max_new, temperature, top_k, rng, eos_token_id):
+        B, prompt_len = input_ids.shape
+        cfg = self.module.config
+        cache, Smax = self._kv_workspace(B, min(cfg.max_seq, prompt_len + max_new))
+        bucket = self._bucket(prompt_len, Smax)
+
+        if self._decode_jit is None:
+            def prefill(params, toks, cache, last_idx):
+                # toks are RIGHT-padded to the bucket; junk cache slots are
+                # overwritten by decode or masked by causality
                 logits, cache = self.module.forward_cached(params, toks, cache, jnp.int32(0))
-                return logits[:, -1, :].astype(jnp.float32), cache
+                return logits[:, last_idx, :].astype(jnp.float32), cache
 
-            def decode(params, tok, cache, pos, rng, temperature, top_k):
-                logits, cache = self.module.forward_cached(params, tok, cache, pos)
-                logits = logits[:, -1, :].astype(jnp.float32)
-                nxt = jax.lax.cond(
+            def sample(logits, rng, temperature, top_k):
+                return jax.lax.cond(
                     temperature > 0.0,
                     lambda: self._sample_jit(logits, temperature, top_k, rng),
                     lambda: jnp.argmax(logits, axis=-1))
-                return nxt, cache
+
+            def decode_loop(params, cache, first, pos0, max_new, rng, temperature,
+                            top_k, eos):
+                """Whole decode loop on device: one host transfer per call,
+                early exit when every row has emitted eos (eos < 0 = never)."""
+                Bd = first.shape[0]
+                cap = cache["k"].shape[2]  # [L, B, Smax, ...]
+                out0 = jnp.zeros((Bd, cap), jnp.int32)
+                out0 = out0.at[:, 0].set(first)
+                done0 = (first == eos) & (eos >= 0)
+
+                def cond(st):
+                    step, _, _, _, done, _ = st
+                    return (step < max_new) & ~jnp.all(done)
+
+                def body(st):
+                    step, tok, pos, r, done, (cache, out) = st
+                    logits, cache = self.module.forward_cached(
+                        params, tok[:, None].astype(jnp.int32), cache, pos)
+                    r, sub = jax.random.split(r)
+                    nxt = sample(logits[:, -1, :].astype(jnp.float32), sub,
+                                 temperature, top_k)
+                    # rows already done keep emitting eos (stable output)
+                    nxt = jnp.where(done & (eos >= 0), eos, nxt)
+                    out = jax.lax.dynamic_update_slice(out, nxt[:, None].astype(jnp.int32),
+                                                       (0, step))
+                    done = done | ((nxt == eos) & (eos >= 0))
+                    return step + 1, nxt, pos + 1, r, done, (cache, out)
+
+                st = (jnp.int32(1), first, pos0, rng, done0, (cache, out0))
+                step, _, _, _, _, (cache, out) = jax.lax.while_loop(cond, body, st)
+                return out, step, cache
 
             self._prefill_jit = jax.jit(prefill, donate_argnums=(2,))
-            self._decode_jit = jax.jit(decode, donate_argnums=(2,))
+            self._decode_jit = jax.jit(decode_loop, donate_argnums=(1,))
 
-        logits0, cache = self._prefill_jit(self.params, input_ids, cache)
+        pad = bucket - prompt_len
+        toks = jnp.pad(input_ids, ((0, 0), (0, pad))) if pad else input_ids
+        logits0, cache = self._prefill_jit(self.params, toks, cache,
+                                           jnp.int32(prompt_len - 1))
         rng, sub = jax.random.split(rng)
-        nxt = self._sample_host(logits0, temperature, top_k, sub)
+        first = jnp.asarray(self._sample_host(logits0, temperature, top_k, sub))
 
-        out = [nxt]
-        pos = prompt_len
-        t = jnp.float32(temperature)
-        k = jnp.int32(top_k)
-        for _ in range(max_new - 1):
-            if eos_token_id is not None and bool((nxt == eos_token_id).all()):
-                break
-            rng, sub = jax.random.split(rng)
-            nxt, cache = self._decode_jit(self.params, nxt[:, None].astype(jnp.int32),
-                                          cache, jnp.int32(pos), sub, t, k)
-            out.append(nxt)
-            pos += 1
-        gen = jnp.stack(out, axis=1).astype(jnp.int32)
-        return jnp.concatenate([input_ids, gen], axis=1)
+        eos = jnp.int32(-1 if eos_token_id is None else eos_token_id)
+        out, n, cache = self._decode_jit(self.params, cache, first,
+                                         jnp.int32(prompt_len), jnp.int32(max_new),
+                                         rng, jnp.float32(temperature),
+                                         jnp.int32(top_k), eos)
+        self._workspace = (B, Smax, cache)  # keep the donated-through workspace
+        n = int(n)
+        gen = np.asarray(out)[:, :n]
+        return jnp.concatenate([input_ids, jnp.asarray(gen, jnp.int32)], axis=1)
 
     @staticmethod
     def _sample_jit(logits, temperature, top_k, rng):

@@ -56,12 +56,16 @@ class CausalLM:
         cfg = self.config
         embed = cfg.vocab_size * cfg.d_model + (cfg.max_seq * cfg.d_model if cfg.pos_embedding == "learned" else 0)
         attn = cfg.d_model * cfg.head_dim * (cfg.n_head + 2 * cfg.kv_heads) + cfg.n_head * cfg.head_dim * cfg.d_model
+        if cfg.attn_bias:
+            attn += cfg.head_dim * (cfg.n_head + 2 * cfg.kv_heads) + cfg.d_model
         if cfg.activation == "swiglu":
             mlp = 3 * cfg.d_model * cfg.ff_dim
         else:
             mlp = 2 * cfg.d_model * cfg.ff_dim + cfg.ff_dim + cfg.d_model
         norms = (4 if cfg.norm == "layernorm" else 2) * cfg.d_model
         final_norm = (2 if cfg.norm == "layernorm" else 1) * cfg.d_model
+        if cfg.embed_layernorm:
+            final_norm += (2 if cfg.norm == "layernorm" else 1) * cfg.d_model
         head = 0 if cfg.tie_embeddings else cfg.d_model * cfg.vocab_size
         return embed + cfg.n_layer * (attn + mlp + norms) + final_norm + head
 

@@ -24,7 +24,7 @@ def gpt2(size: str = "125m", **over) -> CausalLM:
         "1.5b": dict(n_layer=48, n_head=25, d_model=1600),
     }[size]
     cfg = TransformerConfig(vocab_size=50257, max_seq=1024, pos_embedding="learned", norm="layernorm",
-                            activation="gelu", tie_embeddings=True, **dims, **over)
+                            activation="gelu", tie_embeddings=True, attn_bias=True, **dims, **over)
     return CausalLM(cfg)
 
 
@@ -67,7 +67,8 @@ def bloom(size: str = "560m", **over) -> CausalLM:
         "176b": dict(n_layer=70, n_head=112, d_model=14336),
     }[size]
     cfg = TransformerConfig(vocab_size=250880, max_seq=2048, pos_embedding="alibi", norm="layernorm",
-                            activation="gelu", tie_embeddings=True, **dims, **over)
+                            activation="gelu", tie_embeddings=True, embed_layernorm=True,
+                            attn_bias=True, **dims, **over)
     return CausalLM(cfg)
 
 
@@ -81,7 +82,7 @@ def opt(size: str = "125m", **over) -> CausalLM:
         "66b": dict(n_layer=64, n_head=72, d_model=9216),
     }[size]
     cfg = TransformerConfig(vocab_size=50272, max_seq=2048, pos_embedding="learned", norm="layernorm",
-                            activation="relu", tie_embeddings=True, **dims, **over)
+                            activation="relu", tie_embeddings=True, attn_bias=True, **dims, **over)
     return CausalLM(cfg)
 
 
@@ -91,7 +92,8 @@ def gpt_neox(size: str = "20b", **over) -> CausalLM:
         "20b": dict(n_layer=44, n_head=64, d_model=6144),
     }[size]
     cfg = TransformerConfig(vocab_size=50432, max_seq=2048, pos_embedding="rope", norm="layernorm",
-                            activation="gelu", parallel_residual=True, tie_embeddings=False, **dims, **over)
+                            activation="gelu", parallel_residual=True, tie_embeddings=False,
+                            attn_bias=True, **dims, **over)
     return CausalLM(cfg)
 
 

@@ -43,6 +43,8 @@ class TransformerConfig:
     parallel_residual: bool = False      # gpt-neox style
     causal: bool = True
     tie_embeddings: bool = True
+    embed_layernorm: bool = False        # BLOOM word_embeddings_layernorm
+    attn_bias: bool = False              # qkv/out biases (gpt2/opt/bloom/neox)
     # numerics
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
@@ -115,6 +117,10 @@ def init_params(cfg: TransformerConfig, rng, dtype=jnp.float32) -> Dict[str, Any
                 "wk": dense(ks[1], (L, D, KV * Hd)),
                 "wv": dense(ks[2], (L, D, KV * Hd)),
                 "wo": dense(ks[3], (L, H * Hd, D), out_std),
+                **({"bq": jnp.zeros((L, H * Hd), dtype),
+                    "bk": jnp.zeros((L, KV * Hd), dtype),
+                    "bv": jnp.zeros((L, KV * Hd), dtype),
+                    "bo": jnp.zeros((L, D), dtype)} if cfg.attn_bias else {}),
             },
             "ln_mlp": norm_params(),
             "mlp": ({
@@ -133,6 +139,9 @@ def init_params(cfg: TransformerConfig, rng, dtype=jnp.float32) -> Dict[str, Any
     }
     if cfg.pos_embedding == "learned":
         params["embed"]["positions"] = dense(k_pos, (cfg.max_seq, D))
+    if cfg.embed_layernorm:
+        params["embed"]["ln"] = ({"scale": jnp.ones((D,), dtype), "bias": jnp.zeros((D,), dtype)}
+                                 if cfg.norm == "layernorm" else {"scale": jnp.ones((D,), dtype)})
     if not cfg.tie_embeddings:
         params["lm_head"] = dense(k_head, (D, cfg.vocab_size))
     return params
@@ -152,6 +161,8 @@ def tp_specs(cfg: TransformerConfig) -> Dict[str, Any]:
                 "wk": P(None, None, "tp"),
                 "wv": P(None, None, "tp"),
                 "wo": P(None, "tp", None),
+                **({"bq": P(None, "tp"), "bk": P(None, "tp"),
+                    "bv": P(None, "tp"), "bo": P(None, None)} if cfg.attn_bias else {}),
             },
             "ln_mlp": ln,
             "mlp": ({
@@ -169,6 +180,9 @@ def tp_specs(cfg: TransformerConfig) -> Dict[str, Any]:
     }
     if cfg.pos_embedding == "learned":
         specs["embed"]["positions"] = P(None, None)
+    if cfg.embed_layernorm:
+        specs["embed"]["ln"] = ({"scale": P(None), "bias": P(None)}
+                                if cfg.norm == "layernorm" else {"scale": P(None)})
     if not cfg.tie_embeddings:
         specs["lm_head"] = P(None, "tp")
     return specs
@@ -176,6 +190,14 @@ def tp_specs(cfg: TransformerConfig) -> Dict[str, Any]:
 
 # --------------------------------------------------------------------- #
 # forward
+
+
+def _w(entry, like):
+    """Weight access: transparently dequantises int8 ``Quantized8`` leaves
+    (weight-only inference quantisation) to ``like``'s dtype."""
+    from deepspeed_tpu.ops.quant import maybe_dequant
+    return maybe_dequant(entry, like.dtype)
+
 
 def _norm(cfg: TransformerConfig, x, p):
     x32 = x.astype(jnp.float32)
@@ -226,9 +248,12 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
     H, KV, Hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
 
     from jax.ad_checkpoint import checkpoint_name
-    q = checkpoint_name((x @ lp["wq"]).reshape(B, S, H, Hd), "q_proj")
-    k = checkpoint_name((x @ lp["wk"]).reshape(B, S, KV, Hd), "k_proj")
-    v = checkpoint_name((x @ lp["wv"]).reshape(B, S, KV, Hd), "v_proj")
+    bq = lp.get("bq", 0) if cfg.attn_bias else 0
+    bk = lp.get("bk", 0) if cfg.attn_bias else 0
+    bv = lp.get("bv", 0) if cfg.attn_bias else 0
+    q = checkpoint_name((x @ _w(lp["wq"], x) + bq).reshape(B, S, H, Hd), "q_proj")
+    k = checkpoint_name((x @ _w(lp["wk"], x) + bk).reshape(B, S, KV, Hd), "k_proj")
+    v = checkpoint_name((x @ _w(lp["wv"], x) + bv).reshape(B, S, KV, Hd), "v_proj")
 
     if cfg.pos_embedding == "rope":
         q = _rope(q, positions, cfg.rope_theta)
@@ -261,7 +286,8 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
                             mask_bias=None if mask_bias is None else mask_bias[:, None, None, :],
                             causal=cfg.causal, alibi_slopes=slopes)
     out = checkpoint_name(out.reshape(B, S, H * Hd), "attn_out")
-    return checkpoint_name(out @ lp["wo"], "wo_out")
+    proj = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
+    return checkpoint_name(proj, "wo_out")
 
 
 def _use_flash(cfg: TransformerConfig) -> bool:
@@ -281,8 +307,11 @@ def _use_flash(cfg: TransformerConfig) -> bool:
 def _flash_mesh(cfg: TransformerConfig):
     """The active mesh when the shard_map-wrapped flash kernel applies:
     every axis of size > 1 must be one the kernel can shard without
-    communication — batch over dp/fsdp, heads over tp. Pipeline / expert /
-    sequence axes fall back to the einsum form (sp has its own path)."""
+    communication — batch over dp/fsdp, heads over tp — or one attention is
+    replicated over (ep: expert parallelism shards only the expert MLPs, so
+    attention math is identical across the axis). Pipeline / sequence axes
+    fall back to the einsum form (attention there runs under the stage vmap /
+    the sp paths, where a shard_map cannot be placed)."""
     if cfg.attention_backend not in ("flash", "auto"):
         return None
     if cfg.attention_backend == "auto" and jax.default_backend() != "tpu":
@@ -294,8 +323,16 @@ def _flash_mesh(cfg: TransformerConfig):
     if mesh.devices.size == 1:
         return None
     for name, size in mesh.shape.items():
-        if size > 1 and name not in ("dp", "fsdp", "tp"):
+        if size > 1 and name not in ("dp", "fsdp", "tp", "ep"):
             return None
+        if size > 1:
+            # already inside a shard_map/pmap over this axis (e.g. the 1-bit
+            # optimizer step)? a nested shard_map is illegal — use einsum
+            try:
+                jax.lax.axis_size(name)
+                return None
+            except NameError:
+                pass
     return mesh
 
 
@@ -385,11 +422,11 @@ def _remat_policy(remat):
 def mlp(cfg: TransformerConfig, x, lp):
     from jax.ad_checkpoint import checkpoint_name
     if cfg.activation == "swiglu":
-        out = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        out = (jax.nn.silu(x @ _w(lp["w_gate"], x)) * (x @ _w(lp["w_up"], x))) @ _w(lp["w_down"], x)
         return checkpoint_name(out, "ff_down")
-    h = x @ lp["w_up"] + lp["b_up"]
+    h = x @ _w(lp["w_up"], x) + lp["b_up"]
     h = jax.nn.gelu(h, approximate=True) if cfg.activation == "gelu" else jax.nn.relu(h)
-    return checkpoint_name(h @ lp["w_down"] + lp["b_down"], "ff_down")
+    return checkpoint_name(h @ _w(lp["w_down"], x) + lp["b_down"], "ff_down")
 
 
 def block(cfg: TransformerConfig, x, lp, positions, mask_bias):
@@ -435,9 +472,12 @@ def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad
     H, KV, Hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
     Smax = ck.shape[1]
 
-    q = (x @ lp["wq"]).reshape(B, T, H, Hd)
-    k = (x @ lp["wk"]).reshape(B, T, KV, Hd)
-    v = (x @ lp["wv"]).reshape(B, T, KV, Hd)
+    bq = lp.get("bq", 0) if cfg.attn_bias else 0
+    bk = lp.get("bk", 0) if cfg.attn_bias else 0
+    bv = lp.get("bv", 0) if cfg.attn_bias else 0
+    q = (x @ _w(lp["wq"], x) + bq).reshape(B, T, H, Hd)
+    k = (x @ _w(lp["wk"], x) + bk).reshape(B, T, KV, Hd)
+    v = (x @ _w(lp["wv"], x) + bv).reshape(B, T, KV, Hd)
     if cfg.pos_embedding == "rope":
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
@@ -465,7 +505,7 @@ def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad
         scores = scores + pad_bias[:, None, None, :]
     probs = jax.nn.softmax(scores, axis=-1).astype(vv.dtype)
     out = jnp.einsum("bhts,bshd->bthd", probs, vv)
-    out = out.reshape(B, T, H * Hd) @ lp["wo"]
+    out = out.reshape(B, T, H * Hd) @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
     return out, ck, cv
 
 
@@ -479,6 +519,8 @@ def forward_cached(cfg: TransformerConfig, params, tokens, cache, pos, pad_bias=
     positions = pos + jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
     if cfg.pos_embedding == "learned":
         x = x + params["embed"]["positions"][positions].astype(x.dtype)
+    if cfg.embed_layernorm:
+        x = _norm(cfg, x, params["embed"]["ln"])
 
     def run_block(h, xs):
         lp, ck, cv = xs
@@ -505,6 +547,8 @@ def hidden_states(cfg: TransformerConfig, params, tokens, attn_mask=None):
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
     if cfg.pos_embedding == "learned":
         x = x + params["embed"]["positions"][:S][None, :, :]
+    if cfg.embed_layernorm:
+        x = _norm(cfg, x, params["embed"]["ln"])
 
     mask_bias = key_mask_bias(attn_mask)
     layer_params = params["layers"]
@@ -531,7 +575,7 @@ def _head_weight(cfg: TransformerConfig, params):
     """[D, vocab] projection (tied embedding transpose or lm_head)."""
     if cfg.tie_embeddings:
         return params["embed"]["tokens"].T
-    return params["lm_head"]
+    return _w(params["lm_head"], params["embed"]["tokens"])
 
 
 def _token_ce(logits, labels, valid):

@@ -1,0 +1,18 @@
+"""HF-checkpoint ingestion: per-architecture policies + shard streaming.
+
+Reference parity: ``deepspeed/module_inject/`` — ``replace_module.py:271``
+(per-architecture policy dispatch), ``containers/*.py`` (gpt2, gptneox,
+opt, bloom, llama parameter containers), ``load_checkpoint.py`` (sharded
+checkpoint loading into the injected modules).
+
+TPU redesign: instead of monkey-patching ``nn.Module`` trees, a policy maps
+HF tensor *names* to the zoo's stacked-layer pytree layout (weights arrive
+in [L, in, out] orientation, fused qkv de-interleaved per head), and the
+loader streams multi-file safetensors/torch checkpoints shard by shard so
+only one HF shard plus the assembling parameter is resident at a time.
+"""
+
+from deepspeed_tpu.module_inject.loader import load_hf_checkpoint
+from deepspeed_tpu.module_inject.policies import POLICIES, policy_for
+
+__all__ = ["load_hf_checkpoint", "POLICIES", "policy_for"]

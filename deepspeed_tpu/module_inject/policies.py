@@ -1,0 +1,340 @@
+"""Per-architecture HF -> zoo parameter policies.
+
+Reference parity: ``deepspeed/module_inject/containers/{gpt2,gptneox,opt,
+bloom,llama}.py`` + ``replace_policy.py`` — each policy knows the
+architecture's tensor names, fused-qkv layout, and module config.
+
+Conventions of the zoo layout (``models/transformer.py``):
+- linear weights are [in, out] (HF ``nn.Linear`` stores [out, in] and is
+  transposed; GPT-2's ``Conv1D`` already stores [in, out]);
+- per-layer weights are stacked with a leading ``n_layer`` dim;
+- fused query_key_value tensors are de-interleaved with the architecture's
+  actual head layout ([H, 3, Hd] for bloom/neox — a plain reshape would
+  silently interleave q/k/v, reference ``qkv_copy``/containers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.models.transformer import TransformerConfig
+
+
+def _stack(get, names, transform=None):
+    arrs = [np.asarray(get(n)) for n in names]
+    if transform is not None:
+        arrs = [transform(a) for a in arrs]
+    return np.stack(arrs)
+
+
+def _t(a):
+    return np.ascontiguousarray(a.T)
+
+
+class HFPolicy:
+    """Base policy: subclasses define ``model_type``, ``zoo_config`` and
+    ``map_params``."""
+
+    model_type: str = ""
+
+    def zoo_config(self, hf: Dict[str, Any]) -> TransformerConfig:
+        raise NotImplementedError
+
+    def map_params(self, get: Callable[[str], np.ndarray], cfg: TransformerConfig) -> Dict:
+        raise NotImplementedError
+
+
+class GPT2Policy(HFPolicy):
+    """HF ``gpt2`` (reference ``containers/gpt2.py``). Conv1D weights are
+    already [in, out]; c_attn is [D, 3D] fused q|k|v (block concat)."""
+
+    model_type = "gpt2"
+
+    def zoo_config(self, hf):
+        return TransformerConfig(
+            vocab_size=hf["vocab_size"], n_layer=hf["n_layer"], n_head=hf["n_head"],
+            d_model=hf["n_embd"], max_seq=hf["n_positions"], pos_embedding="learned",
+            norm="layernorm", activation="gelu", tie_embeddings=True, attn_bias=True,
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5))
+
+    def map_params(self, raw_get, cfg):
+        L, D = cfg.n_layer, cfg.d_model
+        ls = range(L)
+
+        def get(name):  # files may carry a "transformer." prefix
+            try:
+                return raw_get(name)
+            except KeyError:
+                return raw_get("transformer." + name)
+
+        def qkv_w(i):  # [D, 3D] -> 3 x [D, D]
+            return np.split(np.asarray(get(f"h.{i}.attn.c_attn.weight")), 3, axis=1)
+
+        def qkv_b(i):
+            return np.split(np.asarray(get(f"h.{i}.attn.c_attn.bias")), 3, axis=0)
+
+        qw, kw, vw = zip(*[qkv_w(i) for i in ls])
+        qb, kb, vb = zip(*[qkv_b(i) for i in ls])
+        return {
+            "embed": {"tokens": np.asarray(get("wte.weight")),
+                      "positions": np.asarray(get("wpe.weight"))},
+            "layers": {
+                "ln_attn": {"scale": _stack(get, [f"h.{i}.ln_1.weight" for i in ls]),
+                            "bias": _stack(get, [f"h.{i}.ln_1.bias" for i in ls])},
+                "attn": {"wq": np.stack(qw), "wk": np.stack(kw), "wv": np.stack(vw),
+                         "bq": np.stack(qb), "bk": np.stack(kb), "bv": np.stack(vb),
+                         "wo": _stack(get, [f"h.{i}.attn.c_proj.weight" for i in ls]),
+                         "bo": _stack(get, [f"h.{i}.attn.c_proj.bias" for i in ls])},
+                "ln_mlp": {"scale": _stack(get, [f"h.{i}.ln_2.weight" for i in ls]),
+                           "bias": _stack(get, [f"h.{i}.ln_2.bias" for i in ls])},
+                "mlp": {"w_up": _stack(get, [f"h.{i}.mlp.c_fc.weight" for i in ls]),
+                        "b_up": _stack(get, [f"h.{i}.mlp.c_fc.bias" for i in ls]),
+                        "w_down": _stack(get, [f"h.{i}.mlp.c_proj.weight" for i in ls]),
+                        "b_down": _stack(get, [f"h.{i}.mlp.c_proj.bias" for i in ls])},
+            },
+            "ln_f": {"scale": np.asarray(get("ln_f.weight")),
+                     "bias": np.asarray(get("ln_f.bias"))},
+        }
+
+
+class LlamaPolicy(HFPolicy):
+    """HF ``llama`` (reference ``containers/llama.py``). nn.Linear weights
+    [out, in] -> transpose; separate q/k/v; GQA via num_key_value_heads."""
+
+    model_type = "llama"
+
+    def zoo_config(self, hf):
+        return TransformerConfig(
+            vocab_size=hf["vocab_size"], n_layer=hf["num_hidden_layers"],
+            n_head=hf["num_attention_heads"], d_model=hf["hidden_size"],
+            d_ff=hf["intermediate_size"], max_seq=hf.get("max_position_embeddings", 2048),
+            n_kv_head=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            pos_embedding="rope", norm="rmsnorm", activation="swiglu",
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            norm_eps=hf.get("rms_norm_eps", 1e-6))
+
+    def map_params(self, get, cfg):
+        L = cfg.n_layer
+        ls = range(L)
+        p = "model.layers"
+        out = {
+            "embed": {"tokens": np.asarray(get("model.embed_tokens.weight"))},
+            "layers": {
+                "ln_attn": {"scale": _stack(get, [f"{p}.{i}.input_layernorm.weight" for i in ls])},
+                "attn": {"wq": _stack(get, [f"{p}.{i}.self_attn.q_proj.weight" for i in ls], _t),
+                         "wk": _stack(get, [f"{p}.{i}.self_attn.k_proj.weight" for i in ls], _t),
+                         "wv": _stack(get, [f"{p}.{i}.self_attn.v_proj.weight" for i in ls], _t),
+                         "wo": _stack(get, [f"{p}.{i}.self_attn.o_proj.weight" for i in ls], _t)},
+                "ln_mlp": {"scale": _stack(get, [f"{p}.{i}.post_attention_layernorm.weight" for i in ls])},
+                "mlp": {"w_gate": _stack(get, [f"{p}.{i}.mlp.gate_proj.weight" for i in ls], _t),
+                        "w_up": _stack(get, [f"{p}.{i}.mlp.up_proj.weight" for i in ls], _t),
+                        "w_down": _stack(get, [f"{p}.{i}.mlp.down_proj.weight" for i in ls], _t)},
+            },
+            "ln_f": {"scale": np.asarray(get("model.norm.weight"))},
+        }
+        if not cfg.tie_embeddings:
+            out["lm_head"] = _t(np.asarray(get("lm_head.weight")))
+        return out
+
+
+def _split_headwise_qkv(w, H, Hd):
+    """[3*H*Hd, D] fused with [H, 3, Hd] output layout (bloom/neox) ->
+    three [D, H*Hd] (zoo orientation)."""
+    D = w.shape[1]
+    w = w.reshape(H, 3, Hd, D)
+    return tuple(np.ascontiguousarray(w[:, j].reshape(H * Hd, D).T) for j in range(3))
+
+
+def _split_headwise_qkv_bias(b, H, Hd):
+    b = b.reshape(H, 3, Hd)
+    return tuple(np.ascontiguousarray(b[:, j].reshape(H * Hd)) for j in range(3))
+
+
+class BloomPolicy(HFPolicy):
+    """HF ``bloom`` (reference ``containers/bloom.py``): alibi positions,
+    word-embeddings layernorm, per-head-interleaved fused qkv."""
+
+    model_type = "bloom"
+
+    def zoo_config(self, hf):
+        return TransformerConfig(
+            vocab_size=hf["vocab_size"], n_layer=hf["n_layer"], n_head=hf["n_head"],
+            d_model=hf["hidden_size"], max_seq=2048, pos_embedding="alibi",
+            norm="layernorm", activation="gelu", tie_embeddings=True,
+            embed_layernorm=True, attn_bias=True,
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5))
+
+    def map_params(self, get, cfg):
+        L, H, Hd = cfg.n_layer, cfg.n_head, cfg.head_dim
+        ls = range(L)
+        p = "h"
+
+        def strip(name):  # files may carry a "transformer." prefix
+            try:
+                return get(name)
+            except KeyError:
+                return get("transformer." + name)
+
+        qw, kw, vw, qb, kb, vb = [], [], [], [], [], []
+        for i in ls:
+            w3 = np.asarray(strip(f"{p}.{i}.self_attention.query_key_value.weight"))
+            b3 = np.asarray(strip(f"{p}.{i}.self_attention.query_key_value.bias"))
+            a, b, c = _split_headwise_qkv(w3, H, Hd)
+            qw.append(a); kw.append(b); vw.append(c)
+            a, b, c = _split_headwise_qkv_bias(b3, H, Hd)
+            qb.append(a); kb.append(b); vb.append(c)
+
+        g = lambda n: strip(n)
+        return {
+            "embed": {"tokens": np.asarray(g("word_embeddings.weight")),
+                      "ln": {"scale": np.asarray(g("word_embeddings_layernorm.weight")),
+                             "bias": np.asarray(g("word_embeddings_layernorm.bias"))}},
+            "layers": {
+                "ln_attn": {"scale": _stack(g, [f"{p}.{i}.input_layernorm.weight" for i in ls]),
+                            "bias": _stack(g, [f"{p}.{i}.input_layernorm.bias" for i in ls])},
+                "attn": {"wq": np.stack(qw), "wk": np.stack(kw), "wv": np.stack(vw),
+                         "bq": np.stack(qb), "bk": np.stack(kb), "bv": np.stack(vb),
+                         "wo": _stack(g, [f"{p}.{i}.self_attention.dense.weight" for i in ls], _t),
+                         "bo": _stack(g, [f"{p}.{i}.self_attention.dense.bias" for i in ls])},
+                "ln_mlp": {"scale": _stack(g, [f"{p}.{i}.post_attention_layernorm.weight" for i in ls]),
+                           "bias": _stack(g, [f"{p}.{i}.post_attention_layernorm.bias" for i in ls])},
+                "mlp": {"w_up": _stack(g, [f"{p}.{i}.mlp.dense_h_to_4h.weight" for i in ls], _t),
+                        "b_up": _stack(g, [f"{p}.{i}.mlp.dense_h_to_4h.bias" for i in ls]),
+                        "w_down": _stack(g, [f"{p}.{i}.mlp.dense_4h_to_h.weight" for i in ls], _t),
+                        "b_down": _stack(g, [f"{p}.{i}.mlp.dense_4h_to_h.bias" for i in ls])},
+            },
+            "ln_f": {"scale": np.asarray(g("ln_f.weight")),
+                     "bias": np.asarray(g("ln_f.bias"))},
+        }
+
+
+class OPTPolicy(HFPolicy):
+    """HF ``opt`` (reference ``containers/opt.py``): learned positions with
+    a +2 offset, separate q/k/v with biases, relu MLP."""
+
+    model_type = "opt"
+
+    def zoo_config(self, hf):
+        return TransformerConfig(
+            vocab_size=hf["vocab_size"], n_layer=hf["num_hidden_layers"],
+            n_head=hf["num_attention_heads"], d_model=hf["hidden_size"],
+            d_ff=hf["ffn_dim"], max_seq=hf["max_position_embeddings"],
+            pos_embedding="learned", norm="layernorm",
+            activation=hf.get("activation_function", "relu"),
+            tie_embeddings=True, attn_bias=True)
+
+    def map_params(self, get, cfg):
+        L = cfg.n_layer
+        ls = range(L)
+        p = "model.decoder.layers"
+
+        def g(n):
+            try:
+                return get(n)
+            except KeyError:
+                return get(n.replace("model.decoder.", "decoder."))
+
+        return {
+            # OPT's embed_positions carries a 2-slot offset pad in front
+            "embed": {"tokens": np.asarray(g("model.decoder.embed_tokens.weight")),
+                      "positions": np.asarray(g("model.decoder.embed_positions.weight"))[2:]},
+            "layers": {
+                "ln_attn": {"scale": _stack(g, [f"{p}.{i}.self_attn_layer_norm.weight" for i in ls]),
+                            "bias": _stack(g, [f"{p}.{i}.self_attn_layer_norm.bias" for i in ls])},
+                "attn": {"wq": _stack(g, [f"{p}.{i}.self_attn.q_proj.weight" for i in ls], _t),
+                         "wk": _stack(g, [f"{p}.{i}.self_attn.k_proj.weight" for i in ls], _t),
+                         "wv": _stack(g, [f"{p}.{i}.self_attn.v_proj.weight" for i in ls], _t),
+                         "bq": _stack(g, [f"{p}.{i}.self_attn.q_proj.bias" for i in ls]),
+                         "bk": _stack(g, [f"{p}.{i}.self_attn.k_proj.bias" for i in ls]),
+                         "bv": _stack(g, [f"{p}.{i}.self_attn.v_proj.bias" for i in ls]),
+                         "wo": _stack(g, [f"{p}.{i}.self_attn.out_proj.weight" for i in ls], _t),
+                         "bo": _stack(g, [f"{p}.{i}.self_attn.out_proj.bias" for i in ls])},
+                "ln_mlp": {"scale": _stack(g, [f"{p}.{i}.final_layer_norm.weight" for i in ls]),
+                           "bias": _stack(g, [f"{p}.{i}.final_layer_norm.bias" for i in ls])},
+                "mlp": {"w_up": _stack(g, [f"{p}.{i}.fc1.weight" for i in ls], _t),
+                        "b_up": _stack(g, [f"{p}.{i}.fc1.bias" for i in ls]),
+                        "w_down": _stack(g, [f"{p}.{i}.fc2.weight" for i in ls], _t),
+                        "b_down": _stack(g, [f"{p}.{i}.fc2.bias" for i in ls])},
+            },
+            "ln_f": {"scale": np.asarray(g("model.decoder.final_layer_norm.weight")),
+                     "bias": np.asarray(g("model.decoder.final_layer_norm.bias"))},
+        }
+
+
+class GPTNeoXPolicy(HFPolicy):
+    """HF ``gpt_neox`` (reference ``containers/gptneox.py``): parallel
+    residual, rotary, per-head-interleaved fused qkv with biases.
+    Note: partial rotary (rotary_pct < 1) is not represented in the zoo
+    config; checkpoints with rotary_pct != 1.0 are rejected loudly."""
+
+    model_type = "gpt_neox"
+
+    def zoo_config(self, hf):
+        pct = hf.get("rotary_pct", 1.0)
+        if pct != 1.0:
+            raise NotImplementedError(
+                f"gpt_neox rotary_pct={pct}: partial rotary embedding is not "
+                "supported by the zoo transformer (full-dim rope only)")
+        return TransformerConfig(
+            vocab_size=hf["vocab_size"], n_layer=hf["num_hidden_layers"],
+            n_head=hf["num_attention_heads"], d_model=hf["hidden_size"],
+            d_ff=hf["intermediate_size"], max_seq=hf["max_position_embeddings"],
+            pos_embedding="rope", norm="layernorm", activation="gelu",
+            parallel_residual=bool(hf.get("use_parallel_residual", True)),
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)), attn_bias=True,
+            rope_theta=float(hf.get("rotary_emb_base", 10000.0)),
+            norm_eps=hf.get("layer_norm_eps", 1e-5))
+
+    def map_params(self, get, cfg):
+        L, H, Hd = cfg.n_layer, cfg.n_head, cfg.head_dim
+        ls = range(L)
+        p = "gpt_neox.layers"
+
+        qw, kw, vw, qb, kb, vb = [], [], [], [], [], []
+        for i in ls:
+            w3 = np.asarray(get(f"{p}.{i}.attention.query_key_value.weight"))
+            b3 = np.asarray(get(f"{p}.{i}.attention.query_key_value.bias"))
+            a, b, c = _split_headwise_qkv(w3, H, Hd)
+            qw.append(a); kw.append(b); vw.append(c)
+            a, b, c = _split_headwise_qkv_bias(b3, H, Hd)
+            qb.append(a); kb.append(b); vb.append(c)
+
+        out = {
+            "embed": {"tokens": np.asarray(get("gpt_neox.embed_in.weight"))},
+            "layers": {
+                "ln_attn": {"scale": _stack(get, [f"{p}.{i}.input_layernorm.weight" for i in ls]),
+                            "bias": _stack(get, [f"{p}.{i}.input_layernorm.bias" for i in ls])},
+                "attn": {"wq": np.stack(qw), "wk": np.stack(kw), "wv": np.stack(vw),
+                         "bq": np.stack(qb), "bk": np.stack(kb), "bv": np.stack(vb),
+                         "wo": _stack(get, [f"{p}.{i}.attention.dense.weight" for i in ls], _t),
+                         "bo": _stack(get, [f"{p}.{i}.attention.dense.bias" for i in ls])},
+                "ln_mlp": {"scale": _stack(get, [f"{p}.{i}.post_attention_layernorm.weight" for i in ls]),
+                           "bias": _stack(get, [f"{p}.{i}.post_attention_layernorm.bias" for i in ls])},
+                "mlp": {"w_up": _stack(get, [f"{p}.{i}.mlp.dense_h_to_4h.weight" for i in ls], _t),
+                        "b_up": _stack(get, [f"{p}.{i}.mlp.dense_h_to_4h.bias" for i in ls]),
+                        "w_down": _stack(get, [f"{p}.{i}.mlp.dense_4h_to_h.weight" for i in ls], _t),
+                        "b_down": _stack(get, [f"{p}.{i}.mlp.dense_4h_to_h.bias" for i in ls])},
+            },
+            "ln_f": {"scale": np.asarray(get("gpt_neox.final_layer_norm.weight")),
+                     "bias": np.asarray(get("gpt_neox.final_layer_norm.bias"))},
+        }
+        if not cfg.tie_embeddings:
+            out["lm_head"] = _t(np.asarray(get("embed_out.weight")))
+        return out
+
+
+POLICIES: Dict[str, HFPolicy] = {
+    p.model_type: p() for p in (GPT2Policy, LlamaPolicy, BloomPolicy, OPTPolicy, GPTNeoXPolicy)
+}
+
+
+def policy_for(model_type: str) -> HFPolicy:
+    try:
+        return POLICIES[model_type]
+    except KeyError:
+        raise ValueError(
+            f"no ingestion policy for HF model_type={model_type!r}; "
+            f"supported: {sorted(POLICIES)}") from None

@@ -143,8 +143,24 @@ class DeepSpeedEngine:
                                    "bf16": jnp.bfloat16}[acc_dtype_name]
 
         # ---- optimizer ----
+        # 1-bit family: functional optimizers whose COMPRESSED collectives
+        # run inside the compiled step (reference fp16/onebit/adam.py:11 —
+        # the optimizer owns gradient communication after freeze_step)
+        self._onebit = None
+        ob_name = (self._config.optimizer_name or "").lower() if optimizer is None else ""
+        if ob_name in ("onebitadam", "zerooneadam", "onebitlamb"):
+            self._onebit = self._build_onebit_optimizer(ob_name)
+
         self.client_optimizer = optimizer
-        if optimizer is not None:
+        if self._onebit is not None:
+            self.tx = None
+            self._client_tx_full = False
+            self._optimizer_name = ob_name
+            if float(self._config.gradient_clipping or 0.0) > 0.0:
+                raise NotImplementedError(
+                    f"{ob_name}: gradient_clipping does not compose with the compressed "
+                    "momentum exchange (the optimizer owns communication); disable it")
+        elif optimizer is not None:
             # A user-supplied optax transformation follows standard optax
             # conventions: updates are final (lr and sign already applied),
             # consumed as params + updates. The engine's LR schedule then
@@ -310,7 +326,10 @@ class DeepSpeedEngine:
                 lambda a, s: jax.device_put(jnp.asarray(a, jnp.float32), s), model_parameters, master_sh)
         else:
             master = None
-        if self._offload is None:
+        if self._onebit is not None:
+            opt_target = master if master is not None else params
+            opt_state = self._onebit_init_state(opt_target)
+        elif self._offload is None:
             opt_target = master if master is not None else params
             opt_state = self.tx.init(opt_target)
             opt_sh = rules.opt_state_shardings(opt_state, model_parameters, tp_specs)
@@ -318,10 +337,10 @@ class DeepSpeedEngine:
                                      opt_state, opt_sh)
         else:
             opt_state = ()
-        if self.gradient_accumulation_steps() == 1 and self._offload is None:
-            # the gas==1 fused step feeds grads straight into the update —
-            # no accumulation buffers; the forward/backward/step trio
-            # lazily allocates them on first use (_ensure_acc_grads)
+        if not self._uses_acc_grad_buffers():
+            # the fused step feeds grads straight into the update — no
+            # accumulation buffers; the forward/backward/step trio lazily
+            # allocates them on first use (_ensure_acc_grads)
             acc_grads = ()
         else:
             acc_grads = jax.tree.map(
@@ -375,6 +394,10 @@ class DeepSpeedEngine:
         grads directly so no accumulation buffers are read, written, or
         re-zeroed — and with no scan barrier XLA's scheduler is free to
         overlap per-param optimizer updates with the rest of the backward."""
+        if self._onebit is not None:
+            raise NotImplementedError(
+                "1-bit optimizers run their compressed update inside train_batch(); "
+                "the forward()/backward()/step() trio is not supported with them")
         from_buffers = acc is None
         if from_buffers:
             acc = state.acc_grads
@@ -500,11 +523,149 @@ class DeepSpeedEngine:
             self.lr_scheduler.step()
         return {"loss": self._losses, "lr": lr, "loss_scale": float(new_scaler.loss_scale)}
 
+    # ------------------------------------------------------------------ #
+    # 1-bit optimizer path (reference runtime/fp16/onebit/*: the optimizer
+    # owns gradient communication — full-precision psum during warmup,
+    # error-compensated 1-bit compressed allreduce after freeze_step)
+
+    def _build_onebit_optimizer(self, name: str):
+        p = dict(self._config.optimizer_params or {})
+        mesh = self.mesh
+        dp_axes = [ax for ax in ("dp", "fsdp") if mesh.shape.get(ax, 1) > 1]
+        other = [ax for ax, sz in mesh.shape.items()
+                 if sz > 1 and ax not in ("dp", "fsdp")]
+        if other or len(dp_axes) > 1:
+            raise NotImplementedError(
+                f"{name} supports a single data-parallel mesh axis (got {dict(mesh.shape)}); "
+                "the compressed allreduce composes with dp only (reference parity: "
+                "1-bit optimizers are incompatible with model parallelism + ZeRO>=2)")
+        if self._config.zero_config.stage >= 2:
+            raise NotImplementedError(f"{name} is incompatible with ZeRO stage >= 2 "
+                                      "(gradients must stay whole for the compressed allreduce)")
+        if self.fp16_enabled():
+            raise NotImplementedError(f"{name}: use bf16/fp32 (dynamic loss scaling does not "
+                                      "compose with the compressed momentum exchange)")
+        self._onebit_axis = dp_axes[0] if dp_axes else "dp"
+        n = mesh.shape.get(self._onebit_axis, 1)
+        common = dict(lr=p.get("lr", 1e-3), betas=tuple(p.get("betas", (0.9, 0.999))),
+                      eps=p.get("eps", 1e-8), weight_decay=p.get("weight_decay", 0.0),
+                      axis=self._onebit_axis, comm_group_size=n)
+        if name == "onebitadam":
+            from deepspeed_tpu.runtime.fp16.onebit import OnebitAdam
+            return OnebitAdam(freeze_step=p.get("freeze_step", 100), **common)
+        if name == "onebitlamb":
+            from deepspeed_tpu.runtime.fp16.onebit import OnebitLamb
+            return OnebitLamb(freeze_step=p.get("freeze_step", 100), **common)
+        from deepspeed_tpu.runtime.fp16.onebit import ZeroOneAdam
+        return ZeroOneAdam(var_freeze_step=p.get("var_freeze_step", 100),
+                           local_step_clipper=p.get("local_step_clipper", 16), **common)
+
+    _ONEBIT_ERR_FIELDS = ("worker_error", "server_error")
+
+    def _ob_map_errors(self, st, fn):
+        """Apply ``fn`` leaf-wise to the worker/server error subtrees,
+        wherever they live (OnebitLambState nests an adam state)."""
+        if hasattr(st, "adam"):
+            return st._replace(adam=self._ob_map_errors(st.adam, fn))
+        return st._replace(worker_error=jax.tree.map(fn, st.worker_error),
+                           server_error=jax.tree.map(fn, st.server_error))
+
+    def _ob_is_error_path(self, path) -> bool:
+        return any(getattr(k, "name", None) in self._ONEBIT_ERR_FIELDS for k in path)
+
+    def _onebit_init_state(self, target):
+        """Global optimizer state: per-rank error feedback gets a leading dp
+        dim sharded over the dp axis; everything else replicates."""
+        n = self.mesh.shape.get(self._onebit_axis, 1)
+        st = self._onebit.init(target)
+        st = self._ob_map_errors(st, lambda e: jnp.zeros((n,) + e.shape, e.dtype))
+        rep = NamedSharding(self.mesh, P())
+        shd = NamedSharding(self.mesh, P(self._onebit_axis))
+
+        def put(path, a):
+            return jax.device_put(a, shd if self._ob_is_error_path(path) else rep)
+
+        from jax.tree_util import tree_map_with_path
+        return tree_map_with_path(put, st)
+
+    def _build_onebit_batch_fn(self, gas: int) -> Callable:
+        """Whole step inside shard_map over dp: per-rank LOCAL grads feed the
+        1-bit optimizer, which performs the (compressed) communication."""
+        from jax import shard_map
+
+        opt = self._onebit
+        axis = self._onebit_axis
+        mesh = self.mesh
+        has_axis = mesh.shape.get(axis, 1) > 1
+
+        def step(state: TrainState, batch, rng, lr):
+            params, master, opt_state = state.params, state.master, state.opt_state
+
+            def per_rank(params, master, opt_state, batch, rng):
+                local = self._ob_map_errors(opt_state, lambda e: e[0])
+
+                def micro_grad(carry, mb):
+                    acc, i = carry
+                    def lf(p):
+                        out = self.loss_fn(p, mb, jax.random.fold_in(rng, i))
+                        return (out[0] if isinstance(out, tuple) else out).astype(jnp.float32)
+                    loss, grads = jax.value_and_grad(lf)(params)
+                    acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                    return (acc, i + 1), loss
+
+                zero = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                (gsum, _), losses = jax.lax.scan(micro_grad, (zero, jnp.int32(0)), batch)
+                grads = jax.tree.map(lambda g: g / gas, gsum)  # LOCAL mean
+
+                target = master if master is not None else params
+                new_target, new_local = opt.update(grads, local, target, lr=lr)
+                new_opt = self._ob_map_errors(new_local, lambda e: e[None])
+                loss = jnp.mean(losses)
+                if has_axis:
+                    loss = jax.lax.pmean(loss, axis)
+                return new_target, new_opt, loss
+
+            rep = P()
+            specs = lambda tree, s: jax.tree.map(lambda _: s, tree,
+                                                 is_leaf=lambda x: x is None)
+            opt_in = jax.tree_util.tree_map_with_path(
+                lambda path, _: P(axis) if self._ob_is_error_path(path) else rep,
+                opt_state)
+            batch_spec = jax.tree.map(lambda _: P(None, axis) if has_axis else P(None), batch)
+
+            wrapped = shard_map(
+                per_rank, mesh=mesh,
+                in_specs=(specs(params, rep), specs(master, rep), opt_in, batch_spec, rep),
+                out_specs=(specs(params, rep), opt_in, rep),
+                check_vma=False)
+            new_target, new_opt, loss = wrapped(params, master, opt_state, batch, rng)
+
+            if master is not None:
+                new_master = new_target
+                new_params = jax.lax.with_sharding_constraint(
+                    jax.tree.map(lambda m: m.astype(self.compute_dtype), new_master),
+                    self._param_shardings)
+            else:
+                new_master, new_params = None, new_target
+            state = state._replace(params=new_params, master=new_master, opt_state=new_opt,
+                                   micro_steps=state.micro_steps + gas,
+                                   global_steps=state.global_steps + 1)
+            return state, loss
+
+        def train_batch_fn(state: TrainState, batch, rng):
+            lr = self._lr_fn(state.global_steps)
+            state, loss = step(state, batch, rng, lr)
+            return state, {"loss": loss, "lr": lr, "loss_scale": state.scaler.loss_scale}
+
+        return jax.jit(train_batch_fn, donate_argnums=(0,))
+
     def _build_train_batch_fn(self, gas: int) -> Callable:
         """Fused GAS-scan + update, one XLA program. gas == 1 skips the scan
         and the accumulation buffers entirely: the micro-step grads feed the
         optimizer update directly (no acc read/write/re-zero, no scan
         barrier between backward and update)."""
+        if self._onebit is not None:
+            return self._build_onebit_batch_fn(gas)
 
         if gas == 1:
             def train_batch_fn(state: TrainState, batch, rng):
@@ -692,6 +853,13 @@ class DeepSpeedEngine:
         self.state = self._acc_jit(self.state, self._cached_grads)
         self._cached_grads = None
         return self._losses
+
+    def _uses_acc_grad_buffers(self) -> bool:
+        """Whether the compiled step reads/writes state.acc_grads (the
+        gas==1 fused path, the 1-bit path, and 1F1B pipelines do not)."""
+        if self._onebit is not None:
+            return False
+        return not (self.gradient_accumulation_steps() == 1 and self._offload is None)
 
     def _ensure_acc_grads(self) -> None:
         """Materialize the accumulation buffers the gas==1 fused path skips

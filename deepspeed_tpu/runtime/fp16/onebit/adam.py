@@ -47,7 +47,8 @@ class OnebitAdam:
         self.n = comm_group_size
 
     def _pad(self, numel: int) -> int:
-        return -(-numel // self.n) * self.n
+        from deepspeed_tpu.runtime.comm.compressed import pad_to
+        return pad_to(numel, self.n)  # divisible by 8*n: whole packed bytes per chunk
 
     def init(self, params) -> OnebitAdamState:
         zeros = lambda p: jnp.zeros(p.shape, jnp.float32)

@@ -38,13 +38,20 @@ def build_optimizer(name: Optional[str],
     name = (name or config_core.ADAMW_OPTIMIZER).lower()
     wd = params.get("weight_decay", 0.0)
 
-    if name in (config_core.ADAM_OPTIMIZER, config_core.ONEBIT_ADAM_OPTIMIZER, config_core.ZERO_ONE_ADAM_OPTIMIZER):
+    if name in (config_core.ONEBIT_ADAM_OPTIMIZER, config_core.ZERO_ONE_ADAM_OPTIMIZER,
+                config_core.ONEBIT_LAMB_OPTIMIZER):
+        # the 1-bit family are not optax transformations: their compressed
+        # collectives run INSIDE the engine's compiled step (engine
+        # _build_onebit_batch_fn; reference runtime/fp16/onebit/adam.py:11)
+        raise ValueError(
+            f"{name} is engine-integrated (compressed collectives inside the step); "
+            "configure it via deepspeed_tpu.initialize(config={'optimizer': ...}) — "
+            "it cannot be built as a standalone optax transformation")
+
+    if name == config_core.ADAM_OPTIMIZER:
         # reference Adam applies L2-style weight decay unless adam_w_mode
         adam_w_mode = params.get("adam_w_mode", False)
         args = _adam_args(params)
-        if name != config_core.ADAM_OPTIMIZER:
-            logger.warning(f"{name}: compressed 1-bit variant runs as dense Adam until its "
-                           "compressed collective lands; convergence is identical, comm volume is not.")
         if adam_w_mode or wd == 0.0:
             tx = optax.chain(optax.scale_by_adam(b1=args["b1"], b2=args["b2"], eps=args["eps"]),
                              optax.add_decayed_weights(wd) if wd else optax.identity())
@@ -58,10 +65,8 @@ def build_optimizer(name: Optional[str],
         return optax.chain(optax.scale_by_adam(b1=args["b1"], b2=args["b2"], eps=args["eps"]),
                            optax.add_decayed_weights(wd) if wd else optax.identity())
 
-    if name in (config_core.LAMB_OPTIMIZER, config_core.ONEBIT_LAMB_OPTIMIZER):
+    if name == config_core.LAMB_OPTIMIZER:
         betas = params.get("betas", (0.9, 0.999))
-        if name == config_core.ONEBIT_LAMB_OPTIMIZER:
-            logger.warning("onebitlamb: running as dense LAMB until its compressed collective lands.")
         return optax.chain(
             optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=params.get("eps", 1e-6)),
             optax.add_decayed_weights(wd) if wd else optax.identity(),

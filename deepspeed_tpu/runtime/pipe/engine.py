@@ -112,10 +112,15 @@ def spmd_pipeline_loss(embed_fn: Callable,
         bufs, aux = constrain(bufs), constrain(aux)
 
         outs = vstage(stage_params, bufs, aux, jax.random.fold_in(rng, t))
-        # last stage completes micro-batch t - (S-1)
+        # last stage completes micro-batch t - (S-1); the head (a full vocab
+        # matmul) only runs on ticks where one actually exits
         mb_done = mb_at(t - (S - 1))
-        loss_t = head_loss_fn(params, outs[S - 1], mb_done, jax.random.fold_in(rng, t + T))
-        loss_sum = loss_sum + jnp.where(t >= S - 1, loss_t.astype(jnp.float32), 0.0)
+        loss_t = jax.lax.cond(
+            t >= S - 1,
+            lambda: head_loss_fn(params, outs[S - 1], mb_done,
+                                 jax.random.fold_in(rng, t + T)).astype(jnp.float32),
+            lambda: jnp.float32(0.0))
+        loss_sum = loss_sum + loss_t
 
         bufs = constrain(jnp.roll(outs, 1, axis=0))
         aux = constrain({k: jnp.roll(v, 1, axis=0) for k, v in aux.items()})
@@ -124,6 +129,184 @@ def spmd_pipeline_loss(embed_fn: Callable,
     init = (bufs, carry0, jnp.zeros((), jnp.float32))
     (final_bufs, _, loss_sum), _ = jax.lax.scan(tick, init, jnp.arange(T, dtype=jnp.int32))
     return loss_sum / M
+
+
+def spmd_pipeline_1f1b(embed_fn: Callable,
+                       stage_fn: Callable,
+                       head_loss_fn: Callable,
+                       params: Any,
+                       microbatches: Any,
+                       rng,
+                       num_stages: int,
+                       mesh=None,
+                       carry_keys: tuple = (),
+                       cot_scale=1.0):
+    """1F1B pipelined loss AND grads in one forward-only ``lax.scan``.
+
+    Reference parity: ``deepspeed/runtime/pipe/schedule.py:186-296``
+    (``TrainSchedule`` — interleaved forward/backward so live activations
+    stay bounded by the stage count, not the micro-batch count).
+
+    TPU redesign: instead of interpreting Send/Recv instructions per rank —
+    or differentiating through a GPipe scan, which makes AD save O(M) tick
+    states — the backward wave is computed EXPLICITLY inside the same scan:
+
+    - tick t forwards micro-batch ``t-s`` on stage s and backwards
+      micro-batch ``t-2(S-1)+s`` via per-stage ``jax.vjp`` (activation
+      recompute, the reference's checkpointing default);
+    - each stage keeps its last ``2S-1`` inputs in a ring buffer — the 1F1B
+      memory bound of O(S) activations per stage, independent of M;
+    - activations roll forward and cotangents roll backward one stage per
+      tick (CollectivePermute over ``pp`` in both directions);
+    - parameter gradients accumulate in the scan carry, so AD never
+      differentiates the schedule at all.
+
+    Returns ``(mean_loss, grads)`` where grads covers the full params tree.
+    ``cot_scale`` seeds the head cotangent (loss-scaling support).
+    """
+    S = num_stages
+    leaves = jax.tree.leaves(microbatches)
+    M = leaves[0].shape[0]
+    T = M + 2 * (S - 1)
+    R = max(2 * S - 1, 1)  # ring depth: max write->read delay is 2(S-1)
+    if isinstance(microbatches, dict):
+        carry_keys = tuple(k for k in carry_keys if k in microbatches)
+
+    stage_params = params["stages"]
+    s_idx = jnp.arange(S, dtype=jnp.int32)
+
+    def mb_at(t):
+        idx = jnp.clip(t, 0, M - 1)
+        return jax.tree.map(lambda a: jax.lax.dynamic_index_in_dim(a, idx, 0, keepdims=False),
+                            microbatches)
+
+    def stage_key(s, m):
+        # one key per (stage, micro-batch), identical at fwd and recompute
+        return jax.random.fold_in(rng, s * M + jnp.clip(m, 0, M - 1))
+
+    def constrain(x, batch_dim=1):
+        """Shard dim 0 over pp and the given batch dim over dp (ring
+        buffers carry [stage, ring_slot, batch, ...] so their batch dim is
+        2; rolling buffers are [stage, batch, ...])."""
+        if mesh is None or "pp" not in mesh.shape:
+            return x
+        dp_axes = tuple(dist.data_parallel_axes(mesh))
+        dp = dp_axes if len(dp_axes) != 1 else (dp_axes[0] if dp_axes else None)
+
+        def one(a):
+            spec = [None] * a.ndim
+            spec[0] = "pp"
+            if a.ndim > batch_dim and dp_axes:
+                spec[batch_dim] = dp
+            return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, P(*spec)))
+        return jax.tree.map(one, x)
+
+    # shapes
+    mb0 = mb_at(jnp.asarray(0, jnp.int32))
+    x0 = embed_fn(params, mb0, rng)
+
+    ring0 = constrain(jnp.zeros((S, R) + x0.shape, x0.dtype), batch_dim=2)
+    aux_ring0 = {k: constrain(jnp.zeros((S, R) + mb0[k].shape, mb0[k].dtype), batch_dim=2)
+                 for k in carry_keys}
+    outs0 = constrain(jnp.zeros((S,) + x0.shape, x0.dtype))
+    cots0 = constrain(jnp.zeros((S,) + x0.shape, x0.dtype))
+    gstages0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), stage_params)
+    gfull0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), params)
+
+    def tick(state, t):
+        ring, aux_ring, prev_outs, cots, gstages, gfull, loss_sum = state
+
+        # ---- forward wave: stage s processes micro-batch t - s ----
+        mb = mb_at(t)
+        x_embed = embed_fn(params, mb, stage_key(0, t)).astype(prev_outs.dtype)
+        bufs_in = jnp.roll(prev_outs, 1, axis=0).at[0].set(x_embed)
+        # aux travels with activations: stage s sees micro-batch t-s's aux
+        aux_in = {k: jax.vmap(lambda s: mb_at(t - s)[k])(s_idx) for k in carry_keys}
+        bufs_in = constrain(bufs_in)
+
+        slot = jnp.mod(t, R)
+        ring = jax.lax.dynamic_update_index_in_dim(
+            jnp.swapaxes(ring, 0, 1), bufs_in, slot, 0)
+        ring = jnp.swapaxes(ring, 0, 1)
+        for k in carry_keys:
+            r = jax.lax.dynamic_update_index_in_dim(
+                jnp.swapaxes(aux_ring[k], 0, 1), aux_in[k], slot, 0)
+            aux_ring[k] = jnp.swapaxes(r, 0, 1)
+
+        fwd_keys = jax.vmap(lambda s: stage_key(s, t - s))(s_idx)
+        outs = jax.vmap(stage_fn, in_axes=(0, 0, 0, 0))(
+            stage_params, bufs_in,
+            {k: aux_in[k] for k in carry_keys}, fwd_keys)
+
+        # ---- head: micro-batch t - (S-1) exits; loss + cotangent seed ----
+        mb_h = mb_at(t - (S - 1))
+
+        def head_branch():
+            def f(p, x):
+                return head_loss_fn(p, x, mb_h, stage_key(S, t - (S - 1)))
+            loss_h, vjp = jax.vjp(f, params, outs[S - 1])
+            gp, gx = vjp(jnp.asarray(cot_scale, jnp.float32))
+            return (loss_h.astype(jnp.float32),
+                    jax.tree.map(lambda a: a.astype(jnp.float32), gp),
+                    gx.astype(outs.dtype))
+
+        def head_zeros():
+            return (jnp.float32(0.0), gfull0, jnp.zeros_like(outs[S - 1]))
+
+        valid_h = (t >= S - 1) & (t - (S - 1) < M)
+        loss_h, gp_h, cot_head = jax.lax.cond(valid_h, head_branch, head_zeros)
+        loss_sum = loss_sum + loss_h
+        gfull = jax.tree.map(jnp.add, gfull, gp_h)
+
+        # ---- backward wave: stage s backwards micro-batch t - 2(S-1) + s ----
+        m_b = t - 2 * (S - 1) + s_idx                  # per stage
+        valid_b = (m_b >= 0) & (m_b < M)
+        read_slot = jnp.mod(t - (2 * (S - 1) - 2 * s_idx), R)
+        x_saved = jax.vmap(lambda s, i: jax.lax.dynamic_index_in_dim(ring[s], i, 0, keepdims=False),
+                           in_axes=(0, 0))(s_idx, read_slot)
+        aux_saved = {k: jax.vmap(lambda s, i: jax.lax.dynamic_index_in_dim(
+            aux_ring[k][s], i, 0, keepdims=False), in_axes=(0, 0))(s_idx, read_slot)
+            for k in carry_keys}
+        bwd_keys = jax.vmap(lambda s, m: stage_key(s, m))(s_idx, m_b)
+
+        cot_in = cots.at[S - 1].set(cot_head)
+
+        def stage_bwd(sp, x, aux, key, cot, valid):
+            y, vjp = jax.vjp(lambda sp_, x_: stage_fn(sp_, x_, aux, key), sp, x)
+            dsp, dx = vjp(cot)
+            z = jnp.where(valid, 1.0, 0.0).astype(jnp.float32)
+            dsp = jax.tree.map(lambda a: a.astype(jnp.float32) * z, dsp)
+            dx = dx * z.astype(dx.dtype)
+            return dsp, dx
+
+        dsp, dx = jax.vmap(stage_bwd, in_axes=(0, 0, 0, 0, 0, 0))(
+            stage_params, x_saved, aux_saved, bwd_keys, cot_in, valid_b)
+        gstages = jax.tree.map(jnp.add, gstages, dsp)
+
+        # ---- embed backward: cotangent exiting stage 0 ----
+        m_b0 = t - 2 * (S - 1)
+        mb_b0 = mb_at(m_b0)
+
+        def embed_branch():
+            _, vjp = jax.vjp(lambda p: embed_fn(p, mb_b0, stage_key(0, m_b0)), params)
+            (gp,) = vjp(dx[0])
+            return jax.tree.map(lambda a: a.astype(jnp.float32), gp)
+
+        gp_e = jax.lax.cond((m_b0 >= 0) & (m_b0 < M), embed_branch, lambda: gfull0)
+        gfull = jax.tree.map(jnp.add, gfull, gp_e)
+
+        # cotangents roll backward one stage; slot S-1 is re-seeded next tick
+        cots = constrain(jnp.roll(dx, -1, axis=0))
+        prev_outs = constrain(outs)
+        return (ring, aux_ring, prev_outs, cots, gstages, gfull, loss_sum), None
+
+    init = (ring0, aux_ring0, outs0, cots0, gstages0, gfull0, jnp.zeros((), jnp.float32))
+    (ring, aux_ring, _, _, gstages, gfull, loss_sum), _ = jax.lax.scan(
+        tick, init, jnp.arange(T, dtype=jnp.int32))
+
+    grads = dict(gfull)
+    grads["stages"] = jax.tree.map(jnp.add, gfull["stages"], gstages)
+    return loss_sum / M, grads
 
 
 class PipelineEngine(DeepSpeedEngine):
@@ -149,11 +332,38 @@ class PipelineEngine(DeepSpeedEngine):
             raise ValueError(f"mesh pp={pp} != model num_stages={self.num_stages}")
         self.micro_batches = self.gradient_accumulation_steps()
 
+    def _uses_acc_grad_buffers(self) -> bool:
+        # the 1F1B schedule accumulates grads inside its own scan carry
+        if str(self._config.pipeline.get("schedule", "1f1b")).lower() == "1f1b":
+            return False
+        return super()._uses_acc_grad_buffers()
+
     def is_pipe_parallel(self) -> bool:
         return True
 
     def _build_train_batch_fn(self, gas: int) -> Callable:
         spec = self._pipe_spec
+        schedule = str(self._config.pipeline.get("schedule", "1f1b")).lower()
+
+        if schedule == "1f1b":
+            def train_batch_fn(state: TrainState, batch, rng):
+                scale = state.scaler.loss_scale
+                # manual-backprop 1F1B: loss AND grads from one forward-only
+                # scan; per-micro-batch cotangents seeded with the loss scale
+                # (the sum is divided by scale*gas in _apply_update)
+                loss, grads = spmd_pipeline_1f1b(
+                    spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
+                    state.params, batch, rng, spec["num_stages"], mesh=self.mesh,
+                    carry_keys=tuple(spec.get("carry_keys", ())), cot_scale=scale)
+                grads = jax.lax.with_sharding_constraint(
+                    jax.tree.map(lambda g: g.astype(self.grad_acc_dtype), grads),
+                    self._grad_shardings)
+                state = state._replace(micro_steps=state.micro_steps + gas)
+                state = self._apply_update(state, gas, acc=grads)
+                return state, {"loss": loss, "lr": self._lr_fn(state.global_steps - 1),
+                               "loss_scale": state.scaler.loss_scale}
+
+            return jax.jit(train_batch_fn, donate_argnums=(0,))
 
         def train_batch_fn(state: TrainState, batch, rng):
             scale = state.scaler.loss_scale
@@ -167,9 +377,16 @@ class PipelineEngine(DeepSpeedEngine):
                 return loss * scale * gas, loss
 
             grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params)
-            acc = self._accumulate(state.acc_grads, grads)
-            state = state._replace(acc_grads=acc, micro_steps=state.micro_steps + gas)
-            state = self._apply_update(state, gas)
+            if state.acc_grads == ():  # gas==1 keeps no buffers (structural)
+                grads = jax.lax.with_sharding_constraint(
+                    jax.tree.map(lambda g: g.astype(self.grad_acc_dtype), grads),
+                    self._grad_shardings)
+                state = state._replace(micro_steps=state.micro_steps + gas)
+                state = self._apply_update(state, gas, acc=grads)
+            else:
+                acc = self._accumulate(state.acc_grads, grads)
+                state = state._replace(acc_grads=acc, micro_steps=state.micro_steps + gas)
+                state = self._apply_update(state, gas)
             return state, {"loss": loss, "lr": self._lr_fn(state.global_steps - 1),
                            "loss_scale": state.scaler.loss_scale}
 

@@ -130,6 +130,32 @@ class TestKVCacheDecode:
         assert engine._decode_jit._cache_size() == 1, (
             "decode step recompiled during generation")
 
+    def test_no_recompile_across_prompt_lengths_and_max_new(self):
+        """Reference workspace semantics (inference_context.h:49): differing
+        prompt lengths (same 128-bucket) and max_new values reuse ONE
+        compiled prefill + ONE compiled decode loop and one KV workspace."""
+        model = self._model()
+        engine = deepspeed_tpu.init_inference(model, dtype="fp32")
+        engine.generate(jnp.array([[1, 2, 3]], jnp.int32), max_new_tokens=4)
+        ws0 = engine._workspace
+        engine.generate(jnp.array([[1, 2, 3, 4, 5]], jnp.int32), max_new_tokens=7)
+        engine.generate(jnp.array([[9, 8]], jnp.int32), max_new_tokens=2)
+        assert engine._decode_jit._cache_size() == 1
+        assert engine._prefill_jit._cache_size() == 1
+        assert engine._workspace[1] == ws0[1]  # same workspace capacity reused
+
+    def test_eos_early_exit_on_device(self):
+        """The decode loop must stop early at eos without per-token host
+        syncs: the output stops at the first eos row-wide."""
+        model = self._model()
+        engine = deepspeed_tpu.init_inference(model, dtype="fp32")
+        prompt = jnp.array([[1, 2, 3]], jnp.int32)
+        free = engine.generate(prompt, max_new_tokens=10)
+        # pick the token the model actually emits first, use it as eos
+        eos = int(np.asarray(free)[0, 3])
+        out = engine.generate(prompt, max_new_tokens=10, eos_token_id=eos)
+        assert out.shape[1] == 4  # prompt + the eos token, loop exited early
+
     def test_sampled_generation_shapes(self):
         model = self._model()
         engine = deepspeed_tpu.init_inference(model, dtype="fp32")

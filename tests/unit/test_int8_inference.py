@@ -1,0 +1,94 @@
+"""int8 weight-only inference tests (reference GroupQuantizer,
+``module_inject/replace_module.py:135``)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.models.transformer import TransformerConfig
+from deepspeed_tpu.ops.quant import Quantized8, quantize_int8, quantize_params, tree_nbytes
+
+
+@pytest.fixture(autouse=True)
+def no_mesh():
+    dist.set_mesh(None)
+    yield
+
+
+def tiny():
+    return CausalLM(TransformerConfig(vocab_size=128, n_layer=2, n_head=4, d_model=64,
+                                      max_seq=64, attention_backend="xla"))
+
+
+class TestQuantizeOp:
+    def test_roundtrip_error_small(self):
+        w = np.random.default_rng(0).normal(size=(64, 64)).astype(np.float32) * 0.05
+        q = quantize_int8(jnp.asarray(w), groups=4)
+        back = np.asarray(q.dequant(jnp.float32))
+        err = np.abs(back - w).max() / np.abs(w).max()
+        assert err < 0.02  # int8 grid = ~0.8% of the group amax
+
+    def test_groups_reduce_error(self):
+        rng = np.random.default_rng(0)
+        # one outlier row-segment makes coarse scaling bad
+        w = rng.normal(size=(8, 128)).astype(np.float32)
+        w[:, :16] *= 50
+        e1 = np.abs(np.asarray(quantize_int8(jnp.asarray(w), 1).dequant(jnp.float32)) - w).mean()
+        e8 = np.abs(np.asarray(quantize_int8(jnp.asarray(w), 8).dequant(jnp.float32)) - w).mean()
+        assert e8 < e1
+
+    def test_scan_slices_quantized_layers(self):
+        """lax.scan over a Quantized8 with a leading layer dim slices q and
+        scale together — the property the per-layer dequant design rests on."""
+        w = jnp.asarray(np.random.default_rng(0).normal(size=(3, 8, 16)).astype(np.float32))
+        q = quantize_int8(w, groups=2)
+
+        def body(c, layer_q):
+            assert isinstance(layer_q, Quantized8)
+            return c + layer_q.dequant(jnp.float32).sum(), None
+
+        total, _ = jax.lax.scan(body, jnp.float32(0), q)
+        np.testing.assert_allclose(float(total), float(q.dequant(jnp.float32).sum()), rtol=1e-5)
+
+
+class TestInt8Engine:
+    def test_int8_close_to_bf16_and_smaller(self):
+        m = tiny()
+        params = m.init_params(jax.random.key(0))
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+        e_bf = InferenceEngine(m, DeepSpeedInferenceConfig(dtype="bf16"), params=params)
+        e_i8 = InferenceEngine(m, DeepSpeedInferenceConfig(dtype="int8"), params=params)
+
+        tok = np.random.default_rng(0).integers(0, 128, size=(2, 16)).astype(np.int32)
+        lo_bf = np.asarray(e_bf.forward(tok), np.float32)
+        lo_i8 = np.asarray(e_i8.forward(tok), np.float32)
+        # int8 weights perturb logits but stay close
+        assert np.abs(lo_i8 - lo_bf).max() < 0.15 * max(1.0, np.abs(lo_bf).max())
+
+        def nbytes(t):
+            return sum(l.nbytes for l in jax.tree.leaves(t))
+        assert nbytes(e_i8.params) < nbytes(e_bf.params)
+        # the quantized weight matrices themselves shrink ~2x vs bf16
+        assert any(isinstance(x, Quantized8)
+                   for x in jax.tree.leaves(e_i8.params,
+                                            is_leaf=lambda x: isinstance(x, Quantized8)))
+
+    def test_int8_generate_runs(self):
+        m = tiny()
+        eng = deepspeed_tpu.init_inference(m, dtype="int8")
+        out = eng.generate(np.array([[1, 2, 3]], np.int32), max_new_tokens=4)
+        assert np.asarray(out).shape == (1, 7)
+
+    def test_int8_with_tp_is_loud(self):
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+        m = tiny()
+        with pytest.raises(NotImplementedError, match="int8"):
+            InferenceEngine(m, DeepSpeedInferenceConfig(
+                dtype="int8", tensor_parallel={"tp_size": 2}))

@@ -167,3 +167,152 @@ class TestOnebitVariants:
         mean_target = np.asarray(targets).mean(axis=0)
         assert (np.linalg.norm(np.asarray(w) - mean_target)
                 < np.linalg.norm(np.ones(dim) - mean_target))
+
+
+def _jaxpr_collective_bytes(fn, *args) -> int:
+    """Bytes entering communication primitives in a traced function
+    (collectives inside shard_map appear as explicit jaxpr primitives)."""
+    comm = {"psum", "all_gather", "all_to_all", "psum_scatter", "ppermute",
+            "reduce_scatter", "pmean"}
+    closed = jax.make_jaxpr(fn)(*args)
+    total = 0
+
+    def walk(jaxpr):
+        nonlocal total
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name in comm:
+                for v in eqn.invars:
+                    aval = getattr(v, "aval", None)
+                    if aval is not None and hasattr(aval, "shape"):
+                        import numpy as _np
+                        total += int(_np.prod(aval.shape or (1,))) * aval.dtype.itemsize
+            for sub in eqn.params.values():
+                for s in (sub if isinstance(sub, (list, tuple)) else (sub,)):
+                    if hasattr(s, "eqns"):          # raw Jaxpr (shard_map)
+                        walk(s)
+                    elif hasattr(s, "jaxpr"):       # ClosedJaxpr (pjit etc.)
+                        walk(s.jaxpr)
+
+    walk(closed.jaxpr)
+    return total
+
+
+class TestOnebitEngine:
+    """Engine-level wiring (reference: OnebitAdam drives comm inside step)."""
+
+    def _engine(self, optimizer_type, devices, freeze_step=3, lr=5e-3):
+        import deepspeed_tpu
+        import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.models import CausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+
+        dist.set_mesh(None)
+        model = CausalLM(TransformerConfig(vocab_size=64, n_layer=2, n_head=2, d_model=32,
+                                           max_seq=16, remat=False))
+        params = model.init_params(jax.random.key(0))
+        okey = "params" if optimizer_type != "ZeroOneAdam" else "params"
+        opt_params = {"lr": lr}
+        if optimizer_type == "ZeroOneAdam":
+            opt_params["var_freeze_step"] = freeze_step
+        else:
+            opt_params["freeze_step"] = freeze_step
+        config = {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": optimizer_type, "params": opt_params},
+            "bf16": {"enabled": True},
+            "mesh": {"dp": -1},
+            "steps_per_print": 0,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                                   config=config)
+        return engine
+
+    @pytest.mark.parametrize("opt", ["OneBitAdam", "ZeroOneAdam", "OneBitLamb"])
+    def test_trains_through_compression_phase(self, opt, devices):
+        engine = self._engine(opt, devices)
+        rng = np.random.default_rng(0)
+        dp = engine.mesh.shape["dp"]
+        tok = rng.integers(0, 64, size=(2 * dp, 16)).astype(np.int32)
+        # 12 steps crosses freeze_step=3: warmup AND compressed phases run
+        losses = [float(engine.train_batch({"input_ids": tok})) for _ in range(12)]
+        assert losses[-1] < losses[0], losses
+
+    def test_compressed_comm_bytes_below_dense(self, devices):
+        """The compressed allreduce must move far fewer wire bytes than a
+        dense f32 allreduce of the same tensor (the feature's entire point).
+        Collective traffic is counted at the primitive level."""
+        from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(np.array(devices[:8]), ("dp",))
+        n, numel = 8, 64 * 1024
+        x = jnp.zeros((numel,), jnp.float32)
+
+        def compressed(x):
+            return jax.shard_map(
+                lambda t: compressed_allreduce(t, jnp.zeros((numel,)),
+                                               jnp.zeros((numel // n,)), "dp")[0],
+                mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False)(x)
+
+        def dense(x):
+            return jax.shard_map(lambda t: jax.lax.psum(t, "dp"),
+                                 mesh=mesh, in_specs=P(), out_specs=P(),
+                                 check_vma=False)(x)
+
+        cb = _jaxpr_collective_bytes(compressed, x)
+        db = _jaxpr_collective_bytes(dense, x)
+        assert 0 < cb < db / 8, (cb, db)  # packed uint8 signs: >8x less wire
+
+    def test_engine_step_uses_packed_collectives(self, devices):
+        """The engine's 1-bit step must route through the packed compressed
+        allreduce: a uint8 all_to_all appears in the traced step (dense
+        Adam has none)."""
+        engine = self._engine("OneBitAdam", devices, freeze_step=0)
+        dp = engine.mesh.shape["dp"]
+        tok = np.zeros((2 * dp, 16), np.int32)
+        batch = {"input_ids": tok.reshape(1, 2 * dp, 16)}
+        fn = engine._build_train_batch_fn(1)
+        closed = jax.make_jaxpr(fn)(engine.state, batch, jax.random.key(0))
+
+        found = []
+
+        def walk(jaxpr):
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name == "all_to_all":
+                    found.append(eqn.invars[0].aval.dtype)
+                for sub in eqn.params.values():
+                    for s in (sub if isinstance(sub, (list, tuple)) else (sub,)):
+                        if hasattr(s, "eqns"):
+                            walk(s)
+                        elif hasattr(s, "jaxpr"):
+                            walk(s.jaxpr)
+
+        walk(closed.jaxpr)
+        assert any(dt == jnp.uint8 for dt in found), found
+
+    def test_incompatible_configs_raise(self, devices):
+        import deepspeed_tpu
+        import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.models import CausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+
+        dist.set_mesh(None)
+        model = CausalLM(TransformerConfig(vocab_size=64, n_layer=2, n_head=2, d_model=32,
+                                           max_seq=16))
+        config = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "OneBitAdam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "bf16": {"enabled": True},
+            "mesh": {"dp": -1},
+            "steps_per_print": 0,
+        }
+        with pytest.raises(NotImplementedError, match="ZeRO stage"):
+            deepspeed_tpu.initialize(model=model, config=config)
+        dist.set_mesh(None)
+
+    def test_build_optimizer_refuses_onebit(self):
+        from deepspeed_tpu.runtime.optimizers import build_optimizer
+        with pytest.raises(ValueError, match="engine-integrated"):
+            build_optimizer("onebitadam", {"lr": 1e-3})
