@@ -33,6 +33,8 @@ ADAGRAD_OPTIMIZER = "adagrad"
 ADAM_OPTIMIZER = "adam"
 ADAMW_OPTIMIZER = "adamw"
 LAMB_OPTIMIZER = "lamb"
+FUSED_ADAM_OPTIMIZER = "fusedadam"
+FUSED_LAMB_OPTIMIZER = "fusedlamb"
 ONEBIT_ADAM_OPTIMIZER = "onebitadam"
 ZERO_ONE_ADAM_OPTIMIZER = "zerooneadam"
 ONEBIT_LAMB_OPTIMIZER = "onebitlamb"

@@ -1,0 +1,3 @@
+from deepspeed_tpu.ops.lamb.fused_lamb_kernel import fused_lamb, fused_lamb_step
+
+__all__ = ["fused_lamb", "fused_lamb_step"]

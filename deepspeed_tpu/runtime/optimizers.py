@@ -65,6 +65,21 @@ def build_optimizer(name: Optional[str],
         return optax.chain(optax.scale_by_adam(b1=args["b1"], b2=args["b2"], eps=args["eps"]),
                            optax.add_decayed_weights(wd) if wd else optax.identity())
 
+    if name == config_core.FUSED_ADAM_OPTIMIZER:
+        # named Pallas fused op (reference csrc/adam/multi_tensor_adam.cu:163)
+        from deepspeed_tpu.ops.adam.fused_adam_kernel import fused_adam
+        args = _adam_args(params)
+        return fused_adam(b1=args["b1"], b2=args["b2"], eps=args["eps"],
+                          weight_decay=wd,
+                          adam_w_mode=params.get("adam_w_mode", True))
+
+    if name == config_core.FUSED_LAMB_OPTIMIZER:
+        # named Pallas fused op (reference csrc/lamb/fused_lamb_cuda_kernel.cu)
+        from deepspeed_tpu.ops.lamb.fused_lamb_kernel import fused_lamb
+        betas = params.get("betas", (0.9, 0.999))
+        return fused_lamb(b1=betas[0], b2=betas[1], eps=params.get("eps", 1e-6),
+                          weight_decay=wd)
+
     if name == config_core.LAMB_OPTIMIZER:
         betas = params.get("betas", (0.9, 0.999))
         return optax.chain(
