@@ -248,9 +248,11 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
     H, KV, Hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
 
     from jax.ad_checkpoint import checkpoint_name
-    bq = lp.get("bq", 0) if cfg.attn_bias else 0
-    bk = lp.get("bk", 0) if cfg.attn_bias else 0
-    bv = lp.get("bv", 0) if cfg.attn_bias else 0
+    # attn_bias=True REQUIRES all four bias tensors (loud KeyError on a
+    # params tree saved without them, consistent with the bo access below)
+    bq = lp["bq"] if cfg.attn_bias else 0
+    bk = lp["bk"] if cfg.attn_bias else 0
+    bv = lp["bv"] if cfg.attn_bias else 0
     q = checkpoint_name((x @ _w(lp["wq"], x) + bq).reshape(B, S, H, Hd), "q_proj")
     k = checkpoint_name((x @ _w(lp["wk"], x) + bk).reshape(B, S, KV, Hd), "k_proj")
     v = checkpoint_name((x @ _w(lp["wv"], x) + bv).reshape(B, S, KV, Hd), "v_proj")
@@ -472,9 +474,11 @@ def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad
     H, KV, Hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
     Smax = ck.shape[1]
 
-    bq = lp.get("bq", 0) if cfg.attn_bias else 0
-    bk = lp.get("bk", 0) if cfg.attn_bias else 0
-    bv = lp.get("bv", 0) if cfg.attn_bias else 0
+    # attn_bias=True REQUIRES all four bias tensors (loud KeyError on a
+    # params tree saved without them, consistent with the bo access below)
+    bq = lp["bq"] if cfg.attn_bias else 0
+    bk = lp["bk"] if cfg.attn_bias else 0
+    bv = lp["bv"] if cfg.attn_bias else 0
     q = (x @ _w(lp["wq"], x) + bq).reshape(B, T, H, Hd)
     k = (x @ _w(lp["wk"], x) + bk).reshape(B, T, KV, Hd)
     v = (x @ _w(lp["wv"], x) + bv).reshape(B, T, KV, Hd)

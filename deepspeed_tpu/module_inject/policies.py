@@ -106,6 +106,19 @@ class LlamaPolicy(HFPolicy):
     model_type = "llama"
 
     def zoo_config(self, hf):
+        scaling = hf.get("rope_scaling")
+        if scaling is not None:
+            # configs can spell plain rope explicitly: rope_type/type
+            # "default", or linear with factor 1.0 — those are no-ops
+            kind = scaling.get("rope_type", scaling.get("type", "default"))
+            noop = kind == "default" or (kind == "linear"
+                                         and float(scaling.get("factor", 1.0)) == 1.0)
+            if not noop:
+                # e.g. Llama-3.1 llama3/longrope scaling — silently loading it
+                # as plain rope would give wrong logits at long positions
+                raise NotImplementedError(
+                    f"llama rope_scaling={scaling!r}: scaled rope variants "
+                    "are not represented in the zoo transformer")
         return TransformerConfig(
             vocab_size=hf["vocab_size"], n_layer=hf["num_hidden_layers"],
             n_head=hf["num_attention_heads"], d_model=hf["hidden_size"],
@@ -218,6 +231,15 @@ class OPTPolicy(HFPolicy):
     model_type = "opt"
 
     def zoo_config(self, hf):
+        if not hf.get("do_layer_norm_before", True):
+            # opt-350m style post-LN — the zoo transformer is pre-LN only;
+            # loading it anyway would produce silently wrong logits
+            raise NotImplementedError(
+                "opt do_layer_norm_before=False (post-layernorm variant, e.g. "
+                "opt-350m) is not supported by the pre-LN zoo transformer")
+        if hf.get("_remove_final_layer_norm", False):
+            raise NotImplementedError(
+                "opt _remove_final_layer_norm=True checkpoints are not supported")
         return TransformerConfig(
             vocab_size=hf["vocab_size"], n_layer=hf["num_hidden_layers"],
             n_head=hf["num_attention_heads"], d_model=hf["hidden_size"],
@@ -285,7 +307,10 @@ class GPTNeoXPolicy(HFPolicy):
             pos_embedding="rope", norm="layernorm", activation="gelu",
             parallel_residual=bool(hf.get("use_parallel_residual", True)),
             tie_embeddings=bool(hf.get("tie_word_embeddings", False)), attn_bias=True,
-            rope_theta=float(hf.get("rotary_emb_base", 10000.0)),
+            # newer HF configs serialize the base as "rope_theta", older as
+            # "rotary_emb_base" — honor both so the base is never silently lost
+            rope_theta=float(hf.get("rotary_emb_base",
+                                    hf.get("rope_theta", 10000.0))),
             norm_eps=hf.get("layer_norm_eps", 1e-5))
 
     def map_params(self, get, cfg):

@@ -219,7 +219,12 @@ def fused_adam(learning_rate=None, b1=0.9, b2=0.999, eps=1e-8,
                 interpret=interpret, emit="update")
             u = u.reshape(p.shape)
             if learning_rate is not None:
-                u = (-learning_rate * u).astype(p.dtype)
+                # schedules (callables of the step count) resolve like optax
+                # optax evaluates schedules at the 0-based pre-increment
+                # count; our count is 1-based
+                lr_t = (learning_rate(count - 1) if callable(learning_rate)
+                        else learning_rate)
+                u = (-lr_t * u).astype(p.dtype)
             out_u.append(u)
             out_m.append(nm.reshape(p.shape))
             out_v.append(nv.reshape(p.shape))

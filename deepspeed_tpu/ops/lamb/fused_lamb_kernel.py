@@ -40,12 +40,11 @@ def _lamb_kernel(sc_ref, p_ref, g_ref, m_ref, v_ref,
                  *, b1, b2, eps, wd):
     """One tile: moments + un-scaled LAMB update + running Σp²/Σu².
 
-    sc_ref (SMEM f32[3]): [n_valid, 1-b1^t, 1-b2^t]. ``n_valid`` is the
-    un-padded element count — pad elements are zeros in g/m/v but p's pad
-    is also zero, so they contribute 0 to both norms and u (0/(√0+ε)=0);
-    no masking needed.
+    sc_ref (SMEM f32[2]): [1-b1^t, 1-b2^t]. Pad elements need no masking:
+    they are zeros in p/g/m/v, so they contribute 0 to both norms and to u
+    (0/(√0+ε)=0).
     """
-    bc1, bc2 = sc_ref[1], sc_ref[2]
+    bc1, bc2 = sc_ref[0], sc_ref[1]
     g = g_ref[:].astype(jnp.float32)
     p = p_ref[:].astype(jnp.float32)
     m = b1 * m_ref[:] + (1.0 - b1) * g
@@ -68,8 +67,10 @@ def _lamb_kernel(sc_ref, p_ref, g_ref, m_ref, v_ref,
     norms_ref[0, 1] += jnp.sum(u * u)
 
 
-@functools.partial(jax.jit, static_argnames=("b1", "b2", "eps", "wd", "interpret"))
-def _fused_lamb_flat(p, g, m, v, lr, bc1, bc2, *, b1, b2, eps, wd, interpret):
+@functools.partial(jax.jit, static_argnames=("b1", "b2", "eps", "wd", "emit",
+                                             "interpret"))
+def _fused_lamb_flat(p, g, m, v, lr, bc1, bc2, *, b1, b2, eps, wd, emit,
+                     interpret):
     n = p.shape[0]
     pad = (-n) % _BLOCK
     padded = n + pad
@@ -81,7 +82,7 @@ def _fused_lamb_flat(p, g, m, v, lr, bc1, bc2, *, b1, b2, eps, wd, interpret):
     rows = padded // _LANES
     grid = (rows // _BLOCK_ROWS,)
     spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i, sc: (i, 0))
-    scalars = jnp.stack([jnp.float32(n), bc1, bc2]).astype(jnp.float32)
+    scalars = jnp.stack([bc1, bc2]).astype(jnp.float32)
     kern = functools.partial(_lamb_kernel, b1=b1, b2=b2, eps=eps, wd=wd)
     u, mo, vo, norms = pl.pallas_call(
         kern,
@@ -112,12 +113,15 @@ def _fused_lamb_flat(p, g, m, v, lr, bc1, bc2, *, b1, b2, eps, wd, interpret):
         return flat[:n] if pad else flat
 
     u = unprep(u)
-    new_p = (p.astype(jnp.float32) - lr * ratio * u).astype(p.dtype)
+    # emit="update": callers apply ratio*u themselves — don't burn a
+    # param-sized multiply + cast + HBM write on a discarded new_p
+    new_p = ((p.astype(jnp.float32) - lr * ratio * u).astype(p.dtype)
+             if emit == "param" else None)
     return new_p, unprep(mo), unprep(vo), ratio, u
 
 
-@functools.partial(jax.jit, static_argnames=("b1", "b2", "eps", "wd"))
-def _jnp_lamb_flat(p, g, m, v, lr, bc1, bc2, *, b1, b2, eps, wd):
+@functools.partial(jax.jit, static_argnames=("b1", "b2", "eps", "wd", "emit"))
+def _jnp_lamb_flat(p, g, m, v, lr, bc1, bc2, *, b1, b2, eps, wd, emit):
     """Kernel math in plain jnp — off-TPU fallback (see fused_adam).
     Returns ``(new_p, m, v, ratio, u)`` like :func:`_fused_lamb_flat`."""
     g = g.astype(jnp.float32)
@@ -130,11 +134,12 @@ def _jnp_lamb_flat(p, g, m, v, lr, bc1, bc2, *, b1, b2, eps, wd):
     p_norm = jnp.linalg.norm(pf)
     u_norm = jnp.linalg.norm(u)
     ratio = jnp.where((p_norm > 0.0) & (u_norm > 0.0), p_norm / u_norm, 1.0)
-    return (pf - lr * ratio * u).astype(p.dtype), m, v, ratio, u
+    new_p = (pf - lr * ratio * u).astype(p.dtype) if emit == "param" else None
+    return new_p, m, v, ratio, u
 
 
 def _run_lamb(p, g, m, v, *, step, lr, b1, b2, eps, weight_decay,
-              bias_correction, interpret):
+              bias_correction, interpret, emit="param"):
     # interpret=None: compiled kernel on TPU, jnp elsewhere; True: kernel in
     # interpret mode; False: compiled kernel on any backend.
     use_kernel = True if interpret is not None else jax.default_backend() == "tpu"
@@ -145,7 +150,8 @@ def _run_lamb(p, g, m, v, *, step, lr, b1, b2, eps, weight_decay,
     else:
         bc1 = jnp.float32(1.0)
         bc2 = jnp.float32(1.0)
-    kw = dict(b1=float(b1), b2=float(b2), eps=float(eps), wd=float(weight_decay))
+    kw = dict(b1=float(b1), b2=float(b2), eps=float(eps), wd=float(weight_decay),
+              emit=emit)
     lr = jnp.asarray(lr, jnp.float32)
     if not use_kernel:
         return _jnp_lamb_flat(p, g, m, v, lr, bc1, bc2, **kw)
@@ -202,14 +208,19 @@ def fused_lamb(learning_rate=None, b1=0.9, b2=0.999, eps=1e-6,
             # (saves a pass over p and avoids bf16 cancellation)
             _, nm, nv, ratio, u = _run_lamb(
                 p.reshape(-1), g.reshape(-1), m.reshape(-1), v.reshape(-1),
-                step=count, lr=0.0,
+                step=count, lr=0.0, emit="update",
                 b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
                 bias_correction=bias_correction, interpret=interpret)
             u = (ratio * u).reshape(p.shape)
             if learning_rate is not None:
                 # standard optax deltas (apply_updates adds); None => engine
-                # applies p - lr*u with its scheduled lr
-                u = (-learning_rate * u).astype(p.dtype)
+                # applies p - lr*u with its scheduled lr. Schedules (callables
+                # of the step count) are resolved here like optax does.
+                # optax evaluates schedules at the 0-based pre-increment
+                # count; our count is 1-based
+                lr_t = (learning_rate(count - 1) if callable(learning_rate)
+                        else learning_rate)
+                u = (-lr_t * u).astype(p.dtype)
             out_u.append(u)
             out_m.append(nm.reshape(p.shape))
             out_v.append(nv.reshape(p.shape))

@@ -163,6 +163,11 @@ def spmd_pipeline_1f1b(embed_fn: Callable,
 
     Returns ``(mean_loss, grads)`` where grads covers the full params tree.
     ``cot_scale`` seeds the head cotangent (loss-scaling support).
+
+    Contract: ``embed_fn``/``head_loss_fn`` may read only the non-``stages``
+    subtree of params (embed/head/tied weights); their vjps run over that
+    subtree alone, so any read of ``params["stages"]`` would be treated as a
+    constant (stage grads flow exclusively through ``stage_fn``).
     """
     S = num_stages
     leaves = jax.tree.leaves(microbatches)
@@ -201,20 +206,27 @@ def spmd_pipeline_1f1b(embed_fn: Callable,
             return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, P(*spec)))
         return jax.tree.map(one, x)
 
+    # head/embed cotangents only touch the NON-stage subtree: vjp over the
+    # full tree would carry (and add, every tick) an all-zero second copy of
+    # every stage weight — double gradient memory and two wasted full-model
+    # HBM passes per tick
+    nonstage = {k: v for k, v in params.items() if k != "stages"}
+
+    def with_stages(pns):
+        return {**pns, "stages": stage_params}
+
     # shapes
     mb0 = mb_at(jnp.asarray(0, jnp.int32))
     x0 = embed_fn(params, mb0, rng)
 
     ring0 = constrain(jnp.zeros((S, R) + x0.shape, x0.dtype), batch_dim=2)
-    aux_ring0 = {k: constrain(jnp.zeros((S, R) + mb0[k].shape, mb0[k].dtype), batch_dim=2)
-                 for k in carry_keys}
     outs0 = constrain(jnp.zeros((S,) + x0.shape, x0.dtype))
     cots0 = constrain(jnp.zeros((S,) + x0.shape, x0.dtype))
     gstages0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), stage_params)
-    gfull0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), params)
+    gns0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), nonstage)
 
     def tick(state, t):
-        ring, aux_ring, prev_outs, cots, gstages, gfull, loss_sum = state
+        ring, prev_outs, cots, gstages, gns, loss_sum = state
 
         # ---- forward wave: stage s processes micro-batch t - s ----
         mb = mb_at(t)
@@ -228,10 +240,6 @@ def spmd_pipeline_1f1b(embed_fn: Callable,
         ring = jax.lax.dynamic_update_index_in_dim(
             jnp.swapaxes(ring, 0, 1), bufs_in, slot, 0)
         ring = jnp.swapaxes(ring, 0, 1)
-        for k in carry_keys:
-            r = jax.lax.dynamic_update_index_in_dim(
-                jnp.swapaxes(aux_ring[k], 0, 1), aux_in[k], slot, 0)
-            aux_ring[k] = jnp.swapaxes(r, 0, 1)
 
         fwd_keys = jax.vmap(lambda s: stage_key(s, t - s))(s_idx)
         outs = jax.vmap(stage_fn, in_axes=(0, 0, 0, 0))(
@@ -242,21 +250,22 @@ def spmd_pipeline_1f1b(embed_fn: Callable,
         mb_h = mb_at(t - (S - 1))
 
         def head_branch():
-            def f(p, x):
-                return head_loss_fn(p, x, mb_h, stage_key(S, t - (S - 1)))
-            loss_h, vjp = jax.vjp(f, params, outs[S - 1])
+            def f(pns, x):
+                return head_loss_fn(with_stages(pns), x, mb_h,
+                                    stage_key(S, t - (S - 1)))
+            loss_h, vjp = jax.vjp(f, nonstage, outs[S - 1])
             gp, gx = vjp(jnp.asarray(cot_scale, jnp.float32))
             return (loss_h.astype(jnp.float32),
                     jax.tree.map(lambda a: a.astype(jnp.float32), gp),
                     gx.astype(outs.dtype))
 
         def head_zeros():
-            return (jnp.float32(0.0), gfull0, jnp.zeros_like(outs[S - 1]))
+            return (jnp.float32(0.0), gns0, jnp.zeros_like(outs[S - 1]))
 
         valid_h = (t >= S - 1) & (t - (S - 1) < M)
         loss_h, gp_h, cot_head = jax.lax.cond(valid_h, head_branch, head_zeros)
         loss_sum = loss_sum + loss_h
-        gfull = jax.tree.map(jnp.add, gfull, gp_h)
+        gns = jax.tree.map(jnp.add, gns, gp_h)
 
         # ---- backward wave: stage s backwards micro-batch t - 2(S-1) + s ----
         m_b = t - 2 * (S - 1) + s_idx                  # per stage
@@ -264,9 +273,10 @@ def spmd_pipeline_1f1b(embed_fn: Callable,
         read_slot = jnp.mod(t - (2 * (S - 1) - 2 * s_idx), R)
         x_saved = jax.vmap(lambda s, i: jax.lax.dynamic_index_in_dim(ring[s], i, 0, keepdims=False),
                            in_axes=(0, 0))(s_idx, read_slot)
-        aux_saved = {k: jax.vmap(lambda s, i: jax.lax.dynamic_index_in_dim(
-            aux_ring[k][s], i, 0, keepdims=False), in_axes=(0, 0))(s_idx, read_slot)
-            for k in carry_keys}
+        # aux values are pure functions of the micro-batch index (they ride
+        # along unchanged through stages), so the backward wave re-gathers
+        # them exactly like the forward wave — no aux ring buffers needed
+        aux_saved = {k: jax.vmap(lambda m: mb_at(m)[k])(m_b) for k in carry_keys}
         bwd_keys = jax.vmap(lambda s, m: stage_key(s, m))(s_idx, m_b)
 
         cot_in = cots.at[S - 1].set(cot_head)
@@ -288,24 +298,26 @@ def spmd_pipeline_1f1b(embed_fn: Callable,
         mb_b0 = mb_at(m_b0)
 
         def embed_branch():
-            _, vjp = jax.vjp(lambda p: embed_fn(p, mb_b0, stage_key(0, m_b0)), params)
+            _, vjp = jax.vjp(
+                lambda pns: embed_fn(with_stages(pns), mb_b0, stage_key(0, m_b0)),
+                nonstage)
             (gp,) = vjp(dx[0])
             return jax.tree.map(lambda a: a.astype(jnp.float32), gp)
 
-        gp_e = jax.lax.cond((m_b0 >= 0) & (m_b0 < M), embed_branch, lambda: gfull0)
-        gfull = jax.tree.map(jnp.add, gfull, gp_e)
+        gp_e = jax.lax.cond((m_b0 >= 0) & (m_b0 < M), embed_branch, lambda: gns0)
+        gns = jax.tree.map(jnp.add, gns, gp_e)
 
         # cotangents roll backward one stage; slot S-1 is re-seeded next tick
         cots = constrain(jnp.roll(dx, -1, axis=0))
         prev_outs = constrain(outs)
-        return (ring, aux_ring, prev_outs, cots, gstages, gfull, loss_sum), None
+        return (ring, prev_outs, cots, gstages, gns, loss_sum), None
 
-    init = (ring0, aux_ring0, outs0, cots0, gstages0, gfull0, jnp.zeros((), jnp.float32))
-    (ring, aux_ring, _, _, gstages, gfull, loss_sum), _ = jax.lax.scan(
+    init = (ring0, outs0, cots0, gstages0, gns0, jnp.zeros((), jnp.float32))
+    (ring, _, _, gstages, gns, loss_sum), _ = jax.lax.scan(
         tick, init, jnp.arange(T, dtype=jnp.int32))
 
-    grads = dict(gfull)
-    grads["stages"] = jax.tree.map(jnp.add, gfull["stages"], gstages)
+    grads = dict(gns)
+    grads["stages"] = gstages
     return loss_sum / M, grads
 
 

@@ -119,6 +119,25 @@ def test_fused_lamb_matches_optax_lamb():
     assert _tree_err(fp, rp) < 1e-6
 
 
+def test_fused_adam_schedule_learning_rate():
+    """optax schedules (callables of the step count) work as learning_rate."""
+    sched = optax.cosine_decay_schedule(1e-3, decay_steps=100)
+    params = {"w": jnp.ones((8, 8), jnp.float32)}
+    grads = {"w": jnp.full((8, 8), 0.1, jnp.float32)}
+    ftx = fused_adam(sched)
+    rtx = optax.adamw(sched, weight_decay=0.0)
+    fst, rst = ftx.init(params), rtx.init(params)
+    fp, rp = params, params
+    for _ in range(3):
+        fu, fst = ftx.update(grads, fst, fp)
+        fp = optax.apply_updates(fp, fu)
+        ru, rst = rtx.update(grads, rst, rp)
+        rp = optax.apply_updates(rp, ru)
+    assert _tree_err(fp, rp) < 2e-6
+    lu, _ = fused_lamb(sched).update(grads, fused_lamb(sched).init(params), params)
+    assert jnp.all(jnp.isfinite(lu["w"]))
+
+
 def test_fused_lamb_zero_norm_ratio_is_one():
     p = jnp.zeros(1000, jnp.float32)
     g = jnp.ones(1000, jnp.float32)

@@ -113,6 +113,37 @@ class TestHFPolicies:
         with pytest.raises(NotImplementedError, match="rotary_pct"):
             load_hf_checkpoint(d)
 
+    def test_opt_post_ln_rejected(self):
+        from deepspeed_tpu.module_inject.policies import policy_for
+        hf = dict(vocab_size=96, hidden_size=32, num_hidden_layers=1,
+                  num_attention_heads=2, ffn_dim=64, max_position_embeddings=32,
+                  do_layer_norm_before=False)
+        with pytest.raises(NotImplementedError, match="do_layer_norm_before"):
+            policy_for("opt").zoo_config(hf)
+
+    def test_llama_rope_scaling_rejected(self):
+        from deepspeed_tpu.module_inject.policies import policy_for
+        hf = dict(vocab_size=96, hidden_size=32, num_hidden_layers=1,
+                  num_attention_heads=2, intermediate_size=64,
+                  rope_scaling={"rope_type": "llama3", "factor": 8.0})
+        with pytest.raises(NotImplementedError, match="rope_scaling"):
+            policy_for("llama").zoo_config(hf)
+        # explicit no-op spellings of plain rope must still load
+        hf["rope_scaling"] = {"rope_type": "default"}
+        assert policy_for("llama").zoo_config(hf).pos_embedding == "rope"
+        hf["rope_scaling"] = {"type": "linear", "factor": 1.0}
+        assert policy_for("llama").zoo_config(hf).pos_embedding == "rope"
+
+    def test_neox_rope_theta_field_name(self):
+        from deepspeed_tpu.module_inject.policies import policy_for
+        base = dict(vocab_size=96, hidden_size=32, num_hidden_layers=1,
+                    num_attention_heads=2, intermediate_size=64,
+                    max_position_embeddings=32)
+        cfg = policy_for("gpt_neox").zoo_config({**base, "rope_theta": 500000.0})
+        assert cfg.rope_theta == 500000.0
+        cfg = policy_for("gpt_neox").zoo_config({**base, "rotary_emb_base": 20000.0})
+        assert cfg.rope_theta == 20000.0
+
     def test_unknown_arch_rejected(self, tmp_path):
         os.makedirs(tmp_path, exist_ok=True)
         with open(tmp_path / "config.json", "w") as f:
