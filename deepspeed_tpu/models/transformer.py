@@ -489,6 +489,18 @@ def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
 
+    if T == 1 and _use_flash(cfg):
+        # fused decode kernel: streams the cache once, no GQA repeat copy
+        # (reference softmax_context, pt_binding.cpp:1668-1793)
+        from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+        slopes = _alibi_slopes(H) if cfg.pos_embedding == "alibi" else None
+        o = decode_attention(q[:, 0], ck, cv, pos, pad_bias=pad_bias,
+                             alibi_slopes=slopes)
+        if o is not None:
+            out = o.reshape(B, 1, H * Hd)
+            out = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
+            return out, ck, cv
+
     kk, vv = ck, cv
     if KV != H:
         rep = H // KV

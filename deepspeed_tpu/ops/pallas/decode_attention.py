@@ -1,0 +1,157 @@
+"""Pallas decode attention: one new token per sequence against the KV cache.
+
+TPU-native replacement for the reference's fused ``softmax_context`` decode
+kernel (KV-append + attention over the cached keys,
+``csrc/transformer/inference/csrc/pt_binding.cpp:1668-1793``; workspace
+``csrc/transformer/inference/includes/inference_context.h:49``).
+
+Decode attention is HBM-bandwidth-bound: the cost is streaming the KV cache
+once. The einsum fallback pays 3× that for GQA models because
+``jnp.repeat`` materialises an H/KV-times-larger copy of both cache halves
+before the dot. This kernel:
+
+* streams k/v blocks straight from the ``[B, Smax, KV, Hd]`` cache layout
+  (no repeat, no transpose) — each of the P = H/KV query heads of a kv
+  group shares the block while it sits in VMEM;
+* keeps the running (m, l, acc) streaming-softmax state in VMEM scratch
+  across the sequence-block grid dimension, writing the ``[P, Hd]`` output
+  tile once;
+* masks ``kpos > pos`` blocks entirely (``pl.when``), so dead cache tail
+  blocks cost a DMA but no FLOPs;
+* supports ALiBi slopes and an additive key-side pad bias ``[B, Smax]``
+  (left-padded prompt slots).
+
+Grid: ``(B, KV, Smax/bk)`` — sequence blocks innermost so scratch carries.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+
+
+def _kernel(pos_ref, q_ref, k_ref, v_ref, bias_ref, slope_ref, o_ref,
+            m_ref, l_ref, acc_ref, *, bk, n_blocks, has_bias, has_alibi):
+    i = pl.program_id(2)
+    pos = pos_ref[0]
+
+    @pl.when(i == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    koff = i * bk
+    run = koff <= pos  # whole block beyond the cached prefix → skip
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)            # [P, Hd] (pre-scaled)
+        k = k_ref[0, :, 0].astype(jnp.float32)          # [bk, Hd]
+        v = v_ref[0, :, 0].astype(jnp.float32)          # [bk, Hd]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [P, bk]
+        kpos = koff + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if has_alibi:
+            s = s + slope_ref[0][:, None] * (kpos - pos).astype(jnp.float32)
+        if has_bias:
+            s = s + bias_ref[0][None, :]
+        s = jnp.where(kpos <= pos, s, _NEG)
+
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        # m/l live lane-broadcast in (P, 128) scratch (full-vreg stores)
+        l_ref[:] = l_ref[:] * alpha[:, None] + jnp.sum(p, axis=1)[:, None]
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + p @ v
+
+    @pl.when(i == n_blocks - 1)
+    def _():
+        o_ref[0, 0] = (acc_ref[:] / l_ref[:, 0][:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bk", "has_bias", "has_alibi",
+                                             "interpret"))
+def _decode_call(q, ck, cv, pos, bias, slopes, *, bk, has_bias, has_alibi,
+                 interpret):
+    B, KV, P, Hd = q.shape
+    Smax = ck.shape[1]
+    n_blocks = Smax // bk
+    grid = (B, KV, n_blocks)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, P, Hd), lambda b, g, i, sc: (b, g, 0, 0)),
+        pl.BlockSpec((1, bk, 1, Hd), lambda b, g, i, sc: (b, i, g, 0)),
+        pl.BlockSpec((1, bk, 1, Hd), lambda b, g, i, sc: (b, i, g, 0)),
+        pl.BlockSpec((1, bk), lambda b, g, i, sc: (b, i)),       # pad bias
+        pl.BlockSpec((1, P), lambda b, g, i, sc: (g, 0)),        # alibi slopes
+    ]
+    out = pl.pallas_call(
+        functools.partial(_kernel, bk=bk, n_blocks=n_blocks,
+                          has_bias=has_bias, has_alibi=has_alibi),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, 1, P, Hd), lambda b, g, i, sc: (b, g, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((P, 128), jnp.float32),  # running max (lane-bcast)
+                pltpu.VMEM((P, 128), jnp.float32),  # running denom
+                pltpu.VMEM((P, Hd), jnp.float32),   # running numerator
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, KV, P, Hd), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(pos, jnp.int32).reshape(1), q, ck, cv, bias, slopes)
+    return out
+
+
+def decode_attention(q, ck, cv, pos, *, pad_bias=None, alibi_slopes=None,
+                     scale: Optional[float] = None,
+                     interpret: Optional[bool] = None):
+    """Attention of one new token per sequence against the KV cache.
+
+    q ``[B, H, Hd]`` (the single new token's heads, rope already applied);
+    ck/cv ``[B, Smax, KV, Hd]`` with the new k/v already written at ``pos``;
+    ``pos`` [] int32 — the new token's 0-based position (attends ``<= pos``).
+    GQA head h reads kv head ``h // (H // KV)`` (``jnp.repeat`` order).
+    Returns ``[B, H, Hd]``.
+
+    Returns None when the shape is outside the kernel's envelope (caller
+    falls back to the einsum path): Smax not divisible by the 128 block,
+    or head_dim not lane-aligned.
+    """
+    B, H, Hd = q.shape
+    Smax, KV = ck.shape[1], ck.shape[2]
+    if H % KV != 0 or Hd % 64 != 0:
+        return None
+    bk = next((b for b in (512, 256, 128) if Smax % b == 0), None)
+    if bk is None:
+        return None
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    P = H // KV
+    scale = Hd**-0.5 if scale is None else scale
+    qg = (q * scale).reshape(B, KV, P, Hd)
+    if pad_bias is None:
+        bias = jnp.zeros((B, Smax), jnp.float32)
+    else:
+        bias = pad_bias.astype(jnp.float32)
+    if alibi_slopes is None:
+        slopes = jnp.zeros((KV, P), jnp.float32)
+    else:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(KV, P)
+    out = _decode_call(qg, ck, cv, pos, bias, slopes, bk=bk,
+                       has_bias=pad_bias is not None,
+                       has_alibi=alibi_slopes is not None,
+                       interpret=bool(interpret))
+    return out.reshape(B, H, Hd)
