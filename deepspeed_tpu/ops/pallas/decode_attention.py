@@ -88,11 +88,19 @@ def _decode_call(q, ck, cv, pos, bias, slopes, *, bk, has_bias, has_alibi,
     n_blocks = Smax // bk
     grid = (B, KV, n_blocks)
 
+    # clamp the sequence-block index at the last block containing pos: dead
+    # tail iterations revisit that block, which the pipeline does NOT
+    # re-fetch — the kernel is bandwidth-bound, so with a workspace much
+    # larger than the live prefix this is the dominant saving (the pl.when
+    # guard then skips their FLOPs too)
+    def kv_idx(b, g, i, sc):
+        return (b, jnp.minimum(i, sc[0] // bk), g, 0)
+
     in_specs = [
         pl.BlockSpec((1, 1, P, Hd), lambda b, g, i, sc: (b, g, 0, 0)),
-        pl.BlockSpec((1, bk, 1, Hd), lambda b, g, i, sc: (b, i, g, 0)),
-        pl.BlockSpec((1, bk, 1, Hd), lambda b, g, i, sc: (b, i, g, 0)),
-        pl.BlockSpec((1, bk), lambda b, g, i, sc: (b, i)),       # pad bias
+        pl.BlockSpec((1, bk, 1, Hd), kv_idx),
+        pl.BlockSpec((1, bk, 1, Hd), kv_idx),
+        pl.BlockSpec((1, bk), lambda b, g, i, sc: (b, jnp.minimum(i, sc[0] // bk))),
         pl.BlockSpec((1, P), lambda b, g, i, sc: (g, 0)),        # alibi slopes
     ]
     out = pl.pallas_call(

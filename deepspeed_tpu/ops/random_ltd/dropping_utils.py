@@ -1,0 +1,61 @@
+"""Random-LTD op surface (reference ``deepspeed/ops/random_ltd/dropping_utils.py``
+backed by ``csrc/random_ltd/{token_sort,gather_scatter,slice_attn_masks}.cu``).
+
+The CUDA kernels exist because torch needs a comparison-free device sort and
+explicit gather/scatter launches; on TPU these are ``jax.random.permutation``
++ ``jnp.take``/``dynamic_update`` which XLA schedules natively, so this
+module is the named-op façade over
+:mod:`deepspeed_tpu.runtime.data_pipeline.data_routing.basic_layer` plus the
+reference's sampling entry points.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.data_pipeline.data_routing.basic_layer import (
+    gather_tokens, scatter_tokens, slice_attention_mask, token_sample)
+
+__all__ = ["gpt_sample_tokens", "bert_sample_tokens", "gather_tokens",
+           "scatter_tokens", "slice_attention_mask", "token_sample"]
+
+
+def gpt_sample_tokens(reserved_length: int, seq_length: int, batch_size: int,
+                      layers: int = 1, rng=None, attn_mask=None):
+    """Per-layer sorted token subsets for causal models (reference
+    ``dropping_utils.py:16``): one index set per layer, shared across the
+    batch; the causal mask is sliced to the kept tokens.
+
+    Returns ``(indices [layers, reserved], sliced_mask or None)``.
+    """
+    rng = jax.random.key(0) if rng is None else rng
+    keys = jax.random.split(rng, layers)
+    idx = jnp.stack([token_sample(k, seq_length, reserved_length) for k in keys])
+    mask = None
+    if attn_mask is not None:
+        mask = jnp.stack([slice_attention_mask(attn_mask, idx[l])
+                          for l in range(layers)])
+    return idx, mask
+
+
+def bert_sample_tokens(reserved_length: int, seq_length: int, batch_size: int,
+                       layers: int = 1, rng=None, attn_mask=None):
+    """Per-(layer, batch) sorted subsets for bidirectional models (reference
+    ``dropping_utils.py:50``: each sequence samples independently).
+
+    Returns ``(indices [layers, batch, reserved], sliced_mask or None)``.
+    """
+    rng = jax.random.key(0) if rng is None else rng
+    keys = jax.random.split(rng, layers * batch_size).reshape(layers, batch_size)
+    idx = jnp.stack([
+        jnp.stack([token_sample(keys[l, b], seq_length, reserved_length)
+                   for b in range(batch_size)])
+        for l in range(layers)])
+    mask = None
+    if attn_mask is not None:
+        mask = jnp.stack([
+            jnp.stack([slice_attention_mask(attn_mask[b:b + 1], idx[l, b])[0]
+                       for b in range(batch_size)])
+            for l in range(layers)])
+    return idx, mask

@@ -1,0 +1,26 @@
+"""Sparse-attention op surface (reference Triton kernels
+``deepspeed/ops/sparse_attention/matmul.py`` block-sparse sdd/dsd matmuls
+with LUTs + ``softmax.py``; C++ LUT segmentation ``csrc/sparse_attention/
+utils.cpp``).
+
+TPU design note: the Triton+LUT machinery exists to skip zero blocks in a
+hand-written GPU kernel. The Pallas flash kernel takes the block layout
+directly (``block_layout`` argument — diagonal blocks, local windows,
+global tokens) and skips masked blocks inside its own grid, so the LUT
+builder collapses into :func:`SparsityConfig.make_layout`. This module is
+the named-op home: layout construction + the layout-aware attention call.
+"""
+
+from __future__ import annotations
+
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+    SparseSelfAttention, layout_to_token_bias)
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, LocalSlidingWindowSparsityConfig, SparsityConfig,
+    VariableSparsityConfig)
+
+__all__ = ["SparseSelfAttention", "layout_to_token_bias", "SparsityConfig",
+           "DenseSparsityConfig", "FixedSparsityConfig",
+           "VariableSparsityConfig", "BigBirdSparsityConfig",
+           "BSLongformerSparsityConfig", "LocalSlidingWindowSparsityConfig"]

@@ -1,0 +1,30 @@
+"""Spatial (diffusers/UNet) ops — reference ``csrc/spatial/csrc/opt_bias_add.cu``
+(``nhwc_bias_add``, ``nhwc_bias_add_add``, ``nhwc_bias_add_bias_add``) bound
+via ``csrc/spatial/csrc/pt_binding.cpp``.
+
+The CUDA kernels exist to get vectorized NHWC bias broadcasts without a
+torch kernel launch per op; on TPU these are single fused XLA elementwise
+ops — the named functions keep the reference's call surface (and NHWC
+layout, which is also TPU's preferred conv layout).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["nhwc_bias_add", "nhwc_bias_add_add", "nhwc_bias_add_bias_add"]
+
+
+def nhwc_bias_add(activation, bias):
+    """[N, H, W, C] + [C]."""
+    return activation + bias.reshape((1,) * (activation.ndim - 1) + (-1,))
+
+
+def nhwc_bias_add_add(activation, bias, other):
+    """(a + bias) + other — fused residual form."""
+    return nhwc_bias_add(activation, bias) + other
+
+
+def nhwc_bias_add_bias_add(activation, bias, other, other_bias):
+    """(a + bias) + (other + other_bias) — double-bias residual form."""
+    return nhwc_bias_add(activation, bias) + nhwc_bias_add(other, other_bias)

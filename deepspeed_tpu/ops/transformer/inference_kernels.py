@@ -1,0 +1,45 @@
+"""Inference transformer op surface — the named-op home of the generative
+decode path (reference ``csrc/transformer/inference/csrc/pt_binding.cpp``
+export list :1668-1793: ``qkv_gemm``, ``softmax_context`` (KV-append +
+attention), ``mlp_gemm``, ``residual_add_bias``, rotary embedding,
+workspace ``allocate_workspace_*``).
+
+On TPU the gemm+bias+norm fusions are XLA's job; the ops that need names
+are the ones with real machinery behind them:
+
+- ``softmax_context`` → :func:`decode_attention` (Pallas flash-decode over
+  the KV cache, ``ops/pallas/decode_attention.py``);
+- workspace management → :func:`init_kv_cache` +
+  ``inference/engine.py``'s persistent bucketed decode workspace;
+- rotary embedding → the zoo's :func:`apply_rotary_pos_emb`;
+- the whole per-layer pipeline → :func:`forward_cached` (prefill + decode
+  against the cache in one jitted program).
+"""
+
+from __future__ import annotations
+
+from deepspeed_tpu.models.transformer import (forward_cached, init_kv_cache)
+from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+
+
+def apply_rotary_pos_emb(x, positions, theta: float = 10000.0):
+    """Rotary embedding on [B, T, H, Hd] at the given absolute positions
+    (reference ``apply_rotary_pos_emb.cu``)."""
+    from deepspeed_tpu.models.transformer import _rope
+    return _rope(x, positions, theta)
+
+
+def softmax_context(q, ck, cv, pos, *, pad_bias=None, alibi_slopes=None):
+    """Reference-named alias for the fused decode attention op
+    (``pt_binding.cpp`` ``softmax_context``: attention of new tokens against
+    the appended KV cache). Single-token decode form."""
+    out = decode_attention(q, ck, cv, pos, pad_bias=pad_bias,
+                           alibi_slopes=alibi_slopes)
+    if out is None:
+        raise ValueError("shape outside the decode kernel envelope; use "
+                         "models.transformer.forward_cached (einsum fallback)")
+    return out
+
+
+__all__ = ["forward_cached", "init_kv_cache", "decode_attention",
+           "softmax_context", "apply_rotary_pos_emb"]

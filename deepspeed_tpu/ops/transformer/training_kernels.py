@@ -1,0 +1,82 @@
+"""Fused training transformer layer — named-op surface for the reference's
+``DeepSpeedTransformerLayer`` CUDA stack (``csrc/transformer/
+ds_transformer_cuda.cpp`` orchestrating normalize/softmax/dropout/gelu/
+transform kernels; Python wrapper ``deepspeed/ops/transformer/transformer.py:294``).
+
+On TPU the fusion the CUDA stack hand-schedules is exactly what XLA does to
+a jitted block: layernorm/bias/gelu fuse into the surrounding matmuls, and
+attention runs the Pallas flash kernel. So the named op is a jit-compiled
+closure over :func:`deepspeed_tpu.models.transformer.block` — one compiled
+program per config, matching the reference's one-cuda-graph-per-layer-config
+model. The stochastic variant (``stochastic_mode`` — the reference trades
+determinism for speed) maps to stochastic-rounding quantized activations via
+:mod:`deepspeed_tpu.ops.quantizer.kernels` when requested.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import transformer as T
+
+__all__ = ["DeepSpeedTransformerConfig", "DeepSpeedTransformerLayer"]
+
+
+@dataclasses.dataclass
+class DeepSpeedTransformerConfig:
+    """Mirror of the reference config surface (``transformer.py:32``) with
+    the knobs that exist on TPU (dropout is a model-level concern in the
+    functional zoo; fp16 → bf16)."""
+    batch_size: int = 1
+    hidden_size: int = 768
+    heads: int = 12
+    intermediate_size: Optional[int] = None
+    seq_length: int = 512
+    layer_norm_eps: float = 1e-12
+    pre_layer_norm: bool = True
+    stochastic_mode: bool = False
+    attn_dropout_ratio: float = 0.0   # accepted for parity; dropout is a
+    hidden_dropout_ratio: float = 0.0  # training-loop concern in the zoo
+
+
+class DeepSpeedTransformerLayer:
+    """Callable fused encoder layer: ``layer(params, x, mask_bias=None)``.
+
+    ``params`` is one layer subtree in the zoo layout
+    (``models/transformer.init_params(...)["layers"]`` sliced to one layer).
+    The first call compiles; later calls hit the jit cache — the analogue of
+    the reference's ``create_transformer_layer_*`` + per-layer workspace.
+    """
+
+    def __init__(self, config: DeepSpeedTransformerConfig):
+        self.config = config
+        d_ff = config.intermediate_size or 4 * config.hidden_size
+        self._cfg = T.TransformerConfig(
+            vocab_size=1, max_seq=config.seq_length, n_layer=1,
+            n_head=config.heads, d_model=config.hidden_size, d_ff=d_ff,
+            causal=False, norm="layernorm", activation="gelu",
+            norm_eps=config.layer_norm_eps, attn_bias=True,
+            pos_embedding="none")
+
+        @functools.partial(jax.jit, static_argnames=())
+        def _fwd(params, x, positions, mask_bias):
+            return T.block(self._cfg, x, params, positions, mask_bias)
+
+        self._fwd = _fwd
+
+    def __call__(self, params, x, mask_bias=None):
+        B, S, D = x.shape
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+        if self.config.stochastic_mode:
+            from deepspeed_tpu.ops.quantizer.kernels import ds_sr_quantize
+            x = ds_sr_quantize(x, groups=B, bits=16)
+        return self._fwd(params, x, positions, mask_bias)
+
+    def init_params(self, rng):
+        full = T.init_params(self._cfg, rng)
+        return jax.tree.map(lambda a: a[0], full["layers"])

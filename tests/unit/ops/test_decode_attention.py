@@ -66,9 +66,14 @@ def test_decode_bf16_cache():
 
 
 def test_decode_envelope_fallback():
-    """Shapes outside the kernel envelope return None (caller falls back)."""
-    q = jnp.zeros((1, 6, 48), jnp.float32)          # Hd not 64-aligned
-    ck = jnp.zeros((1, 100, 6, 48), jnp.float32)    # Smax not 128-aligned
+    """Each envelope-rejection condition independently returns None."""
+    # Hd not 64-aligned (Smax fine)
+    q = jnp.zeros((1, 6, 48), jnp.float32)
+    ck = jnp.zeros((1, 128, 6, 48), jnp.float32)
+    assert decode_attention(q, ck, ck, 0) is None
+    # Smax not 128-divisible (Hd fine)
+    q = jnp.zeros((1, 6, 64), jnp.float32)
+    ck = jnp.zeros((1, 100, 6, 64), jnp.float32)
     assert decode_attention(q, ck, ck, 0) is None
 
 
