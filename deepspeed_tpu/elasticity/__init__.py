@@ -8,9 +8,12 @@ from deepspeed_tpu.elasticity.elasticity import (compute_elastic_config,
                                                  ensure_immutable_elastic_config,
                                                  get_candidate_batch_sizes,
                                                  get_valid_gpus)
+from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent, RunResult,
+                                                    WorkerSpec, WorkerState)
 
 __all__ = [
     "ElasticityConfig", "ElasticityError", "ElasticityConfigError",
     "ElasticityIncompatibleWorldSize", "compute_elastic_config",
     "ensure_immutable_elastic_config", "get_candidate_batch_sizes", "get_valid_gpus",
+    "DSElasticAgent", "WorkerSpec", "WorkerState", "RunResult",
 ]

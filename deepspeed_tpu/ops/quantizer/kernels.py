@@ -79,10 +79,16 @@ def ds_quantize_asym(x, groups: int, bits: int = 8):
 
 def _sr_kernel(seed_ref, x_ref, scale_ref, o_ref, *, qmax, n_cols):
     i, j = pl.program_id(0), pl.program_id(1)
-    pltpu.prng_seed(seed_ref[0] + i * n_cols + j)
+    # mix the user seed (odd multiplicative hash, int32 wraparound is fine)
+    # so seed=step streams don't collide with adjacent blocks' streams at
+    # neighbouring steps
+    pltpu.prng_seed(seed_ref[0] * 1000003 + i * n_cols + j)
     bits = pltpu.prng_random_bits(x_ref.shape)
-    # uint32 → uniform [0, 1): top 24 bits scaled by 2^-24
-    u = (bits >> 8).astype(jnp.float32) * (1.0 / 16777216.0)
+    # prng_random_bits is int32: mask to the low 24 bits (non-negative
+    # regardless of sign) → uniform [0, 1). An arithmetic >> of negative
+    # draws would put u in [-0.5, 0) and bias every element low by half a
+    # step.
+    u = (bits & 0x00FFFFFF).astype(jnp.float32) * (1.0 / 16777216.0)
     scaled = x_ref[:] / scale_ref[:]
     q = jnp.clip(jnp.floor(scaled + u), -qmax - 1.0, qmax)
     o_ref[:] = q * scale_ref[:]

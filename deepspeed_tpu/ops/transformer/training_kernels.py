@@ -27,6 +27,11 @@ from deepspeed_tpu.models import transformer as T
 __all__ = ["DeepSpeedTransformerConfig", "DeepSpeedTransformerLayer"]
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _block_fwd(cfg, params, x, positions, mask_bias):
+    return T.block(cfg, x, params, positions, mask_bias)
+
+
 @dataclasses.dataclass
 class DeepSpeedTransformerConfig:
     """Mirror of the reference config surface (``transformer.py:32``) with
@@ -63,18 +68,22 @@ class DeepSpeedTransformerLayer:
             norm_eps=config.layer_norm_eps, attn_bias=True,
             pos_embedding="none")
 
-        @functools.partial(jax.jit, static_argnames=())
-        def _fwd(params, x, positions, mask_bias):
-            return T.block(self._cfg, x, params, positions, mask_bias)
+        # bound method over the shared module-level jit: N identically
+        # configured layers share ONE compiled program (cfg is a hashable
+        # static arg), matching the reference's per-config CUDA graph
+        self._fwd = functools.partial(_block_fwd, self._cfg)
+        self._step = 0
 
-        self._fwd = _fwd
-
-    def __call__(self, params, x, mask_bias=None):
+    def __call__(self, params, x, mask_bias=None, seed=None):
         B, S, D = x.shape
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
         if self.config.stochastic_mode:
             from deepspeed_tpu.ops.quantizer.kernels import ds_sr_quantize
-            x = ds_sr_quantize(x, groups=B, bits=16)
+            # a fresh seed per call: SR's error-averaging needs a different
+            # rounding realization every step
+            if seed is None:
+                seed, self._step = self._step, self._step + 1
+            x = ds_sr_quantize(x, groups=B, bits=16, seed=seed)
         return self._fwd(params, x, positions, mask_bias)
 
     def init_params(self, rng):

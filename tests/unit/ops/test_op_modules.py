@@ -93,6 +93,19 @@ class TestTransformerLayer:
         assert all(bool(jnp.isfinite(a).all()) for a in jax.tree.leaves(g))
 
 
+class TestTransformerLayerSharing:
+    def test_identical_configs_share_compiled_fn(self):
+        from deepspeed_tpu.ops.transformer.training_kernels import (
+            DeepSpeedTransformerConfig, DeepSpeedTransformerLayer, _block_fwd)
+        a = DeepSpeedTransformerLayer(DeepSpeedTransformerConfig(
+            hidden_size=64, heads=4, seq_length=32))
+        b = DeepSpeedTransformerLayer(DeepSpeedTransformerConfig(
+            hidden_size=64, heads=4, seq_length=32))
+        # both layers route through the one module-level jitted function
+        assert a._fwd.func is _block_fwd and b._fwd.func is _block_fwd
+        assert a._cfg == b._cfg  # same static key -> same compile-cache entry
+
+
 class TestSpatial:
     def test_bias_add_variants(self):
         from deepspeed_tpu.ops.spatial.kernels import (

@@ -1,0 +1,99 @@
+"""TiledLinear / eigenvalue / sparse-grad parity components.
+
+Reference analogues: ``tests/unit/runtime/zero/test_zero_tiled.py``, the
+eigenvalue path of ``runtime/quantize.py``, and the engine's sparse
+allreduce tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.sparse_tensor import SparseTensor, sparse_all_reduce
+from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+
+
+class TestTiledLinear:
+    @pytest.mark.parametrize("in_splits,out_splits", [(1, 1), (2, 4), (4, 2)])
+    @pytest.mark.parametrize("scan_tiles", [False, True])
+    def test_matches_dense(self, in_splits, out_splits, scan_tiles):
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.normal(size=(32, 64)), jnp.float32)
+        b = jnp.asarray(rng.normal(size=64), jnp.float32)
+        x = jnp.asarray(rng.normal(size=(3, 5, 32)), jnp.float32)
+        tl = TiledLinear(32, 64, in_splits, out_splits, scan_tiles=scan_tiles)
+        p = tl.from_dense(w, b)
+        want = x @ w + b
+        got = tl(p, x)
+        assert float(jnp.abs(got - want).max()) < 1e-4
+        assert float(jnp.abs(tl.to_dense(p) - w).max()) == 0.0
+
+    def test_uneven_split_rejected(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            TiledLinear(30, 64, in_splits=4)
+
+    def test_init_shapes(self):
+        tl = TiledLinear(32, 64, 2, 4)
+        p = tl.init_params(jax.random.key(0))
+        assert p["w"].shape == (4, 2, 16, 16)
+        assert p["b"].shape == (64,)
+
+
+class TestEigenvalue:
+    def test_known_quadratic(self):
+        """L = 0.5 xᵀAx per block: block eigs 4 and 10 → [0.4, 1.0]."""
+        rng = np.random.default_rng(0)
+        A1 = jnp.asarray(np.diag([4.0, 1.0, 0.5]), jnp.float32)
+        A2 = jnp.asarray(np.diag([10.0, 2.0]), jnp.float32)
+        params = {"a": jnp.asarray(rng.normal(size=3), jnp.float32),
+                  "b": jnp.asarray(rng.normal(size=2), jnp.float32)}
+
+        def loss(p):
+            return 0.5 * p["a"] @ A1 @ p["a"] + 0.5 * p["b"] @ A2 @ p["b"]
+
+        ev = Eigenvalue(max_iter=200, tol=1e-4)
+        blocks = [{"a": jnp.ones(3), "b": jnp.zeros(2)},
+                  {"a": jnp.zeros(3), "b": jnp.ones(2)}]
+        out = ev.compute_eigenvalue(loss, params, blocks)
+        assert abs(out[0] - 0.4) < 1e-2
+        assert out[1] == 1.0
+
+    def test_post_process_zero_block(self):
+        assert Eigenvalue().post_process([0.0, -5.0]) == [1.0, 1.0]
+        assert Eigenvalue().post_process([]) == []
+
+
+class TestSparseTensor:
+    def test_dense_roundtrip_with_duplicates(self):
+        ids = jnp.asarray([1, 3, 1], jnp.int32)
+        vals = jnp.asarray([[1.0, 0.0], [0.0, 2.0], [4.0, 0.0]], jnp.float32)
+        st = SparseTensor.from_embedding_grad(ids, vals, vocab_size=5)
+        dense = st.to_dense()
+        assert dense.shape == (5, 2)
+        assert float(dense[1, 0]) == 5.0  # duplicate rows accumulate
+        assert float(dense[3, 1]) == 2.0
+
+    def test_sparse_all_reduce_matches_dense(self, devices):
+        """shard_map over dp: gathered sparse sum == dense psum."""
+        mesh = Mesh(np.array(devices[:4]), ("dp",))
+        vocab, D, n = 16, 4, 3
+        rng = np.random.default_rng(1)
+        ids = jnp.asarray(rng.integers(0, vocab, size=(4, n)), jnp.int32)
+        vals = jnp.asarray(rng.normal(size=(4, n, D)), jnp.float32)
+
+        def body(i, v):
+            st = SparseTensor.from_embedding_grad(i[0], v[0], vocab)
+            red = sparse_all_reduce(st, "dp", average=True)
+            return red.to_dense()[None]
+
+        out = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(P("dp"), P("dp")),
+            out_specs=P("dp")))(ids, vals)
+        # every rank holds the same averaged dense grad
+        want = jnp.zeros((vocab, D)).at[ids.reshape(-1)].add(
+            vals.reshape(-1, D)) / 4
+        for r in range(4):
+            assert float(jnp.abs(out[r] - want).max()) < 1e-6
