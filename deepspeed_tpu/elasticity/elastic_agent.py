@@ -80,6 +80,9 @@ class DSElasticAgent:
 
     def _admissible_world(self, capacity: int) -> int:
         """Largest world size <= capacity valid under the elastic plan."""
+        if capacity < 1:
+            # a zero-worker group would vacuously "succeed" without running
+            raise RuntimeError(f"no capacity ({capacity}) to run any worker")
         if not self.ds_config:
             return capacity
         from deepspeed_tpu.elasticity import compute_elastic_config

@@ -9,6 +9,10 @@ Every model is a thin preset over ``deepspeed_tpu.models.transformer``:
 """
 
 from deepspeed_tpu.models.causal_lm import CausalLM
+from deepspeed_tpu.models.clip import (CLIPTextConfig, CLIPTextEncoder,
+                                       CLIPVisionConfig, CLIPVisionEncoder,
+                                       DSClipEncoder)
+from deepspeed_tpu.models.diffusers_wrappers import DSUNet, DSVAE
 from deepspeed_tpu.models.pipeline import PipelinedCausalLM
 from deepspeed_tpu.models.presets import (MODEL_PRESETS, bloom, get_model, gpt2, gpt2_large,
                                           gpt2_medium, gpt2_xl, gpt_neox, llama_7b, opt)
@@ -16,4 +20,6 @@ from deepspeed_tpu.models.presets import (MODEL_PRESETS, bloom, get_model, gpt2,
 __all__ = [
     "CausalLM", "PipelinedCausalLM", "MODEL_PRESETS", "get_model", "gpt2", "gpt2_medium", "gpt2_large",
     "gpt2_xl", "llama_7b", "bloom", "opt", "gpt_neox",
+    "CLIPTextEncoder", "CLIPVisionEncoder", "CLIPTextConfig", "CLIPVisionConfig",
+    "DSClipEncoder", "DSUNet", "DSVAE",
 ]

@@ -1,0 +1,57 @@
+"""Diffusers model wrappers (UNet / VAE) — reference
+``deepspeed/model_implementations/diffusers/unet.py`` and ``vae.py``:
+thin modules that capture a CUDA graph of the wrapped denoiser/decoder so
+the diffusion loop replays a fixed graph instead of re-launching kernels.
+
+TPU equivalent: ``jax.jit`` IS the captured graph. Each wrapper owns one
+compiled program per input shape; the denoising loop's repeated calls
+replay it. The wrappers also pin the NHWC layout (TPU's preferred conv
+layout — the reference's spatial kernels exist for the same reason, see
+``ops/spatial/kernels.py``) and donate the latent buffer so the loop
+updates in place.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+__all__ = ["DSUNet", "DSVAE"]
+
+
+class DSUNet:
+    """Wrap a functional UNet ``apply(params, latents, timestep, context)``.
+
+    ``latents`` is donated: the diffusion loop's repeated
+    ``latents = unet(params, latents, t, ctx)`` reuses the same HBM buffer
+    (the reference gets the same effect from replaying into static graph
+    buffers, ``diffusers/unet.py`` ``_graph_replay``).
+    """
+
+    def __init__(self, apply_fn: Callable, donate_latents: bool = True):
+        self.apply_fn = apply_fn
+        argnums = (1,) if donate_latents else ()
+        self._jit = jax.jit(apply_fn, donate_argnums=argnums)
+
+    def __call__(self, params, latents, timestep, context=None, **kw):
+        return self._jit(params, latents, timestep, context, **kw)
+
+
+class DSVAE:
+    """Wrap a functional VAE with separate jitted encode/decode programs
+    (the reference captures two graphs, ``vae.py``)."""
+
+    def __init__(self, encode_fn: Callable = None, decode_fn: Callable = None):
+        self._encode = jax.jit(encode_fn) if encode_fn else None
+        self._decode = jax.jit(decode_fn) if decode_fn else None
+
+    def encode(self, params, images, *a, **kw):
+        if self._encode is None:
+            raise ValueError("no encode_fn configured")
+        return self._encode(params, images, *a, **kw)
+
+    def decode(self, params, latents, *a, **kw):
+        if self._decode is None:
+            raise ValueError("no decode_fn configured")
+        return self._decode(params, latents, *a, **kw)

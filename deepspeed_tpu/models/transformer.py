@@ -427,7 +427,12 @@ def mlp(cfg: TransformerConfig, x, lp):
         out = (jax.nn.silu(x @ _w(lp["w_gate"], x)) * (x @ _w(lp["w_up"], x))) @ _w(lp["w_down"], x)
         return checkpoint_name(out, "ff_down")
     h = x @ _w(lp["w_up"], x) + lp["b_up"]
-    h = jax.nn.gelu(h, approximate=True) if cfg.activation == "gelu" else jax.nn.relu(h)
+    if cfg.activation == "gelu":
+        h = jax.nn.gelu(h, approximate=True)
+    elif cfg.activation == "quick_gelu":
+        h = h * jax.nn.sigmoid(1.702 * h)  # CLIP's QuickGELU
+    else:
+        h = jax.nn.relu(h)
     return checkpoint_name(h @ _w(lp["w_down"], x) + lp["b_down"], "ff_down")
 
 
@@ -555,20 +560,10 @@ def forward_cached(cfg: TransformerConfig, params, tokens, cache, pos, pad_bias=
     return logits, {"k": nk, "v": nv}
 
 
-def hidden_states(cfg: TransformerConfig, params, tokens, attn_mask=None):
-    """tokens [B, S] int32 → final normed hidden states [B, S, D] (the
-    forward body without the vocab projection)."""
-    B, S = tokens.shape
-    x = params["embed"]["tokens"][tokens]
-    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
-    if cfg.pos_embedding == "learned":
-        x = x + params["embed"]["positions"][:S][None, :, :]
-    if cfg.embed_layernorm:
-        x = _norm(cfg, x, params["embed"]["ln"])
-
-    mask_bias = key_mask_bias(attn_mask)
-    layer_params = params["layers"]
-
+def run_layers(cfg: TransformerConfig, x, layer_params, positions, mask_bias):
+    """Run the stacked layer blocks over ``x`` with the config's remat policy
+    and scan/unroll choice — shared by :func:`hidden_states` and non-token
+    encoders (e.g. the CLIP vision tower)."""
     def run_block(h, lp):
         out = block(cfg, h, lp, positions, mask_bias)
         return out, None
@@ -583,7 +578,21 @@ def hidden_states(cfg: TransformerConfig, params, tokens, attn_mask=None):
         for i in range(cfg.n_layer):
             lp = jax.tree.map(lambda a: a[i], layer_params)
             x, _ = run_block(x, lp)
+    return x
 
+
+def hidden_states(cfg: TransformerConfig, params, tokens, attn_mask=None):
+    """tokens [B, S] int32 → final normed hidden states [B, S, D] (the
+    forward body without the vocab projection)."""
+    B, S = tokens.shape
+    x = params["embed"]["tokens"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    if cfg.pos_embedding == "learned":
+        x = x + params["embed"]["positions"][:S][None, :, :]
+    if cfg.embed_layernorm:
+        x = _norm(cfg, x, params["embed"]["ln"])
+
+    x = run_layers(cfg, x, params["layers"], positions, key_mask_bias(attn_mask))
     return _norm(cfg, x, params["ln_f"])
 
 

