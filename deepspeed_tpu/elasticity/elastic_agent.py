@@ -141,7 +141,11 @@ class DSElasticAgent:
 
     def run(self) -> RunResult:
         restart_count = 0
-        world = self._admissible_world(self.capacity_fn())
+        try:
+            world = self._admissible_world(self.capacity_fn())
+        except RuntimeError as e:
+            logger.error(f"elastic agent: {e}")
+            return RunResult(WorkerState.FAILED, [], 0)
         self._start_group(world, restart_count)
         while True:
             time.sleep(self.spec.monitor_interval)

@@ -45,7 +45,9 @@ class CLIPTextConfig:
             n_layer=self.n_layer, n_head=self.n_head, d_model=self.d_model,
             d_ff=self.d_ff, pos_embedding="learned", norm="layernorm",
             activation="quick_gelu", causal=True, attn_bias=True,
-            norm_eps=self.norm_eps, tie_embeddings=False)
+            # tie_embeddings just suppresses the (unused) lm_head alloc —
+            # the encoder never projects to vocab
+            norm_eps=self.norm_eps, tie_embeddings=True)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -69,7 +71,7 @@ class CLIPVisionConfig:
             n_head=self.n_head, d_model=self.d_model, d_ff=self.d_ff,
             pos_embedding="none", norm="layernorm", activation="quick_gelu",
             causal=False, attn_bias=True, norm_eps=self.norm_eps,
-            tie_embeddings=False)
+            tie_embeddings=True)
 
 
 # ------------------------------------------------------------------ #

@@ -23,13 +23,15 @@ __all__ = ["DSUNet", "DSVAE"]
 class DSUNet:
     """Wrap a functional UNet ``apply(params, latents, timestep, context)``.
 
-    ``latents`` is donated: the diffusion loop's repeated
-    ``latents = unet(params, latents, t, ctx)`` reuses the same HBM buffer
-    (the reference gets the same effect from replaying into static graph
-    buffers, ``diffusers/unet.py`` ``_graph_replay``).
+    ``donate_latents=True`` reuses the latents HBM buffer for the output
+    (the reference's static-graph-buffer effect, ``diffusers/unet.py``
+    ``_graph_replay``) — only safe when the caller does NOT read latents
+    after the call (i.e. ``latents = unet(...)`` style loops). The standard
+    ``noise_pred = unet(...); scheduler.step(noise_pred, t, latents)`` loop
+    reads latents again, so donation is OFF by default.
     """
 
-    def __init__(self, apply_fn: Callable, donate_latents: bool = True):
+    def __init__(self, apply_fn: Callable, donate_latents: bool = False):
         self.apply_fn = apply_fn
         argnums = (1,) if donate_latents else ()
         self._jit = jax.jit(apply_fn, donate_argnums=argnums)

@@ -47,6 +47,9 @@ class TransformerConfig:
     attn_bias: bool = False              # qkv/out biases (gpt2/opt/bloom/neox)
     # numerics
     rope_theta: float = 10000.0
+    rope_dim: int = 0                    # 0 = full head dim; else partial
+    rope_interleaved: bool = False       # GPT-J pairing vs NeoX half-split
+    lm_head_bias: bool = False           # GPT-J's lm_head carries a bias
     norm_eps: float = 1e-5
     dropout: float = 0.0
     # memory: activation checkpointing per layer. False/"none" = save all
@@ -144,6 +147,8 @@ def init_params(cfg: TransformerConfig, rng, dtype=jnp.float32) -> Dict[str, Any
                                  if cfg.norm == "layernorm" else {"scale": jnp.ones((D,), dtype)})
     if not cfg.tie_embeddings:
         params["lm_head"] = dense(k_head, (D, cfg.vocab_size))
+        if cfg.lm_head_bias:
+            params["lm_head_bias"] = jnp.zeros((cfg.vocab_size,), dtype)
     return params
 
 
@@ -185,6 +190,8 @@ def tp_specs(cfg: TransformerConfig) -> Dict[str, Any]:
                                 if cfg.norm == "layernorm" else {"scale": P(None)})
     if not cfg.tie_embeddings:
         specs["lm_head"] = P(None, "tp")
+        if cfg.lm_head_bias:
+            specs["lm_head_bias"] = P("tp")
     return specs
 
 
@@ -212,16 +219,35 @@ def _norm(cfg: TransformerConfig, x, p):
     return out.astype(x.dtype)
 
 
-def _rope(x, positions, theta: float):
-    """Rotary position embedding over the last dim (pairs)."""
+def _rope(x, positions, theta: float, rope_dim: int = 0,
+          interleaved: bool = False):
+    """Rotary position embedding.
+
+    ``rope_dim`` 0/None rotates the full head dim; otherwise only the first
+    ``rope_dim`` dims rotate and the tail passes through (GPT-NeoX
+    ``rotary_pct < 1`` / GPT-J ``rotary_dim``). ``interleaved`` selects the
+    GPT-J pairing (dims (0,1),(2,3),...) instead of the NeoX/Llama
+    half-split pairing (dims (i, i+half)).
+    """
     B, S, H, Hd = x.shape
-    half = Hd // 2
+    rd = rope_dim or Hd
+    xr, tail = x[..., :rd], x[..., rd:]
+    half = rd // 2
     freqs = jnp.exp(-math.log(theta) * jnp.arange(0, half, dtype=jnp.float32) / half)
     angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]  # [B,S,half]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = x[..., :half], x[..., half:]
-    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if interleaved:
+        x1, x2 = xr[..., 0::2], xr[..., 1::2]
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        rotated = jnp.stack([r1, r2], axis=-1).reshape(xr.shape)
+    else:
+        x1, x2 = xr[..., :half], xr[..., half:]
+        rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                                  axis=-1)
+    if rd != Hd:
+        rotated = jnp.concatenate([rotated, tail.astype(rotated.dtype)], axis=-1)
     return rotated.astype(x.dtype)
 
 
@@ -258,8 +284,8 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
     v = checkpoint_name((x @ _w(lp["wv"], x) + bv).reshape(B, S, KV, Hd), "v_proj")
 
     if cfg.pos_embedding == "rope":
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q = _rope(q, positions, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
+        k = _rope(k, positions, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
 
     if KV != H:  # GQA: repeat kv heads
         rep = H // KV
@@ -449,7 +475,7 @@ def block(cfg: TransformerConfig, x, lp, positions, mask_bias):
 def forward(cfg: TransformerConfig, params, tokens, attn_mask=None):
     """tokens [B, S] int32 → logits [B, S, vocab]."""
     x = hidden_states(cfg, params, tokens, attn_mask)
-    return x @ _head_weight(cfg, params)
+    return x @ _head_weight(cfg, params) + _head_bias(params)
 
 
 # --------------------------------------------------------------------- #
@@ -488,8 +514,8 @@ def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad
     k = (x @ _w(lp["wk"], x) + bk).reshape(B, T, KV, Hd)
     v = (x @ _w(lp["wv"], x) + bv).reshape(B, T, KV, Hd)
     if cfg.pos_embedding == "rope":
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q = _rope(q, positions, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
+        k = _rope(k, positions, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
 
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
@@ -556,7 +582,7 @@ def forward_cached(cfg: TransformerConfig, params, tokens, cache, pos, pad_bias=
 
     x, (nk, nv) = jax.lax.scan(run_block, x, (params["layers"], cache["k"], cache["v"]))
     x = _norm(cfg, x, params["ln_f"])
-    logits = x @ _head_weight(cfg, params)
+    logits = x @ _head_weight(cfg, params) + _head_bias(params)
     return logits, {"k": nk, "v": nv}
 
 
@@ -603,6 +629,11 @@ def _head_weight(cfg: TransformerConfig, params):
     return _w(params["lm_head"], params["embed"]["tokens"])
 
 
+def _head_bias(params):
+    """Optional [vocab] logits bias (GPT-J's lm_head carries one)."""
+    return params.get("lm_head_bias", 0)
+
+
 def _token_ce(logits, labels, valid):
     """Per-token nll and valid count from [N, V] f32 logits."""
     lse = jax.nn.logsumexp(logits, axis=-1)
@@ -629,9 +660,10 @@ def lm_loss(cfg: TransformerConfig, params, batch, ignore_index: int = -100):
     valid = (labels != ignore_index)
     safe_labels = jnp.where(valid, labels, 0)
 
+    hb = _head_bias(params)
     chunk = cfg.loss_chunk
     if chunk <= 0 or (B * S) % chunk != 0:
-        logits = (x @ w).astype(jnp.float32)
+        logits = (x @ w + hb).astype(jnp.float32)
         nll, n = _token_ce(logits.reshape(B * S, -1),
                            safe_labels.reshape(-1), valid.reshape(-1).astype(jnp.float32))
         return nll / jnp.maximum(n, 1)
@@ -643,7 +675,7 @@ def lm_loss(cfg: TransformerConfig, params, batch, ignore_index: int = -100):
 
     def body(carry, inp):
         xc, lc, vc = inp
-        logits = (xc @ w).astype(jnp.float32)
+        logits = (xc @ w + hb).astype(jnp.float32)
         nll, n = _token_ce(logits, lc, vc)
         s_nll, s_n = carry
         return (s_nll + nll, s_n + n), None

@@ -288,19 +288,20 @@ class OPTPolicy(HFPolicy):
 
 class GPTNeoXPolicy(HFPolicy):
     """HF ``gpt_neox`` (reference ``containers/gptneox.py``): parallel
-    residual, rotary, per-head-interleaved fused qkv with biases.
-    Note: partial rotary (rotary_pct < 1) is not represented in the zoo
-    config; checkpoints with rotary_pct != 1.0 are rejected loudly."""
+    residual, rotary (optionally partial via ``rotary_pct``), per-head-
+    interleaved fused qkv with biases."""
 
     model_type = "gpt_neox"
 
     def zoo_config(self, hf):
-        pct = hf.get("rotary_pct", 1.0)
-        if pct != 1.0:
+        pct = float(hf.get("rotary_pct", 1.0))
+        head_dim = hf["hidden_size"] // hf["num_attention_heads"]
+        rope_dim = int(head_dim * pct)
+        if pct != 1.0 and rope_dim % 2:
             raise NotImplementedError(
-                f"gpt_neox rotary_pct={pct}: partial rotary embedding is not "
-                "supported by the zoo transformer (full-dim rope only)")
+                f"gpt_neox rotary_pct={pct}: odd rotary dim {rope_dim}")
         return TransformerConfig(
+            rope_dim=0 if pct == 1.0 else rope_dim,
             vocab_size=hf["vocab_size"], n_layer=hf["num_hidden_layers"],
             n_head=hf["num_attention_heads"], d_model=hf["hidden_size"],
             d_ff=hf["intermediate_size"], max_seq=hf["max_position_embeddings"],
@@ -351,8 +352,67 @@ class GPTNeoXPolicy(HFPolicy):
         return out
 
 
+class GPTJPolicy(HFPolicy):
+    """HF ``gptj`` (reference ``containers/gptj.py``): single-LN parallel
+    residual (attn and mlp both read ln_1 — mapped by aliasing ln_attn and
+    ln_mlp to the same weights), partial INTERLEAVED rotary (``rotary_dim``,
+    rotate-every-two pairing), bias-free separate q/k/v, untied lm_head
+    WITH bias."""
+
+    model_type = "gptj"
+
+    def zoo_config(self, hf):
+        return TransformerConfig(
+            vocab_size=hf["vocab_size"], n_layer=hf["n_layer"],
+            n_head=hf["n_head"], d_model=hf["n_embd"],
+            d_ff=hf.get("n_inner") or 4 * hf["n_embd"],
+            max_seq=hf.get("n_positions", 2048),
+            pos_embedding="rope", norm="layernorm", activation="gelu",
+            parallel_residual=True, tie_embeddings=False, attn_bias=False,
+            rope_dim=int(hf.get("rotary_dim") or hf["n_embd"] // hf["n_head"]),
+            rope_interleaved=True, lm_head_bias=True,
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5))
+
+    def map_params(self, raw_get, cfg):
+        L = cfg.n_layer
+        ls = range(L)
+        p = "transformer.h"
+
+        def get(name):
+            try:
+                return raw_get(name)
+            except KeyError:
+                return raw_get(name[len("transformer."):]
+                               if name.startswith("transformer.") else
+                               "transformer." + name)
+
+        ln_scale = _stack(get, [f"{p}.{i}.ln_1.weight" for i in ls])
+        ln_bias = _stack(get, [f"{p}.{i}.ln_1.bias" for i in ls])
+        return {
+            "embed": {"tokens": np.asarray(get("transformer.wte.weight"))},
+            "layers": {
+                # GPT-J has ONE pre-LN feeding both branches: alias it
+                "ln_attn": {"scale": ln_scale, "bias": ln_bias},
+                "ln_mlp": {"scale": ln_scale.copy(), "bias": ln_bias.copy()},
+                "attn": {"wq": _stack(get, [f"{p}.{i}.attn.q_proj.weight" for i in ls], _t),
+                         "wk": _stack(get, [f"{p}.{i}.attn.k_proj.weight" for i in ls], _t),
+                         "wv": _stack(get, [f"{p}.{i}.attn.v_proj.weight" for i in ls], _t),
+                         "wo": _stack(get, [f"{p}.{i}.attn.out_proj.weight" for i in ls], _t)},
+                "mlp": {"w_up": _stack(get, [f"{p}.{i}.mlp.fc_in.weight" for i in ls], _t),
+                        "b_up": _stack(get, [f"{p}.{i}.mlp.fc_in.bias" for i in ls]),
+                        "w_down": _stack(get, [f"{p}.{i}.mlp.fc_out.weight" for i in ls], _t),
+                        "b_down": _stack(get, [f"{p}.{i}.mlp.fc_out.bias" for i in ls])},
+            },
+            "ln_f": {"scale": np.asarray(get("transformer.ln_f.weight")),
+                     "bias": np.asarray(get("transformer.ln_f.bias"))},
+            "lm_head": _t(np.asarray(get("lm_head.weight"))),
+            "lm_head_bias": np.asarray(get("lm_head.bias")),
+        }
+
+
 POLICIES: Dict[str, HFPolicy] = {
-    p.model_type: p() for p in (GPT2Policy, LlamaPolicy, BloomPolicy, OPTPolicy, GPTNeoXPolicy)
+    p.model_type: p() for p in (GPT2Policy, LlamaPolicy, BloomPolicy, OPTPolicy,
+                                GPTNeoXPolicy, GPTJPolicy)
 }
 
 

@@ -105,13 +105,21 @@ class TestHFPolicies:
                                          use_parallel_residual=True)
         parity(tmp_path, transformers.GPTNeoXForCausalLM(cfg), cfg)
 
-    def test_neox_partial_rotary_rejected(self, tmp_path):
-        cfg = transformers.GPTNeoXConfig(vocab_size=96, hidden_size=32, num_hidden_layers=1,
+    def test_gpt_neox_partial_rotary(self, tmp_path):
+        """rotary_pct < 1: only the first pct of each head rotates."""
+        cfg = transformers.GPTNeoXConfig(vocab_size=96, hidden_size=32, num_hidden_layers=2,
                                          num_attention_heads=2, intermediate_size=64,
-                                         max_position_embeddings=32, rotary_pct=0.25)
-        d = save_hf(transformers.GPTNeoXForCausalLM(cfg), cfg, tmp_path)
-        with pytest.raises(NotImplementedError, match="rotary_pct"):
-            load_hf_checkpoint(d)
+                                         max_position_embeddings=32, rotary_pct=0.5,
+                                         use_parallel_residual=True)
+        parity(tmp_path, transformers.GPTNeoXForCausalLM(cfg), cfg)
+
+    def test_gptj(self, tmp_path):
+        """GPT-J: interleaved partial rotary, single-LN parallel residual,
+        biased untied lm_head."""
+        cfg = transformers.GPTJConfig(vocab_size=96, n_embd=32, n_layer=2,
+                                      n_head=2, n_inner=64, n_positions=32,
+                                      rotary_dim=8)
+        parity(tmp_path, transformers.GPTJForCausalLM(cfg), cfg)
 
     def test_opt_post_ln_rejected(self):
         from deepspeed_tpu.module_inject.policies import policy_for
