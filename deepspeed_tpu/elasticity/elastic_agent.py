@@ -141,9 +141,11 @@ class DSElasticAgent:
 
     def run(self) -> RunResult:
         restart_count = 0
+        capacity = self.capacity_fn()  # probe errors propagate (caller bug)
         try:
-            world = self._admissible_world(self.capacity_fn())
+            world = self._admissible_world(capacity)
         except RuntimeError as e:
+            # no admissible world at startup -> a failed result, not a crash
             logger.error(f"elastic agent: {e}")
             return RunResult(WorkerState.FAILED, [], 0)
         self._start_group(world, restart_count)

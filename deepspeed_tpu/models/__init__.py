@@ -8,6 +8,7 @@ Every model is a thin preset over ``deepspeed_tpu.models.transformer``:
 ``deepspeed_tpu.initialize`` and the inference engine.
 """
 
+from deepspeed_tpu.models.bert import BertConfig, BertModel
 from deepspeed_tpu.models.causal_lm import CausalLM
 from deepspeed_tpu.models.clip import (CLIPTextConfig, CLIPTextEncoder,
                                        CLIPVisionConfig, CLIPVisionEncoder,
@@ -21,5 +22,5 @@ __all__ = [
     "CausalLM", "PipelinedCausalLM", "MODEL_PRESETS", "get_model", "gpt2", "gpt2_medium", "gpt2_large",
     "gpt2_xl", "llama_7b", "bloom", "opt", "gpt_neox",
     "CLIPTextEncoder", "CLIPVisionEncoder", "CLIPTextConfig", "CLIPVisionConfig",
-    "DSClipEncoder", "DSUNet", "DSVAE",
+    "DSClipEncoder", "DSUNet", "DSVAE", "BertModel", "BertConfig",
 ]

@@ -1,0 +1,106 @@
+"""BERT family: post-LN bidirectional encoder + MLM head.
+
+Reference parity: the BERT/DistilBERT inference policies
+(``deepspeed/module_inject/containers/bert.py``, ``distil_bert.py``) and the
+fused BERT training layer (``csrc/transformer/ds_transformer_cuda.cpp`` —
+the reference's headline "fastest BERT training" kernels support both
+pre- and post-layernorm; this is the post-LN configuration of the same zoo
+block, ``models/transformer.py block()``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import transformer as T
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_seq: int = 512
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+
+    def zoo(self) -> T.TransformerConfig:
+        return T.TransformerConfig(
+            vocab_size=self.vocab_size, max_seq=self.max_seq,
+            n_layer=self.n_layer, n_head=self.n_head, d_model=self.d_model,
+            d_ff=self.d_ff, pos_embedding="learned", norm="layernorm",
+            norm_position="post", activation="gelu_exact", causal=False,
+            attn_bias=True, norm_eps=self.norm_eps, tie_embeddings=True)
+
+
+class BertModel:
+    """HF ``BertModel`` semantics: word+position+token_type embeddings with
+    LN, post-LN encoder stack, tanh pooler on [CLS]; optional MLM head
+    (dense + exact-gelu + LN + tied decoder with bias)."""
+
+    def __init__(self, config: BertConfig, with_mlm_head: bool = False):
+        self.config = config
+        self.zoo_cfg = config.zoo()
+        self.with_mlm_head = with_mlm_head
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        c = self.config
+        p = T.init_params(self.zoo_cfg, rng)
+        k = jax.random.fold_in(rng, 13)
+        k1, k2, k3 = jax.random.split(k, 3)
+        out = {
+            "embed": {
+                "tokens": p["embed"]["tokens"],
+                "positions": p["embed"]["positions"],
+                "token_type": jax.random.normal(k1, (c.type_vocab_size, c.d_model),
+                                                jnp.float32) * 0.02,
+                "ln": {"scale": jnp.ones(c.d_model), "bias": jnp.zeros(c.d_model)},
+            },
+            "layers": p["layers"],
+            "pooler": {"w": jax.random.normal(k2, (c.d_model, c.d_model),
+                                              jnp.float32) * 0.02,
+                       "b": jnp.zeros(c.d_model)},
+        }
+        if self.with_mlm_head:
+            out["mlm"] = {
+                "w": jax.random.normal(k3, (c.d_model, c.d_model),
+                                       jnp.float32) * 0.02,
+                "b": jnp.zeros(c.d_model),
+                "ln": {"scale": jnp.ones(c.d_model), "bias": jnp.zeros(c.d_model)},
+                "decoder_bias": jnp.zeros(c.vocab_size),
+            }
+        return out
+
+    def __call__(self, params, input_ids, token_type_ids=None, attention_mask=None):
+        """→ (last_hidden [B, S, D], pooled [B, D])."""
+        cfg = self.zoo_cfg
+        B, S = input_ids.shape
+        x = params["embed"]["tokens"][input_ids]
+        x = x + params["embed"]["positions"][:S][None]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = x + params["embed"]["token_type"][token_type_ids]
+        x = T._norm(cfg, x, params["embed"]["ln"])
+
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+        x = T.run_layers(cfg, x, params["layers"], positions,
+                         T.key_mask_bias(attention_mask))
+        # post-LN stacks end inside the last block: no final norm here
+        pooled = jnp.tanh(x[:, 0] @ params["pooler"]["w"] + params["pooler"]["b"])
+        return x, pooled
+
+    def mlm_logits(self, params, input_ids, token_type_ids=None, attention_mask=None):
+        """Masked-LM logits [B, S, vocab] (HF BertForMaskedLM head)."""
+        if "mlm" not in params:
+            raise ValueError("model has no MLM head (with_mlm_head=False)")
+        x, _ = self(params, input_ids, token_type_ids, attention_mask)
+        m = params["mlm"]
+        h = jax.nn.gelu(x @ m["w"] + m["b"], approximate=False)
+        h = T._norm(self.zoo_cfg, h, m["ln"])
+        return h @ params["embed"]["tokens"].T + m["decoder_bias"]

@@ -39,8 +39,9 @@ class TransformerConfig:
     # block style
     pos_embedding: str = "learned"       # learned | rope | alibi | none
     norm: str = "layernorm"              # layernorm | rmsnorm
-    activation: str = "gelu"             # gelu | swiglu | relu
+    activation: str = "gelu"             # gelu (tanh) | gelu_exact | quick_gelu | swiglu | relu
     parallel_residual: bool = False      # gpt-neox style
+    norm_position: str = "pre"           # pre (GPT) | post (BERT add&norm)
     causal: bool = True
     tie_embeddings: bool = True
     embed_layernorm: bool = False        # BLOOM word_embeddings_layernorm
@@ -455,6 +456,8 @@ def mlp(cfg: TransformerConfig, x, lp):
     h = x @ _w(lp["w_up"], x) + lp["b_up"]
     if cfg.activation == "gelu":
         h = jax.nn.gelu(h, approximate=True)
+    elif cfg.activation == "gelu_exact":
+        h = jax.nn.gelu(h, approximate=False)  # BERT's erf gelu
     elif cfg.activation == "quick_gelu":
         h = h * jax.nn.sigmoid(1.702 * h)  # CLIP's QuickGELU
     else:
@@ -463,6 +466,13 @@ def mlp(cfg: TransformerConfig, x, lp):
 
 
 def block(cfg: TransformerConfig, x, lp, positions, mask_bias):
+    if cfg.norm_position == "post":
+        # BERT-style add&norm: residual first, LN after (reference's fused
+        # encoder layer, csrc/transformer/ds_transformer_cuda.cpp pre/post
+        # layernorm modes)
+        x = _norm(cfg, x + attention(cfg, x, lp["attn"], positions, mask_bias),
+                  lp["ln_attn"])
+        return _norm(cfg, x + mlp(cfg, x, lp["mlp"]), lp["ln_mlp"])
     a = attention(cfg, _norm(cfg, x, lp["ln_attn"]), lp["attn"], positions, mask_bias)
     if cfg.parallel_residual:
         m = mlp(cfg, _norm(cfg, x, lp["ln_mlp"]), lp["mlp"])
@@ -562,6 +572,9 @@ def forward_cached(cfg: TransformerConfig, params, tokens, cache, pos, pad_bias=
     new cache). ``pad_bias`` [B, Smax] additive f32 masks cache slots of
     left-padded prompts."""
     B, T = tokens.shape
+    if cfg.norm_position == "post":
+        raise ValueError("norm_position='post' is not supported by the "
+                         "KV-cache decode path (pre-LN only)")
     x = params["embed"]["tokens"][tokens].astype(cache["k"].dtype)
     positions = pos + jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
     if cfg.pos_embedding == "learned":
@@ -610,6 +623,12 @@ def run_layers(cfg: TransformerConfig, x, layer_params, positions, mask_bias):
 def hidden_states(cfg: TransformerConfig, params, tokens, attn_mask=None):
     """tokens [B, S] int32 → final normed hidden states [B, S, D] (the
     forward body without the vocab projection)."""
+    if cfg.norm_position == "post":
+        # post-LN stacks end inside the last block and have no ln_f; the
+        # LM paths here are pre-LN only — build on run_layers directly
+        # (see models/bert.py) instead of silently mixing the two schemes
+        raise ValueError("norm_position='post' is not supported by the LM "
+                         "forward paths; use run_layers (e.g. BertModel)")
     B, S = tokens.shape
     x = params["embed"]["tokens"][tokens]
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))

@@ -1,0 +1,132 @@
+"""BERT post-LN encoder vs HF transformers (reference
+``module_inject/containers/bert.py`` parity target).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from deepspeed_tpu.models.bert import BertConfig, BertModel
+
+
+def _map_bert_params(hf, L, with_mlm=False):
+    sd = hf.state_dict()
+    pre = "bert." if any(k.startswith("bert.") for k in sd) else ""
+
+    def g(name):
+        return np.asarray(sd[pre + name].detach().numpy())
+
+    def stack(fmt, tr=False):
+        mats = [np.asarray(sd[pre + fmt.format(i)].detach().numpy()) for i in range(L)]
+        return jnp.asarray(np.stack([m.T if tr else m for m in mats]))
+
+    p = "encoder.layer"
+    out = {
+        "embed": {
+            "tokens": jnp.asarray(g("embeddings.word_embeddings.weight")),
+            "positions": jnp.asarray(g("embeddings.position_embeddings.weight")),
+            "token_type": jnp.asarray(g("embeddings.token_type_embeddings.weight")),
+            "ln": {"scale": jnp.asarray(g("embeddings.LayerNorm.weight")),
+                   "bias": jnp.asarray(g("embeddings.LayerNorm.bias"))},
+        },
+        "layers": {
+            "ln_attn": {"scale": stack(p + ".{}.attention.output.LayerNorm.weight"),
+                        "bias": stack(p + ".{}.attention.output.LayerNorm.bias")},
+            "attn": {"wq": stack(p + ".{}.attention.self.query.weight", tr=True),
+                     "wk": stack(p + ".{}.attention.self.key.weight", tr=True),
+                     "wv": stack(p + ".{}.attention.self.value.weight", tr=True),
+                     "bq": stack(p + ".{}.attention.self.query.bias"),
+                     "bk": stack(p + ".{}.attention.self.key.bias"),
+                     "bv": stack(p + ".{}.attention.self.value.bias"),
+                     "wo": stack(p + ".{}.attention.output.dense.weight", tr=True),
+                     "bo": stack(p + ".{}.attention.output.dense.bias")},
+            "ln_mlp": {"scale": stack(p + ".{}.output.LayerNorm.weight"),
+                       "bias": stack(p + ".{}.output.LayerNorm.bias")},
+            "mlp": {"w_up": stack(p + ".{}.intermediate.dense.weight", tr=True),
+                    "b_up": stack(p + ".{}.intermediate.dense.bias"),
+                    "w_down": stack(p + ".{}.output.dense.weight", tr=True),
+                    "b_down": stack(p + ".{}.output.dense.bias")},
+        },
+    }
+    if pre + "pooler.dense.weight" in sd:
+        out["pooler"] = {"w": jnp.asarray(g("pooler.dense.weight")).T,
+                         "b": jnp.asarray(g("pooler.dense.bias"))}
+    else:
+        out["pooler"] = {"w": jnp.zeros((hf.config.hidden_size,) * 2),
+                         "b": jnp.zeros(hf.config.hidden_size)}
+    if with_mlm:
+        out["mlm"] = {
+            "w": jnp.asarray(np.asarray(sd["cls.predictions.transform.dense.weight"]).T),
+            "b": jnp.asarray(np.asarray(sd["cls.predictions.transform.dense.bias"])),
+            "ln": {"scale": jnp.asarray(np.asarray(sd["cls.predictions.transform.LayerNorm.weight"])),
+                   "bias": jnp.asarray(np.asarray(sd["cls.predictions.transform.LayerNorm.bias"]))},
+            "decoder_bias": jnp.asarray(np.asarray(sd["cls.predictions.bias"])),
+        }
+    return out
+
+
+def _tiny_cfg():
+    return transformers.BertConfig(
+        vocab_size=120, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, type_vocab_size=2)
+
+
+def test_bert_hidden_and_pooled_match_transformers():
+    cfg_hf = _tiny_cfg()
+    torch.manual_seed(0)
+    hf = transformers.BertModel(cfg_hf).eval()
+    ours = BertModel(BertConfig(vocab_size=120, max_seq=32, n_layer=2,
+                                n_head=4, d_model=32, d_ff=64))
+    params = _map_bert_params(hf, 2)
+
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, 120, size=(2, 16)).astype(np.int32)
+    tt = rng.integers(0, 2, size=(2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(tok.astype(np.int64)),
+                 token_type_ids=torch.tensor(tt.astype(np.int64)))
+    hidden, pooled = ours(params, jnp.asarray(tok), jnp.asarray(tt))
+    assert float(jnp.abs(hidden - ref.last_hidden_state.numpy()).max()) < 2e-4
+    assert float(jnp.abs(pooled - ref.pooler_output.numpy()).max()) < 2e-4
+
+
+def test_bert_attention_mask():
+    """Padding mask: masked positions must not affect unmasked outputs."""
+    cfg_hf = _tiny_cfg()
+    torch.manual_seed(1)
+    hf = transformers.BertModel(cfg_hf).eval()
+    ours = BertModel(BertConfig(vocab_size=120, max_seq=32, n_layer=2,
+                                n_head=4, d_model=32, d_ff=64))
+    params = _map_bert_params(hf, 2)
+    rng = np.random.default_rng(1)
+    tok = rng.integers(0, 120, size=(1, 12)).astype(np.int32)
+    mask = np.ones((1, 12), np.int32)
+    mask[:, 8:] = 0
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(tok.astype(np.int64)),
+                 attention_mask=torch.tensor(mask.astype(np.int64)))
+    hidden, _ = ours(params, jnp.asarray(tok), None, jnp.asarray(mask))
+    err = float(jnp.abs(hidden[:, :8] - ref.last_hidden_state.numpy()[:, :8]).max())
+    assert err < 2e-4
+
+
+def test_bert_mlm_head_matches():
+    cfg_hf = _tiny_cfg()
+    torch.manual_seed(2)
+    hf = transformers.BertForMaskedLM(cfg_hf).eval()
+    ours = BertModel(BertConfig(vocab_size=120, max_seq=32, n_layer=2,
+                                n_head=4, d_model=32, d_ff=64),
+                     with_mlm_head=True)
+    params = _map_bert_params(hf, 2, with_mlm=True)
+    rng = np.random.default_rng(2)
+    tok = rng.integers(0, 120, size=(2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(tok.astype(np.int64))).logits.numpy()
+    got = ours.mlm_logits(params, jnp.asarray(tok))
+    assert float(jnp.abs(got - ref).max()) < 5e-4
+    assert np.array_equal(np.asarray(got.argmax(-1)), ref.argmax(-1))
