@@ -197,6 +197,11 @@ class DeepSpeedConfig:
         self.curriculum_enabled_legacy = param_dict.get(C.CURRICULUM_LEARNING, {}).get(C.CURRICULUM_ENABLED,
                                                                                        C.CURRICULUM_ENABLED_DEFAULT)
         self.curriculum_params_legacy = param_dict.get(C.CURRICULUM_LEARNING, False)
+        # MoQ: progressive quantization-aware training (reference
+        # "quantize_training" section, runtime/quantize.py + eigenvalue.py)
+        qt = param_dict.get("quantize_training", {})
+        self.quantize_training_enabled = bool(qt.get("enabled", False))
+        self.quantize_training = qt if self.quantize_training_enabled else {}
 
         from deepspeed_tpu.runtime.data_pipeline.config import get_data_efficiency_config
         self.data_efficiency_config = get_data_efficiency_config(param_dict)

@@ -129,6 +129,9 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None, cli
         "client_state": client_state or {},
         "framework_version": 1,
     }
+    if getattr(engine, "quantizer", None) is not None:
+        # MoQ host schedule: a resumed run must continue mid-schedule
+        meta["moq_state"] = engine.quantizer.state_dict()
     if opt_labels is not None:
         # structured identity of every opt_state_flat leaf, so tools
         # (ds_to_universal) never have to guess moments by shape matching
@@ -230,5 +233,7 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None, loa
             meta = json.load(f)
         if engine.lr_scheduler is not None and meta.get("lr_scheduler"):
             engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        if getattr(engine, "quantizer", None) is not None and meta.get("moq_state"):
+            engine.quantizer.load_state_dict(meta["moq_state"])
     log_dist(f"Loaded checkpoint {tag} from {path} (step {engine.global_steps})", ranks=[0])
     return path, meta.get("client_state", {})

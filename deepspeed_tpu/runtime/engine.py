@@ -276,6 +276,45 @@ class DeepSpeedEngine:
             gamma = self._config.pld_params.get("gamma", 0.001)
             self.progressive_layer_drop = ProgressiveLayerDrop(theta=theta, gamma=gamma)
 
+        # MoQ progressive quantization (reference runtime/quantize.py wired
+        # via the "quantize_training" config section; eigenvalue-guided
+        # schedule per runtime/eigenvalue.py)
+        self.quantizer = None
+        self.eigenvalue = None
+        qt = getattr(self._config, "quantize_training", {})
+        if getattr(self._config, "quantize_training_enabled", False):
+            from deepspeed_tpu.runtime.quantize import Quantizer
+            bits = qt.get("quantize_bits", {})
+            sched = qt.get("quantize_schedule", {})
+            algo = qt.get("quantize_algo", {})
+            mixed = qt.get("fp16_mixed_quantize", {})
+            # only config-present keys: Quantizer's own defaults govern
+            kw = {k: v for k, v in dict(
+                q_groups=qt.get("quantize_groups"),
+                q_mixed_fp16=mixed.get("enabled"),
+                q_change_ratio=mixed.get("quantize_change_ratio"),
+                q_type=algo.get("q_type"),
+                q_rounding=algo.get("q_rounding"),
+                q_verbose=qt.get("quantize_verbose"),
+                q_eigenvalue=qt.get("eigenvalue", {}).get("enabled"),
+                start_bits=bits.get("start_bits"),
+                target_bits=bits.get("target_bits"),
+                q_period=sched.get("quantize_period"),
+            ).items() if v is not None}
+            self.quantizer = Quantizer(**kw)
+            self._moq_seen_skipped = 0
+            ev = qt.get("eigenvalue", {})
+            if ev.get("enabled", False):
+                from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+                self.eigenvalue = Eigenvalue(
+                    verbose=ev.get("verbose", False),
+                    max_iter=ev.get("max_iter", 100),
+                    tol=ev.get("tol", 1e-2),
+                    stability=ev.get("stability", 1e-6),
+                    gas_boundary_resolution=ev.get("gas_boundary_resolution", 1))
+                self._ev_layer_name = ev.get("layer_name", "layers")
+                self._ev_layer_num = ev.get("layer_num", 0)
+
         log_dist(f"DeepSpeedEngine ready: optimizer={self._optimizer_name}, "
                  f"dtype={self.compute_dtype.__name__}, mesh={dict(mesh.shape)}, "
                  f"micro_bs={self.train_micro_batch_size_per_gpu()} x gas={self.gradient_accumulation_steps()}",
@@ -806,9 +845,59 @@ class DeepSpeedEngine:
                 self._train_batch_jit[gas] = fn
             self.state, metrics = fn(self.state, batch, step_rng)
         self.tput_timer.stop(global_step=True)
+        if self.quantizer is not None:
+            self._quantize_step(batch)
         self._write_monitor_events(metrics)
         self._report_progress(metrics)
         return metrics["loss"]
+
+    def _quantize_step(self, batch):
+        """MoQ post-step hook (reference fp16 optimizers calling
+        ``quantizer.quantize`` after each step, runtime/quantize.py): walks
+        the per-leaf bit schedule and fake-quantizes the live params. With
+        eigenvalue enabled, per-block curvature is re-estimated at gas
+        boundaries while a precision switch is pending, and the MAX across
+        blocks stretches the stacked-layers leaves' periods (the zoo stacks
+        all layers in one leaf, so the most conservative block governs)."""
+        # fp16 overflow steps skipped their update: don't advance the bit
+        # schedule on them either (reference defers quantize on overflow)
+        overflow = False
+        if self.fp16_enabled():
+            cur = int(self.state.skipped_steps)
+            overflow = cur > self._moq_seen_skipped
+            self._moq_seen_skipped = cur
+
+        block_ev = None
+        if self.eigenvalue is not None and \
+                self._host_global_steps % self.eigenvalue.gas_boundary_resolution == 0 \
+                and self.quantizer.any_precision_switch():
+            micro = jax.tree.map(lambda x: x[0], batch)
+            params = self.state.params
+            name = self._ev_layer_name
+            n_blocks = self._ev_layer_num or 0
+            if n_blocks > 0 and name in params:
+                masks = self.eigenvalue.layer_masks(params, name, n_blocks)
+            else:
+                masks = [jax.tree.map(lambda a: jnp.ones(a.shape, jnp.float32), params)]
+            self._rng, ev_rng = jax.random.split(self._rng)
+
+            def scalar_loss(p):
+                out = self.loss_fn(p, micro, ev_rng)
+                return out[0] if isinstance(out, tuple) else out
+
+            vals = self.eigenvalue.compute_eigenvalue(
+                scalar_loss, params, masks, rng=ev_rng)
+            # post_process normalizes to [0,1] with max==1; the zoo stacks
+            # all layers in one leaf, so aggregate with the MEAN (a
+            # max would be the constant 1.0 and carry no information)
+            block_ev = {name: sum(vals) / len(vals)} if vals else None
+        new_params = self.quantizer.quantize_tree(self.state.params,
+                                                  overflow=overflow,
+                                                  block_eigenvalue=block_ev)
+        # quantize ops run eagerly: pin the results back onto the param
+        # shardings so the donated train-step jit sees identical layouts
+        new_params = jax.device_put(new_params, self._param_shardings)
+        self.state = self.state._replace(params=new_params)
 
     # ---- reference-shaped trio ---- #
 

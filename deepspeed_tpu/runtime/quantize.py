@@ -83,6 +83,20 @@ class Quantizer:
         # per-leaf schedule state: path -> {"bits": int, "period": int}
         self._state: Dict[Any, Dict[str, int]] = {}
 
+    # -------------------- checkpoint -------------------- #
+
+    def state_dict(self):
+        """Host schedule state (saved in engine checkpoints so a resumed run
+        continues mid-schedule instead of resetting to start_bits)."""
+        return {"qsteps": self.qsteps,
+                "quantize_real_ratio": self.quantize_real_ratio,
+                "leaf_state": {k: dict(v) for k, v in self._state.items()}}
+
+    def load_state_dict(self, sd):
+        self.qsteps = int(sd["qsteps"])
+        self.quantize_real_ratio = float(sd["quantize_real_ratio"])
+        self._state = {k: dict(v) for k, v in sd["leaf_state"].items()}
+
     # -------------------- schedule -------------------- #
 
     def step(self):
