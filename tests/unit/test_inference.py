@@ -84,7 +84,8 @@ class TestKVCacheDecode:
         base.update(over)
         return CausalLM(TransformerConfig(**base))
 
-    @pytest.mark.parametrize("style", ["gpt2", "llama", "alibi", "gqa"])
+    @pytest.mark.parametrize("style", ["gpt2", "llama", "alibi", "gqa", "gptj",
+                                       "neox_partial"])
     def test_decode_logits_match_full_forward(self, style):
         over = {
             "gpt2": {},
@@ -92,6 +93,13 @@ class TestKVCacheDecode:
                           tie_embeddings=False),
             "alibi": dict(pos_embedding="alibi"),
             "gqa": dict(pos_embedding="rope", n_kv_head=2),
+            # GPT-J: partial INTERLEAVED rotary + single-LN parallel residual
+            "gptj": dict(pos_embedding="rope", rope_dim=4, rope_interleaved=True,
+                         parallel_residual=True, tie_embeddings=False,
+                         lm_head_bias=True),
+            # NeoX rotary_pct < 1: partial half-split rotary
+            "neox_partial": dict(pos_embedding="rope", rope_dim=4,
+                                 parallel_residual=True, attn_bias=True),
         }[style]
         model = self._model(**over)
         params = model.init_params(jax.random.key(0))
