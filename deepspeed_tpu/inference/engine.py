@@ -96,22 +96,68 @@ class InferenceEngine:
                      f"{dense_bytes / 2**20:.0f} MiB (bf16) -> "
                      f"{tree_nbytes(params) / 2**20:.0f} MiB at rest", ranks=[0])
 
+        # ZeRO-Inference: layer weights stay in HOST memory and stream to the
+        # device one layer at a time during forward/decode (reference
+        # zero.stage3 + offload_param powering ZeRO-Inference; the BLOOM-176B
+        # serving recipe). Device residency = one layer + activations + KV.
+        off = dict(self._config.zero or {}).get("offload_param", {})
+        self._stream_weights = str(off.get("device", "none")).lower() in ("cpu", "nvme")
+        if self._stream_weights and tp_size > 1:
+            raise NotImplementedError(
+                "ZeRO-Inference weight streaming with tensor_parallel.tp_size > 1 "
+                "is not implemented; stream on tp_size=1 (dp replicas are fine)")
+        if self._stream_weights and not (hasattr(model, "config")
+                                         and "layers" in params):
+            raise ValueError("weight streaming needs a zoo-layout model "
+                             "(.config + params['layers'] stacked per layer)")
+        if self._stream_weights and getattr(model.config, "norm_position", "pre") == "post":
+            # the streamed path is built from the pre-LN cached_* blocks
+            raise ValueError("weight streaming supports pre-LN models only "
+                             "(norm_position='post' has no cached path)")
+
         from jax.sharding import NamedSharding, PartitionSpec as P
-        if tp_specs is not None and not self._weight_quant:
+        from jax.tree_util import GetAttrKey, tree_map_with_path
+
+        def _is_qscale(path):
+            # Quantized8.scale leaves (reached via a dataclass attr, unlike
+            # dict-keyed layernorm "scale") stay f32
+            return any(isinstance(k, GetAttrKey) and k.name == "scale" for k in path)
+
+        if self._stream_weights:
+            import numpy as _np
+            import ml_dtypes
+            np_dtype = {jnp.bfloat16: ml_dtypes.bfloat16,
+                        jnp.float16: _np.float16,
+                        jnp.float32: _np.float32}[self.dtype]
+
+            def host_leaf(path, a):
+                a = _np.asarray(a)
+                if not _is_qscale(path) and _np.issubdtype(a.dtype, _np.floating):
+                    a = a.astype(np_dtype)
+                return a
+
+            L = model.config.n_layer
+            host_stack = tree_map_with_path(host_leaf, params["layers"])
+            self._host_layers = [jax.tree.map(lambda a: a[i], host_stack)
+                                 for i in range(L)]
+            params = {k: v for k, v in params.items() if k != "layers"}
+            host_bytes = sum(a.nbytes for lp in self._host_layers
+                             for a in jax.tree.leaves(lp))
+            log_dist(f"ZeRO-Inference streaming: {L} layers "
+                     f"({host_bytes / 2**20:.0f} MiB) resident on host; device "
+                     "holds one layer at a time", ranks=[0])
+
+        if tp_specs is not None and not self._weight_quant and not self._stream_weights:
             from deepspeed_tpu.runtime.zero.partition import ZeroShardingRules
             rules = ZeroShardingRules(self.mesh)  # stage 0: replicate except TP dims
             shardings = rules.param_shardings(params, tp_specs)
         else:
             shardings = jax.tree.map(lambda _: NamedSharding(self.mesh, P()), params)
 
-        from jax.tree_util import GetAttrKey, tree_map_with_path
-
         def put(path, a, s):
             a = jnp.asarray(a)
-            # int8 payloads stay int8; Quantized8.scale leaves (reached via a
-            # dataclass attr, unlike dict-keyed layernorm "scale") stay f32
-            is_qscale = any(isinstance(k, GetAttrKey) and k.name == "scale" for k in path)
-            if is_qscale or not jnp.issubdtype(a.dtype, jnp.floating):
+            # int8 payloads stay int8
+            if _is_qscale(path) or not jnp.issubdtype(a.dtype, jnp.floating):
                 return jax.device_put(a, s)
             return jax.device_put(a.astype(self.dtype), s)
 
@@ -120,18 +166,101 @@ class InferenceEngine:
         self._fwd_jit = None
         self._prefill_jit = None
         self._decode_jit = None
+        self._stream_jits = None
         log_dist(f"InferenceEngine ready: dtype={self.dtype.__name__}, tp={tp_size}, "
-                 f"mesh={dict(self.mesh.shape)}", ranks=[0])
+                 f"mesh={dict(self.mesh.shape)}"
+                 + (", weight-streaming" if self._stream_weights else ""), ranks=[0])
 
     # ------------------------------------------------------------------ #
 
     def forward(self, input_ids, attention_mask=None):
         """Full-sequence forward → logits."""
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        if self._stream_weights:
+            if attention_mask is not None:
+                raise NotImplementedError("attention_mask with weight streaming")
+            if input_ids.ndim == 1:
+                input_ids = input_ids[None, :]
+            caches = self._stream_caches(input_ids.shape[0], input_ids.shape[1])
+            logits, _ = self._streamed_step(input_ids, caches, jnp.int32(0))
+            return logits
         if self._fwd_jit is None:
             fwd = self.module.forward if hasattr(self.module, "forward") else self.module
             self._fwd_jit = jax.jit(lambda p, t, m: fwd(p, t, m))
-        input_ids = jnp.asarray(input_ids, jnp.int32)
         return self._fwd_jit(self.params, input_ids, attention_mask)
+
+    # ------------------------------------------------------------------ #
+    # ZeRO-Inference weight streaming: one layer on device at a time
+
+    def _stream_caches(self, B: int, Smax: int):
+        cfg = self.module.config
+        shape = (B, Smax, cfg.kv_heads, cfg.head_dim)
+        return [{"k": jnp.zeros(shape, self.dtype), "v": jnp.zeros(shape, self.dtype)}
+                for _ in range(cfg.n_layer)]
+
+    def _streamed_step(self, tokens, caches, pos, pad_bias=None):
+        """tokens [B, T] against per-layer caches at offset pos: embed on
+        device, then per layer H2D-copy the layer weights and run one jitted
+        block (same compiled program for every layer — shapes match), then
+        the head. The reference analogue is stage3 param fetch/release per
+        module during inference forward."""
+        from deepspeed_tpu.models import transformer as T
+        cfg = self.module.config
+        if self._stream_jits is None:
+            emb = jax.jit(lambda p, t, pos: T.cached_embed(cfg, p, t, pos, self.dtype))
+            blk = jax.jit(
+                lambda h, lp, ck, cv, positions, pos, pb:
+                T.cached_block(cfg, h, lp, ck, cv, positions, pos, pb),
+                donate_argnums=(2, 3))
+            head = jax.jit(lambda p, x: T.cached_head(cfg, p, x))
+            self._stream_jits = (emb, blk, head)
+        emb, blk, head = self._stream_jits
+        x, positions = emb(self.params, tokens, pos)
+        # prefetch layer i+1 while layer i computes: device_put is async, so
+        # issuing the next copy before dispatching blk overlaps H2D with
+        # compute (the dominant cost split of ZeRO-Inference decode)
+        nxt = jax.device_put(self._host_layers[0])
+        for i in range(len(self._host_layers)):
+            lp, nxt = nxt, (jax.device_put(self._host_layers[i + 1])
+                            if i + 1 < len(self._host_layers) else None)
+            x, nk, nv = blk(x, lp, caches[i]["k"], caches[i]["v"],
+                            positions, pos, pad_bias)
+            caches[i] = {"k": nk, "v": nv}
+        return head(self.params, x), caches
+
+    def _generate_streamed(self, input_ids, max_new, temperature, top_k, rng,
+                           eos_token_id):
+        B, prompt_len = input_ids.shape
+        cfg = self.module.config
+        Smax = self._bucket(prompt_len + max_new, cfg.max_seq)
+        bucket = self._bucket(prompt_len, Smax)
+        caches = self._stream_caches(B, Smax)
+
+        pad = bucket - prompt_len
+        toks = jnp.pad(input_ids, ((0, 0), (0, pad))) if pad else input_ids
+        logits, caches = self._streamed_step(toks, caches, jnp.int32(0))
+        rng, sub = jax.random.split(rng)
+        nxt = self._sample_host(logits[:, prompt_len - 1].astype(jnp.float32),
+                                temperature, top_k, sub)
+        eos = eos_token_id
+        done = (nxt == eos) if eos is not None else None
+        tokens = jnp.concatenate([input_ids, nxt[:, None].astype(jnp.int32)], axis=1)
+        for step in range(1, max_new):
+            if eos is not None and bool(done.all()):
+                break
+            pos = prompt_len + step - 1
+            logits, caches = self._streamed_step(
+                tokens[:, -1:], caches, jnp.int32(pos))
+            rng, sub = jax.random.split(rng)
+            nxt = self._sample_host(logits[:, -1].astype(jnp.float32),
+                                    temperature, top_k, sub)
+            if eos is not None:
+                # rows already done keep emitting eos (stable batched output,
+                # same invariant as the compiled decode loop)
+                nxt = jnp.where(done, eos, nxt)
+                done = done | (nxt == eos)
+            tokens = jnp.concatenate([tokens, nxt[:, None].astype(jnp.int32)], axis=1)
+        return tokens
 
     __call__ = forward
 
@@ -155,6 +284,9 @@ class InferenceEngine:
                              f"reduce max_new_tokens (reference max_out_tokens check)")
 
         rng = jax.random.key(seed)
+        if self._stream_weights:
+            return self._generate_streamed(input_ids, max_new, temperature,
+                                           top_k, rng, eos_token_id)
         if hasattr(self.module, "forward_cached") and hasattr(self.module, "init_cache"):
             return self._generate_cached(input_ids, max_new, temperature, top_k, rng, eos_token_id)
 

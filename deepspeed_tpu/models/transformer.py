@@ -566,36 +566,57 @@ def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad
     return out, ck, cv
 
 
-def forward_cached(cfg: TransformerConfig, params, tokens, cache, pos, pad_bias=None):
-    """tokens [B, T] (T static: prompt chunk or 1) attended against + appended
-    to ``cache`` at offset ``pos`` ([] int32). Returns (logits [B, T, vocab],
-    new cache). ``pad_bias`` [B, Smax] additive f32 masks cache slots of
-    left-padded prompts."""
+def cached_embed(cfg: TransformerConfig, params, tokens, pos, dtype):
+    """Embedding for the cached path: tokens [B, T] at cache offset pos."""
     B, T = tokens.shape
-    if cfg.norm_position == "post":
-        raise ValueError("norm_position='post' is not supported by the "
-                         "KV-cache decode path (pre-LN only)")
-    x = params["embed"]["tokens"][tokens].astype(cache["k"].dtype)
+    x = params["embed"]["tokens"][tokens].astype(dtype)
     positions = pos + jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
     if cfg.pos_embedding == "learned":
         x = x + params["embed"]["positions"][positions].astype(x.dtype)
     if cfg.embed_layernorm:
         x = _norm(cfg, x, params["embed"]["ln"])
+    return x, positions
+
+
+def cached_block(cfg: TransformerConfig, h, lp, ck, cv, positions, pos,
+                 pad_bias=None):
+    """ONE layer of the KV-cache path: pre-LN attention against + append to
+    the layer's cache. Shared by the compiled scan in :func:`forward_cached`
+    and ZeRO-Inference weight streaming (per-layer host→device loop,
+    ``inference/engine.py``)."""
+    a, nck, ncv = _cached_attention(cfg, _norm(cfg, h, lp["ln_attn"]), lp["attn"],
+                                    positions, pos, ck, cv, pad_bias)
+    if cfg.parallel_residual:
+        m = mlp(cfg, _norm(cfg, h, lp["ln_mlp"]), lp["mlp"])
+        return h + a + m, nck, ncv
+    h = h + a
+    m = mlp(cfg, _norm(cfg, h, lp["ln_mlp"]), lp["mlp"])
+    return h + m, nck, ncv
+
+
+def cached_head(cfg: TransformerConfig, params, x):
+    """Final norm + logits projection of the cached path."""
+    x = _norm(cfg, x, params["ln_f"])
+    return x @ _head_weight(cfg, params) + _head_bias(params)
+
+
+def forward_cached(cfg: TransformerConfig, params, tokens, cache, pos, pad_bias=None):
+    """tokens [B, T] (T static: prompt chunk or 1) attended against + appended
+    to ``cache`` at offset ``pos`` ([] int32). Returns (logits [B, T, vocab],
+    new cache). ``pad_bias`` [B, Smax] additive f32 masks cache slots of
+    left-padded prompts."""
+    if cfg.norm_position == "post":
+        raise ValueError("norm_position='post' is not supported by the "
+                         "KV-cache decode path (pre-LN only)")
+    x, positions = cached_embed(cfg, params, tokens, pos, cache["k"].dtype)
 
     def run_block(h, xs):
         lp, ck, cv = xs
-        a, nck, ncv = _cached_attention(cfg, _norm(cfg, h, lp["ln_attn"]), lp["attn"],
-                                        positions, pos, ck, cv, pad_bias)
-        if cfg.parallel_residual:
-            m = mlp(cfg, _norm(cfg, h, lp["ln_mlp"]), lp["mlp"])
-            return h + a + m, (nck, ncv)
-        h = h + a
-        m = mlp(cfg, _norm(cfg, h, lp["ln_mlp"]), lp["mlp"])
-        return h + m, (nck, ncv)
+        h, nck, ncv = cached_block(cfg, h, lp, ck, cv, positions, pos, pad_bias)
+        return h, (nck, ncv)
 
     x, (nk, nv) = jax.lax.scan(run_block, x, (params["layers"], cache["k"], cache["v"]))
-    x = _norm(cfg, x, params["ln_f"])
-    logits = x @ _head_weight(cfg, params) + _head_bias(params)
+    logits = cached_head(cfg, params, x)
     return logits, {"k": nk, "v": nv}
 
 
