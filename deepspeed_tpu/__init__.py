@@ -62,17 +62,27 @@ def initialize(args=None,
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 
-def init_inference(model, config=None, **kwargs):
-    """Initialize the inference engine (reference deepspeed/__init__.py:214)."""
+def init_inference(model, config=None, params=None, **kwargs):
+    """Initialize the inference engine (reference deepspeed/__init__.py:214).
+
+    ``params`` (a pytree) supplies the model weights explicitly; it is an
+    engine argument, NOT a config field — folding it into the config dict
+    would silently drop it and re-initialize random weights.
+    """
     from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
     from deepspeed_tpu.inference.engine import InferenceEngine
 
     if config is None:
-        config = kwargs
-    elif kwargs:
+        config = dict(kwargs)
+    else:
         config = {**config, **kwargs}
+    if "params" in config:
+        # weights riding in the config dict are honored, never dropped
+        cfg_params = config.pop("params")
+        if params is None:
+            params = cfg_params
     ds_inference_config = DeepSpeedInferenceConfig(**config)
-    return InferenceEngine(model, config=ds_inference_config)
+    return InferenceEngine(model, config=ds_inference_config, params=params)
 
 
 def add_config_arguments(parser):

@@ -39,9 +39,12 @@ class InferenceEngine:
         # int8 = weight-only quantisation (reference GroupQuantizer,
         # module_inject/replace_module.py:135): activations run bf16, weight
         # matrices are stored int8 + per-group scales (see ops/quant.py)
-        self._weight_quant = str(getattr(self._config.dtype, "value", self._config.dtype)) == "int8"
-        self.dtype = (jnp.bfloat16 if self._weight_quant else
-                      self._config.dtype.jnp if hasattr(self._config.dtype, "jnp") else jnp.bfloat16)
+        dt = str(getattr(self._config.dtype, "value", self._config.dtype))
+        self._weight_quant = dt == "int8"
+        # use_enum_values stores the plain string — map it explicitly (a
+        # hasattr(.jnp) probe silently turned every requested dtype into bf16)
+        self.dtype = {"fp32": jnp.float32, "fp16": jnp.float16,
+                      "bf16": jnp.bfloat16, "int8": jnp.bfloat16}[dt]
 
         tp_size = self._config.tensor_parallel.tp_size
         if not dist.has_mesh():
@@ -55,7 +58,7 @@ class InferenceEngine:
         ckpt = self._config.checkpoint
         if isinstance(model, str) and ckpt is None:
             ckpt, model = model, None
-        if params is None and isinstance(ckpt, str):
+        if params is None and isinstance(ckpt, str) and not ckpt.endswith(".json"):
             from deepspeed_tpu.module_inject import load_hf_checkpoint
             loaded_model, params = load_hf_checkpoint(ckpt)
             if model is None:
@@ -63,10 +66,18 @@ class InferenceEngine:
             self.module = model = model if not isinstance(model, str) else loaded_model
             log_dist(f"InferenceEngine: loaded HF checkpoint {ckpt} "
                      f"({loaded_model.num_parameters / 1e6:.1f}M params)", ranks=[0])
-        elif params is None and isinstance(ckpt, dict):
-            raise NotImplementedError(
-                "ds_inference meta-json checkpoints need a Megatron layout policy; "
-                "pass an HF checkpoint directory or explicit params instead")
+        elif params is None and isinstance(ckpt, (dict,)) or \
+                (params is None and isinstance(ckpt, str) and ckpt.endswith(".json")):
+            # ds_inference meta json (reference engine.py:354-419 sharded
+            # "tp/pp" checkpoints): per-TP-rank Megatron files merged by the
+            # SD loader, then mapped to the zoo layout for model.config
+            from deepspeed_tpu.module_inject.megatron import load_megatron_checkpoint
+            if model is None or not hasattr(model, "config"):
+                raise ValueError("Megatron meta-json checkpoints need the model "
+                                 "(with .config) passed to init_inference")
+            params = load_megatron_checkpoint(ckpt, model.config)
+            log_dist("InferenceEngine: loaded Megatron ds_inference checkpoint "
+                     f"({len(jax.tree.leaves(params))} tensors)", ranks=[0])
 
         if params is None and hasattr(model, "init_params"):
             params = model.init_params(jax.random.key(0))
@@ -101,7 +112,13 @@ class InferenceEngine:
         # zero.stage3 + offload_param powering ZeRO-Inference; the BLOOM-176B
         # serving recipe). Device residency = one layer + activations + KV.
         off = dict(self._config.zero or {}).get("offload_param", {})
-        self._stream_weights = str(off.get("device", "none")).lower() in ("cpu", "nvme")
+        off_dev = str(off.get("device", "none")).lower()
+        if off_dev == "nvme":
+            raise NotImplementedError(
+                "offload_param device 'nvme' for inference streaming is not "
+                "implemented (layers would need the aio swapper); use 'cpu' "
+                "(host RAM) streaming")
+        self._stream_weights = off_dev == "cpu"
         if self._stream_weights and tp_size > 1:
             raise NotImplementedError(
                 "ZeRO-Inference weight streaming with tensor_parallel.tp_size > 1 "
@@ -236,6 +253,8 @@ class InferenceEngine:
         bucket = self._bucket(prompt_len, Smax)
         caches = self._stream_caches(B, Smax)
 
+        if max_new <= 0:
+            return input_ids
         pad = bucket - prompt_len
         toks = jnp.pad(input_ids, ((0, 0), (0, pad))) if pad else input_ids
         logits, caches = self._streamed_step(toks, caches, jnp.int32(0))
@@ -244,13 +263,13 @@ class InferenceEngine:
                                 temperature, top_k, sub)
         eos = eos_token_id
         done = (nxt == eos) if eos is not None else None
-        tokens = jnp.concatenate([input_ids, nxt[:, None].astype(jnp.int32)], axis=1)
+        generated = [np.asarray(nxt, np.int32)]
         for step in range(1, max_new):
             if eos is not None and bool(done.all()):
                 break
             pos = prompt_len + step - 1
             logits, caches = self._streamed_step(
-                tokens[:, -1:], caches, jnp.int32(pos))
+                nxt[:, None].astype(jnp.int32), caches, jnp.int32(pos))
             rng, sub = jax.random.split(rng)
             nxt = self._sample_host(logits[:, -1].astype(jnp.float32),
                                     temperature, top_k, sub)
@@ -259,8 +278,9 @@ class InferenceEngine:
                 # same invariant as the compiled decode loop)
                 nxt = jnp.where(done, eos, nxt)
                 done = done | (nxt == eos)
-            tokens = jnp.concatenate([tokens, nxt[:, None].astype(jnp.int32)], axis=1)
-        return tokens
+            generated.append(np.asarray(nxt, np.int32))
+        gen = jnp.asarray(np.stack(generated, axis=1), jnp.int32)
+        return jnp.concatenate([input_ids, gen], axis=1)
 
     __call__ = forward
 

@@ -1,0 +1,149 @@
+"""Megatron-GPT ds-inference checkpoint ingestion.
+
+Reference parity: ``deepspeed/module_inject/containers/megatron_gpt.py``
+(MegatronLayerPolicy) + ``deepspeed/runtime/state_dict_factory.py``
+(``MegatronSDLoader`` — per-TP-rank file merge with version-aware fused-qkv
+handling) + the ds_inference meta-json checkpoint branch
+(``deepspeed/inference/engine.py:354-419``).
+
+Flow: the meta json lists per-TP-rank files → :class:`MegatronSDLoader`
+merges them (qkv-aware, ``checkpoint/state_dict_factory.py``) → this module
+maps Megatron tensor names to the zoo layout for the model's
+``TransformerConfig``. The fused qkv layout depends on the checkpoint
+version (reference ``merge_query_key_value`` doc):
+
+- v0:   ``[3·np·hn, h]`` — after the loader's qkv-aware merge the full
+  tensor is ``[q | k | v]`` block-concat;
+- v1.0: ``[np·hn·3, h]`` — per head, per head-dim, (q,k,v) interleaved;
+- v2.0: ``[np·3·hn, h]`` — per head ``[q_h | k_h | v_h]`` (NeoX-style).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+def megatron_merge_strategies(version=0) -> Dict[str, Any]:
+    """Per-tensor TP merge strategy (Megatron column-parallel weights shard
+    dim 0 in the torch [out, in] layout, row-parallel dim 1, vocab-parallel
+    embedding dim 0; row-parallel biases and layernorms replicate).
+
+    Fused qkv: version 0 ranks hold contiguous ``[q_i | k_i | v_i]`` blocks,
+    so the merge must be q/k/v-aware; v1.0/v2.0 lay q/k/v out per HEAD, so
+    rank shards concat plainly (reference ``merge_query_key_value``).
+    """
+    qkv = (0, "qkv") if version == 0 else 0
+    return {
+        "attention.query_key_value.weight": qkv,
+        "attention.query_key_value.bias": qkv,
+        "attention.dense.weight": 1,
+        "mlp.dense_h_to_4h.weight": 0,
+        "mlp.dense_h_to_4h.bias": 0,
+        "mlp.dense_4h_to_h.weight": 1,
+        "word_embeddings.weight": 0,
+    }
+
+
+def _split_fused_qkv(w3, H: int, Hd: int, version) -> tuple:
+    """Version-aware de-fuse of a MERGED qkv tensor (weight [3D, D] or bias
+    [3D]) into (q, k, v), each transposed to the zoo's [in, out] layout."""
+    D3 = w3.shape[0]
+    D = D3 // 3
+    if version == 0:
+        q, k, v = np.split(w3, 3, axis=0)                  # [q | k | v]
+    elif float(version) == 1.0:
+        r = w3.reshape((H, Hd, 3) + w3.shape[1:])          # per-dim triples
+        q, k, v = (r[:, :, i].reshape((D,) + w3.shape[1:]) for i in range(3))
+    elif float(version) == 2.0:
+        r = w3.reshape((H, 3, Hd) + w3.shape[1:])          # per-head blocks
+        q, k, v = (r[:, i].reshape((D,) + w3.shape[1:]) for i in range(3))
+    else:
+        raise ValueError(f"unsupported Megatron checkpoint version {version!r}")
+    if w3.ndim == 2:  # torch [out, in] -> zoo [in, out]
+        q, k, v = q.T, k.T, v.T
+    return (np.ascontiguousarray(q), np.ascontiguousarray(k),
+            np.ascontiguousarray(v))
+
+
+def map_megatron_params(sd: Dict[str, np.ndarray], cfg, version=0) -> Dict[str, Any]:
+    """Merged Megatron-GPT state dict → zoo params for ``cfg``."""
+    def g(name):
+        for pre in ("", "module.", "model.", "language_model."):
+            if pre + name in sd:
+                return np.asarray(sd[pre + name])
+        # embedding/transformer scoping variants
+        for pre in ("language_model.embedding.", "embedding."):
+            if pre + name in sd:
+                return np.asarray(sd[pre + name])
+        raise KeyError(name)
+
+    L, H, Hd = cfg.n_layer, cfg.n_head, cfg.head_dim
+    lp = None
+    for cand in ("transformer.layers", "language_model.transformer.layers",
+                 "encoder.layers", "language_model.encoder.layers"):
+        if any(k.startswith(cand) or k.startswith("module." + cand) for k in sd):
+            lp = cand
+            break
+    if lp is None:
+        raise KeyError("no Megatron transformer layers found in state dict")
+
+    def t(a):
+        return np.ascontiguousarray(np.asarray(a).T)
+
+    def stack(fmt, tr=False):
+        return np.stack([(t(g(fmt.format(i))) if tr else np.asarray(g(fmt.format(i))))
+                         for i in range(L)])
+
+    qw, kw, vw, qb, kb, vb = [], [], [], [], [], []
+    for i in range(L):
+        a, b, c = _split_fused_qkv(
+            g(f"{lp}.{i}.attention.query_key_value.weight"), H, Hd, version)
+        qw.append(a); kw.append(b); vw.append(c)
+        a, b, c = _split_fused_qkv(
+            g(f"{lp}.{i}.attention.query_key_value.bias"), H, Hd, version)
+        qb.append(a); kb.append(b); vb.append(c)
+
+    fl = "final_layernorm"
+    for cand in (f"{lp.rsplit('.layers', 1)[0]}.final_layernorm",):
+        try:
+            g(cand + ".weight")
+            fl = cand
+            break
+        except KeyError:
+            pass
+
+    return {
+        "embed": {"tokens": np.asarray(g("word_embeddings.weight")),
+                  "positions": np.asarray(g("position_embeddings.weight"))},
+        "layers": {
+            "ln_attn": {"scale": stack(lp + ".{}.input_layernorm.weight"),
+                        "bias": stack(lp + ".{}.input_layernorm.bias")},
+            "attn": {"wq": np.stack(qw), "wk": np.stack(kw), "wv": np.stack(vw),
+                     "bq": np.stack(qb), "bk": np.stack(kb), "bv": np.stack(vb),
+                     "wo": stack(lp + ".{}.attention.dense.weight", tr=True),
+                     "bo": stack(lp + ".{}.attention.dense.bias")},
+            "ln_mlp": {"scale": stack(lp + ".{}.post_attention_layernorm.weight"),
+                       "bias": stack(lp + ".{}.post_attention_layernorm.bias")},
+            "mlp": {"w_up": stack(lp + ".{}.mlp.dense_h_to_4h.weight", tr=True),
+                    "b_up": stack(lp + ".{}.mlp.dense_h_to_4h.bias"),
+                    "w_down": stack(lp + ".{}.mlp.dense_4h_to_h.weight", tr=True),
+                    "b_down": stack(lp + ".{}.mlp.dense_4h_to_h.bias")},
+        },
+        "ln_f": {"scale": np.asarray(g(fl + ".weight")),
+                 "bias": np.asarray(g(fl + ".bias"))},
+    }
+
+
+def load_megatron_checkpoint(ckpt_json, cfg) -> Dict[str, Any]:
+    """ds_inference meta json (``{"type": "Megatron", "checkpoints": [...],
+    "version": V}``) → zoo params for the model config ``cfg``."""
+    from deepspeed_tpu.checkpoint.state_dict_factory import SDLoaderFactory
+
+    sd_type, paths, version = SDLoaderFactory.get_sd_loader_json(ckpt_json)
+    if str(sd_type).lower() not in ("megatron", "ds_model"):
+        raise ValueError(f"unsupported ds_inference checkpoint type {sd_type!r}")
+    loader = SDLoaderFactory.get_sd_loader(paths, sd_type, version)
+    merged = loader.load(mp_world_size=1,
+                         merge_strategies=megatron_merge_strategies(version))
+    return map_megatron_params(merged, cfg, version=version)

@@ -103,3 +103,32 @@ def test_streaming_composes_with_int8():
     toks = jnp.asarray([[1, 2, 3]], jnp.int32)
     out = eng.forward(toks)
     assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+
+def test_streaming_nvme_rejected_loudly():
+    model = _model()
+    params = model.init_params(jax.random.key(0))
+    with pytest.raises(NotImplementedError, match="nvme"):
+        deepspeed_tpu.init_inference(
+            model, dtype="fp32", params=params,
+            zero={"stage": 3, "offload_param": {"device": "nvme"}})
+
+
+def test_streamed_generate_zero_new_tokens():
+    model = _model()
+    params = model.init_params(jax.random.key(0))
+    _, eng = _engines(model, params)
+    prompt = jnp.asarray([[5, 9, 2, 7]], jnp.int32)
+    out = np.asarray(eng.generate(prompt, max_new_tokens=0))
+    assert out.shape == (1, 4)
+
+
+def test_params_in_config_dict_honored():
+    """Weights riding in the config dict must not be silently dropped."""
+    model = _model()
+    p1 = model.init_params(jax.random.key(7))
+    eng = deepspeed_tpu.init_inference(model, config={"dtype": "fp32",
+                                                      "params": p1})
+    got = np.asarray(eng.params["embed"]["tokens"], np.float32)
+    want = np.asarray(p1["embed"]["tokens"], np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
