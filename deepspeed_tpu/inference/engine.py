@@ -365,6 +365,8 @@ class InferenceEngine:
         return min(-(-max(n, 1) // 128) * 128, cap)
 
     def _generate_cached(self, input_ids, max_new, temperature, top_k, rng, eos_token_id):
+        if max_new <= 0:
+            return input_ids
         B, prompt_len = input_ids.shape
         cfg = self.module.config
         cache, Smax = self._kv_workspace(B, min(cfg.max_seq, prompt_len + max_new))

@@ -16,11 +16,11 @@ from deepspeed_tpu.models.clip import (CLIPTextConfig, CLIPTextEncoder,
 from deepspeed_tpu.models.diffusers_wrappers import DSUNet, DSVAE
 from deepspeed_tpu.models.pipeline import PipelinedCausalLM
 from deepspeed_tpu.models.presets import (MODEL_PRESETS, bloom, get_model, gpt2, gpt2_large,
-                                          gpt2_medium, gpt2_xl, gpt_neox, llama_7b, opt)
+                                          gpt2_medium, gpt2_xl, gpt_neox, llama, llama_7b, opt)
 
 __all__ = [
     "CausalLM", "PipelinedCausalLM", "MODEL_PRESETS", "get_model", "gpt2", "gpt2_medium", "gpt2_large",
-    "gpt2_xl", "llama_7b", "bloom", "opt", "gpt_neox",
+    "gpt2_xl", "llama", "llama_7b", "bloom", "opt", "gpt_neox",
     "CLIPTextEncoder", "CLIPVisionEncoder", "CLIPTextConfig", "CLIPVisionConfig",
     "DSClipEncoder", "DSUNet", "DSVAE", "BertModel", "BertConfig",
 ]
