@@ -69,7 +69,9 @@ def main():
         out = engine.generate(prompt, max_new_tokens=args.gen)
         total.append(time.perf_counter() - t0)
     n_gen = int(np.asarray(out).shape[1]) - args.prompt_len
-    decode = [(t - p) / max(n_gen - 1, 1) for t, p in zip(total, prefill)]
+    # with gen < 2 "decode" would be the jitter between two identical calls
+    decode = ([(t - p) / (n_gen - 1) for t, p in zip(total, prefill)]
+              if n_gen >= 2 else None)
 
     print(json.dumps({
         "model": args.model, "batch": args.batch,
@@ -77,8 +79,9 @@ def main():
         "stream": bool(args.stream),
         "prefill_ms": {q: round(pct(prefill, p) * 1e3, 2)
                        for q, p in (("p50", 50), ("p90", 90), ("p99", 99))},
-        "decode_ms_per_token": {q: round(pct(decode, p) * 1e3, 2)
-                                for q, p in (("p50", 50), ("p90", 90), ("p99", 99))},
+        "decode_ms_per_token": ({q: round(pct(decode, p) * 1e3, 2)
+                                 for q, p in (("p50", 50), ("p90", 90), ("p99", 99))}
+                                if decode else None),
         "tokens_per_s": round(args.batch * n_gen / pct(total, 50), 1),
     }))
 

@@ -365,6 +365,21 @@ def _flash_mesh(cfg: TransformerConfig):
     return mesh
 
 
+def _shard_axes(mesh, B: int, H: int, KV: int = None):
+    """Batch/head mesh-axis split shared by the shard_map-wrapped kernels:
+    returns (batch_axes, head_axis, nb, nh), or None when the sizes don't
+    divide the axes."""
+    batch_axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+    head_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
+    nb = 1
+    for a in batch_axes:
+        nb *= mesh.shape[a]
+    nh = mesh.shape["tp"] if head_axis else 1
+    if B % nb or H % nh or (KV is not None and KV % nh):
+        return None
+    return batch_axes, head_axis, nb, nh
+
+
 def _flash_sharded(cfg: TransformerConfig, q, k, v, mask_bias, slopes, mesh):
     """Flash attention under a dp/fsdp×tp mesh: shard_map over the batch and
     head axes (no cross-shard communication — attention is pointwise in batch
@@ -374,14 +389,10 @@ def _flash_sharded(cfg: TransformerConfig, q, k, v, mask_bias, slopes, mesh):
     from jax import shard_map
 
     B, S, H, Hd = q.shape
-    batch_axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
-    head_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
-    nb = 1
-    for a in batch_axes:
-        nb *= mesh.shape[a]
-    nh = mesh.shape["tp"] if head_axis else 1
-    if B % nb != 0 or H % nh != 0:
+    split = _shard_axes(mesh, B, H)
+    if split is None:
         return None
+    batch_axes, head_axis, nb, nh = split
 
     qspec = P(batch_axes or None, None, head_axis, None)
     mspec = P(batch_axes or None, None)
@@ -409,6 +420,51 @@ def _flash_sharded(cfg: TransformerConfig, q, k, v, mask_bias, slopes, mesh):
 
     wrapped = shard_map(inner, mesh=mesh, in_specs=tuple(specs),
                        out_specs=qspec, check_vma=False)
+    return wrapped(*operands)
+
+
+def _decode_sharded(q1, ck, cv, pos, pad_bias, slopes, mesh):
+    """Decode-attention kernel under a dp/fsdp×tp mesh: shard_map over batch
+    (q/cache/pad_bias) and heads (q + KV cache + slopes) — decode attention
+    is pointwise in batch and head, so shards need no communication and the
+    multi-chip TP serving path keeps the fused kernel instead of the
+    O(B·H·Smax) einsum with a repeated GQA cache.
+    Returns None when shard sizes don't divide or the per-shard shape is
+    outside the kernel envelope (caller falls back)."""
+    from jax import shard_map
+
+    B, H, Hd = q1.shape
+    Smax, KV = ck.shape[1], ck.shape[2]
+    split = _shard_axes(mesh, B, H, KV)
+    if split is None:
+        return None
+    batch_axes, head_axis, nb, nh = split
+    # per-shard kernel envelope, checked here because the shard_map body
+    # cannot fall back per-shard
+    if (H // nh) % (KV // nh) or Hd % 64 or Smax % 128:
+        return None
+
+    from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+
+    qspec = P(batch_axes or None, head_axis, None)
+    cspec = P(batch_axes or None, None, head_axis, None)
+    operands = [q1, ck, cv, jnp.asarray(pos, jnp.int32)]
+    specs = [qspec, cspec, cspec, P()]
+    if pad_bias is not None:
+        operands.append(pad_bias.astype(jnp.float32))
+        specs.append(P(batch_axes or None, None))
+    if slopes is not None:
+        operands.append(jnp.asarray(slopes, jnp.float32).reshape(H))
+        specs.append(P(head_axis))
+
+    def inner(qs, cks, cvs, ps, *rest):
+        rest = list(rest)
+        ms = rest.pop(0) if pad_bias is not None else None
+        ss = rest.pop(0) if slopes is not None else None
+        return decode_attention(qs, cks, cvs, ps, pad_bias=ms, alibi_slopes=ss)
+
+    wrapped = shard_map(inner, mesh=mesh, in_specs=tuple(specs),
+                        out_specs=qspec, check_vma=False)
     return wrapped(*operands)
 
 
@@ -530,13 +586,21 @@ def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
 
-    if T == 1 and _use_flash(cfg):
+    if T == 1:
         # fused decode kernel: streams the cache once, no GQA repeat copy
-        # (reference softmax_context, pt_binding.cpp:1668-1793)
-        from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+        # (reference softmax_context, pt_binding.cpp:1668-1793) — direct on
+        # one device, shard_map over batch/head axes on dp/fsdp×tp meshes
         slopes = _alibi_slopes(H) if cfg.pos_embedding == "alibi" else None
-        o = decode_attention(q[:, 0], ck, cv, pos, pad_bias=pad_bias,
-                             alibi_slopes=slopes)
+        o = None
+        if _use_flash(cfg):
+            from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+            o = decode_attention(q[:, 0], ck, cv, pos, pad_bias=pad_bias,
+                                 alibi_slopes=slopes)
+        else:
+            dmesh = _flash_mesh(cfg)
+            if dmesh is not None:
+                o = _decode_sharded(q[:, 0], ck, cv, pos, pad_bias,
+                                    slopes, dmesh)
         if o is not None:
             out = o.reshape(B, 1, H * Hd)
             out = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
