@@ -164,7 +164,13 @@ class InferenceEngine:
                      f"({host_bytes / 2**20:.0f} MiB) resident on host; device "
                      "holds one layer at a time", ranks=[0])
 
-        if tp_specs is not None and not self._weight_quant and not self._stream_weights:
+        # pre-quantized param trees (e.g. quantize-on-load) carry Quantized8
+        # nodes the model's plain tp_specs tree can't be mapped over
+        from deepspeed_tpu.ops.quant import Quantized8
+        has_quant_nodes = any(isinstance(l, Quantized8) for l in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, Quantized8)))
+        if tp_specs is not None and not self._weight_quant \
+                and not self._stream_weights and not has_quant_nodes:
             from deepspeed_tpu.runtime.zero.partition import ZeroShardingRules
             rules = ZeroShardingRules(self.mesh)  # stage 0: replicate except TP dims
             shardings = rules.param_shardings(params, tp_specs)

@@ -135,9 +135,15 @@ def map_megatron_params(sd: Dict[str, np.ndarray], cfg, version=0) -> Dict[str, 
     }
 
 
-def load_megatron_checkpoint(ckpt_json, cfg) -> Dict[str, Any]:
+def load_megatron_checkpoint(ckpt_json, cfg, quantize: bool = False,
+                             quantize_bits: int = 8, quantize_groups: int = 64,
+                             mlp_extra_grouping: bool = True) -> Dict[str, Any]:
     """ds_inference meta json (``{"type": "Megatron", "checkpoints": [...],
-    "version": V}``) → zoo params for the model config ``cfg``."""
+    "version": V}``) → zoo params for the model config ``cfg``.
+
+    ``quantize`` flags mirror the reference SD loader's quantize-on-load
+    surface; quantization runs AFTER name-mapping so the per-group scales
+    line up with the zoo's [in, out] layout (see runtime/weight_quantizer)."""
     from deepspeed_tpu.checkpoint.state_dict_factory import SDLoaderFactory
 
     sd_type, paths, version = SDLoaderFactory.get_sd_loader_json(ckpt_json)
@@ -146,4 +152,10 @@ def load_megatron_checkpoint(ckpt_json, cfg) -> Dict[str, Any]:
     loader = SDLoaderFactory.get_sd_loader(paths, sd_type, version)
     merged = loader.load(mp_world_size=1,
                          merge_strategies=megatron_merge_strategies(version))
-    return map_megatron_params(merged, cfg, version=version)
+    params = map_megatron_params(merged, cfg, version=version)
+    if quantize:
+        from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
+        wq = WeightQuantization(mlp_extra_grouping=mlp_extra_grouping)
+        params = wq.quantize_params(params, quantize_bits, quantize_groups,
+                                    include_head=not cfg.tie_embeddings)
+    return params

@@ -150,3 +150,36 @@ def test_engine_loads_megatron_meta_json(tmp_path):
     np.testing.assert_allclose(np.asarray(eng.forward(toks)),
                                np.asarray(base.forward(toks)),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_quantize_on_load(tmp_path):
+    """quantize flags on load_megatron_checkpoint: zoo matmul weights come
+    back as int8 Quantized8 nodes with zoo-layout scales; norms/embeddings/
+    biases stay dense; MLP matrices get 2x groups (reference
+    WeightQuantization mlp_extra_grouping)."""
+    from deepspeed_tpu.ops.quant import Quantized8
+
+    cfg = _cfg()
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.key(2))
+    ranks = _shard_megatron_sd(_to_megatron_sd(params, cfg, 0), 2, 0)
+    meta = _write_ckpt(tmp_path, ranks, 0)
+    loaded = load_megatron_checkpoint(meta, cfg, quantize=True,
+                                      quantize_groups=4)
+    att = loaded["layers"]["attn"]["wq"]
+    mlp = loaded["layers"]["mlp"]["w_up"]
+    assert isinstance(att, Quantized8) and isinstance(mlp, Quantized8)
+    # scales group the LAST (zoo out) axis; extra grouping doubles the MLP's
+    assert att.scale.shape[-1] == 4
+    assert mlp.scale.shape[-1] == 8
+    # dense leaves untouched
+    assert not isinstance(loaded["layers"]["ln_attn"]["scale"], Quantized8)
+    assert not isinstance(loaded["embed"]["tokens"], Quantized8)
+    # round-trips to int8 precision
+    w = np.asarray(params["layers"]["attn"]["wq"])
+    err = np.abs(np.asarray(att.dequant(jnp.float32)) - w).max()
+    assert err <= np.abs(w).max() / 127
+    # the quantized tree still serves: engine forward is finite
+    eng = deepspeed_tpu.init_inference(model, dtype="fp32", params=loaded)
+    out = eng.forward(jnp.asarray([[1, 2, 3]], jnp.int32))
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
