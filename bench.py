@@ -43,10 +43,12 @@ def main():
     params = model.init_params(jax.random.key(0))
 
     dist.set_mesh(None)
+    # BENCH_OPT=FusedAdam selects the Pallas fused single-pass optimizer
+    OPT = os.environ.get("BENCH_OPT", "AdamW")
     config = {
         "train_micro_batch_size_per_gpu": BATCH,
         "gradient_accumulation_steps": 1,
-        "optimizer": {"type": "AdamW", "params": {"lr": 6e-4, "weight_decay": 0.1}},
+        "optimizer": {"type": OPT, "params": {"lr": 6e-4, "weight_decay": 0.1}},
         "zero_optimization": {"stage": 1},
         "bf16": {"enabled": True},
         "mesh": {"dp": -1},

@@ -186,3 +186,25 @@ def test_engine_accessors():
     assert engine.train_batch_size() == 4 * 2 * 8
     assert engine.zero_optimization_stage() == 2
     assert engine.hidden_dim == HIDDEN  # __getattr__ delegation to client model
+
+
+def test_checkpoint_roundtrip_fused_adam(tmp_path):
+    """Fused-optimizer state (custom FusedAdamState NamedTuple) survives
+    save/load, incl. the mu/nu opt-state labels."""
+    import json
+
+    extra = {"optimizer": {"type": "FusedAdam", "params": {"lr": 1e-2}}}
+    engine = make_engine(stage=1, precision="bf16", extra=extra)
+    for i in range(2):
+        engine.train_batch(global_batch(engine, seed=i))
+    engine.save_checkpoint(str(tmp_path), tag="f1")
+    with open(tmp_path / "f1" / "meta.json") as f:
+        meta = json.load(f)
+    moments = {l["moment"] for l in meta["opt_state_labels"]}
+    assert "mu" in moments and "nu" in moments  # labels resolve the state
+
+    engine2 = make_engine(stage=1, precision="bf16", extra=extra)
+    engine2.load_checkpoint(str(tmp_path))
+    l1 = float(engine.train_batch(global_batch(engine, seed=42)))
+    l2 = float(engine2.train_batch(global_batch(engine2, seed=42)))
+    assert abs(l1 - l2) < 1e-5
