@@ -288,27 +288,30 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
         q = _rope(q, positions, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
         k = _rope(k, positions, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
 
-    if KV != H:  # GQA: repeat kv heads
-        rep = H // KV
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-
     slopes = _alibi_slopes(H) if cfg.pos_embedding == "alibi" else None
 
     sp_mesh = _sp_mesh(cfg)
     out = None
     if sp_mesh is not None:
+        # GQA kv stays UNREPEATED through the sp collectives (ring ppermute /
+        # ulysses all-to-all move H/KV-times less data); the shard bodies
+        # broadcast kv heads locally
         from deepspeed_tpu.sequence import sp_attention
         out = sp_attention(q, k, v, mesh=sp_mesh, impl=cfg.sequence_parallel,
                            causal=cfg.causal, mask_bias=mask_bias, alibi_slopes=slopes)
-    elif _use_flash(cfg):
-        from deepspeed_tpu.ops.pallas import flash_attention
-        out = flash_attention(q, k, v, mask_bias=mask_bias, causal=cfg.causal,
-                              alibi_slopes=slopes)
     else:
-        fmesh = _flash_mesh(cfg)
-        if fmesh is not None:
-            out = _flash_sharded(cfg, q, k, v, mask_bias, slopes, fmesh)
+        if KV != H:  # GQA: repeat kv heads for the flash/dense paths
+            rep = H // KV
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        if _use_flash(cfg):
+            from deepspeed_tpu.ops.pallas import flash_attention
+            out = flash_attention(q, k, v, mask_bias=mask_bias, causal=cfg.causal,
+                                  alibi_slopes=slopes)
+        else:
+            fmesh = _flash_mesh(cfg)
+            if fmesh is not None:
+                out = _flash_sharded(cfg, q, k, v, mask_bias, slopes, fmesh)
     if out is None:
         from deepspeed_tpu.ops.attention import mha_attention
         out = mha_attention(q, k, v,

@@ -29,11 +29,14 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=N
                          alibi_slopes=None, scale: Optional[float] = None):
     """Per-shard body (call inside ``shard_map`` over ``axis``).
 
-    q, k, v: LOCAL [B, Sq, H, Hd] / [B, Sk, H, Hd] blocks; mask_bias: local
-    additive key mask [B, Sk] or None. Returns local [B, Sq, H, Hd].
+    q, k, v: LOCAL [B, Sq, H, Hd] / [B, Sk, KV, Hd] blocks (KV may be a
+    divisor of H — GQA kv rides the ring UNREPEATED, H/KV× less ppermute
+    traffic); mask_bias: local additive key mask [B, Sk] or None. Returns
+    local [B, Sq, H, Hd].
     """
     B, Sq, H, Hd = q.shape
-    Sk = k.shape[1]
+    Sk, KV = k.shape[1], k.shape[2]
+    rep = H // KV
     sp = jax.lax.axis_size(axis)
     my_block = jax.lax.axis_index(axis)
     scale = scale if scale is not None else Hd**-0.5
@@ -48,6 +51,9 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=N
         kv_block = (my_block - s) % sp
         kvpos = kv_block * Sk + jnp.arange(Sk)
 
+        if rep != 1:  # broadcast GQA kv heads locally (fuses into the dot)
+            kb = jnp.repeat(kb, rep, axis=2)
+            vb = jnp.repeat(vb, rep, axis=2)
         logits = jnp.einsum("bqhd,bkhd->bhqk", q32, kb.astype(jnp.float32),
                             preferred_element_type=jnp.float32) * scale
         if alibi_slopes is not None:

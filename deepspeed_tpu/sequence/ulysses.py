@@ -27,11 +27,14 @@ def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bia
                             alibi_slopes=None, scale: Optional[float] = None):
     """Per-shard body (inside ``shard_map`` over ``axis``).
 
-    q [B, Sq_loc, H, Hd], k/v [B, Sk_loc, H_or_KV, Hd], mask_bias local
-    [B, Sk_loc] additive. H must be divisible by the axis size.
+    q [B, Sq_loc, H, Hd], k/v [B, Sk_loc, H_or_KV, Hd] (GQA kv may carry
+    KV < H heads: when KV divides the axis size it rides the all-to-all
+    unrepeated — H/KV× less wire — and is broadcast after; otherwise it is
+    repeated first), mask_bias local [B, Sk_loc] additive. H must be
+    divisible by the axis size.
     """
     sp = jax.lax.axis_size(axis)
-    H = q.shape[2]
+    H, KV = q.shape[2], k.shape[2]
     if H % sp != 0:
         raise ValueError(f"Ulysses SP needs heads ({H}) divisible by sp axis size ({sp})")
 
@@ -39,7 +42,16 @@ def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bia
     def to_heads(x):
         return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
 
+    if KV != H and KV % sp != 0:
+        # can't head-scatter fewer kv heads than chips: fall back to
+        # repeating before the transfer
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+        KV = H
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    if KV != H:  # broadcast the local KV/sp kv heads to H/sp query heads
+        kh = jnp.repeat(kh, H // KV, axis=2)
+        vh = jnp.repeat(vh, H // KV, axis=2)
     if mask_bias is not None:
         mask_bias = jax.lax.all_gather(mask_bias, axis, axis=1, tiled=True)  # [B, S]
 
