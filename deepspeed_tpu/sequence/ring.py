@@ -24,6 +24,12 @@ from deepspeed_tpu.sequence._program import run_sp_program
 
 _NEG_INF = -1e9  # matches ops.attention masking constant
 
+# per-ring-step key-chunk size: local shards larger than this stream their
+# softmax in chunks (bounds logits memory to O(Sq * RING_KEY_CHUNK)).
+# Import-time knob: the compiled sp programs are cached WITHOUT this in the
+# key, so set it before the first ring_attention call of the process.
+RING_KEY_CHUNK = 1024
+
 
 def ring_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=None,
                          alibi_slopes=None, scale: Optional[float] = None):
@@ -46,12 +52,26 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=N
 
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
-    def accumulate(kb, vb, maskb, m, l, o, s):
-        """One flash-softmax update against kv block (my_block - s) mod sp."""
-        kv_block = (my_block - s) % sp
-        kvpos = kv_block * Sk + jnp.arange(Sk)
+    # inner key-chunking bounds per-ring-step logits to O(Sq·chunk): at real
+    # long context the LOCAL shard is still big (512k/16 = 32k keys → a
+    # 32k×32k logits block is GBs per head), so the shard-local softmax
+    # must itself stream
+    if Sk > RING_KEY_CHUNK:
+        # smallest chunk count >= Sk/RING_KEY_CHUNK that divides Sk, so the
+        # memory bound holds for non-multiple shard sizes too (worst case a
+        # prime Sk degrades to n_chunks == Sk, never to unchunked)
+        n_chunks = -(-Sk // RING_KEY_CHUNK)
+        while Sk % n_chunks:
+            n_chunks += 1
+    else:
+        n_chunks = 1
+    Ck = Sk // n_chunks
 
-        if rep != 1:  # broadcast GQA kv heads locally (fuses into the dot)
+    def _update(kb, vb, maskb, kvpos, m, l, o):
+        """Streaming-softmax update against one key chunk at global kvpos.
+        GQA kv arrives unrepeated and broadcasts here, per CHUNK — the full
+        rep-expanded shard never materializes."""
+        if rep != 1:
             kb = jnp.repeat(kb, rep, axis=2)
             vb = jnp.repeat(vb, rep, axis=2)
         logits = jnp.einsum("bqhd,bkhd->bhqk", q32, kb.astype(jnp.float32),
@@ -71,6 +91,29 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=N
         o_new = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vb.astype(jnp.float32),
                                                   preferred_element_type=jnp.float32)
         return m_new, l_new, o_new
+
+    def accumulate(kb, vb, maskb, m, l, o, s):
+        """One flash-softmax update against kv block (my_block - s) mod sp."""
+        pos0 = ((my_block - s) % sp) * Sk
+
+        if n_chunks == 1:
+            return _update(kb, vb, maskb, pos0 + jnp.arange(Sk), m, l, o)
+
+        def chunk_step(carry, c):
+            m, l, o = carry
+            kc = jax.lax.dynamic_slice_in_dim(kb, c * Ck, Ck, 1)
+            vc = jax.lax.dynamic_slice_in_dim(vb, c * Ck, Ck, 1)
+            mc = (jax.lax.dynamic_slice_in_dim(maskb, c * Ck, Ck, 1)
+                  if maskb is not None else None)
+            return _update(kc, vc, mc, pos0 + c * Ck + jnp.arange(Ck), m, l, o), None
+
+        # remat: without it AD stacks each chunk's softmax residuals and the
+        # O(Sq*S) footprint the chunking exists to avoid comes right back in
+        # the backward pass
+        chunk_step = jax.checkpoint(chunk_step, prevent_cse=False)
+        (m, l, o), _ = jax.lax.scan(chunk_step, (m, l, o),
+                                    jnp.arange(n_chunks, dtype=jnp.int32))
+        return m, l, o
 
     m0 = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, H, Sq), jnp.float32)
