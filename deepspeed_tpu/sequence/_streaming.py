@@ -1,0 +1,209 @@
+"""Shared chunked streaming-softmax attention core for the SP paths.
+
+One implementation of the numerically sensitive flash-softmax math used by
+both sequence-parallel programs (``ring.py`` per ring step, ``ulysses.py``
+over the full gathered sequence), with a **custom VJP**: the backward pass
+recomputes per-chunk probabilities from the saved logsumexp instead of
+letting AD stack per-chunk residuals — residual memory is O(S·Hd)
+(q/k/v/out/lse) and live memory O(Sq·chunk) in BOTH directions. Same
+recompute strategy as the Pallas flash kernel's bwd
+(``ops/pallas/flash_attention.py``), expressed in XLA for the places a bare
+kernel cannot go (inside sp shard_map bodies).
+
+Key chunks are PADDED to a multiple of ``chunk`` with fully-masked tails
+(no divisor search — shard sizes with no good divisor would otherwise
+collapse to tiny chunks and thousands of sequential steps).
+
+GQA: k/v may carry KV = H/rep heads; they broadcast per CHUNK inside the
+loop, so the rep-expanded kv never materializes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG_INF = -1e9  # matches ops.attention masking constant
+
+
+def _float0_like(x):
+    return np.zeros(np.shape(x), jax.dtypes.float0)
+
+
+def _pad_kv(k, v, mask_bias, chunk):
+    """Pad keys to a chunk multiple. The pad tail rides a TRUE -inf bias
+    (not _NEG_INF): its weight is exactly 0 even for degenerate rows whose
+    every real key is -1e9-masked, keeping fully-masked-row outputs equal
+    to the dense reference's uniform-over-real-keys. Safe from exp(-inf+inf)
+    NaNs because pad < chunk, so every chunk holds >= 1 key whose logit is
+    > -inf."""
+    Sk = k.shape[1]
+    pad = (-Sk) % chunk
+    if pad == 0:
+        return k, v, mask_bias, Sk
+    if mask_bias is None:
+        mask_bias = jnp.zeros((k.shape[0], Sk), jnp.float32)
+    k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    mask_bias = jnp.pad(mask_bias, ((0, 0), (0, pad)),
+                        constant_values=-jnp.inf)
+    return k, v, mask_bias, Sk
+
+
+def _chunk_logits(q32, kc, maskc, qpos, kposc, causal, slopes, scale, rep):
+    """fp32 logits for one key chunk: GQA broadcast, scale, alibi, causal
+    and key-mask bias. q32 [B,H,Sq,Hd], kc [B,Ck,KV,Hd] → [B,H,Sq,Ck]."""
+    if rep != 1:
+        kc = jnp.repeat(kc, rep, axis=2)
+    logits = jnp.einsum("bhqd,bkhd->bhqk", q32, kc.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * scale
+    if slopes is not None:
+        dist = (kposc[None, :] - qpos[:, None]).astype(jnp.float32)
+        logits = logits + slopes[None, :, None, None] * dist[None, None]
+    if causal:
+        logits = jnp.where((qpos[:, None] >= kposc[None, :])[None, None],
+                           logits, _NEG_INF)
+    if maskc is not None:
+        logits = logits + maskc[:, None, None, :]
+    return logits
+
+
+# qpos0/kpos0 are TRACED int32 scalars (ring passes axis_index-derived block
+# offsets), so they are regular operands with float0 cotangents — only the
+# genuinely static knobs are nondiff.
+@partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def chunked_attention(q, k, v, mask_bias, slopes, qpos0, kpos0,
+                      causal: bool, chunk: int, out_dtype, scale=None):
+    """Exact softmax attention, streamed over key chunks.
+
+    q [B, Sq, H, Hd]; k/v [B, Sk, KV, Hd] with KV | H; mask_bias [B, Sk]
+    additive key bias or None; slopes [H] alibi or None; qpos0/kpos0 [] int32
+    global offsets of the local q/k blocks; ``scale`` (static float) defaults
+    to Hd**-0.5. Returns ``(out [B,Sq,H,Hd] in out_dtype, lse [B,H,Sq])``.
+    BOTH outputs are differentiable (ring's cross-step softmax combination
+    differentiates through lse).
+    """
+    return _fwd_impl(q, k, v, mask_bias, slopes, qpos0, kpos0,
+                     causal, chunk, out_dtype, scale)
+
+
+def _fwd_impl(q, k, v, mask_bias, slopes, qpos0, kpos0, causal, chunk,
+              out_dtype, scale=None):
+    B, Sq, H, Hd = q.shape
+    rep = H // k.shape[2]
+    scale = Hd**-0.5 if scale is None else scale
+    chunk = min(chunk, k.shape[1])  # small shards run exact-size, unpadded
+    k, v, mask_bias, _ = _pad_kv(k, v, mask_bias, chunk)
+    n = k.shape[1] // chunk
+    q32 = jnp.transpose(q.astype(jnp.float32), (0, 2, 1, 3))
+    qpos = qpos0 + jnp.arange(Sq)
+
+    def step(carry, c):
+        m, l, o = carry
+        kc = jax.lax.dynamic_slice_in_dim(k, c * chunk, chunk, 1)
+        vc = jax.lax.dynamic_slice_in_dim(v, c * chunk, chunk, 1)
+        mc = (jax.lax.dynamic_slice_in_dim(mask_bias, c * chunk, chunk, 1)
+              if mask_bias is not None else None)
+        kposc = kpos0 + c * chunk + jnp.arange(chunk)
+        logits = _chunk_logits(q32, kc, mc, qpos, kposc, causal, slopes,
+                               scale, rep)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[..., None])
+        l_new = l * alpha + p.sum(axis=-1)
+        vc32 = (jnp.repeat(vc, rep, axis=2) if rep != 1 else vc).astype(jnp.float32)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vc32, preferred_element_type=jnp.float32)
+        return (m_new, l_new, o_new), None
+
+    init = (jnp.full((B, H, Sq), -jnp.inf, jnp.float32),
+            jnp.zeros((B, H, Sq), jnp.float32),
+            jnp.zeros((B, H, Sq, Hd), jnp.float32))
+    (m, l, o), _ = jax.lax.scan(step, init, jnp.arange(n, dtype=jnp.int32))
+    l_safe = jnp.maximum(l, 1e-30)
+    out = jnp.transpose(o / l_safe[..., None], (0, 2, 1, 3)).astype(out_dtype)
+    lse = m + jnp.log(l_safe)
+    return out, lse
+
+
+def _fwd_rule(q, k, v, mask_bias, slopes, qpos0, kpos0, causal, chunk,
+              out_dtype, scale=None):
+    out, lse = _fwd_impl(q, k, v, mask_bias, slopes, qpos0, kpos0,
+                         causal, chunk, out_dtype, scale)
+    return (out, lse), (q, k, v, mask_bias, slopes, qpos0, kpos0, out, lse)
+
+
+def _bwd_rule(causal, chunk, out_dtype, scale, res, cts):
+    q, k, v, mask_bias, slopes, qpos0, kpos0, out, lse = res
+    do, dlse = cts  # d lse / d logits = p, folded into ds below
+    B, Sq, H, Hd = q.shape
+    KV = k.shape[2]
+    rep = H // KV
+    scale = Hd**-0.5 if scale is None else scale
+    Sk_orig = k.shape[1]
+    chunk = min(chunk, k.shape[1])  # mirror _fwd_impl's small-shard clamp
+    k_p, v_p, mask_p, _ = _pad_kv(k, v, mask_bias, chunk)
+    n = k_p.shape[1] // chunk
+
+    q32 = jnp.transpose(q.astype(jnp.float32), (0, 2, 1, 3))
+    do32 = jnp.transpose(do.astype(jnp.float32), (0, 2, 1, 3))
+    o32 = jnp.transpose(out.astype(jnp.float32), (0, 2, 1, 3))
+    D = jnp.sum(do32 * o32, axis=-1)                              # [B,H,Sq]
+    dlse32 = dlse.astype(jnp.float32)
+    qpos = qpos0 + jnp.arange(Sq)
+
+    def step(carry, c):
+        dq, dslopes_acc = carry
+        kc = jax.lax.dynamic_slice_in_dim(k_p, c * chunk, chunk, 1)
+        vc = jax.lax.dynamic_slice_in_dim(v_p, c * chunk, chunk, 1)
+        mc = (jax.lax.dynamic_slice_in_dim(mask_p, c * chunk, chunk, 1)
+              if mask_p is not None else None)
+        kposc = kpos0 + c * chunk + jnp.arange(chunk)
+        logits = _chunk_logits(q32, kc, mc, qpos, kposc, causal, slopes,
+                               scale, rep)
+        # normalized probabilities recomputed from the saved lse (fully
+        # masked rows recompute the same uniform weights the forward used;
+        # -inf pad keys recompute exactly 0)
+        p = jnp.exp(logits - lse[..., None])
+        vc_r = (jnp.repeat(vc, rep, axis=2) if rep != 1 else vc).astype(jnp.float32)
+        kc_r = (jnp.repeat(kc, rep, axis=2) if rep != 1 else kc).astype(jnp.float32)
+        dv_c = jnp.einsum("bhqk,bhqd->bkhd", p, do32,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bhqd,bkhd->bhqk", do32, vc_r,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - D[..., None] + dlse32[..., None])          # [B,H,Sq,Ck]
+        dq = dq + jnp.einsum("bhqk,bkhd->bhqd", ds, kc_r,
+                             preferred_element_type=jnp.float32) * scale
+        dk_c = jnp.einsum("bhqk,bhqd->bkhd", ds, q32,
+                          preferred_element_type=jnp.float32) * scale
+        if rep != 1:  # fold query-head grads onto the shared kv head
+            dk_c = dk_c.reshape(B, chunk, KV, rep, Hd).sum(axis=3)
+            dv_c = dv_c.reshape(B, chunk, KV, rep, Hd).sum(axis=3)
+        dm_c = ds.sum(axis=(1, 2)) if mask_bias is not None else None
+        if slopes is not None:
+            dist = (kposc[None, :] - qpos[:, None]).astype(jnp.float32)
+            dslopes_acc = dslopes_acc + jnp.einsum(
+                "bhqk,qk->h", ds, dist, preferred_element_type=jnp.float32)
+        return (dq, dslopes_acc), (dk_c, dv_c, dm_c)
+
+    dq0 = jnp.zeros((B, H, Sq, Hd), jnp.float32)
+    ds0 = jnp.zeros((H,), jnp.float32) if slopes is not None else jnp.zeros((0,))
+    (dq, dslopes), (dk_chunks, dv_chunks, dm_chunks) = jax.lax.scan(
+        step, (dq0, ds0), jnp.arange(n, dtype=jnp.int32))
+    dk = jnp.moveaxis(dk_chunks, 0, 1).reshape(B, n * chunk, KV, Hd)[:, :Sk_orig]
+    dv = jnp.moveaxis(dv_chunks, 0, 1).reshape(B, n * chunk, KV, Hd)[:, :Sk_orig]
+    dq = jnp.transpose(dq, (0, 2, 1, 3)).astype(q.dtype)
+    dmask = None
+    if mask_bias is not None:
+        dmask = jnp.moveaxis(dm_chunks, 0, 1).reshape(B, n * chunk)[:, :Sk_orig]
+        dmask = dmask.astype(mask_bias.dtype)
+    dslopes_out = None if slopes is None else dslopes.astype(slopes.dtype)
+    return (dq, dk.astype(k.dtype), dv.astype(v.dtype), dmask, dslopes_out,
+            _float0_like(qpos0), _float0_like(kpos0))
+
+
+chunked_attention.defvjp(_fwd_rule, _bwd_rule)

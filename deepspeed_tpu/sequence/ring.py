@@ -2,13 +2,16 @@
 
 Flash-style streaming softmax over K/V blocks that rotate around the ring
 with ``lax.ppermute``: at ring step ``s`` a device holding query block ``i``
-attends to key/value block ``(i - s) mod sp``. The running (max, sum, out)
-accumulators make the result exactly equal to full softmax attention while
-every chip only ever holds S/sp keys — O(S/sp) memory and ppermute traffic
-that XLA overlaps with each step's matmuls on the MXU.
+attends to key/value block ``(i - s) mod sp``. Per-step attention runs the
+shared chunked streaming core (``sequence/_streaming.py`` — custom-VJP
+recompute backward, O(Sq·chunk) live memory in both directions); the
+partial ``(out_s, lse_s)`` results combine across ring steps in the log
+domain, so the total is exactly full softmax attention while every chip
+only ever holds S/sp keys. GQA kv rides the ring UNREPEATED (H/KV× less
+ppermute traffic) and broadcasts per chunk inside the core.
 
-Causality is handled per block-pair from *global* positions (query block i,
-key block j: j>i fully masked, j==i triangular, j<i dense), so the math
+Causality is handled from *global* positions inside the core (query block
+i, key block j: j>i fully masked, j==i triangular, j<i dense), so the math
 matches :func:`deepspeed_tpu.ops.attention.mha_attention` bit-for-bit in
 fp32.
 """
@@ -21,11 +24,9 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.sequence._program import run_sp_program
+from deepspeed_tpu.sequence._streaming import chunked_attention
 
-_NEG_INF = -1e9  # matches ops.attention masking constant
-
-# per-ring-step key-chunk size: local shards larger than this stream their
-# softmax in chunks (bounds logits memory to O(Sq * RING_KEY_CHUNK)).
+# per-ring-step key-chunk size inside the shared streaming core.
 # Import-time knob: the compiled sp programs are cached WITHOUT this in the
 # key, so set it before the first ring_attention call of the process.
 RING_KEY_CHUNK = 1024
@@ -36,106 +37,51 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=N
     """Per-shard body (call inside ``shard_map`` over ``axis``).
 
     q, k, v: LOCAL [B, Sq, H, Hd] / [B, Sk, KV, Hd] blocks (KV may be a
-    divisor of H — GQA kv rides the ring UNREPEATED, H/KV× less ppermute
-    traffic); mask_bias: local additive key mask [B, Sk] or None. Returns
-    local [B, Sq, H, Hd].
+    divisor of H); mask_bias: local additive key mask [B, Sk] or None.
+    Returns local [B, Sq, H, Hd].
     """
     B, Sq, H, Hd = q.shape
-    Sk, KV = k.shape[1], k.shape[2]
-    rep = H // KV
+    Sk = k.shape[1]
     sp = jax.lax.axis_size(axis)
     my_block = jax.lax.axis_index(axis)
-    scale = scale if scale is not None else Hd**-0.5
-
-    q32 = q.astype(jnp.float32)
-    qpos = my_block * Sq + jnp.arange(Sq)  # global query positions
 
     perm = [(i, (i + 1) % sp) for i in range(sp)]
+    qpos0 = (my_block * Sq).astype(jnp.int32)
 
-    # inner key-chunking bounds per-ring-step logits to O(Sq·chunk): at real
-    # long context the LOCAL shard is still big (512k/16 = 32k keys → a
-    # 32k×32k logits block is GBs per head), so the shard-local softmax
-    # must itself stream
-    if Sk > RING_KEY_CHUNK:
-        # smallest chunk count >= Sk/RING_KEY_CHUNK that divides Sk, so the
-        # memory bound holds for non-multiple shard sizes too (worst case a
-        # prime Sk degrades to n_chunks == Sk, never to unchunked)
-        n_chunks = -(-Sk // RING_KEY_CHUNK)
-        while Sk % n_chunks:
-            n_chunks += 1
-    else:
-        n_chunks = 1
-    Ck = Sk // n_chunks
+    def block_attn(kb, vb, maskb, s):
+        kpos0 = (((my_block - s) % sp) * Sk).astype(jnp.int32)
+        return chunked_attention(q, kb, vb, maskb, alibi_slopes, qpos0, kpos0,
+                                 causal, RING_KEY_CHUNK, jnp.float32, scale)
 
-    def _update(kb, vb, maskb, kvpos, m, l, o):
-        """Streaming-softmax update against one key chunk at global kvpos.
-        GQA kv arrives unrepeated and broadcasts here, per CHUNK — the full
-        rep-expanded shard never materializes."""
-        if rep != 1:
-            kb = jnp.repeat(kb, rep, axis=2)
-            vb = jnp.repeat(vb, rep, axis=2)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, kb.astype(jnp.float32),
-                            preferred_element_type=jnp.float32) * scale
-        if alibi_slopes is not None:
-            dist = (kvpos[None, :] - qpos[:, None]).astype(jnp.float32)
-            logits = logits + alibi_slopes[None, :, None, None] * dist[None, None, :, :]
-        if causal:
-            logits = jnp.where((qpos[:, None] >= kvpos[None, :])[None, None], logits, _NEG_INF)
-        if maskb is not None:
-            logits = logits + maskb[:, None, None, :]
+    def combine(M, L, O, o_s, lse_s):
+        """Log-domain merge of a normalized partial (o_s, lse_s) into the
+        running (M, L, O); the final output is O / L."""
+        M_new = jnp.maximum(M, lse_s)
+        a = jnp.exp(M - M_new)
+        b = jnp.exp(lse_s - M_new)
+        O_new = O * a[..., None] + jnp.transpose(o_s, (0, 2, 1, 3)) * b[..., None]
+        L_new = L * a + b
+        return M_new, L_new, O_new
 
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(logits - m_new[..., None])
-        l_new = l * alpha + p.sum(axis=-1)
-        o_new = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vb.astype(jnp.float32),
-                                                  preferred_element_type=jnp.float32)
-        return m_new, l_new, o_new
-
-    def accumulate(kb, vb, maskb, m, l, o, s):
-        """One flash-softmax update against kv block (my_block - s) mod sp."""
-        pos0 = ((my_block - s) % sp) * Sk
-
-        if n_chunks == 1:
-            return _update(kb, vb, maskb, pos0 + jnp.arange(Sk), m, l, o)
-
-        def chunk_step(carry, c):
-            m, l, o = carry
-            kc = jax.lax.dynamic_slice_in_dim(kb, c * Ck, Ck, 1)
-            vc = jax.lax.dynamic_slice_in_dim(vb, c * Ck, Ck, 1)
-            mc = (jax.lax.dynamic_slice_in_dim(maskb, c * Ck, Ck, 1)
-                  if maskb is not None else None)
-            return _update(kc, vc, mc, pos0 + c * Ck + jnp.arange(Ck), m, l, o), None
-
-        # remat: without it AD stacks each chunk's softmax residuals and the
-        # O(Sq*S) footprint the chunking exists to avoid comes right back in
-        # the backward pass
-        chunk_step = jax.checkpoint(chunk_step, prevent_cse=False)
-        (m, l, o), _ = jax.lax.scan(chunk_step, (m, l, o),
-                                    jnp.arange(n_chunks, dtype=jnp.int32))
-        return m, l, o
-
-    m0 = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((B, H, Sq), jnp.float32)
-    o0 = jnp.zeros((B, H, Sq, Hd), jnp.float32)
-
-    # step 0 on the resident block, then permute-then-accumulate for the
-    # remaining sp-1 steps (no dead permute after the last accumulate)
-    m, l, o = accumulate(k, v, mask_bias, m0, l0, o0, 0)
+    o0, lse0 = block_attn(k, v, mask_bias, jnp.int32(0))
+    M = lse0
+    L = jnp.ones_like(lse0)
+    O = jnp.transpose(o0, (0, 2, 1, 3))  # [B, H, Sq, Hd]
 
     def step(carry, s):
-        kb, vb, maskb, m, l, o = carry
+        kb, vb, maskb, M, L, O = carry
         kb = jax.lax.ppermute(kb, axis, perm)
         vb = jax.lax.ppermute(vb, axis, perm)
         if maskb is not None:
             maskb = jax.lax.ppermute(maskb, axis, perm)
-        m, l, o = accumulate(kb, vb, maskb, m, l, o, s)
-        return (kb, vb, maskb, m, l, o), None
+        o_s, lse_s = block_attn(kb, vb, maskb, s)
+        M, L, O = combine(M, L, O, o_s, lse_s)
+        return (kb, vb, maskb, M, L, O), None
 
-    (_, _, _, m, l, o), _ = jax.lax.scan(step, (k, v, mask_bias, m, l, o),
+    (_, _, _, M, L, O), _ = jax.lax.scan(step, (k, v, mask_bias, M, L, O),
                                          jnp.arange(1, sp))
 
-    out = o / jnp.maximum(l, 1e-30)[..., None]
+    out = O / jnp.maximum(L, 1e-30)[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 

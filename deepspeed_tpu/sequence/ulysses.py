@@ -2,10 +2,14 @@
 
 Around the attention core, seq-sharded q/k/v [B, S/sp, H, Hd] are re-sharded
 with ``lax.all_to_all`` into head-sharded [B, S, H/sp, Hd]; each chip then
-runs ordinary dense attention for its H/sp heads over the FULL sequence, and
-a second all-to-all restores sequence sharding. Communication volume is
-O(B·S·D/sp) per direction — the all-to-alls ride ICI on the innermost mesh
-axes.
+runs attention for its H/sp heads over the FULL sequence, and a second
+all-to-all restores sequence sharding. Communication volume is O(B·S·D/sp)
+per direction — the all-to-alls ride ICI on the innermost mesh axes.
+
+Long context: above ``ULYSSES_KEY_CHUNK`` the local attention runs the
+shared chunked streaming core (``sequence/_streaming.py`` — custom-VJP
+recompute backward), so neither direction materializes the S×S logits and
+GQA kv is broadcast per chunk, never as a full rep-expanded copy.
 
 Reference analogue: none at this version (SURVEY.md §2.3 — SP absent);
 this implements the capability the reference later shipped as
@@ -21,6 +25,12 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.sequence._program import run_sp_program
+from deepspeed_tpu.sequence._streaming import chunked_attention
+
+# key-chunk size for the head-sharded local attention: above this the local
+# softmax streams over key chunks (bounds logits to O(S·chunk) instead of
+# S²). Import-time knob — the compiled sp programs cache without it.
+ULYSSES_KEY_CHUNK = 2048
 
 
 def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=None,
@@ -29,9 +39,9 @@ def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bia
 
     q [B, Sq_loc, H, Hd], k/v [B, Sk_loc, H_or_KV, Hd] (GQA kv may carry
     KV < H heads: when KV divides the axis size it rides the all-to-all
-    unrepeated — H/KV× less wire — and is broadcast after; otherwise it is
-    repeated first), mask_bias local [B, Sk_loc] additive. H must be
-    divisible by the axis size.
+    unrepeated — H/KV× less wire; otherwise it is repeated first),
+    mask_bias local [B, Sk_loc] additive. H must be divisible by the axis
+    size.
     """
     sp = jax.lax.axis_size(axis)
     H, KV = q.shape[2], k.shape[2]
@@ -49,9 +59,6 @@ def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bia
         v = jnp.repeat(v, H // KV, axis=2)
         KV = H
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    if KV != H:  # broadcast the local KV/sp kv heads to H/sp query heads
-        kh = jnp.repeat(kh, H // KV, axis=2)
-        vh = jnp.repeat(vh, H // KV, axis=2)
     if mask_bias is not None:
         mask_bias = jax.lax.all_gather(mask_bias, axis, axis=1, tiled=True)  # [B, S]
 
@@ -61,10 +68,22 @@ def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bia
         h_loc = H // sp
         slopes = jax.lax.dynamic_slice_in_dim(alibi_slopes, my * h_loc, h_loc)
 
-    from deepspeed_tpu.ops.attention import mha_attention
-    out = mha_attention(qh, kh, vh,
-                        mask_bias=None if mask_bias is None else mask_bias[:, None, None, :],
-                        causal=causal, alibi_slopes=slopes, scale=scale)
+    S, Hd = qh.shape[1], qh.shape[3]
+    if S > ULYSSES_KEY_CHUNK:
+        # long context: dense attention would materialize an S×S logits
+        # block — stream through the shared core (unrepeated GQA kv goes in
+        # directly; the core broadcasts per chunk)
+        out, _ = chunked_attention(qh, kh, vh, mask_bias, slopes,
+                                   jnp.int32(0), jnp.int32(0),
+                                   causal, ULYSSES_KEY_CHUNK, qh.dtype, scale)
+    else:
+        if KV != H:  # dense path: broadcast the local kv heads up front
+            kh = jnp.repeat(kh, H // KV, axis=2)
+            vh = jnp.repeat(vh, H // KV, axis=2)
+        from deepspeed_tpu.ops.attention import mha_attention
+        out = mha_attention(qh, kh, vh,
+                            mask_bias=None if mask_bias is None else mask_bias[:, None, None, :],
+                            causal=causal, alibi_slopes=slopes, scale=scale)
 
     # head-sharded -> seq-sharded (gather heads, scatter seq)
     return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)

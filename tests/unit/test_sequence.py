@@ -309,3 +309,38 @@ def test_ring_chunking_nondivisible_and_grad(sp_mesh, monkeypatch):
     _cached_program.cache_clear()
     np.testing.assert_allclose(np.asarray(g_chunked), np.asarray(g_ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_chunking_exact_and_grad(sp_mesh, monkeypatch):
+    """Ulysses' chunked local softmax matches the dense path exactly,
+    including gradients through the remat scan."""
+    import deepspeed_tpu.sequence.ulysses as ul_mod
+    from deepspeed_tpu.sequence._program import _cached_program
+
+    q, k, v = _qkv(jax.random.key(40), S=64)
+    mask = jnp.where(jax.random.uniform(jax.random.key(41), (2, 64)) > 0.2,
+                     0.0, -1e9).astype(jnp.float32)
+
+    def run():
+        _cached_program.cache_clear()
+        return jax.jit(lambda a, b, c, m: ulysses_attention(
+            a, b, c, mesh=sp_mesh, causal=True, mask_bias=m))(q, k, v, mask)
+
+    ref = run()                                        # S=64 <= 2048: dense
+    monkeypatch.setattr(ul_mod, "ULYSSES_KEY_CHUNK", 10)  # 64 -> 8x8 chunks
+    out = run()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+    def loss_fn(qq):
+        return jnp.sum(ulysses_attention(qq, k, v, mesh=sp_mesh,
+                                         causal=True) ** 2)
+
+    _cached_program.cache_clear()
+    g_chunked = jax.jit(jax.grad(loss_fn))(q)
+    monkeypatch.setattr(ul_mod, "ULYSSES_KEY_CHUNK", 2048)
+    _cached_program.cache_clear()
+    g_ref = jax.jit(jax.grad(loss_fn))(q)
+    _cached_program.cache_clear()
+    np.testing.assert_allclose(np.asarray(g_chunked), np.asarray(g_ref),
+                               rtol=2e-5, atol=2e-5)
