@@ -267,6 +267,14 @@ def key_mask_bias(attn_mask):
     return jnp.where(attn_mask > 0, 0.0, -1e9).astype(jnp.float32)
 
 
+# sequence length beyond which the XLA fallback attention streams its
+# softmax (sequence/_streaming.py) instead of materialising S x S logits;
+# the chunk size is deliberately smaller so just-over-threshold sequences
+# don't pad a near-full chunk of dead keys
+DENSE_STREAM_THRESHOLD = 4096
+DENSE_STREAM_CHUNK = 1024
+
+
 def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
     """Einsum-form multi-head attention; XLA maps the batched matmuls onto
     the MXU and fuses softmax. (A Pallas flash-attention kernel can be slotted
@@ -299,6 +307,18 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
         from deepspeed_tpu.sequence import sp_attention
         out = sp_attention(q, k, v, mesh=sp_mesh, impl=cfg.sequence_parallel,
                            causal=cfg.causal, mask_bias=mask_bias, alibi_slopes=slopes)
+    elif S > DENSE_STREAM_THRESHOLD:
+        # long sequences off the kernel paths (pipeline stage vmap, sp-less
+        # CPU, shapes outside the kernel envelope): stream the softmax
+        # through the shared chunked core instead of materialising the
+        # S x S logits — pure jnp, so it vmaps over pipeline stages and
+        # partitions under pp where a Pallas call cannot go. GQA kv goes in
+        # UNREPEATED (the core broadcasts per chunk).
+        from deepspeed_tpu.sequence._streaming import chunked_attention
+        mb = None if mask_bias is None else mask_bias.astype(jnp.float32)
+        out, _ = chunked_attention(q, k, v, mb, slopes, jnp.int32(0),
+                                   jnp.int32(0), cfg.causal,
+                                   DENSE_STREAM_CHUNK, q.dtype)
     else:
         if KV != H:  # GQA: repeat kv heads for the flash/dense paths
             rep = H // KV
