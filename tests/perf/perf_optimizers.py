@@ -1,0 +1,84 @@
+"""Optimizer micro-benchmarks (reference ``tests/perf/adam_test.py``).
+
+Run directly (not collected by pytest):
+
+    python tests/perf/perf_optimizers.py [--n 25000000]
+
+Times the native C++ cpu_adam against a numpy reference on host, and the
+fused Pallas Adam against the optax chain on the current jax backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def bench_cpu_adam(n: int, iters: int = 10):
+    from deepspeed_tpu.ops import native
+    from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+    if not native.available():
+        print("cpu_adam: native library unavailable, skipped")
+        return
+    rng = np.random.default_rng(0)
+    p = rng.normal(size=n).astype(np.float32)
+    g = rng.normal(size=n).astype(np.float32)
+    opt = DeepSpeedCPUAdam(lr=1e-3)
+    opt.register("p0", n)
+    opt.step("p0", p, g)  # warmup
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        opt.step("p0", p, g)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"cpu_adam (C++ SIMD): {n/1e6:.0f}M params, {dt*1e3:.1f} ms/step, "
+          f"{n/dt/1e9:.2f} Gparam/s")
+
+
+def bench_fused_adam(n: int, iters: int = 10):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from deepspeed_tpu.ops.adam.fused_adam_kernel import fused_adam_step
+
+    key = jax.random.key(0)
+    p = jax.random.normal(key, (n,), jnp.float32)
+    g = jax.random.normal(key, (n,), jnp.float32)
+    m = jnp.zeros(n, jnp.float32)
+    v = jnp.zeros(n, jnp.float32)
+
+    def run_fused():
+        return fused_adam_step(p, g, m, v, step=2, lr=1e-3)
+
+    tx = optax.adamw(1e-3)
+    st = tx.init(p)
+
+    @jax.jit
+    def run_optax(p, g, st):
+        u, st = tx.update(g, st, p)
+        return optax.apply_updates(p, u), st
+
+    for name, fn in (("fused pallas", lambda: run_fused()[0]),
+                     ("optax chain", lambda: run_optax(p, g, st)[0])):
+        fn().block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / iters
+        print(f"{name} ({jax.default_backend()}): {n/1e6:.0f}M params, "
+              f"{dt*1e3:.2f} ms/step, {n/dt/1e9:.2f} Gparam/s")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=25_000_000)
+    args = ap.parse_args()
+    bench_cpu_adam(args.n)
+    bench_fused_adam(args.n)
