@@ -997,6 +997,25 @@ class DeepSpeedEngine:
     def train_batch_size(self) -> int:
         return self._config.train_batch_size
 
+    def set_train_batch_size(self, train_batch_size: int) -> None:
+        """Adjust the global batch by changing gradient-accumulation steps;
+        the micro-batch size is unchanged (reference ``engine.py:426`` —
+        elastic/curriculum batch scaling). The compiled step cache is keyed
+        by gas, so a new gas compiles once and is then hot."""
+        micro = self.train_micro_batch_size_per_gpu()
+        dp = dist.get_world_size(dist.data_parallel_axes(self.mesh))
+        if train_batch_size % (micro * dp):
+            raise ValueError(
+                f"Train batch size ({train_batch_size}) must be divisible by "
+                f"micro-batch ({micro}) x data parallelism ({dp})")
+        new_gas = train_batch_size // (micro * dp)
+        self._config.train_batch_size = train_batch_size
+        self._config.gradient_accumulation_steps = new_gas
+        if new_gas > 1:
+            # an engine born at gas==1 skipped the accumulation buffers; the
+            # gas>1 scan path reads state.acc_grads, so materialize them now
+            self._ensure_acc_grads()
+
     def train_micro_batch_size_per_gpu(self) -> int:
         return self._config.train_micro_batch_size_per_gpu
 

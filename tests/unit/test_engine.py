@@ -208,3 +208,20 @@ def test_checkpoint_roundtrip_fused_adam(tmp_path):
     l1 = float(engine.train_batch(global_batch(engine, seed=42)))
     l2 = float(engine2.train_batch(global_batch(engine2, seed=42)))
     assert abs(l1 - l2) < 1e-5
+
+
+def test_set_train_batch_size_runtime_gas_change():
+    """Reference engine.py:426 semantics: global batch adjusts via gas; the
+    per-gas compiled-step cache makes both sizes hot after one compile."""
+    engine = make_engine(stage=1)
+    micro, dp = engine.train_micro_batch_size_per_gpu(), 8
+    l1 = float(engine.train_batch(global_batch(engine, seed=0)))
+    engine.set_train_batch_size(micro * dp * 2)   # gas 1 -> 2
+    assert engine.gradient_accumulation_steps() == 2
+    l2 = float(engine.train_batch(global_batch(engine, seed=1)))
+    assert np.isfinite(l1) and np.isfinite(l2)
+    engine.set_train_batch_size(micro * dp)       # back to gas 1
+    l3 = float(engine.train_batch(global_batch(engine, seed=2)))
+    assert np.isfinite(l3)
+    with pytest.raises(ValueError, match="divisible"):
+        engine.set_train_batch_size(micro * dp + 1)
