@@ -136,6 +136,7 @@ class DeepSpeedEngine:
         # the update, so keep them in compute dtype — the persistent fp32
         # accumulator would cost 4 bytes/param for nothing; with gas>1 the
         # reference accumulates in fp32 (bf16_optimizer.py) and so do we
+        self._acc_dtype_name = acc_dtype_name
         if acc_dtype_name is None and self.gradient_accumulation_steps() == 1:
             self.grad_acc_dtype = self.compute_dtype
         else:
@@ -1009,11 +1010,26 @@ class DeepSpeedEngine:
                 f"Train batch size ({train_batch_size}) must be divisible by "
                 f"micro-batch ({micro}) x data parallelism ({dp})")
         new_gas = train_batch_size // (micro * dp)
+        if new_gas < 1:
+            raise ValueError(f"Train batch size ({train_batch_size}) must cover "
+                             f"at least one micro-batch per dp rank ({micro * dp})")
         self._config.train_batch_size = train_batch_size
         self._config.gradient_accumulation_steps = new_gas
-        if new_gas > 1:
-            # an engine born at gas==1 skipped the accumulation buffers; the
-            # gas>1 scan path reads state.acc_grads, so materialize them now
+        # the trio's cached apply step froze the OLD gas (grad divisor):
+        # rebuild it at the new one
+        self._apply_jit = None
+        self.tput_timer.batch_size = train_batch_size
+        if new_gas > 1 and self._acc_dtype_name is None and \
+                self.grad_acc_dtype != jnp.float32:
+            # engines born at gas==1 pinned accumulation to the compute dtype
+            # (no buffers existed); gas>1 accumulates in fp32 per the
+            # init-time rule, so restore it before (re)allocating buffers
+            self.grad_acc_dtype = jnp.float32
+            if self.state is not None and self.state.acc_grads != ():
+                self.state = self.state._replace(acc_grads=())
+        if self._uses_acc_grad_buffers():
+            # the gas>1 scan path reads state.acc_grads; 1-bit/offload-free
+            # gas==1 engines skip them entirely
             self._ensure_acc_grads()
 
     def train_micro_batch_size_per_gpu(self) -> int:

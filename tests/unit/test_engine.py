@@ -225,3 +225,24 @@ def test_set_train_batch_size_runtime_gas_change():
     assert np.isfinite(l3)
     with pytest.raises(ValueError, match="divisible"):
         engine.set_train_batch_size(micro * dp + 1)
+    with pytest.raises(ValueError, match="at least one micro-batch"):
+        engine.set_train_batch_size(0)
+
+
+def test_set_train_batch_size_trio_and_fp16_acc_dtype():
+    """After a gas change: the fwd/bwd/step trio divides by the NEW gas, and
+    an fp16 engine born at gas==1 accumulates in fp32 at gas>1."""
+    engine = make_engine(stage=0, precision="fp16")
+    assert engine.grad_acc_dtype == jnp.float16  # gas==1 shortcut
+    engine.set_train_batch_size(engine.train_micro_batch_size_per_gpu() * 8 * 2)
+    assert engine.grad_acc_dtype == jnp.float32
+    assert jax.tree.leaves(engine.state.acc_grads)[0].dtype == jnp.float32
+    # trio at gas=2: two backward passes then one step; loss must stay finite
+    for seed in (0, 1):
+        b = {k: v[: v.shape[0] // 2]
+             for k, v in global_batch(engine, seed=seed).items()}
+        engine.forward(b)
+        engine.backward()
+    engine.step()
+    l = float(engine.eval_batch({k: v for k, v in global_batch(engine, seed=3).items()}))
+    assert np.isfinite(l)
