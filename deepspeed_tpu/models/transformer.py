@@ -587,9 +587,11 @@ def init_kv_cache(cfg: TransformerConfig, batch_size: int, max_len: Optional[int
 def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad_bias):
     """Attention for T new tokens against the (updated) KV cache.
 
-    x [B, T, D]; positions [B, T] global positions of the new tokens;
-    pos [] int32 tokens already cached; ck/cv [B, Smax, KV, Hd].
-    Returns (out [B, T, D], new ck, new cv)."""
+    x [B, T, D]; positions [B, T] global positions of the new tokens —
+    the engine contract is ``positions == pos + arange(T)`` per row (rope
+    uses the array; causal/alibi geometry in both the streaming and dense
+    branches assumes that contiguous layout); pos [] int32 tokens already
+    cached; ck/cv [B, Smax, KV, Hd]. Returns (out [B, T, D], new ck, cv)."""
     B, T, D = x.shape
     H, KV, Hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
     Smax = ck.shape[1]
@@ -628,6 +630,23 @@ def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad
             out = o.reshape(B, 1, H * Hd)
             out = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
             return out, ck, cv
+
+    if Smax > DENSE_STREAM_THRESHOLD:
+        # long-workspace prefill AND kernel-less decode: stream the softmax
+        # over cache chunks (O(T·chunk) live memory, no rep-expanded cache
+        # copy) instead of the O(T·Smax) einsum below. The core derives
+        # query positions as pos + arange(T) — identical to the engine
+        # contract this function documents (positions = pos + arange), which
+        # the dense path below also assumes per batch row.
+        from deepspeed_tpu.sequence._streaming import chunked_attention
+        slopes = _alibi_slopes(H) if cfg.pos_embedding == "alibi" else None
+        pb = None if pad_bias is None else pad_bias.astype(jnp.float32)
+        o, _ = chunked_attention(q, ck, cv, pb, slopes,
+                                 jnp.asarray(pos, jnp.int32), jnp.int32(0),
+                                 True, DENSE_STREAM_CHUNK, q.dtype)
+        out = o.reshape(B, T, H * Hd)
+        out = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
+        return out, ck, cv
 
     kk, vv = ck, cv
     if KV != H:

@@ -166,3 +166,44 @@ def test_decode_sharded_matches_einsum_on_mesh(devices, monkeypatch):
         assert calls and all(calls), calls
     finally:
         dist.set_mesh(None)
+
+
+def test_prefill_streaming_matches_einsum(monkeypatch):
+    """Long-workspace prefill streams through the shared core and matches
+    the einsum cache path exactly (GQA, pad bias, offset positions)."""
+    import deepspeed_tpu.comm as dist
+    import deepspeed_tpu.models.transformer as Tmod
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  forward_cached, init_kv_cache)
+
+    dist.set_mesh(None)
+    cfg = TransformerConfig(vocab_size=96, max_seq=256, n_layer=2, n_head=4,
+                            n_kv_head=2, d_model=64, pos_embedding="rope",
+                            norm="rmsnorm", activation="swiglu",
+                            attention_backend="xla")
+    params = Tmod.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(7)
+    prompt = jnp.asarray(rng.integers(0, 96, size=(2, 24)), jnp.int32)
+    # mask the CAUSALLY VISIBLE left-pad slots [0, 8) — the junk before the
+    # prefill offset — so the pad-bias path is genuinely exercised
+    pad_bias = jnp.where(jnp.arange(192)[None, :] >= 8, 0.0, -1e9
+                         ).astype(jnp.float32).repeat(2, axis=0).reshape(2, 192)
+
+    def run():
+        cache = init_kv_cache(cfg, 2, 192, dtype=jnp.float32)
+        # prefill at offset 8 (decode-style nonzero pos) with a pad mask
+        lp, cache = forward_cached(cfg, params, prompt, cache, 8,
+                                   pad_bias=pad_bias)
+        # and one kernel-less DECODE step through the streaming branch
+        ld, cache = forward_cached(cfg, params, prompt[:, :1], cache, 32,
+                                   pad_bias=pad_bias)
+        return lp, ld
+
+    dense_p, dense_d = run()
+    monkeypatch.setattr(Tmod, "DENSE_STREAM_THRESHOLD", 64)
+    monkeypatch.setattr(Tmod, "DENSE_STREAM_CHUNK", 64)
+    streamed_p, streamed_d = run()
+    np.testing.assert_allclose(np.asarray(streamed_p), np.asarray(dense_p),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(streamed_d), np.asarray(dense_d),
+                               rtol=2e-4, atol=2e-4)
