@@ -307,32 +307,40 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
         from deepspeed_tpu.sequence import sp_attention
         out = sp_attention(q, k, v, mesh=sp_mesh, impl=cfg.sequence_parallel,
                            causal=cfg.causal, mask_bias=mask_bias, alibi_slopes=slopes)
-    elif S > DENSE_STREAM_THRESHOLD:
-        # long sequences off the kernel paths (pipeline stage vmap, sp-less
-        # CPU, shapes outside the kernel envelope): stream the softmax
-        # through the shared chunked core instead of materialising the
-        # S x S logits — pure jnp, so it vmaps over pipeline stages and
-        # partitions under pp where a Pallas call cannot go. GQA kv goes in
-        # UNREPEATED (the core broadcasts per chunk).
-        from deepspeed_tpu.sequence._streaming import chunked_attention
-        mb = None if mask_bias is None else mask_bias.astype(jnp.float32)
-        out, _ = chunked_attention(q, k, v, mb, slopes, jnp.int32(0),
-                                   jnp.int32(0), cfg.causal,
-                                   DENSE_STREAM_CHUNK, q.dtype)
     else:
-        if KV != H:  # GQA: repeat kv heads for the flash/dense paths
+        # kernel paths first — the Pallas kernel beats the XLA streaming
+        # core at every length it can run
+        use_direct = _use_flash(cfg)
+        fmesh = None if use_direct else _flash_mesh(cfg)
+        if use_direct or fmesh is not None:
+            if KV != H:  # the flash kernels take repeated kv heads
+                rep = H // KV
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            if use_direct:
+                from deepspeed_tpu.ops.pallas import flash_attention
+                out = flash_attention(q, k, v, mask_bias=mask_bias,
+                                      causal=cfg.causal, alibi_slopes=slopes)
+            else:
+                out = _flash_sharded(cfg, q, k, v, mask_bias, slopes, fmesh)
+        if out is None and S > DENSE_STREAM_THRESHOLD:
+            # long sequences off the kernel paths (pipeline stage vmap,
+            # sp-less CPU, shapes outside the kernel envelope): stream the
+            # softmax through the shared chunked core instead of
+            # materialising the S x S logits — pure jnp, so it vmaps over
+            # pipeline stages and partitions under pp where a Pallas call
+            # cannot go. GQA kv goes in unrepeated when no kernel was tried
+            # (the core broadcasts per chunk).
+            from deepspeed_tpu.sequence._streaming import chunked_attention
+            mb = None if mask_bias is None else mask_bias.astype(jnp.float32)
+            out, _ = chunked_attention(q, k, v, mb, slopes, jnp.int32(0),
+                                       jnp.int32(0), cfg.causal,
+                                       DENSE_STREAM_CHUNK, q.dtype)
+    if out is None:
+        if KV != H and k.shape[2] != H:  # dense fallback needs repeated kv
             rep = H // KV
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
-        if _use_flash(cfg):
-            from deepspeed_tpu.ops.pallas import flash_attention
-            out = flash_attention(q, k, v, mask_bias=mask_bias, causal=cfg.causal,
-                                  alibi_slopes=slopes)
-        else:
-            fmesh = _flash_mesh(cfg)
-            if fmesh is not None:
-                out = _flash_sharded(cfg, q, k, v, mask_bias, slopes, fmesh)
-    if out is None:
         from deepspeed_tpu.ops.attention import mha_attention
         out = mha_attention(q, k, v,
                             mask_bias=None if mask_bias is None else mask_bias[:, None, None, :],
