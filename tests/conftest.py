@@ -16,7 +16,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if os.environ.get("DS_TPU_TESTS") != "1":
+    # the TPU tier (pytest -m tpu, DS_TPU_TESTS=1) keeps the real device
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
