@@ -1,0 +1,86 @@
+"""Randomized configuration sweep of the streaming-attention core.
+
+The core is the most intricate hand-written math in the repo (custom VJP,
+padding, GQA, positions); this fuzz harness compares forward AND all
+gradients against dense AD across random shapes/feature combinations.
+A small subset runs in the default tier; the full sweep is nightly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.sequence._streaming import chunked_attention
+
+
+def _dense(q, k, v, mask, slopes, causal, qpos0, kpos0):
+    rep = q.shape[2] // k.shape[2]
+    kk = jnp.repeat(k, rep, axis=2).astype(jnp.float32)
+    vv = jnp.repeat(v, rep, axis=2).astype(jnp.float32)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), kk) * scale
+    qpos = qpos0 + jnp.arange(q.shape[1])[:, None]
+    kpos = kpos0 + jnp.arange(k.shape[1])[None, :]
+    if slopes is not None:
+        logits = logits + slopes[None, :, None, None] * \
+            (kpos - qpos).astype(jnp.float32)[None, None]
+    if causal:
+        logits = jnp.where((qpos >= kpos)[None, None], logits, -1e9)
+    if mask is not None:
+        logits = logits + mask[:, None, None, :]
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    p = jnp.exp(logits - lse[..., None])
+    return jnp.einsum("bhqk,bkhd->bqhd", p, vv)
+
+
+def _one_case(seed: int):
+    r = np.random.default_rng(seed)
+    B = int(r.integers(1, 3))
+    Sq = int(r.integers(1, 33))
+    Sk = int(r.integers(Sq, 64))          # causal needs kpos range >= qpos
+    KV = int(r.choice([1, 2, 4]))
+    H = KV * int(r.choice([1, 2, 3]))
+    Hd = int(r.choice([8, 16, 32]))
+    chunk = int(r.choice([4, 8, 16, 1024]))
+    causal = bool(r.integers(0, 2))
+    qpos0 = int(r.integers(0, Sk - Sq + 1)) if causal else int(r.integers(0, 8))
+
+    q = jnp.asarray(r.normal(size=(B, Sq, H, Hd)), jnp.float32)
+    k = jnp.asarray(r.normal(size=(B, Sk, KV, Hd)), jnp.float32)
+    v = jnp.asarray(r.normal(size=(B, Sk, KV, Hd)), jnp.float32)
+    mask = (jnp.asarray(r.normal(size=(B, Sk)) * 0.2, jnp.float32)
+            if r.integers(0, 2) else None)
+    slopes = (jnp.asarray(r.uniform(0.05, 0.4, size=H), jnp.float32)
+              if r.integers(0, 2) else None)
+
+    out, _ = chunked_attention(q, k, v, mask, slopes, jnp.int32(qpos0),
+                               jnp.int32(0), causal, chunk, jnp.float32)
+    ref = _dense(q, k, v, mask, slopes, causal, qpos0, 0)
+    fwd_err = float(jnp.abs(out - ref).max())
+    assert fwd_err < 5e-5, (seed, B, Sq, Sk, H, KV, Hd, chunk, causal, fwd_err)
+
+    def loss_c(q, k, v):
+        o, _ = chunked_attention(q, k, v, mask, slopes, jnp.int32(qpos0),
+                                 jnp.int32(0), causal, chunk, jnp.float32)
+        return jnp.sum(jnp.tanh(o))
+
+    def loss_d(q, k, v):
+        return jnp.sum(jnp.tanh(_dense(q, k, v, mask, slopes, causal, qpos0, 0)))
+
+    gc = jax.grad(loss_c, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gc, gd):
+        gerr = float(jnp.abs(a - b).max())
+        assert gerr < 5e-4, (seed, name, B, Sq, Sk, H, KV, Hd, chunk, causal, gerr)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_streaming_fuzz_smoke(seed):
+    _one_case(seed)
+
+
+@pytest.mark.nightly
+@pytest.mark.parametrize("seed", range(5, 60))
+def test_streaming_fuzz_nightly(seed):
+    _one_case(seed)
