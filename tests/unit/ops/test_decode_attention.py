@@ -207,3 +207,26 @@ def test_prefill_streaming_matches_einsum(monkeypatch):
                                rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(np.asarray(streamed_d), np.asarray(dense_d),
                                rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_decode_fuzz(seed):
+    """Random decode configurations (interpret kernel) vs einsum."""
+    r = np.random.default_rng(100 + seed)
+    B = int(r.integers(1, 4))
+    KV = int(r.choice([1, 2, 4]))
+    H = KV * int(r.choice([1, 2, 4]))
+    Hd = int(r.choice([64, 128]))
+    Smax = 128 * int(r.integers(1, 5))
+    pos = int(r.integers(0, Smax))
+    q = jnp.asarray(r.normal(size=(B, H, Hd)), jnp.float32)
+    ck = jnp.asarray(r.normal(size=(B, Smax, KV, Hd)), jnp.float32)
+    cv = jnp.asarray(r.normal(size=(B, Smax, KV, Hd)), jnp.float32)
+    bias = (jnp.asarray(r.normal(size=(B, Smax)) * 0.2, jnp.float32)
+            if r.integers(0, 2) else None)
+    slopes = (jnp.asarray(r.uniform(0.05, 0.4, size=H), jnp.float32)
+              if r.integers(0, 2) else None)
+    out = decode_attention(q, ck, cv, pos, pad_bias=bias, alibi_slopes=slopes)
+    want = ref_decode(q, ck, cv, pos, bias, slopes)
+    err = float(jnp.abs(out - want).max())
+    assert err < 5e-5, (seed, B, H, KV, Hd, Smax, pos, err)
