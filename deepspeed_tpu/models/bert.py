@@ -95,6 +95,14 @@ class BertModel:
         pooled = jnp.tanh(x[:, 0] @ params["pooler"]["w"] + params["pooler"]["b"])
         return x, pooled
 
+    def forward(self, params, tokens, attn_mask=None):
+        """InferenceEngine-compatible surface (``fwd(params, tokens, mask)``):
+        MLM logits when the head exists, else the last hidden states."""
+        if self.with_mlm_head:
+            return self.mlm_logits(params, tokens, attention_mask=attn_mask)
+        hidden, _ = self(params, tokens, attention_mask=attn_mask)
+        return hidden
+
     def mlm_logits(self, params, input_ids, token_type_ids=None, attention_mask=None):
         """Masked-LM logits [B, S, vocab] (HF BertForMaskedLM head)."""
         if "mlm" not in params:

@@ -130,3 +130,25 @@ def test_bert_mlm_head_matches():
     got = ours.mlm_logits(params, jnp.asarray(tok))
     assert float(jnp.abs(got - ref).max()) < 5e-4
     assert np.array_equal(np.asarray(got.argmax(-1)), ref.argmax(-1))
+
+
+def test_bert_serves_through_init_inference():
+    """BertModel plugs into init_inference for fill-mask style serving
+    (reference test_inference.py sweeps HF BERT models)."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+
+    dist.set_mesh(None)
+    model = BertModel(BertConfig(vocab_size=120, max_seq=32, n_layer=2,
+                                 n_head=4, d_model=32, d_ff=64),
+                      with_mlm_head=True)
+    params = model.init_params(jax.random.key(0))
+    eng = deepspeed_tpu.init_inference(model, dtype="fp32", params=params)
+    toks = jnp.asarray(np.random.default_rng(9).integers(0, 120, (2, 16)),
+                       jnp.int32)
+    logits = eng.forward(toks)
+    assert logits.shape == (2, 16, 120)
+    want = model.mlm_logits(params, toks)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    dist.set_mesh(None)
