@@ -313,10 +313,9 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
         use_direct = _use_flash(cfg)
         fmesh = None if use_direct else _flash_mesh(cfg)
         if use_direct or fmesh is not None:
-            if KV != H:  # the flash kernels take repeated kv heads
-                rep = H // KV
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
+            # GQA kv goes in UNREPEATED — the flash kernel index-maps query
+            # head h to kv head h // (H/KV), so HBM/VMEM kv traffic stays at
+            # KV heads (H/KV× less on llama-style GQA)
             if use_direct:
                 from deepspeed_tpu.ops.pallas import flash_attention
                 out = flash_attention(q, k, v, mask_bias=mask_bias,
@@ -337,7 +336,7 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
                                        jnp.int32(0), cfg.causal,
                                        DENSE_STREAM_CHUNK, q.dtype)
     if out is None:
-        if KV != H and k.shape[2] != H:  # dense fallback needs repeated kv
+        if KV != H:  # dense fallback needs repeated kv
             rep = H // KV
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
@@ -420,7 +419,16 @@ def _flash_sharded(cfg: TransformerConfig, q, k, v, mask_bias, slopes, mesh):
     from jax import shard_map
 
     B, S, H, Hd = q.shape
-    split = _shard_axes(mesh, B, H)
+    KV = k.shape[2]
+    split = _shard_axes(mesh, B, H, KV)
+    if split is None and KV != H and _shard_axes(mesh, B, H) is not None:
+        # KV heads don't divide the tp axis (e.g. 8 kv heads, tp=16): repeat
+        # kv to H heads so each shard still runs the kernel — pays the GQA
+        # repeat copy but keeps the flash path
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+        KV = H
+        split = _shard_axes(mesh, B, H)
     if split is None:
         return None
     batch_axes, head_axis, nb, nh = split

@@ -219,12 +219,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, slope
                 *rest, scale, causal, seq_len, bq, bk, plain, has_layout):
     tri_ref, layout_ref, (dk_ref, dv_ref, dk_scr, dv_scr) = \
         _parse_rest(rest, plain, has_layout)
-    # grid (B, H, nk, nq): q blocks are innermost
-    i = pl.program_id(3)
-    nq = pl.num_programs(3)
+    # grid (B, KV, nk, G, nq): q blocks innermost, then the G query heads of
+    # the kv group — dk/dv for one kv block accumulate in scratch across BOTH
+    # inner axes, which is what makes the kernel GQA-native (kv gradients sum
+    # over the group's query heads without ever materialising repeated kv)
+    i = pl.program_id(4)
+    nq = pl.num_programs(4)
+    g = pl.program_id(3)
+    ng = pl.num_programs(3)
     j = pl.program_id(2)
 
-    @pl.when(i == 0)
+    @pl.when(jnp.logical_and(i == 0, g == 0))
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -251,7 +256,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, slope
               lambda: _block_bias(qoff, koff, bq, bk, seq_len, causal,
                                   slope_ref[0, 0], mask_ref[0].astype(jnp.float32)))
 
-    @pl.when(i == nq - 1)
+    @pl.when(jnp.logical_and(i == nq - 1, g == ng - 1))
     def _():
         dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
@@ -485,8 +490,13 @@ def _q_spec(bq, Hd):
     return pl.BlockSpec((None, None, bq, Hd), lambda b, h, i, j: (b, h, i, 0))
 
 
-def _kv_spec(bk, Hd):
-    return pl.BlockSpec((None, None, bk, Hd), lambda b, h, i, j: (b, h, j, 0))
+def _kv_spec(bk, Hd, G=1):
+    # GQA: query head h reads kv head h // G — the index map IS the repeat,
+    # so the group's shared kv block is DMA'd once per program with no
+    # H/KV-times-larger HBM copy (replaces the jnp.repeat the dispatch
+    # used to do; reference analogue: softmax_context's kv-head indexing in
+    # csrc/transformer/inference/csrc/pt_binding.cpp)
+    return pl.BlockSpec((None, None, bk, Hd), lambda b, h, i, j: (b, h // G, j, 0))
 
 
 def _row_spec(bq):
@@ -518,14 +528,17 @@ def _layout_spec():
 
 @functools.lru_cache(maxsize=32)
 def _build(causal: bool, scale: float, bq: int, bk: int, seq_len: int, interpret: bool,
-           has_layout: bool = False, plain: bool = False):
+           has_layout: bool = False, plain: bool = False, kv_group: int = 1):
     """Build the custom-VJP flash function for one static configuration.
 
-    Operates on padded [B, H, Sp, Hd] inputs, mask [B, Sp] additive f32,
+    Operates on padded [B, H, Sp, Hd] q / [B, KV, Sp, Hd] k,v
+    (KV = H // kv_group; GQA is native — query head h reads kv head
+    h // kv_group via the BlockSpec index map), mask [B, Sp] additive f32,
     slopes [H, 1] f32 (zeros ⇒ no alibi). ``plain`` is the no-mask/no-alibi/
     no-padding fast path (tri = precomputed diagonal-block causal bias).
     """
 
+    G = kv_group
     maybe_tri = [_tri_spec(bq, bk)] if plain else []
     maybe_layout = [_layout_spec()] if has_layout else []
     statics = dict(scale=scale, causal=causal, seq_len=seq_len, bq=bq, bk=bk,
@@ -538,7 +551,7 @@ def _build(causal: bool, scale: float, bq: int, bk: int, seq_len: int, interpret
         o, lse = pl.pallas_call(
             kernel,
             grid=(B, H, nq, nk),
-            in_specs=[_q_spec(bq, Hd), _kv_spec(bk, Hd), _kv_spec(bk, Hd),
+            in_specs=[_q_spec(bq, Hd), _kv_spec(bk, Hd, G), _kv_spec(bk, Hd, G),
                       _mask_spec(bk), _slope_spec()] + maybe_tri + maybe_layout,
             out_specs=[_q_spec(bq, Hd), _row_spec(bq)],
             out_shape=[
@@ -574,7 +587,7 @@ def _build(causal: bool, scale: float, bq: int, bk: int, seq_len: int, interpret
         dq = pl.pallas_call(
             dq_kernel,
             grid=(B, H, nq, nk),
-            in_specs=[_q_spec(bq, Hd), _kv_spec(bk, Hd), _kv_spec(bk, Hd),
+            in_specs=[_q_spec(bq, Hd), _kv_spec(bk, Hd, G), _kv_spec(bk, Hd, G),
                       _q_spec(bq, Hd), _row_spec(bq), _row_spec(bq),
                       _mask_spec(bk), _slope_spec()] + maybe_tri + maybe_layout,
             out_specs=_q_spec(bq, Hd),
@@ -583,26 +596,34 @@ def _build(causal: bool, scale: float, bq: int, bk: int, seq_len: int, interpret
             interpret=interpret,
         )(q, k, v, g, lse, delta, mask, slopes, *extra)
 
-        # grid (B, H, nk, nq): swap the roles of the last two grid axes
-        kq_spec = pl.BlockSpec((None, None, bq, Hd), lambda b, h, j, i: (b, h, i, 0))
-        kk_spec = pl.BlockSpec((None, None, bk, Hd), lambda b, h, j, i: (b, h, j, 0))
-        krow_spec = pl.BlockSpec((None, None, 1, bq), lambda b, h, j, i: (b, h, 0, i))
-        kmask_spec = pl.BlockSpec((None, 1, bk), lambda b, h, j, i: (b, 0, j))
-        kslope_spec = pl.BlockSpec((None, 8, 128), lambda b, h, j, i: (h, 0, 0))
-        kmaybe_tri = [pl.BlockSpec((bq, bk), lambda b, h, j, i: (0, 0))] if plain else []
-        kmaybe_layout = ([pl.BlockSpec((None, 8, 128), lambda b, h, j, i: (h, i, j))]
+        # grid (B, KV, nk, G, nq): q blocks innermost, then the group's query
+        # heads — one dk/dv block accumulates across both in scratch
+        KV = H // G
+        kq_spec = pl.BlockSpec((None, None, bq, Hd),
+                               lambda b, kv, j, gg, i: (b, kv * G + gg, i, 0))
+        kk_spec = pl.BlockSpec((None, None, bk, Hd),
+                               lambda b, kv, j, gg, i: (b, kv, j, 0))
+        krow_spec = pl.BlockSpec((None, None, 1, bq),
+                                 lambda b, kv, j, gg, i: (b, kv * G + gg, 0, i))
+        kmask_spec = pl.BlockSpec((None, 1, bk), lambda b, kv, j, gg, i: (b, 0, j))
+        kslope_spec = pl.BlockSpec((None, 8, 128),
+                                   lambda b, kv, j, gg, i: (kv * G + gg, 0, 0))
+        kmaybe_tri = ([pl.BlockSpec((bq, bk), lambda b, kv, j, gg, i: (0, 0))]
+                      if plain else [])
+        kmaybe_layout = ([pl.BlockSpec((None, 8, 128),
+                                       lambda b, kv, j, gg, i: (kv * G + gg, i, j))]
                          if has_layout else [])
 
         dkv_kernel = functools.partial(_dkv_kernel, **statics)
         dk, dv = pl.pallas_call(
             dkv_kernel,
-            grid=(B, H, nk, nq),
+            grid=(B, KV, nk, G, nq),
             in_specs=[kq_spec, kk_spec, kk_spec, kq_spec, krow_spec, krow_spec,
                       kmask_spec, kslope_spec] + kmaybe_tri + kmaybe_layout,
             out_specs=[kk_spec, kk_spec],
             out_shape=[
-                jax.ShapeDtypeStruct((B, H, Sp, Hd), q.dtype),
-                jax.ShapeDtypeStruct((B, H, Sp, Hd), q.dtype),
+                jax.ShapeDtypeStruct((B, KV, Sp, Hd), q.dtype),
+                jax.ShapeDtypeStruct((B, KV, Sp, Hd), q.dtype),
             ],
             scratch_shapes=[
                 pltpu.VMEM((bk, Hd), jnp.float32),
@@ -630,8 +651,17 @@ def flash_attention(q, k, v, mask_bias=None, causal: bool = True, alibi_slopes=N
     :mod:`deepspeed_tpu.ops.sparse_attention`); the kernel block size then
     follows the layout's block size S/nb, and zero blocks are skipped in
     forward AND backward — true block-sparse flash attention.
+
+    GQA is native: k/v may carry KV = H / group kv heads ([B, S, KV, Hd]);
+    query head h attends kv head ``h // (H // KV)`` (``jnp.repeat`` order)
+    via BlockSpec index maps — no repeated kv copy in HBM or VMEM, and
+    dk/dv come back at [B, S, KV, Hd] (summed over the group in-kernel).
     """
     B, S, H, Hd = q.shape
+    KV = k.shape[2]
+    if H % KV:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {KV}")
+    kv_group = H // KV
     scale = float(scale if scale is not None else Hd**-0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -678,8 +708,11 @@ def flash_attention(q, k, v, mask_bias=None, causal: bool = True, alibi_slopes=N
 
     # packed-heads fastest path: small head_dim packs P heads into one full
     # 128-lane tile and q/k/v stay in their natural [B, S, H*Hd] layout —
-    # no transposes, no lane padding, P× fewer programs
-    if plain and Hd < 128 and 128 % Hd == 0 and H % (128 // Hd) == 0:
+    # no transposes, no lane padding, P× fewer programs. MHA only: GQA's
+    # shared kv heads break the per-head lane-group pairing, and GQA models
+    # are Hd=128-class anyway (general kernel, zero lane padding)
+    if (plain and kv_group == 1 and Hd < 128 and 128 % Hd == 0
+            and H % (128 // Hd) == 0):
         P128 = 128 // Hd
         fn = _build_packed(causal, scale, bq, bk, interpret, P128, Hd)
         tri = _make_tri(bq, bk)
@@ -718,7 +751,8 @@ def flash_attention(q, k, v, mask_bias=None, causal: bool = True, alibi_slopes=N
         layout = jnp.repeat(jnp.repeat(layout, 8, axis=1), 128, axis=2)
         extra = extra + (layout,)
 
-    fn = _build(causal, scale, bq, bk, S, interpret, block_layout is not None, plain)
+    fn = _build(causal, scale, bq, bk, S, interpret, block_layout is not None,
+                plain, kv_group)
     out = fn(qt, kt, vt, mask, slopes, *extra)
     return jnp.transpose(out[:, :, :S, :], (0, 2, 1, 3))
 
