@@ -47,8 +47,32 @@ class InferenceEngine:
                       "bf16": jnp.bfloat16, "int8": jnp.bfloat16}[dt]
 
         tp_size = self._config.tensor_parallel.tp_size
+        # MoE serving (reference inference/engine.py:209-216 _create_ep_parallel_group):
+        # the ep axis shards the expert dimension at serve time; gating and
+        # attention replicate over it
+        moe_cfg = self._config.moe
+        if isinstance(moe_cfg, bool):
+            moe_enabled, ep_size = moe_cfg, max(1, int(self._config.ep_size))
+            moe_type = str(getattr(self._config.moe_type, "value", self._config.moe_type))
+        else:
+            moe_enabled = moe_cfg.enabled
+            ep_size = max(int(moe_cfg.ep_size), int(self._config.ep_size), 1)
+            moe_type = str(getattr(moe_cfg.type, "value", moe_cfg.type))
+        self._ep_size = ep_size if moe_enabled else 1
+        if moe_type != "standard":
+            # regardless of ep_size: a residual/PR-MoE checkpoint served with
+            # standard routing would be silently wrong
+            raise NotImplementedError(
+                f"MoE inference type {moe_type!r} is not implemented; only "
+                "'standard' expert-parallel serving is supported (the "
+                "residual-MoE coefficient blend has no zoo model)")
         if not dist.has_mesh():
-            axes = {"tp": tp_size, "dp": -1} if tp_size > 1 else {"dp": -1}
+            axes = {}
+            if self._ep_size > 1:
+                axes["ep"] = self._ep_size
+            if tp_size > 1:
+                axes["tp"] = tp_size
+            axes["dp"] = -1
             dist.init_mesh(axes)
         self.mesh = dist.get_mesh()
 
@@ -84,6 +108,32 @@ class InferenceEngine:
         if params is None:
             raise ValueError("InferenceEngine needs params (or a model with init_params, "
                              "or config.checkpoint pointing at an HF checkpoint)")
+
+        # MoE models (zoo MoECausalLM shape: .moe config + aux-loss forward):
+        # wire the serve mesh into the model so dispatch_combine constrains
+        # the dispatched tensor to the ep axis (all-to-all over ICI), and
+        # drop the aux loss from the served logits
+        self._is_moe = hasattr(model, "moe") and hasattr(model, "_moe_mlp")
+        if self._ep_size > 1 and not self._is_moe:
+            raise ValueError(
+                f"config.moe.ep_size={self._ep_size} but the model has no MoE "
+                "layers; remove the moe section or serve an MoE model")
+        if self._is_moe:
+            if self._weight_quant:
+                raise NotImplementedError(
+                    "int8 weight-only quantisation of MoE expert weights is not "
+                    "implemented; serve MoE models in bf16/fp16")
+            n_experts = int(getattr(model.moe, "num_experts", 0))
+            if self._ep_size > 1 and n_experts % self._ep_size:
+                raise ValueError(
+                    f"moe.ep_size={self._ep_size} must divide the model's "
+                    f"num_experts={n_experts}")
+            # serve on a shallow copy bound to the serve mesh — mutating the
+            # caller's model would clobber a training mesh (or an earlier
+            # engine's) and put stale sharding constraints inside their jit
+            import copy
+            self.module = model = copy.copy(model)
+            model.mesh = self.mesh
 
         tp_specs = None
         if hasattr(model, "tp_specs"):
@@ -131,6 +181,10 @@ class InferenceEngine:
             # the streamed path is built from the pre-LN cached_* blocks
             raise ValueError("weight streaming supports pre-LN models only "
                              "(norm_position='post' has no cached path)")
+        if self._stream_weights and (hasattr(model, "moe") or self._ep_size > 1):
+            raise NotImplementedError(
+                "ZeRO-Inference weight streaming of MoE models is not "
+                "implemented (the streamed block is the dense cached path)")
 
         from jax.sharding import NamedSharding, PartitionSpec as P
         from jax.tree_util import GetAttrKey, tree_map_with_path
@@ -209,7 +263,13 @@ class InferenceEngine:
             return logits
         if self._fwd_jit is None:
             fwd = self.module.forward if hasattr(self.module, "forward") else self.module
-            self._fwd_jit = jax.jit(lambda p, t, m: fwd(p, t, m))
+            if self._is_moe:
+                # eval routing (eval_capacity_factor, no jitter/RTS) and the
+                # aux loss dropped — serving returns logits only (reference
+                # DeepSpeedMoEInference forward, moe_inference.py:300-364)
+                self._fwd_jit = jax.jit(lambda p, t, m: fwd(p, t, m, train=False)[0])
+            else:
+                self._fwd_jit = jax.jit(lambda p, t, m: fwd(p, t, m))
         return self._fwd_jit(self.params, input_ids, attention_mask)
 
     # ------------------------------------------------------------------ #

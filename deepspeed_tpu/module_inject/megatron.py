@@ -38,9 +38,12 @@ def megatron_merge_strategies(version=0) -> Dict[str, Any]:
         "attention.query_key_value.weight": qkv,
         "attention.query_key_value.bias": qkv,
         "attention.dense.weight": 1,
-        "mlp.dense_h_to_4h.weight": 0,
-        "mlp.dense_h_to_4h.bias": 0,
-        "mlp.dense_4h_to_h.weight": 1,
+        # no "mlp." prefix: the same column/row-parallel split applies to the
+        # dense mlp AND the MoE expert FFNs
+        # (...mlp.deepspeed_moe.experts.deepspeed_experts.{e}.dense_h_to_4h...)
+        "dense_h_to_4h.weight": 0,
+        "dense_h_to_4h.bias": 0,
+        "dense_4h_to_h.weight": 1,
         "word_embeddings.weight": 0,
     }
 
@@ -113,6 +116,49 @@ def map_megatron_params(sd: Dict[str, np.ndarray], cfg, version=0) -> Dict[str, 
         except KeyError:
             pass
 
+    # Megatron-DeepSpeed MoE layers (reference policy
+    # module_inject/containers/megatron_gpt_moe.py:57-82 'standard' type):
+    # per-layer gate ``mlp.deepspeed_moe.gate.wg`` and experts
+    # ``mlp.deepspeed_moe.experts.deepspeed_experts.{e}.dense_{h_to_4h,4h_to_h}``
+    # → zoo MoE layout [L, E, ...] (every layer must be MoE; the zoo model
+    # has no mixed dense/MoE stacking)
+    moe_probe = f"{lp}.0.mlp.deepspeed_moe.experts.deepspeed_experts."
+    is_moe = any(moe_probe in k for k in sd)
+    if is_moe:
+        ex = f"{lp}.{{}}.mlp.deepspeed_moe.experts.deepspeed_experts.{{}}"
+        E = 0
+        while True:
+            try:
+                g(ex.format(0, E) + ".dense_h_to_4h.weight")
+                E += 1
+            except KeyError:
+                break
+        if E == 0:
+            raise KeyError("deepspeed_moe expert keys present but no "
+                           "dense_h_to_4h weights found")
+
+        def estack(suffix, tr=False):
+            # [L, E, ...]; missing expert keys on ANY layer raise loudly
+            return np.stack([
+                np.stack([(t(g(ex.format(i, e) + suffix)) if tr
+                           else np.asarray(g(ex.format(i, e) + suffix)))
+                          for e in range(E)])
+                for i in range(L)])
+
+        mlp = {
+            # torch Linear wg [E, D] → gate_w [D, E]
+            "gate_w": stack(lp + ".{}.mlp.deepspeed_moe.gate.wg.weight", tr=True),
+            "w_up": estack(".dense_h_to_4h.weight", tr=True),
+            "b_up": estack(".dense_h_to_4h.bias"),
+            "w_down": estack(".dense_4h_to_h.weight", tr=True),
+            "b_down": estack(".dense_4h_to_h.bias"),
+        }
+    else:
+        mlp = {"w_up": stack(lp + ".{}.mlp.dense_h_to_4h.weight", tr=True),
+               "b_up": stack(lp + ".{}.mlp.dense_h_to_4h.bias"),
+               "w_down": stack(lp + ".{}.mlp.dense_4h_to_h.weight", tr=True),
+               "b_down": stack(lp + ".{}.mlp.dense_4h_to_h.bias")}
+
     return {
         "embed": {"tokens": np.asarray(g("word_embeddings.weight")),
                   "positions": np.asarray(g("position_embeddings.weight"))},
@@ -125,10 +171,7 @@ def map_megatron_params(sd: Dict[str, np.ndarray], cfg, version=0) -> Dict[str, 
                      "bo": stack(lp + ".{}.attention.dense.bias")},
             "ln_mlp": {"scale": stack(lp + ".{}.post_attention_layernorm.weight"),
                        "bias": stack(lp + ".{}.post_attention_layernorm.bias")},
-            "mlp": {"w_up": stack(lp + ".{}.mlp.dense_h_to_4h.weight", tr=True),
-                    "b_up": stack(lp + ".{}.mlp.dense_h_to_4h.bias"),
-                    "w_down": stack(lp + ".{}.mlp.dense_4h_to_h.weight", tr=True),
-                    "b_down": stack(lp + ".{}.mlp.dense_4h_to_h.bias")},
+            "mlp": mlp,
         },
         "ln_f": {"scale": np.asarray(g(fl + ".weight")),
                  "bias": np.asarray(g(fl + ".bias"))},

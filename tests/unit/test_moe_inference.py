@@ -1,0 +1,208 @@
+"""MoE inference / expert-parallel serving.
+
+Reference parity: ``deepspeed/inference/engine.py:209-216`` (EP group
+creation at inference), ``deepspeed/ops/transformer/inference/moe_inference.py``
+(DeepSpeedMoEInference serving path),
+``deepspeed/module_inject/containers/megatron_gpt_moe.py`` (Megatron-MoE
+ingestion policy). Here expert parallelism at serve time is an ``ep`` mesh
+axis the expert weights and dispatched tokens shard over.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.models.moe_lm import MoECausalLM, MoEConfig
+from deepspeed_tpu.models.transformer import TransformerConfig
+
+
+def _moe_model(n_experts=4):
+    cfg = TransformerConfig(vocab_size=128, n_layer=2, n_head=4, d_model=32,
+                            d_ff=64, max_seq=32, remat=False)
+    return MoECausalLM(cfg, MoEConfig(num_experts=n_experts, capacity_factor=2.0,
+                                      eval_capacity_factor=2.0, expert_ff_mult=2))
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    dist.set_mesh(None)
+    yield
+    dist.set_mesh(None)
+
+
+class TestMoEServing:
+
+    def test_ep_matches_ep1_logits(self):
+        """init_inference with moe.ep_size=4 == ep_size=1 logits (the sharded
+        all-to-all dispatch is a layout change, not a math change)."""
+        model = _moe_model()
+        params = model.init_params(jax.random.key(0))
+        toks = np.asarray(jax.random.randint(jax.random.key(1), (2, 32), 0, 128))
+
+        eng1 = deepspeed_tpu.init_inference(model, params=params,
+                                            config={"dtype": "fp32"})
+        ref = np.asarray(eng1.forward(toks))
+
+        dist.set_mesh(None)
+        eng4 = deepspeed_tpu.init_inference(model, params=params,
+                                            config={"dtype": "fp32",
+                                                    "moe": {"ep_size": 4}})
+        assert eng4.mesh.shape.get("ep") == 4
+        out = np.asarray(eng4.forward(toks))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_ep_with_tp_compose(self):
+        """moe.ep_size=2 x tensor_parallel tp_size=2: experts shard over ep,
+        expert matmuls shard over tp, logits still match ep=1."""
+        model = _moe_model()
+        params = model.init_params(jax.random.key(2))
+        toks = np.asarray(jax.random.randint(jax.random.key(3), (2, 32), 0, 128))
+        eng1 = deepspeed_tpu.init_inference(model, params=params,
+                                            config={"dtype": "fp32"})
+        ref = np.asarray(eng1.forward(toks))
+        dist.set_mesh(None)
+        eng = deepspeed_tpu.init_inference(
+            model, params=params,
+            config={"dtype": "fp32", "moe": {"ep_size": 2},
+                    "tensor_parallel": {"tp_size": 2}})
+        assert eng.mesh.shape.get("ep") == 2 and eng.mesh.shape.get("tp") == 2
+        out = np.asarray(eng.forward(toks))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_generate_runs(self):
+        model = _moe_model()
+        params = model.init_params(jax.random.key(4))
+        eng = deepspeed_tpu.init_inference(model, params=params,
+                                           config={"dtype": "fp32",
+                                                   "moe": {"ep_size": 4}})
+        out = eng.generate(np.asarray([[5, 6, 7]]), max_new_tokens=4)
+        assert out.shape == (1, 7)
+
+    def test_ep_on_dense_model_raises(self):
+        from deepspeed_tpu.models import CausalLM
+        model = CausalLM(TransformerConfig(vocab_size=64, n_layer=1, n_head=2,
+                                           d_model=32, max_seq=16, remat=False))
+        with pytest.raises(ValueError, match="no MoE layers"):
+            deepspeed_tpu.init_inference(model, config={"moe": {"ep_size": 2}})
+
+    def test_residual_moe_type_raises(self):
+        model = _moe_model()
+        params = model.init_params(jax.random.key(5))
+        with pytest.raises(NotImplementedError, match="residual"):
+            deepspeed_tpu.init_inference(
+                model, params=params,
+                config={"moe": {"ep_size": 2, "type": "residual"}})
+
+    def test_int8_moe_raises(self):
+        model = _moe_model()
+        params = model.init_params(jax.random.key(6))
+        with pytest.raises(NotImplementedError, match="int8"):
+            deepspeed_tpu.init_inference(model, params=params,
+                                         config={"dtype": "int8"})
+
+
+class TestMegatronMoEIngestion:
+    """Megatron-DeepSpeed MoE checkpoint naming → zoo MoE layout
+    (reference megatron_gpt_moe.py:57-82 'standard' expert extraction)."""
+
+    def _fake_sd(self, model, params):
+        """Write zoo params back out in Megatron-DeepSpeed MoE naming."""
+        cfg = model.config
+        sd = {}
+        lp = "transformer.layers"
+        sd["word_embeddings.weight"] = np.asarray(params["embed"]["tokens"])
+        sd["position_embeddings.weight"] = np.asarray(params["embed"]["positions"])
+        sd["transformer.final_layernorm.weight"] = np.asarray(params["ln_f"]["scale"])
+        sd["transformer.final_layernorm.bias"] = np.asarray(params["ln_f"]["bias"])
+        L = cfg.n_layer
+        lay = params["layers"]
+        E = lay["mlp"]["w_up"].shape[1]
+        for i in range(L):
+            pre = f"{lp}.{i}"
+            sd[f"{pre}.input_layernorm.weight"] = np.asarray(lay["ln_attn"]["scale"][i])
+            sd[f"{pre}.input_layernorm.bias"] = np.asarray(lay["ln_attn"]["bias"][i])
+            sd[f"{pre}.post_attention_layernorm.weight"] = np.asarray(lay["ln_mlp"]["scale"][i])
+            sd[f"{pre}.post_attention_layernorm.bias"] = np.asarray(lay["ln_mlp"]["bias"][i])
+            # fused qkv, version 0 layout: [q|k|v] contiguous rows
+            qkv_w = np.concatenate([np.asarray(lay["attn"][w][i]).T
+                                    for w in ("wq", "wk", "wv")], axis=0)
+            qkv_b = np.concatenate([np.asarray(lay["attn"][b][i])
+                                    for b in ("bq", "bk", "bv")], axis=0)
+            sd[f"{pre}.attention.query_key_value.weight"] = qkv_w
+            sd[f"{pre}.attention.query_key_value.bias"] = qkv_b
+            sd[f"{pre}.attention.dense.weight"] = np.asarray(lay["attn"]["wo"][i]).T
+            sd[f"{pre}.attention.dense.bias"] = np.asarray(lay["attn"]["bo"][i])
+            sd[f"{pre}.mlp.deepspeed_moe.gate.wg.weight"] = \
+                np.asarray(lay["mlp"]["gate_w"][i]).T
+            for e in range(E):
+                ex = f"{pre}.mlp.deepspeed_moe.experts.deepspeed_experts.{e}"
+                sd[f"{ex}.dense_h_to_4h.weight"] = np.asarray(lay["mlp"]["w_up"][i, e]).T
+                sd[f"{ex}.dense_h_to_4h.bias"] = np.asarray(lay["mlp"]["b_up"][i, e])
+                sd[f"{ex}.dense_4h_to_h.weight"] = np.asarray(lay["mlp"]["w_down"][i, e]).T
+                sd[f"{ex}.dense_4h_to_h.bias"] = np.asarray(lay["mlp"]["b_down"][i, e])
+        return sd
+
+    def test_roundtrip_exact(self):
+        from deepspeed_tpu.module_inject.megatron import map_megatron_params
+
+        cfg = TransformerConfig(vocab_size=96, n_layer=2, n_head=4, d_model=32,
+                                max_seq=16, attn_bias=True, remat=False)
+        model = MoECausalLM(cfg, MoEConfig(num_experts=3, expert_ff_mult=2))
+        params = model.init_params(jax.random.key(7))
+        sd = self._fake_sd(model, params)
+        mapped = map_megatron_params(sd, cfg, version=0)
+
+        ref_layers = params["layers"]
+        assert mapped["layers"]["mlp"]["w_up"].shape == ref_layers["mlp"]["w_up"].shape
+        for path, (a, b) in {
+            "gate_w": (mapped["layers"]["mlp"]["gate_w"], ref_layers["mlp"]["gate_w"]),
+            "w_up": (mapped["layers"]["mlp"]["w_up"], ref_layers["mlp"]["w_up"]),
+            "w_down": (mapped["layers"]["mlp"]["w_down"], ref_layers["mlp"]["w_down"]),
+            "b_down": (mapped["layers"]["mlp"]["b_down"], ref_layers["mlp"]["b_down"]),
+            "wq": (mapped["layers"]["attn"]["wq"], ref_layers["attn"]["wq"]),
+            "wk": (mapped["layers"]["attn"]["wk"], ref_layers["attn"]["wk"]),
+        }.items():
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=path)
+
+        # the mapped tree must serve identically to the original params
+        toks = np.asarray(jax.random.randint(jax.random.key(8), (1, 16), 0, 96))
+        eng_ref = deepspeed_tpu.init_inference(model, params=params,
+                                               config={"dtype": "fp32"})
+        ref = np.asarray(eng_ref.forward(toks))
+        dist.set_mesh(None)
+        eng = deepspeed_tpu.init_inference(model, params=mapped,
+                                           config={"dtype": "fp32"})
+        out = np.asarray(eng.forward(toks))
+        np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+
+class TestMoEGuards:
+
+    def test_ep_must_divide_experts(self):
+        model = _moe_model(n_experts=4)
+        params = model.init_params(jax.random.key(9))
+        with pytest.raises(ValueError, match="divide"):
+            deepspeed_tpu.init_inference(model, params=params,
+                                         config={"dtype": "fp32",
+                                                 "moe": {"ep_size": 8}})
+
+    def test_residual_raises_even_without_ep(self):
+        model = _moe_model()
+        params = model.init_params(jax.random.key(10))
+        with pytest.raises(NotImplementedError, match="residual"):
+            deepspeed_tpu.init_inference(model, params=params,
+                                         config={"moe": {"type": "residual"}})
+
+    def test_caller_model_not_mutated(self):
+        model = _moe_model()
+        params = model.init_params(jax.random.key(11))
+        assert model.mesh is None
+        eng = deepspeed_tpu.init_inference(model, params=params,
+                                           config={"dtype": "fp32",
+                                                   "moe": {"ep_size": 4}})
+        assert model.mesh is None          # caller's object untouched
+        assert eng.module is not model     # engine serves a bound copy
+        assert eng.module.mesh is eng.mesh
