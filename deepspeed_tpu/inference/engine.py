@@ -119,7 +119,13 @@ class InferenceEngine:
                 f"config.moe.ep_size={self._ep_size} but the model has no MoE "
                 "layers; remove the moe section or serve an MoE model")
         if self._is_moe:
-            if self._weight_quant:
+            from deepspeed_tpu.ops.quant import Quantized8 as _Q8
+            pre_quantized = any(isinstance(l, _Q8) for l in jax.tree.leaves(
+                params, is_leaf=lambda x: isinstance(x, _Q8)))
+            if self._weight_quant or pre_quantized:
+                # also catches pre-quantized trees (quantize-on-load), which
+                # would otherwise crash on a Quantized8 matmul operand deep
+                # inside the MoE forward trace
                 raise NotImplementedError(
                     "int8 weight-only quantisation of MoE expert weights is not "
                     "implemented; serve MoE models in bf16/fp16")
@@ -143,10 +149,6 @@ class InferenceEngine:
             tp_specs = auto_tp_specs(params)
 
         if self._weight_quant:
-            if tp_size > 1:
-                raise NotImplementedError(
-                    "int8 weight-only inference with tensor_parallel.tp_size > 1 is "
-                    "not implemented yet; use bf16/fp16 for TP or tp_size=1 for int8")
             from deepspeed_tpu.ops.quant import quantize_params, tree_nbytes
             groups = max(1, int(self._config.quant.weight.q_groups))
             dense_bytes = sum(a.size * 2 for a in jax.tree.leaves(params))
@@ -218,13 +220,17 @@ class InferenceEngine:
                      f"({host_bytes / 2**20:.0f} MiB) resident on host; device "
                      "holds one layer at a time", ranks=[0])
 
-        # pre-quantized param trees (e.g. quantize-on-load) carry Quantized8
-        # nodes the model's plain tp_specs tree can't be mapped over
-        from deepspeed_tpu.ops.quant import Quantized8
+        # quantized param trees (int8 config or quantize-on-load) carry
+        # Quantized8 nodes: their payload+scale shardings are derived
+        # together so group boundaries align with TP shard boundaries
+        # (reference GroupQuantizer x TP slicing, replace_module.py:42-135)
+        from deepspeed_tpu.ops.quant import Quantized8, quantized_shardings
         has_quant_nodes = any(isinstance(l, Quantized8) for l in jax.tree.leaves(
             params, is_leaf=lambda x: isinstance(x, Quantized8)))
-        if tp_specs is not None and not self._weight_quant \
-                and not self._stream_weights and not has_quant_nodes:
+        if tp_specs is not None and not self._stream_weights \
+                and (self._weight_quant or has_quant_nodes):
+            shardings = quantized_shardings(params, tp_specs, self.mesh)
+        elif tp_specs is not None and not self._stream_weights:
             from deepspeed_tpu.runtime.zero.partition import ZeroShardingRules
             rules = ZeroShardingRules(self.mesh)  # stage 0: replicate except TP dims
             shardings = rules.param_shardings(params, tp_specs)

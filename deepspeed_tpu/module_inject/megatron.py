@@ -122,10 +122,25 @@ def map_megatron_params(sd: Dict[str, np.ndarray], cfg, version=0) -> Dict[str, 
     # ``mlp.deepspeed_moe.experts.deepspeed_experts.{e}.dense_{h_to_4h,4h_to_h}``
     # → zoo MoE layout [L, E, ...] (every layer must be MoE; the zoo model
     # has no mixed dense/MoE stacking)
-    moe_probe = f"{lp}.0.mlp.deepspeed_moe.experts.deepspeed_experts."
-    is_moe = any(moe_probe in k for k in sd)
+    is_moe = any(".mlp.deepspeed_moe." in k for k in sd)
     if is_moe:
         ex = f"{lp}.{{}}.mlp.deepspeed_moe.experts.deepspeed_experts.{{}}"
+
+        def has_expert(i):
+            try:
+                g(ex.format(i, 0) + ".dense_h_to_4h.weight")
+                return True
+            except KeyError:
+                return False
+
+        dense_layers = [i for i in range(L) if not has_expert(i)]
+        if dense_layers:
+            # e.g. Megatron-DeepSpeed --moe-layer-freq 2 alternating stacking
+            raise NotImplementedError(
+                f"mixed dense/MoE layer stacking is not supported (layers "
+                f"{dense_layers} of {L} have no deepspeed_moe experts, e.g. "
+                "a --moe-layer-freq > 1 checkpoint); the zoo MoECausalLM "
+                "stacks an MoE MLP in every layer")
         E = 0
         while True:
             try:
@@ -133,9 +148,6 @@ def map_megatron_params(sd: Dict[str, np.ndarray], cfg, version=0) -> Dict[str, 
                 E += 1
             except KeyError:
                 break
-        if E == 0:
-            raise KeyError("deepspeed_moe expert keys present but no "
-                           "dense_h_to_4h weights found")
 
         def estack(suffix, tr=False):
             # [L, E, ...]; missing expert keys on ANY layer raise loudly

@@ -93,3 +93,54 @@ def quantize_params(params, groups: int = 1, include_embed: bool = False):
 
 def tree_nbytes(params) -> int:
     return sum(l.nbytes for l in jax.tree.leaves(params))
+
+
+def quantized_shardings(params, tp_specs, mesh):
+    """Sharding tree for a (possibly partially) quantized param tree under
+    tensor parallelism — the reference composes ``GroupQuantizer`` output with
+    TP slicing inside ``replace_module.py:42-119``; here the composition is a
+    consistency rule between the int8 payload and its per-group scales:
+
+    - ``q`` shards exactly like the original weight's PartitionSpec;
+    - ``scale`` (shape ``lead + (groups,)``) shards its lead dims the same
+      way, and its groups axis like the weight's LAST (quantisation) axis —
+      group boundaries align with shard boundaries iff the axis size divides
+      ``groups``, otherwise the quant-axis sharding is dropped from BOTH so
+      a shard never needs another shard's scales.
+
+    Mesh axes absent from the mesh or not dividing a dim are dropped
+    (same policy as ``ZeroShardingRules.param_spec``). Returns a tree
+    congruent with ``params`` (Quantized8 nodes carry NamedShardings).
+    """
+    import math
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.runtime.zero.partition import sanitize_tp_spec
+
+    def axis_size(entry):
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        return math.prod(mesh.shape.get(a, 1) for a in axes)
+
+    def prune(shape, spec):
+        out = list(sanitize_tp_spec(mesh, shape, spec) or ())
+        return out + [None] * (len(shape) - len(out))
+
+    def one(leaf, spec):
+        spec = P() if spec is None else spec
+        if not isinstance(leaf, Quantized8):
+            return NamedSharding(mesh, P(*prune(leaf.shape, spec)))
+        qs = prune(leaf.q.shape, spec)
+        groups = leaf.scale.shape[-1]
+        last = qs[-1]
+        if last is not None and groups % axis_size(last):
+            last = None          # shard/group boundaries misalign: replicate
+        qs[-1] = last
+        # scale lead dims == q lead dims (scale.shape = q.shape[:-1] + (groups,)),
+        # so the pruned lead entries transfer; the groups axis takes `last`
+        ss = qs[:-1] + [last]
+        return Quantized8(q=NamedSharding(mesh, P(*qs)),
+                          scale=NamedSharding(mesh, P(*ss)))
+
+    return jax.tree.map(one, params, tp_specs,
+                        is_leaf=lambda x: isinstance(x, Quantized8))

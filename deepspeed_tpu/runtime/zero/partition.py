@@ -47,6 +47,29 @@ def _partition_axes(mesh: Mesh, zero_config: ZeroConfig) -> Tuple[str, ...]:
     return tuple(axes)
 
 
+def sanitize_tp_spec(mesh: Mesh, arr_shape: Tuple[int, ...],
+                     tp_spec: Optional[P]) -> Optional[P]:
+    """Drop TP axis entries whose mesh axes are absent or whose size doesn't
+    divide the dim (e.g. an odd vocab over tp=2 falls back to replication on
+    that dim). The single axis-drop policy shared by ZeRO parameter sharding
+    and the quantized-inference sharding (`ops/quant.py quantized_shardings`)."""
+    import math
+    if tp_spec is None:
+        return None
+    out = []
+    for i, entry in enumerate(tp_spec):
+        if entry is None or i >= len(arr_shape):
+            out.append(None if i >= len(arr_shape) else entry)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        if any(a not in mesh.shape for a in axes):
+            out.append(None)  # axis absent from this mesh (e.g. no tp)
+            continue
+        size = math.prod(mesh.shape[a] for a in axes)
+        out.append(entry if arr_shape[i] % size == 0 else None)
+    return P(*out)
+
+
 class ZeroShardingRules:
     """Produces NamedShardings for params / master params / grads / opt state.
 
@@ -67,23 +90,7 @@ class ZeroShardingRules:
     # -------------------- per-array spec builders -------------------- #
 
     def _sanitize_tp(self, arr_shape: Tuple[int, ...], tp_spec: Optional[P]) -> Optional[P]:
-        """Drop TP axis entries whose dim isn't divisible by the axis size
-        (e.g. an odd vocab over tp=2 falls back to replication on that dim)."""
-        import math
-        if tp_spec is None:
-            return None
-        out = []
-        for i, entry in enumerate(tp_spec):
-            if entry is None or i >= len(arr_shape):
-                out.append(None if i >= len(arr_shape) else entry)
-                continue
-            axes = entry if isinstance(entry, tuple) else (entry,)
-            if any(a not in self.mesh.shape for a in axes):
-                out.append(None)  # axis absent from this mesh (e.g. no tp)
-                continue
-            size = math.prod(self.mesh.shape[a] for a in axes)
-            out.append(entry if arr_shape[i] % size == 0 else None)
-        return P(*out)
+        return sanitize_tp_spec(self.mesh, arr_shape, tp_spec)
 
     def _zero_spec(self, arr_shape: Tuple[int, ...], tp_spec: Optional[P], threshold: int) -> P:
         """Shard over the ZeRO axes, avoiding dims already taken by TP."""

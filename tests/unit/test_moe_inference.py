@@ -206,3 +206,27 @@ class TestMoEGuards:
         assert model.mesh is None          # caller's object untouched
         assert eng.module is not model     # engine serves a bound copy
         assert eng.module.mesh is eng.mesh
+
+
+class TestMoEGuards2:
+
+    def test_prequantized_moe_params_raise_clearly(self):
+        from deepspeed_tpu.ops.quant import quantize_params
+        model = _moe_model()
+        params = quantize_params(model.init_params(jax.random.key(12)), groups=8)
+        with pytest.raises(NotImplementedError, match="int8"):
+            deepspeed_tpu.init_inference(model, params=params,
+                                         config={"dtype": "bf16"})
+
+    def test_mixed_dense_moe_stacking_raises(self):
+        from deepspeed_tpu.module_inject.megatron import map_megatron_params
+        cfg = TransformerConfig(vocab_size=96, n_layer=2, n_head=4, d_model=32,
+                                max_seq=16, attn_bias=True, remat=False)
+        model = MoECausalLM(cfg, MoEConfig(num_experts=2, expert_ff_mult=2))
+        params = model.init_params(jax.random.key(13))
+        sd = TestMegatronMoEIngestion()._fake_sd(model, params)
+        # layer 1 loses its experts -> alternating dense/MoE layout
+        sd = {k: v for k, v in sd.items()
+              if not ("layers.1.mlp.deepspeed_moe.experts" in k)}
+        with pytest.raises(NotImplementedError, match="mixed dense/MoE"):
+            map_megatron_params(sd, cfg, version=0)
