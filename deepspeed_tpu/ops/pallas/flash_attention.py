@@ -577,11 +577,17 @@ def _build(causal: bool, scale: float, bq: int, bk: int, seq_len: int, interpret
         o, lse = fwd_call(q, k, v, mask, slopes, *extra)
         return o, (q, k, v, mask, slopes, extra, o, lse)
 
-    def flash_bwd(res, g):
+    def bwd_impl(res, g, glse):
+        """Shared backward: ``glse`` (cotangent of the log2-domain lse
+        [B, H, 1, Sp], or None) folds into delta — d s_k gains
+        p_k * d lse_nat and lse2 = log2(e) * lse_nat, so
+        delta' = delta - log2(e) * glse reuses the dq/dkv kernels unchanged."""
         q, k, v, mask, slopes, extra, o, lse = res
         B, H, Sp, Hd = q.shape
         nq, nk = Sp // bq, Sp // bk
         delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[:, :, None, :]
+        if glse is not None:
+            delta = delta - _LOG2E * glse.astype(jnp.float32)
 
         dq_kernel = functools.partial(_dq_kernel, **statics)
         dq = pl.pallas_call(
@@ -635,14 +641,34 @@ def _build(causal: bool, scale: float, bq: int, bk: int, seq_len: int, interpret
         return (dq, dk, dv, jnp.zeros_like(mask), jnp.zeros_like(slopes),
                 *(jnp.zeros_like(l) for l in extra))
 
+    def flash_bwd(res, g):
+        return bwd_impl(res, g, None)
+
     flash.defvjp(flash_fwd, flash_bwd)
-    return flash
+
+    # (o, lse) variant for callers that combine partial attentions across
+    # blocks (ring attention): lse is the raw log2-domain [B, H, 1, Sp]
+    # kernel output; its cotangent rides the same backward kernels
+    @jax.custom_vjp
+    def flash_lse(q, k, v, mask, slopes, *extra):
+        return fwd_call(q, k, v, mask, slopes, *extra)
+
+    def flash_lse_fwd(q, k, v, mask, slopes, *extra):
+        o, lse = fwd_call(q, k, v, mask, slopes, *extra)
+        return (o, lse), (q, k, v, mask, slopes, extra, o, lse)
+
+    def flash_lse_bwd(res, cot):
+        g, glse = cot
+        return bwd_impl(res, g, glse)
+
+    flash_lse.defvjp(flash_lse_fwd, flash_lse_bwd)
+    return flash, flash_lse
 
 
 def flash_attention(q, k, v, mask_bias=None, causal: bool = True, alibi_slopes=None,
                     scale: Optional[float] = None, block_q: Optional[int] = None,
                     block_k: Optional[int] = None, block_layout=None,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None, return_lse: bool = False):
     """Flash attention on [B, S, H, Hd] q/k/v (same contract as
     :func:`deepspeed_tpu.ops.attention.mha_attention`; mask_bias is the
     additive key-side [B, S] bias). Pads S up to the block size internally.
@@ -656,6 +682,11 @@ def flash_attention(q, k, v, mask_bias=None, causal: bool = True, alibi_slopes=N
     query head h attends kv head ``h // (H // KV)`` (``jnp.repeat`` order)
     via BlockSpec index maps — no repeated kv copy in HBM or VMEM, and
     dk/dv come back at [B, S, KV, Hd] (summed over the group in-kernel).
+
+    ``return_lse=True`` returns ``(out, lse)`` with lse the **log2-domain**
+    logsumexp [B, H, S] (fully-masked rows carry +1e30); both outputs are
+    differentiable — ring attention combines partial blocks through it.
+    Uses the general kernel (no packed-heads fast path).
     """
     B, S, H, Hd = q.shape
     KV = k.shape[2]
@@ -711,8 +742,8 @@ def flash_attention(q, k, v, mask_bias=None, causal: bool = True, alibi_slopes=N
     # no transposes, no lane padding, P× fewer programs. MHA only: GQA's
     # shared kv heads break the per-head lane-group pairing, and GQA models
     # are Hd=128-class anyway (general kernel, zero lane padding)
-    if (plain and kv_group == 1 and Hd < 128 and 128 % Hd == 0
-            and H % (128 // Hd) == 0):
+    if (plain and kv_group == 1 and not return_lse and Hd < 128
+            and 128 % Hd == 0 and H % (128 // Hd) == 0):
         P128 = 128 // Hd
         fn = _build_packed(causal, scale, bq, bk, interpret, P128, Hd)
         tri = _make_tri(bq, bk)
@@ -751,8 +782,12 @@ def flash_attention(q, k, v, mask_bias=None, causal: bool = True, alibi_slopes=N
         layout = jnp.repeat(jnp.repeat(layout, 8, axis=1), 128, axis=2)
         extra = extra + (layout,)
 
-    fn = _build(causal, scale, bq, bk, S, interpret, block_layout is not None,
-                plain, kv_group)
+    fn, fn_lse = _build(causal, scale, bq, bk, S, interpret, block_layout is not None,
+                        plain, kv_group)
+    if return_lse:
+        out, lse = fn_lse(qt, kt, vt, mask, slopes, *extra)
+        return (jnp.transpose(out[:, :, :S, :], (0, 2, 1, 3)),
+                lse[:, :, 0, :S])
     out = fn(qt, kt, vt, mask, slopes, *extra)
     return jnp.transpose(out[:, :, :S, :], (0, 2, 1, 3))
 

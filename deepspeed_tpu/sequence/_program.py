@@ -16,9 +16,14 @@ from jax.sharding import PartitionSpec as P
 
 @functools.lru_cache(maxsize=128)
 def _cached_program(local_fn: Callable, mesh, axis: str, causal: bool, has_mask: bool,
-                    has_alibi: bool, scale: Optional[float]):
+                    has_alibi: bool, scale: Optional[float], knobs: tuple = ()):
     """Build + jit the shard_map program once per (body, mesh, static-arg)
-    combo so eager callers hit the jit cache instead of recompiling."""
+    combo so eager callers hit the jit cache instead of recompiling.
+    ``knobs`` carries the caller's module-level tuning globals (chunk sizes,
+    kernel toggles) purely as cache-key salt: the body reads the globals at
+    trace time, so keying on their current values makes mutating a knob
+    after first compile take effect instead of silently hitting a stale
+    program."""
     qkv_spec = P(None, axis, None, None)
     in_specs = [qkv_spec, qkv_spec, qkv_spec]
     if has_mask:
@@ -41,14 +46,24 @@ def _cached_program(local_fn: Callable, mesh, axis: str, causal: bool, has_mask:
 
 
 def run_sp_program(local_fn: Callable, q, k, v, *, mesh, axis: str, causal: bool,
-                   mask_bias, alibi_slopes, scale: Optional[float]):
+                   mask_bias, alibi_slopes, scale: Optional[float], knobs: tuple = ()):
     """Dispatch q/k/v (+ optional mask/slopes) through the cached shard_map
-    program built around ``local_fn``."""
+    program built around ``local_fn``. ``knobs``: the caller's current
+    tuning-global values (cache-key salt, see _cached_program)."""
     args = [q, k, v]
     if mask_bias is not None:
         args.append(mask_bias)
     if alibi_slopes is not None:
         args.append(jnp.asarray(alibi_slopes))
     fn = _cached_program(local_fn, mesh, axis, causal, mask_bias is not None,
-                         alibi_slopes is not None, scale)
+                         alibi_slopes is not None, scale, knobs)
     return fn(*args)
+
+
+def resolve_use_flash(override) -> bool:
+    """Shared auto-detection for the SP bodies' Pallas-kernel toggles
+    (ring.RING_USE_FLASH / ulysses.ULYSSES_USE_FLASH): explicit override
+    wins, else kernel on TPU, XLA streaming core elsewhere."""
+    if override is not None:
+        return bool(override)
+    return jax.default_backend() == "tpu"

@@ -23,13 +23,28 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from deepspeed_tpu.sequence._program import run_sp_program
 from deepspeed_tpu.sequence._streaming import chunked_attention
 
-# per-ring-step key-chunk size inside the shared streaming core.
-# Import-time knob: the compiled sp programs are cached WITHOUT this in the
-# key, so set it before the first ring_attention call of the process.
+# per-ring-step key-chunk size inside the shared streaming core. Mutable
+# module knob; the compiled sp program is keyed on its current value.
 RING_KEY_CHUNK = 1024
+
+# ring-flash: run the Pallas flash kernel on the shard-local blocks inside
+# the sp shard_map body (the kernel itself is not shard_mappable from the
+# model dispatch, but a pallas_call composes fine INSIDE a shard body).
+# None = auto (TPU: kernel; elsewhere: XLA streaming core). Tests force True
+# (interpret mode). Keyed into the compiled-program cache like RING_KEY_CHUNK.
+RING_USE_FLASH = None
+
+_LN2 = float(np.log(2.0))
+
+
+def _use_flash() -> bool:
+    from deepspeed_tpu.sequence._program import resolve_use_flash
+    return resolve_use_flash(RING_USE_FLASH)
 
 
 def ring_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=None,
@@ -47,9 +62,34 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=N
 
     perm = [(i, (i + 1) % sp) for i in range(sp)]
     qpos0 = (my_block * Sq).astype(jnp.int32)
+    use_flash = _use_flash()
 
-    def block_attn(kb, vb, maskb, s):
+    def flash_block(kb, vb, maskb, kpos0, diag):
+        """One ring step through the Pallas kernel. Sq == Sk and offsets are
+        block-aligned, so a step is either the causal diagonal (diag), fully
+        visible, or fully masked (gated by the caller via lse) — never a
+        partial triangle, which is why the kernel's LOCAL causal mask
+        suffices. Alibi's global-position term slope*(kpos0-qpos0) is
+        constant within the block: softmax-invariant for o, a per-head lse
+        shift applied after."""
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        o, lse2 = flash_attention(q, kb, vb, mask_bias=maskb,
+                                  causal=bool(diag) and causal,
+                                  alibi_slopes=alibi_slopes, scale=scale,
+                                  return_lse=True)
+        # log2 → natural; the kernel's +1e30 empty-row marker becomes -1e30
+        # so an empty block contributes zero weight to the combine
+        lse = jnp.where(lse2 > 1e29, jnp.float32(-1e30), lse2 * _LN2)
+        if alibi_slopes is not None:
+            shift = (jnp.asarray(alibi_slopes, jnp.float32)
+                     * (kpos0 - qpos0).astype(jnp.float32))
+            lse = jnp.where(lse > -1e29, lse + shift[None, :, None], lse)
+        return o, lse
+
+    def block_attn(kb, vb, maskb, s, diag):
         kpos0 = (((my_block - s) % sp) * Sk).astype(jnp.int32)
+        if use_flash:
+            return flash_block(kb, vb, maskb, kpos0, diag)
         return chunked_attention(q, kb, vb, maskb, alibi_slopes, qpos0, kpos0,
                                  causal, RING_KEY_CHUNK, jnp.float32, scale)
 
@@ -63,10 +103,10 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=N
         L_new = L * a + b
         return M_new, L_new, O_new
 
-    o0, lse0 = block_attn(k, v, mask_bias, jnp.int32(0))
+    o0, lse0 = block_attn(k, v, mask_bias, jnp.int32(0), True)
     M = lse0
     L = jnp.ones_like(lse0)
-    O = jnp.transpose(o0, (0, 2, 1, 3))  # [B, H, Sq, Hd]
+    O = jnp.transpose(o0.astype(jnp.float32), (0, 2, 1, 3))  # [B, H, Sq, Hd]
 
     def step(carry, s):
         kb, vb, maskb, M, L, O = carry
@@ -74,7 +114,16 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=N
         vb = jax.lax.ppermute(vb, axis, perm)
         if maskb is not None:
             maskb = jax.lax.ppermute(maskb, axis, perm)
-        o_s, lse_s = block_attn(kb, vb, maskb, s)
+        o_s, lse_s = block_attn(kb, vb, maskb, s, False)
+        if use_flash and causal:
+            # the kernel computed the block dense (off-diagonal steps are
+            # all-or-nothing); gate invisible blocks out via lse = -inf so
+            # their combine weight exp(lse_s - M) is exactly 0 EVEN when the
+            # running max M is itself the -1e30 empty-row marker (a -1e30
+            # sentinel here would give exp(0)=1 and leak future keys into
+            # fully-masked-prefix rows)
+            visible = ((my_block - s) % sp) < my_block
+            lse_s = jnp.where(visible, lse_s, -jnp.inf)
         M, L, O = combine(M, L, O, o_s, lse_s)
         return (kb, vb, maskb, M, L, O), None
 
@@ -91,4 +140,5 @@ def ring_attention(q, k, v, *, mesh, axis: str = "sp", causal: bool = True, mask
     other dims (batch→dp, heads→tp) stay auto-sharded."""
     return run_sp_program(ring_attention_local, q, k, v, mesh=mesh, axis=axis,
                           causal=causal, mask_bias=mask_bias,
-                          alibi_slopes=alibi_slopes, scale=scale)
+                          alibi_slopes=alibi_slopes, scale=scale,
+                          knobs=(RING_KEY_CHUNK, _use_flash()))

@@ -29,8 +29,18 @@ from deepspeed_tpu.sequence._streaming import chunked_attention
 
 # key-chunk size for the head-sharded local attention: above this the local
 # softmax streams over key chunks (bounds logits to O(S·chunk) instead of
-# S²). Import-time knob — the compiled sp programs cache without it.
+# S²). Mutable module knob; the compiled sp program is keyed on its value.
 ULYSSES_KEY_CHUNK = 2048
+
+# run the Pallas flash kernel for the head-sharded local attention (after
+# the all-to-all each chip holds the FULL sequence for H/sp heads — plain
+# kernel territory). None = auto (TPU only). Cache-keyed like the chunk knob.
+ULYSSES_USE_FLASH = None
+
+
+def _use_flash() -> bool:
+    from deepspeed_tpu.sequence._program import resolve_use_flash
+    return resolve_use_flash(ULYSSES_USE_FLASH)
 
 
 def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=None,
@@ -69,7 +79,14 @@ def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bia
         slopes = jax.lax.dynamic_slice_in_dim(alibi_slopes, my * h_loc, h_loc)
 
     S, Hd = qh.shape[1], qh.shape[3]
-    if S > ULYSSES_KEY_CHUNK:
+    if _use_flash():
+        # Pallas flash on the full-sequence local attention: O(S·Hd) HBM
+        # like the streaming core, kernel-grade VPU/MXU utilisation, GQA kv
+        # native (unrepeated)
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        out = flash_attention(qh, kh, vh, mask_bias=mask_bias, causal=causal,
+                              alibi_slopes=slopes, scale=scale)
+    elif S > ULYSSES_KEY_CHUNK:
         # long context: dense attention would materialize an S×S logits
         # block — stream through the shared core (unrepeated GQA kv goes in
         # directly; the core broadcasts per chunk)
@@ -95,4 +112,5 @@ def ulysses_attention(q, k, v, *, mesh, axis: str = "sp", causal: bool = True, m
     head dims stay auto-sharded (dp/tp compose via partial-auto)."""
     return run_sp_program(ulysses_attention_local, q, k, v, mesh=mesh, axis=axis,
                           causal=causal, mask_bias=mask_bias,
-                          alibi_slopes=alibi_slopes, scale=scale)
+                          alibi_slopes=alibi_slopes, scale=scale,
+                          knobs=(ULYSSES_KEY_CHUNK, _use_flash()))
