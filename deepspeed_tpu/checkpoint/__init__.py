@@ -1,6 +1,10 @@
 """Checkpoint conversion & interop (reference ``deepspeed/checkpoint/`` +
 ``utils/zero_to_fp32.py`` + ``runtime/state_dict_factory.py``)."""
 
+from deepspeed_tpu.checkpoint.reshape_pipeline import (layers_to_stages,
+                                                       reshape_pipeline_checkpoint,
+                                                       reshape_stages_tree,
+                                                       stages_to_layers)
 from deepspeed_tpu.checkpoint.reshape_utils import (merge_qkv_shards, merge_tp_shards,
                                                     partition_data, split_qkv_shards,
                                                     split_tp_shards)
@@ -15,6 +19,8 @@ from deepspeed_tpu.checkpoint.zero_to_fp32 import (convert_zero_checkpoint_to_fp
 __all__ = [
     "merge_tp_shards", "split_tp_shards", "merge_qkv_shards", "split_qkv_shards",
     "partition_data", "SDLoaderFactory", "MegatronSDLoader", "load_state_dict_file",
+    "reshape_pipeline_checkpoint", "reshape_stages_tree", "stages_to_layers",
+    "layers_to_stages",
     "ds_to_universal", "load_universal_state_dict", "load_universal_into_params",
     "convert_zero_checkpoint_to_fp32_state_dict", "get_fp32_state_dict_from_zero_checkpoint",
 ]

@@ -1,0 +1,126 @@
+"""Offline pipeline-degree reshaping of engine checkpoints.
+
+Reference parity: ``deepspeed/checkpoint/reshape_meg_2d.py:1-219`` +
+``deepspeed_checkpoint.py:30`` — reshape a saved checkpoint across tp x pp
+degrees without running the model. In the TPU engine the tp degree never
+enters the saved layout (orbax stores full logical arrays; the mesh
+reshards natively on load), so "2D reshape" reduces to re-stacking the
+pipeline stage axis: every ``stages`` leaf ``[S, layers_per_stage, ...]``
+re-stacks to ``[S', n_layer/S', ...]`` — applied consistently to params,
+fp32 masters, accumulated grads, and the labelled optimizer moments.
+
+For topology-independent interop prefer ``ds_to_universal`` (it
+canonicalizes the stage axis away entirely); this tool is the direct
+tag -> tag equivalent of the reference's offline reshaper.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def stages_to_layers(tree: Any):
+    """Stage-stacked subtree [S, Lps, ...] -> flat layer-stacked [L, ...]."""
+    import jax
+    return jax.tree.map(lambda a: np.asarray(a).reshape((-1,) + a.shape[2:]), tree)
+
+
+def layers_to_stages(tree: Any, num_stages: int):
+    """Flat layer-stacked subtree [L, ...] -> [num_stages, L/num_stages, ...]."""
+    import jax
+
+    def one(a):
+        a = np.asarray(a)
+        if a.shape[0] % num_stages:
+            raise ValueError(f"n_layer {a.shape[0]} not divisible by "
+                             f"target pp degree {num_stages}")
+        return a.reshape((num_stages, a.shape[0] // num_stages) + a.shape[1:])
+
+    return jax.tree.map(one, tree)
+
+
+def _reshape_leaf(a: np.ndarray, target_pp: int) -> np.ndarray:
+    a = np.asarray(a)
+    L = a.shape[0] * a.shape[1]
+    if L % target_pp:
+        raise ValueError(f"n_layer {L} not divisible by target pp {target_pp}")
+    return a.reshape((target_pp, L // target_pp) + a.shape[2:])
+
+
+def reshape_stages_tree(stages: Any, target_pp: int):
+    """[S, Lps, ...] stage leaves re-stacked to the target pp degree."""
+    import jax
+    return jax.tree.map(lambda a: _reshape_leaf(a, target_pp), stages)
+
+
+def reshape_pipeline_checkpoint(src_dir: str, dst_dir: str, target_pp: int,
+                                tag: Optional[str] = None) -> str:
+    """Rewrite the checkpoint at ``src_dir[/tag]`` with its pipeline stage
+    axis re-stacked to ``target_pp``; returns the destination tag dir. The
+    destination can be loaded by an engine running pp=target_pp (any tp/dp)."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    from deepspeed_tpu.checkpoint.zero_to_fp32 import _resolve_tag
+
+    src_dir = os.path.abspath(src_dir)
+    tag = _resolve_tag(src_dir, tag)
+    src_state = os.path.join(src_dir, tag, "state")
+
+    # per-process offload sidecars (host optimizer state) are dp-sharded and
+    # topology-bound: refuse BEFORE the (potentially multi-GB) restore
+    side = [p for p in os.listdir(os.path.join(src_dir, tag))
+            if p.startswith("offload_state_p")]
+    if side:
+        raise ValueError("checkpoint has ZeRO-Offload host-state sidecars; "
+                         "offload state is dp-rank-sharded and cannot be "
+                         "reshaped offline — resume at the original topology "
+                         "or convert via ds_to_universal")
+
+    with ocp.StandardCheckpointer() as ckptr:
+        tree = ckptr.restore(src_state)
+
+    if "stages" not in tree.get("params", {}):
+        raise ValueError(f"checkpoint {src_dir}/{tag} has no pipeline 'stages' "
+                         "subtree; nothing to reshape")
+
+    for section in ("params", "master", "acc_grads"):
+        sub = tree.get(section)
+        if isinstance(sub, dict) and "stages" in sub:
+            sub["stages"] = reshape_stages_tree(sub["stages"], target_pp)
+
+    # labelled optimizer moments: reshape every flat leaf whose param path
+    # points into the stages subtree
+    meta_path = os.path.join(src_dir, tag, "meta.json")
+    meta: Dict[str, Any] = {}
+    if os.path.isfile(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    labels = meta.get("opt_state_labels")
+    opt_flat = tree.get("opt_state_flat")
+    if opt_flat is not None:
+        if labels is None:
+            raise ValueError(
+                "checkpoint carries optimizer state without opt_state_labels; "
+                "re-save with a current engine (or drop the optimizer state) "
+                "before reshaping")
+        for i, lab in enumerate(labels):
+            pname = lab.get("param") or ""
+            if pname.startswith("stages."):
+                key = f"leaf_{i}"
+                opt_flat[key] = _reshape_leaf(opt_flat[key], target_pp)
+
+    dst_dir = os.path.abspath(dst_dir)
+    os.makedirs(os.path.join(dst_dir, tag), exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.join(dst_dir, tag, "state"), tree, force=True)
+    meta["reshaped_to_pp"] = int(target_pp)
+    with open(os.path.join(dst_dir, tag, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2, default=str)
+    with open(os.path.join(dst_dir, "latest"), "w") as f:
+        f.write(tag)
+    return os.path.join(dst_dir, tag)

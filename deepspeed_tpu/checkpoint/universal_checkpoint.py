@@ -88,12 +88,35 @@ def ds_to_universal(checkpoint_dir: str, out_dir: str, tag: Optional[str] = None
             for (pname, _), arr in zip(param_items, run):
                 moments[pname][name] = arr.astype(np.float32)
 
+    # canonicalize pipeline topology out of the layout (reference
+    # reshape_meg_2d.py / deepspeed_checkpoint.py:30 reshape across tp x pp
+    # degrees): "stages.*" leaves [num_stages, layers_per_stage, ...] are
+    # stored as "layers.*" [n_layer, ...], so one universal checkpoint loads
+    # at ANY pp degree (tp degree never enters: arrays are full logical)
+    def canon(pname, arr):
+        if pname.startswith("stages."):
+            S, Lps = arr.shape[0], arr.shape[1]
+            return "layers." + pname[len("stages."):], \
+                arr.reshape((S * Lps,) + arr.shape[2:])
+        if pname.startswith("head."):
+            # the pipeline model nests ln_f/lm_head under "head."; the plain
+            # model keeps them top-level — canonical form is top-level
+            return pname[len("head."):], arr
+        return pname, arr
+
     params_dir = os.path.join(out_dir, "params")
+    if os.path.isdir(params_dir) and os.listdir(params_dir):
+        # canonicalization renames entries (stages.* -> layers.*, head.* ->
+        # top-level): stale files from a previous export would silently
+        # shadow fresh weights on load — start clean
+        import shutil
+        shutil.rmtree(params_dir)
     os.makedirs(params_dir, exist_ok=True)
     for pname, arr in fp32.items():
-        payload = {"param": arr}
-        payload.update(moments.get(pname, {}))
-        np.savez(os.path.join(params_dir, f"{pname}.npz"), **payload)
+        cname, carr = canon(pname, arr)
+        payload = {"param": carr}
+        payload.update({k: canon(pname, v)[1] for k, v in moments.get(pname, {}).items()})
+        np.savez(os.path.join(params_dir, f"{cname}.npz"), **payload)
 
     meta_src = os.path.join(checkpoint_dir, tag, "meta.json")
     meta: Dict[str, Any] = {"source_tag": tag, "format": "universal", "version": 1}
@@ -121,7 +144,14 @@ def load_universal_state_dict(universal_dir: str) -> Dict[str, Dict[str, np.ndar
 def load_universal_into_params(universal_dir: str, params: Any, dtype=None) -> Any:
     """Map a universal checkpoint onto an existing (possibly sharded) param
     pytree: each leaf is replaced by the stored fp32 weight cast to the
-    leaf's dtype and placed with the leaf's sharding."""
+    leaf's dtype and placed with the leaf's sharding.
+
+    Pipeline topology adapts on load: a target "stages.*" leaf
+    [num_stages, layers_per_stage, ...] pulls the canonical "layers.*"
+    entry and re-stacks it, so a checkpoint saved at tp=2 x pp=2 loads at
+    pp=4, pp=1, or any tp (reference reshape_meg_2d capability). Universal
+    dirs written before canonicalization (carrying "stages.*" entries)
+    still load when the stage split matches or the target is "layers.*"."""
     import jax
     import jax.numpy as jnp
 
@@ -129,14 +159,52 @@ def load_universal_into_params(universal_dir: str, params: Any, dtype=None) -> A
 
     from deepspeed_tpu.utils.pytree import leaf_key
 
+    def lookup(dotted, leaf_shape):
+        """Resolve stages<->layers and head-nesting naming + leading-dim
+        re-stacking against the target leaf shape."""
+        ent = sd.get(dotted)
+        if ent is None:
+            # pipeline "head.X" <-> canonical top-level "X"
+            alias = dotted[len("head."):] if dotted.startswith("head.") \
+                else "head." + dotted
+            ent = sd.get(alias)
+        if ent is not None and ent["param"].shape == leaf_shape:
+            return ent["param"]
+        if dotted.startswith("stages."):
+            # target is pipelined [S, Lps, ...]: pull the canonical flat
+            # "layers." entry (or flatten an old-format "stages." entry)
+            tail = dotted[len("stages."):]
+            src = sd.get("layers." + tail)
+            if src is not None:
+                flat = src["param"]
+            elif ent is not None:
+                flat = ent["param"].reshape((-1,) + ent["param"].shape[2:])
+            else:
+                raise KeyError(f"universal checkpoint missing parameter {dotted}")
+            S, Lps = leaf_shape[0], leaf_shape[1]
+            if flat.shape != (S * Lps,) + tuple(leaf_shape[2:]):
+                raise ValueError(f"cannot re-stack {dotted}: ckpt layers "
+                                 f"{flat.shape} vs target {leaf_shape}")
+            return flat.reshape((S, Lps) + flat.shape[1:])
+        if dotted.startswith("layers.") and ent is None:
+            # target is non-pipelined: flatten an old-format "stages." entry
+            src = sd.get("stages." + dotted[len("layers."):])
+            if src is None:
+                raise KeyError(f"universal checkpoint missing parameter {dotted}")
+            flat = src["param"].reshape((-1,) + src["param"].shape[2:])
+            if flat.shape != leaf_shape:
+                raise ValueError(f"cannot flatten stages for {dotted}: ckpt "
+                                 f"{src['param'].shape} vs target {leaf_shape}")
+            return flat
+        if ent is not None:
+            raise ValueError(f"shape mismatch for {dotted}: ckpt "
+                             f"{ent['param'].shape} vs model {leaf_shape}")
+        raise KeyError(f"universal checkpoint missing parameter {dotted}")
+
     def replace(path_tuple, leaf):
         dotted = leaf_key(path_tuple)
-        if dotted not in sd:
-            raise KeyError(f"universal checkpoint missing parameter {dotted}")
+        arr = lookup(dotted, tuple(leaf.shape))
         out_dtype = dtype or leaf.dtype
-        arr = sd[dotted]["param"]
-        if arr.shape != leaf.shape:
-            raise ValueError(f"shape mismatch for {dotted}: ckpt {arr.shape} vs model {leaf.shape}")
         if hasattr(leaf, "sharding"):
             return jax.device_put(jnp.asarray(arr, dtype=out_dtype), leaf.sharding)
         return jnp.asarray(arr, dtype=out_dtype)

@@ -183,3 +183,120 @@ class TestSDLoader:
         r0 = loader.load(mp_world_size=2, mp_rank=0,
                          merge_strategies={"query_key_value": (1, "qkv")})
         np.testing.assert_array_equal(r0["attn.query_key_value.weight"], rank_shards[0])
+
+
+class TestPipelineReshape:
+    """Offline tp x pp checkpoint reshaping (reference reshape_meg_2d.py /
+    deepspeed_checkpoint.py:30): save at tp=2 x pp=2, load at pp=4 (tp=1)
+    and pp=1 (tp=4) with identical evals; universal checkpoints canonicalize
+    the stage axis away entirely."""
+
+    def _pipe_engine(self, num_stages, mesh, params):
+        from deepspeed_tpu.models.pipeline import PipelinedCausalLM
+        cfg = TransformerConfig(vocab_size=64, n_layer=4, n_head=4, d_model=32,
+                                d_ff=64, max_seq=16, pos_embedding="learned",
+                                tie_embeddings=True, remat=False)
+        model = PipelinedCausalLM(cfg, num_stages=num_stages)
+        if params is None:
+            params = model.init_params(jax.random.key(0))
+        dist.set_mesh(None)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config={
+                "train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 1},
+                "mesh": mesh,
+                "steps_per_print": 0,
+            })
+        return engine, model
+
+    def test_pp2_tp2_to_pp4_and_pp1(self, tmp_path, devices):
+        from deepspeed_tpu.checkpoint import (reshape_pipeline_checkpoint,
+                                              stages_to_layers)
+
+        rng = np.random.default_rng(0)
+        dp = 2
+        batch = {"input_ids": rng.integers(0, 64, (2 * 1 * dp, 16)).astype(np.int32)}
+        evalb = {"input_ids": rng.integers(0, 64, (4, 16)).astype(np.int32)}
+
+        src_engine, _ = self._pipe_engine(2, {"pp": 2, "tp": 2, "dp": 2}, None)
+        src_engine.train_batch(batch)
+        ref_eval = float(src_engine.eval_batch(evalb))
+        src_engine.save_checkpoint(str(tmp_path / "src"), tag="step1")
+
+        # ---- pp=4 (tp=1) ----
+        dst4 = reshape_pipeline_checkpoint(str(tmp_path / "src"),
+                                           str(tmp_path / "pp4"), target_pp=4)
+        assert os.path.isdir(dst4)
+        eng4, _ = self._pipe_engine(4, {"pp": 4, "dp": 2}, None)
+        eng4.load_checkpoint(str(tmp_path / "pp4"))
+        np.testing.assert_allclose(float(eng4.eval_batch(evalb)), ref_eval,
+                                   rtol=2e-5, atol=2e-5)
+        # optimizer moments re-stacked, not lost: same flattened values
+        src_stage_leaves = jax.tree.leaves(stages_to_layers(
+            jax.tree.map(np.asarray, src_engine.state.params["stages"])))
+        dst_stage_leaves = jax.tree.leaves(stages_to_layers(
+            jax.tree.map(np.asarray, eng4.state.params["stages"])))
+        for a, b in zip(src_stage_leaves, dst_stage_leaves):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+        # ---- pp=1 (tp=4) ----
+        dst1 = reshape_pipeline_checkpoint(str(tmp_path / "src"),
+                                           str(tmp_path / "pp1"), target_pp=1)
+        eng1, _ = self._pipe_engine(1, {"tp": 4, "dp": 2}, None)
+        eng1.load_checkpoint(str(tmp_path / "pp1"))
+        np.testing.assert_allclose(float(eng1.eval_batch(evalb)), ref_eval,
+                                   rtol=2e-5, atol=2e-5)
+        dist.set_mesh(None)
+
+    def test_universal_canonicalizes_stages(self, tmp_path, devices):
+        """ds_to_universal stores flat layers; loads into BOTH a plain
+        CausalLM and a differently-staged pipeline model."""
+        from deepspeed_tpu.models.pipeline import PipelinedCausalLM
+
+        rng = np.random.default_rng(1)
+        batch = {"input_ids": rng.integers(0, 64, (2 * 1 * 4, 16)).astype(np.int32)}
+        evalb = {"input_ids": rng.integers(0, 64, (4, 16)).astype(np.int32)}
+        src_engine, src_model = self._pipe_engine(2, {"pp": 2, "dp": 4}, None)
+        src_engine.train_batch(batch)
+        ref_eval = float(src_engine.eval_batch(evalb))
+        src_engine.save_checkpoint(str(tmp_path / "src"), tag="s1")
+        ds_to_universal(str(tmp_path / "src"), str(tmp_path / "uni"))
+
+        sd = load_universal_state_dict(str(tmp_path / "uni"))
+        assert any(k.startswith("layers.") for k in sd)
+        assert not any(k.startswith("stages.") for k in sd)
+
+        # plain (non-pipelined) model: layers.* paths, [L, ...] leaves
+        cfg = src_model.config
+        plain = CausalLM(cfg)
+        pp = load_universal_into_params(str(tmp_path / "uni"),
+                                        plain.init_params(jax.random.key(9)))
+        np.testing.assert_allclose(float(plain.loss(pp, evalb)), ref_eval,
+                                   rtol=2e-5, atol=2e-5)
+
+        # pipeline model at a different stage count
+        pipe4 = PipelinedCausalLM(cfg, num_stages=4)
+        p4 = load_universal_into_params(str(tmp_path / "uni"),
+                                        pipe4.init_params(jax.random.key(10)))
+        np.testing.assert_allclose(float(pipe4.loss(p4, evalb)), ref_eval,
+                                   rtol=2e-5, atol=2e-5)
+        dist.set_mesh(None)
+
+    def test_reshape_guards(self, tmp_path, devices, saved_checkpoint):
+        from deepspeed_tpu.checkpoint import reshape_pipeline_checkpoint
+        ckpt_dir, _, _ = saved_checkpoint
+        # non-pipeline checkpoint: loud reject
+        with pytest.raises(ValueError, match="stages"):
+            reshape_pipeline_checkpoint(str(ckpt_dir), str(tmp_path / "x"),
+                                        target_pp=2)
+
+    def test_indivisible_pp_raises(self, tmp_path, devices):
+        from deepspeed_tpu.checkpoint import reshape_pipeline_checkpoint
+        eng, _ = self._pipe_engine(2, {"pp": 2, "dp": 4}, None)
+        eng.save_checkpoint(str(tmp_path / "src"), tag="s1")
+        with pytest.raises(ValueError, match="divisible"):
+            reshape_pipeline_checkpoint(str(tmp_path / "src"),
+                                        str(tmp_path / "bad"), target_pp=3)
+        dist.set_mesh(None)
