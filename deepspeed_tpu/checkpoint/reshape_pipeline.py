@@ -88,6 +88,11 @@ def reshape_pipeline_checkpoint(src_dir: str, dst_dir: str, target_pp: int,
         raise ValueError(f"checkpoint {src_dir}/{tag} has no pipeline 'stages' "
                          "subtree; nothing to reshape")
 
+    # original stage-stacked leaf shapes, recorded BEFORE reshaping: used to
+    # refuse unattributable per-param optimizer state below
+    stage_shapes = {tuple(np.asarray(a).shape)
+                    for a in jax.tree.leaves(tree["params"]["stages"])}
+
     for section in ("params", "master", "acc_grads"):
         sub = tree.get(section)
         if isinstance(sub, dict) and "stages" in sub:
@@ -110,9 +115,22 @@ def reshape_pipeline_checkpoint(src_dir: str, dst_dir: str, target_pp: int,
                 "before reshaping")
         for i, lab in enumerate(labels):
             pname = lab.get("param") or ""
+            key = f"leaf_{i}"
             if pname.startswith("stages."):
-                key = f"leaf_{i}"
                 opt_flat[key] = _reshape_leaf(opt_flat[key], target_pp)
+            elif not pname and \
+                    tuple(np.asarray(opt_flat[key]).shape) in stage_shapes:
+                # a per-param leaf the labeller could not attribute (e.g. an
+                # SGD momentum 'trace' — only adam-family mu/nu carry param
+                # paths) that is stage-shaped: reshaping params around it
+                # would write an unloadable mixed-shape checkpoint
+                raise ValueError(
+                    f"optimizer leaf {lab.get('path', key)} is stage-shaped "
+                    "but not attributed to a parameter (non-adam-family "
+                    "state); cannot reshape this checkpoint's optimizer "
+                    "state — pass load_optimizer_states=False semantics by "
+                    "deleting opt_state_flat, or re-save with an adam-family "
+                    "optimizer")
 
     dst_dir = os.path.abspath(dst_dir)
     os.makedirs(os.path.join(dst_dir, tag), exist_ok=True)
