@@ -16,23 +16,36 @@ from deepspeed_tpu.config import constants as C
 from deepspeed_tpu.elasticity.config import (ElasticityConfig, ElasticityConfigError,
                                              ElasticityError, ElasticityIncompatibleWorldSize)
 
+# The 38 smallest highly composite numbers — enough to scale candidate
+# batch sizes up to ~720K (the reference plans over the same constant set)
+HCN_LIST = [
+    1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260, 1680,
+    2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720, 45360, 50400, 55440,
+    83160, 110880, 166320, 221760, 277200, 332640, 498960, 554400, 665280,
+    720720,
+]
+
+
 def get_candidate_batch_sizes(base_list: List[int], max_acceptable_batch_size: int) -> List[int]:
-    """All unique batch sizes base * 2^n ≤ max (reference ``:25``)."""
+    """One candidate per base: the base scaled by the largest highly
+    composite number that keeps it ≤ max (reference ``:25-37``) — HCN
+    scaling maximizes the divisor count, hence the valid chip counts."""
     candidates = set()
     for base in base_list:
         if base >= max_acceptable_batch_size:
             candidates.add(base)
             continue
-        value = base
-        while value <= max_acceptable_batch_size:
-            candidates.add(value)
-            value *= 2
+        limit = max_acceptable_batch_size // base
+        hcn = max(h for h in HCN_LIST if h <= limit)
+        candidates.add(hcn * base)
     return sorted(candidates)
 
 
 def get_valid_gpus(batch_size: int, micro_batches: List[int],
                    min_valid_gpus: int, max_valid_gpus: int) -> List[int]:
-    """Chip counts g where batch = m * gas * g for some micro-batch m."""
+    """Chip counts g in range where batch = m * gas * g for some micro-batch
+    m — i.e. the divisors of batch/m (factor search in the reference,
+    ``:39-58``; identical set, enumerated by range here)."""
     valid = []
     for g in range(min_valid_gpus, max_valid_gpus + 1):
         if any(batch_size % (g * m) == 0 for m in micro_batches):
@@ -43,16 +56,16 @@ def get_valid_gpus(batch_size: int, micro_batches: List[int],
 def get_best_candidates(candidate_batch_sizes: List[int], micro_batches: List[int],
                         min_gpus: int, max_gpus: int, prefer_larger: bool):
     """The candidate with the most valid chip counts (ties → batch-size
-    preference), reference ``:40-80``."""
+    preference), reference ``:61-80``."""
     max_valid_gpus = 0
-    best_batch = None
+    best_batch = int(min(micro_batches))
     best_gpus = None
     for batch in candidate_batch_sizes:
         valid = get_valid_gpus(batch, micro_batches, min_gpus, max_gpus)
         if (len(valid) > max_valid_gpus
                 or (len(valid) == max_valid_gpus
-                    and ((prefer_larger and best_batch is not None and batch > best_batch)
-                         or (not prefer_larger and best_batch is not None and batch < best_batch)))):
+                    and ((prefer_larger and batch > best_batch)
+                         or (not prefer_larger and batch < best_batch)))):
             max_valid_gpus = len(valid)
             best_batch = batch
             best_gpus = valid
@@ -61,7 +74,17 @@ def get_best_candidates(candidate_batch_sizes: List[int], micro_batches: List[in
 
 def _get_compatible_gpus_v01(micro_batches: List[int], max_acceptable_batch_size: int,
                              min_gpus: int, max_gpus: int, prefer_larger: bool):
-    candidates = get_candidate_batch_sizes(micro_batches, max_acceptable_batch_size)
+    import math
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
+    if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
+        raise ElasticityConfigError(
+            f"All micro batches {micro_batches} must be <= "
+            f"max_acceptable_batch_size {max_acceptable_batch_size}")
+    # bases: each micro batch AND their lcm (reference ``:110-114``)
+    lcm = math.lcm(*micro_batches)
+    candidates = get_candidate_batch_sizes(list(micro_batches) + [lcm],
+                                           max_acceptable_batch_size)
     return get_best_candidates(candidates, micro_batches, min_gpus, max_gpus, prefer_larger)
 
 
@@ -84,8 +107,8 @@ def _get_compatible_gpus_v02(micro_batches: List[int], max_acceptable_batch_size
         min_gpus=max(1, min_gpus // num_gpus_per_node),
         max_gpus=max(1, max_gpus // num_gpus_per_node),
         prefer_larger=prefer_larger)
-    if per_node_batch is None:
-        return None, []
+    if not valid_nodes:
+        return per_node_batch, []
     final_batch = per_node_batch * dp_size_per_node
     valid_gpus = [n * num_gpus_per_node for n in (valid_nodes or [])]
     return final_batch, valid_gpus
@@ -118,7 +141,7 @@ def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
     else:
         raise ElasticityConfigError(f"Unknown elasticity version {elastic_config.version}")
 
-    if final_batch_size is None:
+    if final_batch_size is None or not valid_gpus:
         raise ElasticityError(
             f"No valid batch size found for micro batches {elastic_config.micro_batches} "
             f"within max batch {elastic_config.max_acceptable_batch_size}")

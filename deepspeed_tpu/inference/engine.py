@@ -233,6 +233,12 @@ class InferenceEngine:
                 swap_dir = tempfile.mkdtemp(dir=str(off.get("nvme_path")),
                                             prefix="zero_inference_")
                 self._swapper = AsyncTensorSwapper(swap_dir)
+                # swap files are engine-lifetime caches of a model-sized
+                # footprint: reclaim them on engine GC / interpreter exit
+                import shutil
+                import weakref
+                self._swap_cleanup = weakref.finalize(
+                    self, shutil.rmtree, swap_dir, True)
                 self._layer_meta = []
                 for i, lp in enumerate(self._host_layers):
                     leaves, treedef = jax.tree.flatten(lp)

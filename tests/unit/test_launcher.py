@@ -215,8 +215,10 @@ class TestElasticity:
     }
 
     def test_candidates(self):
+        # reference HCN scaling: every base lands on 24 (base * largest
+        # HCN <= 32/base), collapsing to one maximally-divisible candidate
         c = get_candidate_batch_sizes([2, 4, 6], 32)
-        assert c == [2, 4, 6, 8, 12, 16, 24, 32]
+        assert c == [24]
 
     def test_valid_gpus(self):
         assert get_valid_gpus(24, [2, 4, 6], 1, 12) == [1, 2, 3, 4, 6, 12]
@@ -238,3 +240,46 @@ class TestElasticity:
                               "max_train_batch_size": 4}}
         with pytest.raises(ElasticityIncompatibleWorldSize):
             compute_elastic_config(cfg, world_size=3)
+
+
+class TestElasticPlannerReferenceParity:
+    """Table-driven reproduction of the reference planner's outputs
+    (deepspeed/elasticity/elasticity.py:25-80 HCN candidate enumeration +
+    factor-based valid-GPU search; expected values from the reference's own
+    tests/unit/elasticity/test_elastic.py)."""
+
+    TEN_K = {"elasticity": {"enabled": True, "max_train_batch_size": 10000,
+                            "micro_batch_sizes": [8, 12, 16, 17],
+                            "min_gpus": 32, "max_gpus": 1500, "min_time": 20,
+                            "version": 0.1}}
+
+    def test_basic_10k(self):
+        batch, valid = compute_elastic_config(self.TEN_K)
+        assert batch == 9792
+        assert len(valid) == 23
+        for g in valid:
+            assert batch % g == 0
+            assert any((batch // g) % m == 0
+                       for m in self.TEN_K["elasticity"]["micro_batch_sizes"])
+
+    def test_world_size_micro_batch_selection(self):
+        _, micro, _ = compute_elastic_config(self.TEN_K, world_size=64)
+        assert micro == 17
+
+    def test_incompatible_world_size_128(self):
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            compute_elastic_config(self.TEN_K, world_size=128)
+
+    def test_proper_mbsz(self):
+        cfg = {"elasticity": {**self.TEN_K["elasticity"],
+                              "max_train_batch_size": 32,
+                              "micro_batch_sizes": [1, 2, 3, 7],
+                              "min_gpus": 1}}
+        _, micro, _ = compute_elastic_config(cfg, world_size=7)
+        assert micro == 3
+
+    def test_hcn_candidates(self):
+        # base 8 with max 10000: largest HCN <= 1250 is 840 -> 6720; etc.
+        assert get_candidate_batch_sizes([8], 10000) == [6720]
+        assert get_candidate_batch_sizes([8, 12, 16, 17], 10000) == \
+            sorted({840 * 8, 720 * 12, 360 * 16, 360 * 17})

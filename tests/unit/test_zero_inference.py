@@ -163,3 +163,20 @@ def test_params_in_config_dict_honored():
     got = np.asarray(eng.params["embed"]["tokens"], np.float32)
     want = np.asarray(p1["embed"]["tokens"], np.float32)
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_streaming_nvme_cleans_up_on_release(tmp_path):
+    import gc
+    import glob
+    model = _model()
+    params = model.init_params(jax.random.key(0))
+    eng = deepspeed_tpu.init_inference(
+        model, dtype="fp32", params=params,
+        zero={"stage": 3, "offload_param": {"device": "nvme",
+                                            "nvme_path": str(tmp_path)}})
+    assert glob.glob(str(tmp_path / "zero_inference_*"))
+    eng._swap_cleanup()          # what GC / interpreter exit runs
+    del eng
+    gc.collect()
+    assert not glob.glob(str(tmp_path / "zero_inference_*")), \
+        "swap dir leaked after engine release"
