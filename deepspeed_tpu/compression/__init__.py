@@ -3,7 +3,8 @@ structured/unstructured pruning, schedule-gated activation, and
 redundancy_clean for deployment."""
 
 from deepspeed_tpu.compression.compress import (CompressedModel, init_compression,
-                                                redundancy_clean)
+                                                redundancy_clean,
+                                                student_initialization)
 from deepspeed_tpu.compression.config import get_compression_config
 from deepspeed_tpu.compression.functional import (channel_mask, fake_quantize, head_mask,
                                                   prune, quantize_activation, row_mask,
@@ -11,7 +12,8 @@ from deepspeed_tpu.compression.functional import (channel_mask, fake_quantize, h
 from deepspeed_tpu.compression.scheduler import CompressionScheduler
 
 __all__ = [
-    "init_compression", "redundancy_clean", "CompressedModel", "CompressionScheduler",
+    "init_compression", "redundancy_clean", "student_initialization",
+    "CompressedModel", "CompressionScheduler",
     "get_compression_config", "fake_quantize", "quantize_activation", "prune",
     "sparse_mask", "row_mask", "channel_mask", "head_mask",
 ]

@@ -18,7 +18,8 @@ import jax
 
 from deepspeed_tpu.compression import functional as F
 from deepspeed_tpu.compression.config import (ACTIVATION_QUANTIZATION, CHANNEL_PRUNING,
-                                              DIFFERENT_GROUPS, HEAD_PRUNING, ROW_PRUNING,
+                                              DIFFERENT_GROUPS, HEAD_PRUNING,
+                                              LAYER_REDUCTION, ROW_PRUNING,
                                               SHARED_PARAMETERS, SPARSE_PRUNING,
                                               WEIGHT_QUANTIZATION, get_compression_config)
 from deepspeed_tpu.utils.logging import logger
@@ -42,13 +43,6 @@ class _GroupRule:
 
 def _collect_rules(compression_config: Dict) -> List[_GroupRule]:
     rules: List[_GroupRule] = []
-    act = compression_config.get(ACTIVATION_QUANTIZATION, {})
-    if act.get(SHARED_PARAMETERS, act).get("enabled", False):
-        logger.warning(
-            "activation_quantization is configured but not applied: functional "
-            "param-tree compression cannot inject activation hooks from outside "
-            "the model. Call compression.functional.quantize_activation inside "
-            "the model's forward (or request it via TransformerConfig) instead.")
     for technique in _TECHNIQUES:
         tcfg = compression_config.get(technique, {})
         shared = tcfg.get(SHARED_PARAMETERS, tcfg)
@@ -94,11 +88,100 @@ class CompressedModel:
         self.config = compression_config
         self.rules = _collect_rules(compression_config)
         self._active = {id(r): True for r in self.rules}  # scheduler toggles
+        self._act_rule = None
+        if model is not None:
+            # structural rewiring first (layer reduction is not scheduled)
+            model = self._rewire(model, self._layer_reduction_changes(compression_config))
+            self._plain_model = model
+            act_changes, act_rule = self._act_quant_changes(compression_config)
+            if act_changes:
+                # activation quant is a scheduled technique like the others:
+                # it rides self.rules so CompressionScheduler honors its
+                # schedule_offset by flipping between the two model variants
+                self._act_model = self._rewire(model, act_changes)
+                self._act_rule = act_rule
+                self.rules.append(act_rule)
+                self._active[id(act_rule)] = True
+                model = self._act_model
+            self.model = model
         if hasattr(model, "config"):
             self.config_model = model.config
 
+    @staticmethod
+    def _act_quant_changes(compression_config: Dict):
+        """Config-section → TransformerConfig field changes for activation
+        fake-quant (reference QuantAct layers, basic_layer.py:118-860)."""
+        act = compression_config.get(ACTIVATION_QUANTIZATION, {})
+        shared = act.get(SHARED_PARAMETERS, act)
+        if not shared.get("enabled", False):
+            return {}, None
+        groups = act.get(DIFFERENT_GROUPS, {})
+        bit_set = {int(g.get("params", {}).get("bits", 8)) for g in groups.values()} or {8}
+        if len(bit_set) > 1:
+            raise ValueError(
+                f"activation_quantization groups request different bit widths "
+                f"{sorted(bit_set)}; per-module scoped activation quant is not "
+                "supported (the fake-quant applies at every attention/MLP "
+                "input) — use one bit width")
+        scoped = [m for g in groups.values() for m in g.get("modules", ["*"])
+                  if m != "*"]
+        if scoped:
+            from deepspeed_tpu.utils.logging import warn_once
+            warn_once(f"activation_quantization 'modules' patterns {scoped} are "
+                      "applied GLOBALLY (every attention/MLP input) — scoped "
+                      "activation quant is not supported")
+        if str(shared.get("range_calibration", "dynamic")) == "static":
+            from deepspeed_tpu.utils.logging import warn_once
+            warn_once("activation_quantization range_calibration='static' "
+                      "uses dynamic per-tensor ranges here (no calibration "
+                      "momentum state in the functional design)")
+        changes = dict(act_quant_bits=next(iter(bit_set)),
+                       act_quant_sym=shared.get("quantization_type",
+                                                "symmetric") == "symmetric")
+        rule = _GroupRule(ACTIVATION_QUANTIZATION, "activation_quantization",
+                          {"schedule_offset": shared.get("schedule_offset", 0)},
+                          ["*"])
+        return changes, rule
+
+    @staticmethod
+    def _layer_reduction_changes(compression_config: Dict) -> Dict:
+        lr = compression_config.get(LAYER_REDUCTION, {})
+        if not lr.get("enabled", False):
+            return {}
+        teacher_layer = list(lr.get("teacher_layer") or [])
+        keep = int(lr.get("keep_number_layer", len(teacher_layer)))
+        if keep <= 0:
+            raise ValueError("layer_reduction needs keep_number_layer "
+                             "(or teacher_layer) in the config")
+        if teacher_layer and keep != len(teacher_layer):
+            raise ValueError(
+                f"layer_reduction keep_number_layer={keep} inconsistent with "
+                f"teacher_layer (length {len(teacher_layer)}): "
+                "student_initialization would reject this config later")
+        return {"n_layer": keep}
+
+    @staticmethod
+    def _rewire(model, changes: Dict):
+        """Apply TransformerConfig field changes on a COPY of the model."""
+        import copy
+        import dataclasses
+
+        if not changes:
+            return model
+        if not (hasattr(model, "config")
+                and all(hasattr(model.config, k) for k in changes)):
+            raise ValueError(
+                f"compression config requests model-side rewrites {changes} "
+                "but the model has no compatible TransformerConfig; zoo "
+                "models (or a config with these fields) are required")
+        model = copy.copy(model)
+        model.config = dataclasses.replace(model.config, **changes)
+        return model
+
     def set_active(self, rule: _GroupRule, active: bool) -> None:
         self._active[id(rule)] = active
+        if rule is self._act_rule:
+            self.model = self._act_model if active else self._plain_model
 
     def compress_params(self, params):
         """Apply every active transform to its matching leaves."""
@@ -163,3 +246,56 @@ def redundancy_clean(model_or_params, deepspeed_config, mpu=None):
     ccfg = get_compression_config(deepspeed_config)
     shell = CompressedModel(model=None, compression_config=ccfg)
     return shell.compress_params(model_or_params)
+
+
+def student_initialization(student_params, teacher_params, deepspeed_config):
+    """Layer-reduction student init (reference ``compression/compress.py:164``
+    ``student_initialization``): re-initialize the student's stacked layers
+    from the configured teacher layers, and copy the non-layer modules.
+
+    Works on zoo param TREES (layers stacked on the leading axis) instead of
+    nn.Modules: ``teacher_layer`` indexes the teacher's layer axis;
+    ``other_module_name`` lists top-level subtrees to copy verbatim
+    (default: every non-"layers" top-level entry, i.e. embed/ln_f/lm_head).
+    Returns a new student tree; inputs are not mutated.
+    """
+    import json
+
+    import numpy as np
+
+    if isinstance(deepspeed_config, str):
+        with open(deepspeed_config) as f:
+            deepspeed_config = json.load(f)
+    lr = get_compression_config(deepspeed_config).get(LAYER_REDUCTION, {})
+    if not lr.get("enabled", False):
+        raise ValueError("student_initialization needs compression_training."
+                         "layer_reduction.enabled=true")
+    teacher_layer = list(lr.get("teacher_layer") or [])
+    if not teacher_layer:
+        raise ValueError("layer_reduction.teacher_layer is required")
+    if "layers" not in student_params or "layers" not in teacher_params:
+        raise ValueError("student_initialization expects zoo param trees "
+                         "with a stacked 'layers' subtree")
+    n_student = jax.tree.leaves(student_params["layers"])[0].shape[0]
+    if n_student != len(teacher_layer):
+        raise ValueError(f"student has {n_student} layers but teacher_layer "
+                         f"names {len(teacher_layer)} source layers")
+
+    idx = np.asarray(teacher_layer, np.int64)
+    out = dict(student_params)
+    out["layers"] = jax.tree.map(lambda a: np.asarray(a)[idx],
+                                 teacher_params["layers"])
+    others = lr.get("other_module_name")
+    if others is None:
+        others = [k for k in teacher_params if k != "layers"]
+    for name in others:
+        if name not in teacher_params:
+            raise KeyError(f"other_module_name entry {name!r} not in the "
+                           f"teacher tree (has {sorted(teacher_params)})")
+        if name not in student_params:
+            raise KeyError(f"other_module_name entry {name!r} not in the "
+                           f"student tree (has {sorted(student_params)}); "
+                           "a silently skipped module would train from "
+                           "random init")
+        out[name] = teacher_params[name]
+    return out

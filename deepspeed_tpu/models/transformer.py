@@ -67,6 +67,12 @@ class TransformerConfig:
     # cross-entropy in sequence chunks of this many tokens: never
     # materialises the full [B, S, vocab] logits (0 = unchunked)
     loss_chunk: int = 0
+    # QAT activation fake-quant (dynamic range, straight-through bwd) applied
+    # to the attention and MLP inputs; 0 = off. Wired automatically by
+    # compression.init_compression from the activation_quantization config
+    # section (reference compression/basic_layer.py:118-860 QuantAct)
+    act_quant_bits: int = 0
+    act_quant_sym: bool = True
     # init
     init_std: float = 0.02
 
@@ -283,6 +289,7 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
     H, KV, Hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
 
     from jax.ad_checkpoint import checkpoint_name
+    x = _maybe_act_quant(cfg, x)
     # attn_bias=True REQUIRES all four bias tensors (loud KeyError on a
     # params tree saved without them, consistent with the bo access below)
     bq = lp["bq"] if cfg.attn_bias else 0
@@ -543,8 +550,18 @@ def _remat_policy(remat):
                      "'dots', 'selective', 'offload_dots', False/'none')")
 
 
+def _maybe_act_quant(cfg: TransformerConfig, x):
+    """QAT activation fake-quant at the matmul inputs (the reference's
+    QuantAct placement); dynamic per-tensor range, STE backward."""
+    if cfg.act_quant_bits:
+        from deepspeed_tpu.compression.functional import quantize_activation
+        return quantize_activation(x, cfg.act_quant_bits, cfg.act_quant_sym)
+    return x
+
+
 def mlp(cfg: TransformerConfig, x, lp):
     from jax.ad_checkpoint import checkpoint_name
+    x = _maybe_act_quant(cfg, x)
     if cfg.activation == "swiglu":
         out = (jax.nn.silu(x @ _w(lp["w_gate"], x)) * (x @ _w(lp["w_up"], x))) @ _w(lp["w_down"], x)
         return checkpoint_name(out, "ff_down")
@@ -612,6 +629,9 @@ def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad
     H, KV, Hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
     Smax = ck.shape[1]
 
+    # QAT parity with the training path: decode must quantize the attention
+    # input too, or prefill/decode logits diverge from forward()
+    x = _maybe_act_quant(cfg, x)
     # attn_bias=True REQUIRES all four bias tensors (loud KeyError on a
     # params tree saved without them, consistent with the bo access below)
     bq = lp["bq"] if cfg.attn_bias else 0

