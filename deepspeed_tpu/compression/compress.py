@@ -79,6 +79,14 @@ def _apply_rule(technique: str, w, params: Dict):
     return w
 
 
+def _load_config(deepspeed_config):
+    if isinstance(deepspeed_config, str):
+        import json
+        with open(deepspeed_config) as f:
+            return json.load(f)
+    return deepspeed_config
+
+
 class CompressedModel:
     """Wraps a model: the configured transforms are applied to matching
     params (per the scheduler's active set) before every forward/loss."""
@@ -88,6 +96,7 @@ class CompressedModel:
         self.config = compression_config
         self.rules = _collect_rules(compression_config)
         self._active = {id(r): True for r in self.rules}  # scheduler toggles
+        self.compression_epoch = 0
         self._act_rule = None
         if model is not None:
             # structural rewiring first (layer reduction is not scheduled)
@@ -138,9 +147,11 @@ class CompressedModel:
         changes = dict(act_quant_bits=next(iter(bit_set)),
                        act_quant_sym=shared.get("quantization_type",
                                                 "symmetric") == "symmetric")
+        rule_params = {k: v for k, v in shared.items()
+                       if k not in ("enabled", DIFFERENT_GROUPS)}
+        rule_params.setdefault("schedule_offset", 0)
         rule = _GroupRule(ACTIVATION_QUANTIZATION, "activation_quantization",
-                          {"schedule_offset": shared.get("schedule_offset", 0)},
-                          ["*"])
+                          rule_params, ["*"])
         return changes, rule
 
     @staticmethod
@@ -176,9 +187,22 @@ class CompressedModel:
                 "models (or a config with these fields) are required")
         model = copy.copy(model)
         model.config = dataclasses.replace(model.config, **changes)
+        if hasattr(model, "zoo_cfg"):
+            # models caching a derived config (BertModel.zoo_cfg) would
+            # silently keep computing with the stale one
+            if not hasattr(model.config, "zoo"):
+                raise ValueError(
+                    f"cannot rewire {type(model).__name__}: it caches a "
+                    "derived zoo_cfg its config cannot rebuild")
+            model.zoo_cfg = model.config.zoo()
         return model
 
     def set_active(self, rule: _GroupRule, active: bool) -> None:
+        if self._active.get(id(rule)) != active:
+            # compiled programs captured the old active set at trace time;
+            # bumping the epoch tells the engine to drop them (train_batch
+            # checks client_model.compression_epoch)
+            self.compression_epoch += 1
         self._active[id(rule)] = active
         if rule is self._act_rule:
             self.model = self._act_model if active else self._plain_model
@@ -223,11 +247,7 @@ class CompressedModel:
 def init_compression(model, deepspeed_config, mpu=None):
     """Reference ``init_compression`` (``compress.py:92``): returns the
     compression-wrapped model. ``deepspeed_config``: dict or path."""
-    import json
-    if isinstance(deepspeed_config, str):
-        with open(deepspeed_config) as f:
-            deepspeed_config = json.load(f)
-    ccfg = get_compression_config(deepspeed_config)
+    ccfg = get_compression_config(_load_config(deepspeed_config))
     wrapped = CompressedModel(model, ccfg)
     logger.info(f"init_compression: {len(wrapped.rules)} compression group(s) active")
     return wrapped
@@ -237,10 +257,7 @@ def redundancy_clean(model_or_params, deepspeed_config, mpu=None):
     """Reference ``redundancy_clean`` (``compress.py:120``): burn the
     transforms into the params for deployment. Takes the raw param tree +
     the ds config (NOT a CompressedModel — pass ``engine.state.params``)."""
-    import json
-    if isinstance(deepspeed_config, str):
-        with open(deepspeed_config) as f:
-            deepspeed_config = json.load(f)
+    deepspeed_config = _load_config(deepspeed_config)
     if isinstance(model_or_params, CompressedModel):
         raise ValueError("pass the param tree: redundancy_clean(params, config)")
     ccfg = get_compression_config(deepspeed_config)
@@ -259,14 +276,9 @@ def student_initialization(student_params, teacher_params, deepspeed_config):
     (default: every non-"layers" top-level entry, i.e. embed/ln_f/lm_head).
     Returns a new student tree; inputs are not mutated.
     """
-    import json
-
     import numpy as np
 
-    if isinstance(deepspeed_config, str):
-        with open(deepspeed_config) as f:
-            deepspeed_config = json.load(f)
-    lr = get_compression_config(deepspeed_config).get(LAYER_REDUCTION, {})
+    lr = get_compression_config(_load_config(deepspeed_config)).get(LAYER_REDUCTION, {})
     if not lr.get("enabled", False):
         raise ValueError("student_initialization needs compression_training."
                          "layer_reduction.enabled=true")

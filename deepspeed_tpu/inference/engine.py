@@ -89,8 +89,10 @@ class InferenceEngine:
             if model is None:
                 model = loaded_model
             self.module = model = model if not isinstance(model, str) else loaded_model
+            n_params = sum(int(np.prod(a.shape))
+                           for a in jax.tree.leaves(params))
             log_dist(f"InferenceEngine: loaded HF checkpoint {ckpt} "
-                     f"({loaded_model.num_parameters / 1e6:.1f}M params)", ranks=[0])
+                     f"({n_params / 1e6:.1f}M params)", ranks=[0])
         elif params is None and isinstance(ckpt, (dict,)) or \
                 (params is None and isinstance(ckpt, str) and ckpt.endswith(".json")):
             # ds_inference meta json (reference engine.py:354-419 sharded

@@ -67,6 +67,9 @@ class TransformerConfig:
     # cross-entropy in sequence chunks of this many tokens: never
     # materialises the full [B, S, vocab] logits (0 = unchunked)
     loss_chunk: int = 0
+    # attention logit scale; None = head_dim**-0.5. GPT-Neo-family models
+    # use UNSCALED attention (1.0)
+    attn_scale: Optional[float] = None
     # QAT activation fake-quant (dynamic range, straight-through bwd) applied
     # to the attention and MLP inputs; 0 = off. Wired automatically by
     # compression.init_compression from the activation_quantization config
@@ -313,7 +316,8 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
         # broadcast kv heads locally
         from deepspeed_tpu.sequence import sp_attention
         out = sp_attention(q, k, v, mesh=sp_mesh, impl=cfg.sequence_parallel,
-                           causal=cfg.causal, mask_bias=mask_bias, alibi_slopes=slopes)
+                           causal=cfg.causal, mask_bias=mask_bias,
+                           alibi_slopes=slopes, scale=cfg.attn_scale)
     else:
         # kernel paths first — the Pallas kernel beats the XLA streaming
         # core at every length it can run
@@ -326,7 +330,8 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
             if use_direct:
                 from deepspeed_tpu.ops.pallas import flash_attention
                 out = flash_attention(q, k, v, mask_bias=mask_bias,
-                                      causal=cfg.causal, alibi_slopes=slopes)
+                                      causal=cfg.causal, alibi_slopes=slopes,
+                                      scale=cfg.attn_scale)
             else:
                 out = _flash_sharded(cfg, q, k, v, mask_bias, slopes, fmesh)
         if out is None and S > DENSE_STREAM_THRESHOLD:
@@ -341,7 +346,8 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
             mb = None if mask_bias is None else mask_bias.astype(jnp.float32)
             out, _ = chunked_attention(q, k, v, mb, slopes, jnp.int32(0),
                                        jnp.int32(0), cfg.causal,
-                                       DENSE_STREAM_CHUNK, q.dtype)
+                                       DENSE_STREAM_CHUNK, q.dtype,
+                                       cfg.attn_scale)
     if out is None:
         if KV != H:  # dense fallback needs repeated kv
             rep = H // KV
@@ -350,7 +356,8 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
         from deepspeed_tpu.ops.attention import mha_attention
         out = mha_attention(q, k, v,
                             mask_bias=None if mask_bias is None else mask_bias[:, None, None, :],
-                            causal=cfg.causal, alibi_slopes=slopes)
+                            causal=cfg.causal, alibi_slopes=slopes,
+                            scale=cfg.attn_scale)
     out = checkpoint_name(out.reshape(B, S, H * Hd), "attn_out")
     proj = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
     return checkpoint_name(proj, "wo_out")
@@ -462,14 +469,14 @@ def _flash_sharded(cfg: TransformerConfig, q, k, v, mask_bias, slopes, mesh):
         ms = rest.pop(0) if mask_bias is not None else None
         ss = rest.pop(0) if slopes is not None else None
         return flash_attention(qs, ks, vs, mask_bias=ms, causal=cfg.causal,
-                               alibi_slopes=ss)
+                               alibi_slopes=ss, scale=cfg.attn_scale)
 
     wrapped = shard_map(inner, mesh=mesh, in_specs=tuple(specs),
                        out_specs=qspec, check_vma=False)
     return wrapped(*operands)
 
 
-def _decode_sharded(q1, ck, cv, pos, pad_bias, slopes, mesh):
+def _decode_sharded(q1, ck, cv, pos, pad_bias, slopes, mesh, scale=None):
     """Decode-attention kernel under a dp/fsdp×tp mesh: shard_map over batch
     (q/cache/pad_bias) and heads (q + KV cache + slopes) — decode attention
     is pointwise in batch and head, so shards need no communication and the
@@ -507,7 +514,8 @@ def _decode_sharded(q1, ck, cv, pos, pad_bias, slopes, mesh):
         rest = list(rest)
         ms = rest.pop(0) if pad_bias is not None else None
         ss = rest.pop(0) if slopes is not None else None
-        return decode_attention(qs, cks, cvs, ps, pad_bias=ms, alibi_slopes=ss)
+        return decode_attention(qs, cks, cvs, ps, pad_bias=ms, alibi_slopes=ss,
+                                scale=scale)
 
     wrapped = shard_map(inner, mesh=mesh, in_specs=tuple(specs),
                         out_specs=qspec, check_vma=False)
@@ -656,12 +664,12 @@ def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad
         if _use_flash(cfg):
             from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
             o = decode_attention(q[:, 0], ck, cv, pos, pad_bias=pad_bias,
-                                 alibi_slopes=slopes)
+                                 alibi_slopes=slopes, scale=cfg.attn_scale)
         else:
             dmesh = _flash_mesh(cfg)
             if dmesh is not None:
                 o = _decode_sharded(q[:, 0], ck, cv, pos, pad_bias,
-                                    slopes, dmesh)
+                                    slopes, dmesh, scale=cfg.attn_scale)
         if o is not None:
             out = o.reshape(B, 1, H * Hd)
             out = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
@@ -679,7 +687,8 @@ def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad
         pb = None if pad_bias is None else pad_bias.astype(jnp.float32)
         o, _ = chunked_attention(q, ck, cv, pb, slopes,
                                  jnp.asarray(pos, jnp.int32), jnp.int32(0),
-                                 True, DENSE_STREAM_CHUNK, q.dtype)
+                                 True, DENSE_STREAM_CHUNK, q.dtype,
+                                 cfg.attn_scale)
         out = o.reshape(B, T, H * Hd)
         out = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
         return out, ck, cv
@@ -690,7 +699,7 @@ def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad
         kk = jnp.repeat(kk, rep, axis=2)
         vv = jnp.repeat(vv, rep, axis=2)
 
-    scale = Hd**-0.5
+    scale = Hd**-0.5 if cfg.attn_scale is None else cfg.attn_scale
     scores = jnp.einsum("bthd,bshd->bhts", q, kk,
                         preferred_element_type=jnp.float32) * scale
     kpos = jnp.arange(Smax, dtype=jnp.int32)[None, None, None, :]      # [1,1,1,S]

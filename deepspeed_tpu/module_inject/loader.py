@@ -125,7 +125,10 @@ def load_hf_checkpoint(path: str, model_type: Optional[str] = None,
 
     params = policy.map_params(get, cfg)
     params = _jnp_tree(params)
-    return CausalLM(cfg), params
+    model = policy.build_model(cfg, hf_cfg, params)
+    if model is None:
+        model = CausalLM(cfg)
+    return model, params
 
 
 def _jnp_tree(tree):

@@ -35,7 +35,7 @@ def _t(a):
 
 class HFPolicy:
     """Base policy: subclasses define ``model_type``, ``zoo_config`` and
-    ``map_params``."""
+    ``map_params``; non-decoder families also override ``build_model``."""
 
     model_type: str = ""
 
@@ -44,6 +44,12 @@ class HFPolicy:
 
     def map_params(self, get: Callable[[str], np.ndarray], cfg: TransformerConfig) -> Dict:
         raise NotImplementedError
+
+    def build_model(self, cfg: TransformerConfig, hf: Dict[str, Any], params: Dict):
+        """Model instance for the mapped params; None = ``CausalLM(cfg)``
+        (decoder families). Encoder families (DistilBERT) return their own
+        zoo model here."""
+        return None
 
 
 class GPT2Policy(HFPolicy):
@@ -410,9 +416,178 @@ class GPTJPolicy(HFPolicy):
         }
 
 
+class GPTNeoPolicy(HFPolicy):
+    """HF ``gpt_neo`` (reference ``containers/gptneo.py``): GPT-2-style
+    block with UNSCALED attention (attn_scale=1.0), gelu_new MLP, and
+    q/k/v projections without biases (out_proj keeps one; the zoo's
+    all-or-nothing attn_bias rides with zero q/k/v biases).
+
+    Local-attention layers (``attention_types`` containing "local") are
+    window-limited at window_size tokens; at sequence lengths <= the window
+    local == global attention, so ingestion caps ``max_seq`` to the window
+    and the model is exact there. Longer contexts would need the banded
+    mask and are rejected by max_seq."""
+
+    model_type = "gpt_neo"
+
+    @staticmethod
+    def _has_local(hf) -> bool:
+        def leaves(x):
+            if isinstance(x, (list, tuple)):
+                for e in x:
+                    yield from leaves(e)
+            else:
+                yield x
+        return any(l == "local" for l in leaves(hf.get("attention_types", [])))
+
+    def zoo_config(self, hf):
+        max_seq = hf.get("max_position_embeddings", 2048)
+        if self._has_local(hf):
+            window = int(hf.get("window_size", 256))
+            if window < max_seq:
+                from deepspeed_tpu.utils.logging import warn_once
+                warn_once(
+                    f"gpt_neo has local-attention layers: max_seq capped to "
+                    f"window_size={window} (local == global there); longer "
+                    "contexts need banded attention")
+                max_seq = window
+        return TransformerConfig(
+            vocab_size=hf["vocab_size"], n_layer=hf["num_layers"],
+            n_head=hf["num_heads"], d_model=hf["hidden_size"],
+            d_ff=hf.get("intermediate_size") or 4 * hf["hidden_size"],
+            max_seq=max_seq, pos_embedding="learned", norm="layernorm",
+            activation="gelu", tie_embeddings=True, attn_bias=True,
+            attn_scale=1.0, norm_eps=hf.get("layer_norm_epsilon", 1e-5))
+
+    def map_params(self, raw_get, cfg):
+        L, D = cfg.n_layer, cfg.d_model
+        ls = range(L)
+
+        def get(name):
+            try:
+                return raw_get(name)
+            except KeyError:
+                return raw_get("transformer." + name)
+
+        def zeros_like_rows(n):
+            return np.zeros((L, n), np.float32)
+
+        att = "h.{}.attn.attention"
+        return {
+            "embed": {"tokens": np.asarray(get("wte.weight")),
+                      "positions": np.asarray(get("wpe.weight"))[:cfg.max_seq]},
+            "layers": {
+                "ln_attn": {"scale": _stack(get, [f"h.{i}.ln_1.weight" for i in ls]),
+                            "bias": _stack(get, [f"h.{i}.ln_1.bias" for i in ls])},
+                "attn": {
+                    "wq": _stack(get, [att.format(i) + ".q_proj.weight" for i in ls], _t),
+                    "wk": _stack(get, [att.format(i) + ".k_proj.weight" for i in ls], _t),
+                    "wv": _stack(get, [att.format(i) + ".v_proj.weight" for i in ls], _t),
+                    "wo": _stack(get, [att.format(i) + ".out_proj.weight" for i in ls], _t),
+                    # q/k/v carry no biases in gpt-neo; out_proj does
+                    "bq": zeros_like_rows(D), "bk": zeros_like_rows(D),
+                    "bv": zeros_like_rows(D),
+                    "bo": _stack(get, [att.format(i) + ".out_proj.bias" for i in ls]),
+                },
+                "ln_mlp": {"scale": _stack(get, [f"h.{i}.ln_2.weight" for i in ls]),
+                           "bias": _stack(get, [f"h.{i}.ln_2.bias" for i in ls])},
+                "mlp": {"w_up": _stack(get, [f"h.{i}.mlp.c_fc.weight" for i in ls], _t),
+                        "b_up": _stack(get, [f"h.{i}.mlp.c_fc.bias" for i in ls]),
+                        "w_down": _stack(get, [f"h.{i}.mlp.c_proj.weight" for i in ls], _t),
+                        "b_down": _stack(get, [f"h.{i}.mlp.c_proj.bias" for i in ls])},
+            },
+            "ln_f": {"scale": np.asarray(get("ln_f.weight")),
+                     "bias": np.asarray(get("ln_f.bias"))},
+        }
+
+
+class DistilBertPolicy(HFPolicy):
+    """HF ``distilbert`` (reference ``containers/distil_bert.py``): a BERT
+    encoder without token-type embeddings or pooler; the MLM head
+    (vocab_transform + vocab_layer_norm + tied vocab_projector) maps onto
+    the zoo BertModel's mlm block. Serves through the BertModel fill-mask
+    surface."""
+
+    model_type = "distilbert"
+
+    def zoo_config(self, hf):
+        return TransformerConfig(
+            vocab_size=hf["vocab_size"], n_layer=hf["n_layers"],
+            n_head=hf["n_heads"], d_model=hf["dim"], d_ff=hf["hidden_dim"],
+            max_seq=hf.get("max_position_embeddings", 512),
+            pos_embedding="learned", norm="layernorm", norm_position="post",
+            activation="gelu_exact", causal=False, attn_bias=True,
+            tie_embeddings=True, norm_eps=1e-12)
+
+    def build_model(self, cfg, hf, params):
+        from deepspeed_tpu.models.bert import BertConfig, BertModel
+        bc = BertConfig(vocab_size=cfg.vocab_size, max_seq=cfg.max_seq,
+                        n_layer=cfg.n_layer, n_head=cfg.n_head,
+                        d_model=cfg.d_model, d_ff=cfg.d_ff,
+                        type_vocab_size=1, norm_eps=1e-12)
+        return BertModel(bc, with_mlm_head="mlm" in params)
+
+    def map_params(self, raw_get, cfg):
+        L, D = cfg.n_layer, cfg.d_model
+        ls = range(L)
+
+        def get(name):
+            try:
+                return raw_get(name)
+            except KeyError:
+                return raw_get("distilbert." + name)
+
+        lp = "transformer.layer.{}"
+        out = {
+            "embed": {
+                "tokens": np.asarray(get("embeddings.word_embeddings.weight")),
+                "positions": np.asarray(get("embeddings.position_embeddings.weight")),
+                # distilbert has no token types: one all-zero row (index 0)
+                "token_type": np.zeros((1, D), np.float32),
+                "ln": {"scale": np.asarray(get("embeddings.LayerNorm.weight")),
+                       "bias": np.asarray(get("embeddings.LayerNorm.bias"))},
+            },
+            "layers": {
+                "ln_attn": {"scale": _stack(get, [lp.format(i) + ".sa_layer_norm.weight" for i in ls]),
+                            "bias": _stack(get, [lp.format(i) + ".sa_layer_norm.bias" for i in ls])},
+                "attn": {
+                    "wq": _stack(get, [lp.format(i) + ".attention.q_lin.weight" for i in ls], _t),
+                    "wk": _stack(get, [lp.format(i) + ".attention.k_lin.weight" for i in ls], _t),
+                    "wv": _stack(get, [lp.format(i) + ".attention.v_lin.weight" for i in ls], _t),
+                    "wo": _stack(get, [lp.format(i) + ".attention.out_lin.weight" for i in ls], _t),
+                    "bq": _stack(get, [lp.format(i) + ".attention.q_lin.bias" for i in ls]),
+                    "bk": _stack(get, [lp.format(i) + ".attention.k_lin.bias" for i in ls]),
+                    "bv": _stack(get, [lp.format(i) + ".attention.v_lin.bias" for i in ls]),
+                    "bo": _stack(get, [lp.format(i) + ".attention.out_lin.bias" for i in ls]),
+                },
+                "ln_mlp": {"scale": _stack(get, [lp.format(i) + ".output_layer_norm.weight" for i in ls]),
+                           "bias": _stack(get, [lp.format(i) + ".output_layer_norm.bias" for i in ls])},
+                "mlp": {"w_up": _stack(get, [lp.format(i) + ".ffn.lin1.weight" for i in ls], _t),
+                        "b_up": _stack(get, [lp.format(i) + ".ffn.lin1.bias" for i in ls]),
+                        "w_down": _stack(get, [lp.format(i) + ".ffn.lin2.weight" for i in ls], _t),
+                        "b_down": _stack(get, [lp.format(i) + ".ffn.lin2.bias" for i in ls])},
+            },
+            # no pooler in distilbert: zero weights make pooled = tanh(0)
+            "pooler": {"w": np.zeros((D, D), np.float32),
+                       "b": np.zeros((D,), np.float32)},
+        }
+        try:
+            out["mlm"] = {
+                "w": _t(raw_get("vocab_transform.weight")),
+                "b": np.asarray(raw_get("vocab_transform.bias")),
+                "ln": {"scale": np.asarray(raw_get("vocab_layer_norm.weight")),
+                       "bias": np.asarray(raw_get("vocab_layer_norm.bias"))},
+                "decoder_bias": np.asarray(raw_get("vocab_projector.bias")),
+            }
+        except KeyError:
+            pass  # plain DistilBertModel checkpoint: no fill-mask head
+        return out
+
+
 POLICIES: Dict[str, HFPolicy] = {
     p.model_type: p() for p in (GPT2Policy, LlamaPolicy, BloomPolicy, OPTPolicy,
-                                GPTNeoXPolicy, GPTJPolicy)
+                                GPTNeoXPolicy, GPTJPolicy, GPTNeoPolicy,
+                                DistilBertPolicy)
 }
 
 

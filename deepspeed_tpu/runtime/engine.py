@@ -752,6 +752,16 @@ class DeepSpeedEngine:
         ``gas * micro_bs * dp_size`` (this process's share of the global
         batch), or pass ``data_iter`` yielding ``gas`` micro-batches of
         ``micro_bs * dp_size`` samples each."""
+        # compression scheduling: a CompressionScheduler transition changes
+        # what the model computes; compiled programs captured the OLD trace,
+        # so drop them when the wrapped model's epoch moved
+        epoch = getattr(self.client_model, "compression_epoch", None)
+        if epoch is not None and epoch != getattr(self, "_compression_epoch_seen", None):
+            if getattr(self, "_compression_epoch_seen", None) is not None:
+                self._train_batch_jit.clear()
+                self._grad_jit = self._apply_jit = self._eval_jit = None
+            self._compression_epoch_seen = epoch
+
         gas = self.gradient_accumulation_steps()
         micro_bs = self.train_micro_batch_size_per_gpu()
         dp = dist.get_world_size(dist.data_parallel_axes(self.mesh))
@@ -857,9 +867,11 @@ class DeepSpeedEngine:
         ``quantizer.quantize`` after each step, runtime/quantize.py): walks
         the per-leaf bit schedule and fake-quantizes the live params. With
         eigenvalue enabled, per-block curvature is re-estimated at gas
-        boundaries while a precision switch is pending, and the MAX across
-        blocks stretches the stacked-layers leaves' periods (the zoo stacks
-        all layers in one leaf, so the most conservative block governs)."""
+        boundaries while a precision switch is pending, and the MEAN across
+        blocks scales the stacked-layers leaves' periods (deviation from the
+        reference's per-block factor, forced by the stacked-layers leaf
+        layout; max is useless here because post_process normalizes the
+        largest eigenvalue to 1.0)."""
         # fp16 overflow steps skipped their update: don't advance the bit
         # schedule on them either (reference defers quantize on overflow)
         overflow = False

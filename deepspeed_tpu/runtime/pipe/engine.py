@@ -332,6 +332,13 @@ class PipelineEngine(DeepSpeedEngine):
     ``gradient_accumulation_steps`` plays the reference's ``micro_batches``
     role (pipe/engine.py: micro_batches == gas): each ``train_batch`` feeds
     gas micro-batches through the pipeline and applies one update.
+
+    CONTRACT: ``embed_fn`` and ``head_loss_fn`` may read only the NON-stage
+    parameter subtree (everything except ``params["stages"]``). The 1F1B
+    schedule takes their vjps over that subtree alone — a read of
+    ``params["stages"]`` inside embed/head would silently receive ZERO
+    gradient (e.g. do not store the final norm under stages). Stage weights
+    get gradients exclusively through ``stage_fn``.
     """
 
     def __init__(self, *args, **kwargs):
@@ -342,7 +349,12 @@ class PipelineEngine(DeepSpeedEngine):
         pp = self.mesh.shape.get("pp", 1)
         if pp > 1 and pp != self.num_stages:
             raise ValueError(f"mesh pp={pp} != model num_stages={self.num_stages}")
-        self.micro_batches = self.gradient_accumulation_steps()
+
+    @property
+    def micro_batches(self) -> int:
+        # reference-shaped surface (pipe/engine.py micro_batches == gas); a
+        # property so set_train_batch_size's gas changes are never stale here
+        return self.gradient_accumulation_steps()
 
     def _uses_acc_grad_buffers(self) -> bool:
         # the 1F1B schedule accumulates grads inside its own scan carry

@@ -326,3 +326,61 @@ def test_act_quant_decode_matches_forward():
     logits, cache = model.forward_cached(params, toks, cache, jnp.int32(0))
     np.testing.assert_allclose(np.asarray(logits, np.float32)[:, :6], full,
                                rtol=2e-4, atol=2e-4)
+
+
+def test_scheduler_transition_retraces_engine(devices):
+    """A schedule transition changes the computation: the engine must drop
+    its compiled programs (compression_epoch) or QAT silently never starts."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.compression import CompressionScheduler, init_compression
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.models.transformer import TransformerConfig
+
+    cfg = {"compression_training": {"activation_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 2},
+        "different_groups": {"aq1": {"params": {"bits": 4}}}}}}
+    model = CausalLM(TransformerConfig(vocab_size=64, n_layer=1, n_head=2,
+                                       d_model=32, d_ff=64, max_seq=16,
+                                       remat=False))
+    wrapped = init_compression(model, cfg)
+    sched = CompressionScheduler(wrapped)
+    dist.set_mesh(None)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=wrapped,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "mesh": {"dp": 8}, "steps_per_print": 0})
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 64, (8, 16))}
+    # step 0/1: plain model traced
+    engine.train_batch(batch); sched.step()
+    assert wrapped.model.config.act_quant_bits == 0
+    engine.train_batch(batch); sched.step()
+    # transition fired: quantized model must now be what compiles
+    assert wrapped.model.config.act_quant_bits == 4
+    params_before = engine.state.params
+    jaxpr = str(jax.make_jaxpr(lambda p: wrapped.loss(p, batch))(
+        jax.tree.map(np.asarray, params_before)))
+    assert "round" in jaxpr
+    loss = float(engine.train_batch(batch))  # re-traced with 4-bit act quant
+    assert np.isfinite(loss)
+    dist.set_mesh(None)
+
+
+def test_bert_layer_reduction_rebuilds_zoo_cfg():
+    """Models caching a derived config (BertModel.zoo_cfg) must not keep
+    computing at the stale depth after layer_reduction."""
+    from deepspeed_tpu.compression import init_compression
+    from deepspeed_tpu.models.bert import BertConfig, BertModel
+
+    cfg = {"compression_training": {"layer_reduction": {
+        "enabled": True, "keep_number_layer": 2, "teacher_layer": [1, 3]}}}
+    model = BertModel(BertConfig(vocab_size=64, n_layer=4, n_head=2,
+                                 d_model=32, d_ff=64, max_seq=16))
+    wrapped = init_compression(model, cfg)
+    assert wrapped.model.config.n_layer == 2
+    assert wrapped.model.zoo_cfg.n_layer == 2      # derived config rebuilt
+    assert model.zoo_cfg.n_layer == 4              # caller untouched
+    # the reduced model actually runs at depth 2
+    params = wrapped.model.init_params(jax.random.key(0))
+    assert jax.tree.leaves(params["layers"])[0].shape[0] == 2

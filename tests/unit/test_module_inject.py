@@ -215,3 +215,69 @@ class TestShardedIndex:
         import jax
         jax.tree.map(lambda a, b: np.testing.assert_array_equal(
             np.asarray(a), np.asarray(b)), params1, params2)
+
+
+class TestGPTNeoPolicy:
+    """HF gpt_neo ingestion (reference containers/gptneo.py): unscaled
+    attention, gelu_new, bias-free q/k/v."""
+
+    def test_gpt_neo_global(self, tmp_path):
+        cfg = transformers.GPTNeoConfig(
+            vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+            max_position_embeddings=32, attention_types=[[["global"], 2]],
+            intermediate_size=64)
+        parity(tmp_path, transformers.GPTNeoForCausalLM(cfg), cfg)
+
+    def test_gpt_neo_local_capped_to_window(self, tmp_path):
+        """Alternating global/local layers: exact at seq <= window_size, and
+        max_seq is capped there so longer prompts are rejected."""
+        cfg = transformers.GPTNeoConfig(
+            vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+            max_position_embeddings=64, window_size=24,
+            attention_types=[[["global", "local"], 1]], intermediate_size=64)
+        hf_model = transformers.GPTNeoForCausalLM(cfg)
+        d = save_hf(hf_model, cfg, tmp_path)
+        model, params = load_hf_checkpoint(d)
+        assert model.config.max_seq == 24
+        assert model.config.attn_scale == 1.0
+        rng = np.random.default_rng(1)
+        tok = rng.integers(0, 96, size=(2, 20)).astype(np.int64)
+        with torch.no_grad():
+            ref = hf_model(input_ids=torch.from_numpy(tok)).logits.float().numpy()
+        got = np.asarray(model.forward(params, jnp.asarray(tok.astype(np.int32))),
+                         np.float32)
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-3)
+        np.testing.assert_array_equal(got.argmax(-1), ref.argmax(-1))
+
+
+class TestDistilBertPolicy:
+    """HF distilbert ingestion (reference containers/distil_bert.py): BERT
+    encoder without token types/pooler, fill-mask head tied to embeddings."""
+
+    def test_distilbert_fill_mask(self, tmp_path):
+        cfg = transformers.DistilBertConfig(
+            vocab_size=96, dim=32, n_layers=2, n_heads=4, hidden_dim=64,
+            max_position_embeddings=32)
+        hf_model = transformers.DistilBertForMaskedLM(cfg)
+        d = save_hf(hf_model, cfg, tmp_path)
+        model, params = load_hf_checkpoint(d)
+        from deepspeed_tpu.models.bert import BertModel
+        assert isinstance(model, BertModel) and model.with_mlm_head
+        rng = np.random.default_rng(2)
+        tok = rng.integers(0, 96, size=(2, 16)).astype(np.int64)
+        with torch.no_grad():
+            ref = hf_model(input_ids=torch.from_numpy(tok)).logits.float().numpy()
+        got = np.asarray(model.forward(params, jnp.asarray(tok.astype(np.int32))),
+                         np.float32)
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-3)
+
+    def test_distilbert_serves_through_init_inference(self, tmp_path):
+        import deepspeed_tpu
+        cfg = transformers.DistilBertConfig(
+            vocab_size=96, dim=32, n_layers=2, n_heads=4, hidden_dim=64,
+            max_position_embeddings=32)
+        d = save_hf(transformers.DistilBertForMaskedLM(cfg), cfg, tmp_path)
+        eng = deepspeed_tpu.init_inference(d, dtype="fp32")
+        out = np.asarray(eng.forward(np.asarray([[1, 2, 3, 4]], np.int32)))
+        assert out.shape == (1, 4, 96)
+        assert np.isfinite(out).all()
