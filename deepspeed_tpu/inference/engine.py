@@ -60,13 +60,11 @@ class InferenceEngine:
             ep_size = max(int(moe_cfg.ep_size), int(self._config.ep_size), 1)
             moe_type = str(getattr(moe_cfg.type, "value", moe_cfg.type))
         self._ep_size = ep_size if moe_enabled else 1
-        if moe_type != "standard":
-            # regardless of ep_size: a residual/PR-MoE checkpoint served with
-            # standard routing would be silently wrong
+        if moe_type not in ("standard", "residual"):
             raise NotImplementedError(
-                f"MoE inference type {moe_type!r} is not implemented; only "
-                "'standard' expert-parallel serving is supported (the "
-                "residual-MoE coefficient blend has no zoo model)")
+                f"MoE inference type {moe_type!r} is not implemented; "
+                "'standard' and 'residual' (PR-MoE) are supported")
+        self._moe_type = moe_type
         if not dist.has_mesh():
             axes = {}
             if self._ep_size > 1:
@@ -137,6 +135,15 @@ class InferenceEngine:
                 raise ValueError(
                     f"moe.ep_size={self._ep_size} must divide the model's "
                     f"num_experts={n_experts}")
+            # the config's moe type and the model's architecture must agree:
+            # serving a PR-MoE with standard routing (or vice versa) would be
+            # silently wrong (reference moe_inference moe_type dispatch)
+            model_residual = bool(getattr(model.moe, "use_residual", False))
+            if model_residual != (self._moe_type == "residual"):
+                raise ValueError(
+                    f"config moe.type={self._moe_type!r} but the model "
+                    f"{'IS' if model_residual else 'is NOT'} a residual "
+                    "(PR-)MoE; set moe.type accordingly")
             # serve on a shallow copy bound to the serve mesh — mutating the
             # caller's model would clobber a training mesh (or an earlier
             # engine's) and put stale sharding constraints inside their jit

@@ -29,13 +29,14 @@ class BertConfig:
     d_ff: int = 3072
     type_vocab_size: int = 2
     norm_eps: float = 1e-12
+    activation: str = "gelu_exact"   # HF 'gelu' (erf); distilbert may use relu
 
     def zoo(self) -> T.TransformerConfig:
         return T.TransformerConfig(
             vocab_size=self.vocab_size, max_seq=self.max_seq,
             n_layer=self.n_layer, n_head=self.n_head, d_model=self.d_model,
             d_ff=self.d_ff, pos_embedding="learned", norm="layernorm",
-            norm_position="post", activation="gelu_exact", causal=False,
+            norm_position="post", activation=self.activation, causal=False,
             attn_bias=True, norm_eps=self.norm_eps, tie_embeddings=True)
 
 

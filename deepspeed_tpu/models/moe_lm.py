@@ -35,6 +35,10 @@ class MoEConfig:
     drop_tokens: bool = True
     use_rts: bool = True
     expert_ff_mult: int = 4
+    # Residual (PR-)MoE, arXiv:2201.05596: each MoE MLP is blended with a
+    # dense MLP through a learned 2-way softmax coefficient (reference
+    # moe/layer.py use_residual + inference moe_type='residual')
+    use_residual: bool = False
 
 
 class MoECausalLM:
@@ -65,6 +69,16 @@ class MoECausalLM:
             "w_down": (jax.random.normal(k3, (L, E, F, D)) * s_out).astype(self.param_dtype),
             "b_down": jnp.zeros((L, E, D), self.param_dtype),
         }
+        if moe.use_residual:
+            k4, k5, k6 = jax.random.split(jax.random.fold_in(rng, 1001), 3)
+            base["layers"]["mlp"].update({
+                "res_w_up": (jax.random.normal(k4, (L, D, F)) * s_in).astype(self.param_dtype),
+                "res_b_up": jnp.zeros((L, F), self.param_dtype),
+                "res_w_down": (jax.random.normal(k5, (L, F, D)) * s_out).astype(self.param_dtype),
+                "res_b_down": jnp.zeros((L, D), self.param_dtype),
+                "coef_w": (jax.random.normal(k6, (L, D, 2)) * 0.02).astype(self.param_dtype),
+                "coef_b": jnp.zeros((L, 2), self.param_dtype),
+            })
         return base
 
     def tp_specs(self) -> Dict[str, Any]:
@@ -76,6 +90,12 @@ class MoECausalLM:
             "w_down": P(None, "ep", "tp", None),
             "b_down": P(None, "ep", None),
         }
+        if self.moe.use_residual:
+            specs["layers"]["mlp"].update({
+                "res_w_up": P(None, None, "tp"), "res_b_up": P(None, "tp"),
+                "res_w_down": P(None, "tp", None), "res_b_down": P(None, None),
+                "coef_w": P(None, None, None), "coef_b": P(None, None),
+            })
         return specs
 
     # -------------------- forward -------------------- #
@@ -103,6 +123,14 @@ class MoECausalLM:
 
         eps = {k: lp[k] for k in ("w_up", "b_up", "w_down", "b_down")}
         combined = dispatch_combine(tokens, combine, dispatch, expert, eps, mesh=self.mesh)
+        if moe.use_residual:
+            # PR-MoE blend (reference moe/layer.py:115-123): dense MLP +
+            # 2-way softmax coefficient over [moe, dense]
+            h = jax.nn.gelu(tokens @ lp["res_w_up"] + lp["res_b_up"],
+                            approximate=True)
+            res = h @ lp["res_w_down"] + lp["res_b_down"]
+            coef = jax.nn.softmax(tokens @ lp["coef_w"] + lp["coef_b"], axis=-1)
+            combined = combined * coef[..., 0:1] + res * coef[..., 1:2]
         return combined.reshape(B, S, D), l_aux
 
     def _block(self, x, lp, positions, mask_bias, rng, train: bool):

@@ -122,9 +122,14 @@ def map_megatron_params(sd: Dict[str, np.ndarray], cfg, version=0) -> Dict[str, 
     # ``mlp.deepspeed_moe.experts.deepspeed_experts.{e}.dense_{h_to_4h,4h_to_h}``
     # → zoo MoE layout [L, E, ...] (every layer must be MoE; the zoo model
     # has no mixed dense/MoE stacking)
-    is_moe = any(".mlp.deepspeed_moe." in k for k in sd)
+    # standard MoE nests under mlp.deepspeed_moe; residual (PR-)MoE under
+    # mlp.moe.deepspeed_moe with a dense mlp.mlp branch + mlp.coefficient
+    # (reference megatron_gpt_moe.py:57-82 moe_type dispatch)
+    is_residual = any(".mlp.moe.deepspeed_moe." in k for k in sd)
+    is_moe = is_residual or any(".mlp.deepspeed_moe." in k for k in sd)
     if is_moe:
-        ex = f"{lp}.{{}}.mlp.deepspeed_moe.experts.deepspeed_experts.{{}}"
+        moe_root = "mlp.moe.deepspeed_moe" if is_residual else "mlp.deepspeed_moe"
+        ex = f"{lp}.{{}}.{moe_root}.experts.deepspeed_experts.{{}}"
 
         def has_expert(i):
             try:
@@ -159,12 +164,21 @@ def map_megatron_params(sd: Dict[str, np.ndarray], cfg, version=0) -> Dict[str, 
 
         mlp = {
             # torch Linear wg [E, D] → gate_w [D, E]
-            "gate_w": stack(lp + ".{}.mlp.deepspeed_moe.gate.wg.weight", tr=True),
+            "gate_w": stack(lp + ".{}." + moe_root + ".gate.wg.weight", tr=True),
             "w_up": estack(".dense_h_to_4h.weight", tr=True),
             "b_up": estack(".dense_h_to_4h.bias"),
             "w_down": estack(".dense_4h_to_h.weight", tr=True),
             "b_down": estack(".dense_4h_to_h.bias"),
         }
+        if is_residual:
+            mlp.update({
+                "res_w_up": stack(lp + ".{}.mlp.mlp.dense_h_to_4h.weight", tr=True),
+                "res_b_up": stack(lp + ".{}.mlp.mlp.dense_h_to_4h.bias"),
+                "res_w_down": stack(lp + ".{}.mlp.mlp.dense_4h_to_h.weight", tr=True),
+                "res_b_down": stack(lp + ".{}.mlp.mlp.dense_4h_to_h.bias"),
+                "coef_w": stack(lp + ".{}.mlp.coefficient.weight", tr=True),
+                "coef_b": stack(lp + ".{}.mlp.coefficient.bias"),
+            })
     else:
         mlp = {"w_up": stack(lp + ".{}.mlp.dense_h_to_4h.weight", tr=True),
                "b_up": stack(lp + ".{}.mlp.dense_h_to_4h.bias"),

@@ -451,12 +451,17 @@ class GPTNeoPolicy(HFPolicy):
                     f"window_size={window} (local == global there); longer "
                     "contexts need banded attention")
                 max_seq = window
+        act = {"gelu_new": "gelu", "gelu": "gelu_exact",
+               "relu": "relu"}.get(hf.get("activation_function", "gelu_new"))
+        if act is None:
+            raise ValueError(f"unsupported gpt_neo activation_function "
+                             f"{hf.get('activation_function')!r}")
         return TransformerConfig(
             vocab_size=hf["vocab_size"], n_layer=hf["num_layers"],
             n_head=hf["num_heads"], d_model=hf["hidden_size"],
             d_ff=hf.get("intermediate_size") or 4 * hf["hidden_size"],
             max_seq=max_seq, pos_embedding="learned", norm="layernorm",
-            activation="gelu", tie_embeddings=True, attn_bias=True,
+            activation=act, tie_embeddings=True, attn_bias=True,
             attn_scale=1.0, norm_eps=hf.get("layer_norm_epsilon", 1e-5))
 
     def map_params(self, raw_get, cfg):
@@ -511,12 +516,17 @@ class DistilBertPolicy(HFPolicy):
     model_type = "distilbert"
 
     def zoo_config(self, hf):
+        act = {"gelu": "gelu_exact", "relu": "relu"}.get(
+            hf.get("activation", "gelu"))
+        if act is None:
+            raise ValueError(f"unsupported distilbert activation "
+                             f"{hf.get('activation')!r}")
         return TransformerConfig(
             vocab_size=hf["vocab_size"], n_layer=hf["n_layers"],
             n_head=hf["n_heads"], d_model=hf["dim"], d_ff=hf["hidden_dim"],
             max_seq=hf.get("max_position_embeddings", 512),
             pos_embedding="learned", norm="layernorm", norm_position="post",
-            activation="gelu_exact", causal=False, attn_bias=True,
+            activation=act, causal=False, attn_bias=True,
             tie_embeddings=True, norm_eps=1e-12)
 
     def build_model(self, cfg, hf, params):
@@ -524,7 +534,8 @@ class DistilBertPolicy(HFPolicy):
         bc = BertConfig(vocab_size=cfg.vocab_size, max_seq=cfg.max_seq,
                         n_layer=cfg.n_layer, n_head=cfg.n_head,
                         d_model=cfg.d_model, d_ff=cfg.d_ff,
-                        type_vocab_size=1, norm_eps=1e-12)
+                        type_vocab_size=1, norm_eps=1e-12,
+                        activation=cfg.activation)
         return BertModel(bc, with_mlm_head="mlm" in params)
 
     def map_params(self, raw_get, cfg):

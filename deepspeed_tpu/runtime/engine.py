@@ -752,16 +752,7 @@ class DeepSpeedEngine:
         ``gas * micro_bs * dp_size`` (this process's share of the global
         batch), or pass ``data_iter`` yielding ``gas`` micro-batches of
         ``micro_bs * dp_size`` samples each."""
-        # compression scheduling: a CompressionScheduler transition changes
-        # what the model computes; compiled programs captured the OLD trace,
-        # so drop them when the wrapped model's epoch moved
-        epoch = getattr(self.client_model, "compression_epoch", None)
-        if epoch is not None and epoch != getattr(self, "_compression_epoch_seen", None):
-            if getattr(self, "_compression_epoch_seen", None) is not None:
-                self._train_batch_jit.clear()
-                self._grad_jit = self._apply_jit = self._eval_jit = None
-            self._compression_epoch_seen = epoch
-
+        self._check_compression_epoch()
         gas = self.gradient_accumulation_steps()
         micro_bs = self.train_micro_batch_size_per_gpu()
         dp = dist.get_world_size(dist.data_parallel_axes(self.mesh))
@@ -912,6 +903,18 @@ class DeepSpeedEngine:
         new_params = jax.device_put(new_params, self._param_shardings)
         self.state = self.state._replace(params=new_params)
 
+    def _check_compression_epoch(self) -> None:
+        """A CompressionScheduler transition changes what the model
+        computes; compiled programs captured the OLD trace, so drop them
+        when the wrapped model's epoch moved. Consulted on every public
+        compute entry (train_batch / forward / eval_batch / step)."""
+        epoch = getattr(self.client_model, "compression_epoch", None)
+        if epoch is not None and epoch != getattr(self, "_compression_epoch_seen", None):
+            if getattr(self, "_compression_epoch_seen", None) is not None:
+                self._train_batch_jit.clear()
+                self._grad_jit = self._apply_jit = self._eval_jit = None
+            self._compression_epoch_seen = epoch
+
     # ---- reference-shaped trio ---- #
 
     def forward(self, batch):
@@ -996,6 +999,7 @@ class DeepSpeedEngine:
         self._report_progress(metrics)
 
     def eval_batch(self, batch):
+        self._check_compression_epoch()
         if self._eval_jit is None:
             def eval_fn(params, b, rng):
                 out = self.loss_fn(params, b, rng)

@@ -88,10 +88,10 @@ class TestMoEServing:
         with pytest.raises(ValueError, match="no MoE layers"):
             deepspeed_tpu.init_inference(model, config={"moe": {"ep_size": 2}})
 
-    def test_residual_moe_type_raises(self):
+    def test_residual_type_on_standard_model_raises(self):
         model = _moe_model()
         params = model.init_params(jax.random.key(5))
-        with pytest.raises(NotImplementedError, match="residual"):
+        with pytest.raises(ValueError, match="is NOT a residual"):
             deepspeed_tpu.init_inference(
                 model, params=params,
                 config={"moe": {"ep_size": 2, "type": "residual"}})
@@ -189,12 +189,14 @@ class TestMoEGuards:
                                          config={"dtype": "fp32",
                                                  "moe": {"ep_size": 8}})
 
-    def test_residual_raises_even_without_ep(self):
+    def test_unknown_moe_type_rejected_at_config(self):
+        # MoETypeEnum admits only standard/residual: bogus types die in
+        # config validation before the engine ever sees them
         model = _moe_model()
         params = model.init_params(jax.random.key(10))
-        with pytest.raises(NotImplementedError, match="residual"):
+        with pytest.raises(Exception):
             deepspeed_tpu.init_inference(model, params=params,
-                                         config={"moe": {"type": "residual"}})
+                                         config={"moe": {"type": "bogus"}})
 
     def test_caller_model_not_mutated(self):
         model = _moe_model()
@@ -230,3 +232,89 @@ class TestMoEGuards2:
               if not ("layers.1.mlp.deepspeed_moe.experts" in k)}
         with pytest.raises(NotImplementedError, match="mixed dense/MoE"):
             map_megatron_params(sd, cfg, version=0)
+
+
+class TestResidualMoE:
+    """Residual (PR-)MoE, arXiv:2201.05596 (reference moe/layer.py
+    use_residual + moe_inference moe_type='residual')."""
+
+    def _model(self):
+        cfg = TransformerConfig(vocab_size=128, n_layer=2, n_head=4, d_model=32,
+                                d_ff=64, max_seq=32, remat=False)
+        return MoECausalLM(cfg, MoEConfig(num_experts=4, capacity_factor=2.0,
+                                          eval_capacity_factor=2.0,
+                                          expert_ff_mult=2, use_residual=True))
+
+    def test_trains(self):
+        import deepspeed_tpu
+        model = self._model()
+        params = model.init_params(jax.random.key(0))
+        assert "res_w_up" in params["layers"]["mlp"]
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "mesh": {"dp": 4, "ep": 2}, "steps_per_print": 0})
+        model.mesh = engine.mesh
+        batch = {"input_ids": np.asarray(
+            jax.random.randint(jax.random.key(1), (4, 32), 0, 128))}
+        losses = [float(engine.train_batch(batch)) for _ in range(4)]
+        assert losses[-1] < losses[0], losses
+        dist.set_mesh(None)
+
+    def test_serves_with_ep_and_matches_ep1(self):
+        model = self._model()
+        params = model.init_params(jax.random.key(2))
+        toks = np.asarray(jax.random.randint(jax.random.key(3), (2, 32), 0, 128))
+        eng1 = deepspeed_tpu.init_inference(
+            model, params=params,
+            config={"dtype": "fp32", "moe": {"type": "residual"}})
+        ref = np.asarray(eng1.forward(toks))
+        dist.set_mesh(None)
+        eng4 = deepspeed_tpu.init_inference(
+            model, params=params,
+            config={"dtype": "fp32", "moe": {"type": "residual", "ep_size": 4}})
+        out = np.asarray(eng4.forward(toks))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_type_mismatch_rejected_both_ways(self):
+        residual = self._model()
+        rp = residual.init_params(jax.random.key(4))
+        with pytest.raises(ValueError, match="IS a residual"):
+            deepspeed_tpu.init_inference(residual, params=rp,
+                                         config={"dtype": "fp32"})
+        dist.set_mesh(None)
+        standard = _moe_model()
+        sp = standard.init_params(jax.random.key(5))
+        with pytest.raises(ValueError, match="is NOT a residual"):
+            deepspeed_tpu.init_inference(
+                standard, params=sp,
+                config={"dtype": "fp32", "moe": {"type": "residual"}})
+
+    def test_megatron_residual_ingestion(self):
+        from deepspeed_tpu.module_inject.megatron import map_megatron_params
+        cfg = TransformerConfig(vocab_size=96, n_layer=2, n_head=4, d_model=32,
+                                max_seq=16, attn_bias=True, remat=False)
+        model = MoECausalLM(cfg, MoEConfig(num_experts=2, expert_ff_mult=2,
+                                           use_residual=True))
+        params = model.init_params(jax.random.key(6))
+        lay = params["layers"]
+        sd = TestMegatronMoEIngestion()._fake_sd(model, params)
+        # rewrite into the RESIDUAL naming: experts under mlp.moe.deepspeed_moe,
+        # dense branch under mlp.mlp, coefficient under mlp.coefficient
+        rsd = {}
+        for k, v in sd.items():
+            rsd[k.replace(".mlp.deepspeed_moe.", ".mlp.moe.deepspeed_moe.")] = v
+        for i in range(2):
+            pre = f"transformer.layers.{i}.mlp"
+            rsd[f"{pre}.mlp.dense_h_to_4h.weight"] = np.asarray(lay["mlp"]["res_w_up"][i]).T
+            rsd[f"{pre}.mlp.dense_h_to_4h.bias"] = np.asarray(lay["mlp"]["res_b_up"][i])
+            rsd[f"{pre}.mlp.dense_4h_to_h.weight"] = np.asarray(lay["mlp"]["res_w_down"][i]).T
+            rsd[f"{pre}.mlp.dense_4h_to_h.bias"] = np.asarray(lay["mlp"]["res_b_down"][i])
+            rsd[f"{pre}.coefficient.weight"] = np.asarray(lay["mlp"]["coef_w"][i]).T
+            rsd[f"{pre}.coefficient.bias"] = np.asarray(lay["mlp"]["coef_b"][i])
+        mapped = map_megatron_params(rsd, cfg, version=0)
+        for key in ("res_w_up", "res_b_up", "res_w_down", "res_b_down",
+                    "coef_w", "coef_b", "w_up", "gate_w"):
+            np.testing.assert_array_equal(np.asarray(mapped["layers"]["mlp"][key]),
+                                          np.asarray(lay["mlp"][key]), err_msg=key)
