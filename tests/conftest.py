@@ -20,6 +20,19 @@ if os.environ.get("DS_TPU_TESTS") != "1":
     # the TPU tier (pytest -m tpu, DS_TPU_TESTS=1) keeps the real device
     jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the suite compiles hundreds of
+# near-identical tiny programs; caching them across runs cuts repeat
+# wall-clock several-fold on this single-core box (first run pays full
+# compile cost). DS_TEST_NO_JAX_CACHE=1 opts out (e.g. when bisecting
+# lowering changes).
+if os.environ.get("DS_TEST_NO_JAX_CACHE") != "1":
+    _cache_dir = os.environ.get(
+        "DS_TEST_JAX_CACHE_DIR",
+        os.path.join(os.path.dirname(__file__), "..", ".jax_test_cache"))
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 import numpy as np
 import pytest
 

@@ -53,6 +53,7 @@ class TestPrune:
 
 
 class TestTune:
+    @pytest.mark.nightly
     def test_picks_best_and_writes_optimal_config(self, tmp_path, monkeypatch):
         cfg = AutotuningConfig(
             fast=False, zero_stages=[1], remat_policies=["none", "dots"],
@@ -95,6 +96,7 @@ class TestTune:
         assert best["train_micro_batch_size_per_gpu"] == 1
         assert len(measured) == 3  # best + 2 stale = early stop
 
+    @pytest.mark.nightly
     def test_measure_smoke_real_engine(self, tmp_path):
         """One real engine measurement end-to-end on CPU."""
         from deepspeed_tpu.autotuning.autotuner import Candidate

@@ -228,6 +228,7 @@ class TestActivationCheckpointing:
 
 class TestEngineCurriculum:
 
+    @pytest.mark.nightly
     def test_seqlen_truncation(self, devices):
         from deepspeed_tpu.models import CausalLM
         from deepspeed_tpu.models.transformer import TransformerConfig

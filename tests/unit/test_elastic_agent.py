@@ -32,6 +32,7 @@ def test_success_first_try(tmp_path):
     assert res.return_codes == [0, 0]
 
 
+@pytest.mark.nightly
 def test_restart_until_success(tmp_path):
     """Workers fail twice (shared counter file), then succeed; env carries
     the attempt number."""
@@ -59,6 +60,7 @@ def test_max_restarts_exceeded(tmp_path):
     assert 3 in res.return_codes
 
 
+@pytest.mark.nightly
 def test_scale_down_does_not_count_as_restart(tmp_path):
     """Capacity drops 4 → 2 after the first failure: the agent rescales to
     the largest elastic-valid world and the scale event is free."""
@@ -105,6 +107,7 @@ def test_no_admissible_world_fails(tmp_path):
     assert res.state == WorkerState.FAILED
 
 
+@pytest.mark.nightly
 def test_flapping_capacity_still_bounded(tmp_path):
     """A crashing job behind oscillating capacity cannot loop forever:
     only genuine scale-DOWNs are free attempts."""

@@ -84,8 +84,12 @@ class TestKVCacheDecode:
         base.update(over)
         return CausalLM(TransformerConfig(**base))
 
-    @pytest.mark.parametrize("style", ["gpt2", "llama", "alibi", "gqa", "gptj",
-                                       "neox_partial"])
+    @pytest.mark.parametrize("style", [
+        "gpt2", "gqa",
+        pytest.param("llama", marks=pytest.mark.nightly),
+        pytest.param("alibi", marks=pytest.mark.nightly),
+        pytest.param("gptj", marks=pytest.mark.nightly),
+        pytest.param("neox_partial", marks=pytest.mark.nightly)])
     def test_decode_logits_match_full_forward(self, style):
         over = {
             "gpt2": {},

@@ -183,6 +183,7 @@ class TestLocalLaunch:
             assert data["WORLD_SIZE"] == "2"
             assert data["RANK"] == str(rank)
 
+    @pytest.mark.nightly
     def test_failing_rank_kills_job(self, tmp_path):
         script = tmp_path / "worker.py"
         script.write_text(

@@ -47,7 +47,10 @@ class TestRematPolicies:
         loss, grads = jax.value_and_grad(lambda p: m.loss(p, b))(p)
         return p, b, loss, grads
 
-    @pytest.mark.parametrize("remat", [False, "dots", "selective", "offload_dots"])
+    @pytest.mark.parametrize("remat", [
+        pytest.param(False, marks=pytest.mark.nightly),
+        "dots", "selective",
+        pytest.param("offload_dots", marks=pytest.mark.nightly)])
     def test_loss_and_grad_parity(self, remat):
         p, b, ref_loss, ref_grads = self.reference()
         if remat == "offload_dots" and jax.default_backend() == "cpu":

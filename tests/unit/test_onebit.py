@@ -228,7 +228,10 @@ class TestOnebitEngine:
                                                    config=config)
         return engine
 
-    @pytest.mark.parametrize("opt", ["OneBitAdam", "ZeroOneAdam", "OneBitLamb"])
+    @pytest.mark.parametrize("opt", [
+        "OneBitAdam",
+        pytest.param("ZeroOneAdam", marks=pytest.mark.nightly),
+        pytest.param("OneBitLamb", marks=pytest.mark.nightly)])
     def test_trains_through_compression_phase(self, opt, devices):
         engine = self._engine(opt, devices)
         rng = np.random.default_rng(0)

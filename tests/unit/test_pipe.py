@@ -334,6 +334,7 @@ def test_1f1b_loss_and_grads_match_gpipe(devices):
         grads, ref_grads)
 
 
+@pytest.mark.nightly
 def test_1f1b_bounds_live_activations(devices):
     """The 1F1B scan's compiled memory stays bounded in the micro-batch
     count M, while differentiating the GPipe scan grows with M."""
@@ -367,6 +368,7 @@ def test_1f1b_bounds_live_activations(devices):
     assert g_1f1b < 2.0, f"1F1B memory grew {g_1f1b:.2f}x when M grew 4x"
 
 
+@pytest.mark.nightly
 def test_pipeline_engine_gpipe_schedule_still_works(devices):
     import deepspeed_tpu
     import deepspeed_tpu.comm as dist

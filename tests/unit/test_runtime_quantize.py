@@ -79,6 +79,7 @@ def test_low_bit_requires_symmetric_nearest():
         q.quantize_tree(p)  # drops 3->2, then ternary demands symmetric
 
 
+@pytest.mark.nightly
 def test_engine_moq_integration(devices):
     """quantize_training config wires the MoQ quantizer into train_batch
     (reference engine/fp16 quantizer hook)."""

@@ -346,6 +346,7 @@ def test_ulysses_chunking_exact_and_grad(sp_mesh, monkeypatch):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.nightly
 def test_three_axis_dp_sp_tp_composition(devices):
     """dp x sp x tp (2x2x2) training step: ring attention under the sp axis
     composes with TP-sharded weights and ZeRO-2 over dp — loss matches the
