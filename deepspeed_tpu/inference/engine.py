@@ -517,8 +517,16 @@ class InferenceEngine:
         if self._decode_jit is None:
             def prefill(params, toks, cache, last_idx):
                 # toks are RIGHT-padded to the bucket; junk cache slots are
-                # overwritten by decode or masked by causality
-                logits, cache = self.module.forward_cached(params, toks, cache, jnp.int32(0))
+                # overwritten by decode or masked by causality. MoE modules
+                # additionally get a validity mask so bucket padding never
+                # competes for expert capacity (top1 used_token)
+                kw = {}
+                if self._is_moe:
+                    kw["valid"] = (jnp.arange(toks.shape[1])[None, :]
+                                   <= last_idx).astype(jnp.float32)
+                    kw["valid"] = jnp.broadcast_to(kw["valid"], toks.shape)
+                logits, cache = self.module.forward_cached(
+                    params, toks, cache, jnp.int32(0), **kw)
                 return logits[:, last_idx, :].astype(jnp.float32), cache
 
             def sample(logits, rng, temperature, top_k):

@@ -100,8 +100,10 @@ class MoECausalLM:
 
     # -------------------- forward -------------------- #
 
-    def _moe_mlp(self, lp, x, rng, train: bool):
-        """x [B,S,D] → ([B,S,D], l_aux) via top-k expert routing."""
+    def _moe_mlp(self, lp, x, rng, train: bool, used_token=None):
+        """x [B,S,D] → ([B,S,D], l_aux) via top-k expert routing.
+        ``used_token`` [B*S] 1/0 keeps masked tokens out of capacity (top-1
+        only; the reference's top-2 gate has no mask either)."""
         moe = self.moe
         B, S, D = x.shape
         tokens = x.reshape(-1, D)
@@ -111,7 +113,7 @@ class MoECausalLM:
         cf = moe.capacity_factor if train else moe.eval_capacity_factor
         if moe.k == 1:
             l_aux, combine, dispatch, _ = top1gating(
-                logits, cf, moe.min_capacity, None,
+                logits, cf, moe.min_capacity, used_token,
                 moe.noisy_gate_policy if train else None, moe.drop_tokens, moe.use_rts, rng=rng)
         else:
             l_aux, combine, dispatch, _ = top2gating(logits, cf, moe.min_capacity,
@@ -170,6 +172,34 @@ class MoECausalLM:
         else:
             logits = x @ params["lm_head"]
         return logits, aux_total / cfg.n_layer
+
+    # -------------------- KV-cache serving path -------------------- #
+
+    def init_cache(self, batch_size: int, max_len: Optional[int] = None,
+                   dtype=jnp.bfloat16) -> Dict[str, Any]:
+        return T.init_kv_cache(self.config, batch_size, max_len, dtype)
+
+    def forward_cached(self, params, tokens, cache, pos, pad_bias=None,
+                       valid=None):
+        """Incremental MoE decode (reference DeepSpeedMoEInference serving,
+        ops/transformer/inference/moe_inference.py) on the shared cached
+        path with the MoE MLP slotted in: attention runs against the KV
+        cache, the MLP routes the step's tokens with eval capacity.
+        ``valid`` [B, T] (1 = real token) keeps prefill bucket PADDING out
+        of the expert-capacity competition (top1 used_token; top-2 has no
+        mask, same as the reference). Routing capacity is per call, so with
+        drop_tokens at tight capacity a decoded step can drop differently
+        than the same token inside one long forward — the reference's
+        per-forward capacity semantics."""
+        used = None if valid is None else valid.reshape(-1)
+
+        def moe_mlp_fn(cfg, x_normed, lp):
+            out, _ = self._moe_mlp(lp["mlp"], x_normed, None, train=False,
+                                   used_token=used)
+            return out
+
+        return T.forward_cached(self.config, params, tokens, cache, pos,
+                                pad_bias, mlp_fn=moe_mlp_fn)
 
     def loss(self, params, batch, rng=None):
         logits, aux = self.forward(params, batch["input_ids"], batch.get("attention_mask"),

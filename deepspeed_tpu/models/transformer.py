@@ -730,18 +730,21 @@ def cached_embed(cfg: TransformerConfig, params, tokens, pos, dtype):
 
 
 def cached_block(cfg: TransformerConfig, h, lp, ck, cv, positions, pos,
-                 pad_bias=None):
+                 pad_bias=None, mlp_fn=None):
     """ONE layer of the KV-cache path: pre-LN attention against + append to
     the layer's cache. Shared by the compiled scan in :func:`forward_cached`
     and ZeRO-Inference weight streaming (per-layer host→device loop,
-    ``inference/engine.py``)."""
+    ``inference/engine.py``). ``mlp_fn(cfg, x_normed, lp)`` overrides the
+    dense MLP (the MoE zoo passes its routed experts)."""
+    mfn = mlp_fn if mlp_fn is not None else (
+        lambda c, xx, lpp: mlp(c, xx, lpp["mlp"]))
     a, nck, ncv = _cached_attention(cfg, _norm(cfg, h, lp["ln_attn"]), lp["attn"],
                                     positions, pos, ck, cv, pad_bias)
     if cfg.parallel_residual:
-        m = mlp(cfg, _norm(cfg, h, lp["ln_mlp"]), lp["mlp"])
+        m = mfn(cfg, _norm(cfg, h, lp["ln_mlp"]), lp)
         return h + a + m, nck, ncv
     h = h + a
-    m = mlp(cfg, _norm(cfg, h, lp["ln_mlp"]), lp["mlp"])
+    m = mfn(cfg, _norm(cfg, h, lp["ln_mlp"]), lp)
     return h + m, nck, ncv
 
 
@@ -751,11 +754,12 @@ def cached_head(cfg: TransformerConfig, params, x):
     return x @ _head_weight(cfg, params) + _head_bias(params)
 
 
-def forward_cached(cfg: TransformerConfig, params, tokens, cache, pos, pad_bias=None):
+def forward_cached(cfg: TransformerConfig, params, tokens, cache, pos, pad_bias=None,
+                   mlp_fn=None):
     """tokens [B, T] (T static: prompt chunk or 1) attended against + appended
     to ``cache`` at offset ``pos`` ([] int32). Returns (logits [B, T, vocab],
     new cache). ``pad_bias`` [B, Smax] additive f32 masks cache slots of
-    left-padded prompts."""
+    left-padded prompts; ``mlp_fn`` see :func:`cached_block`."""
     if cfg.norm_position == "post":
         raise ValueError("norm_position='post' is not supported by the "
                          "KV-cache decode path (pre-LN only)")
@@ -763,7 +767,8 @@ def forward_cached(cfg: TransformerConfig, params, tokens, cache, pos, pad_bias=
 
     def run_block(h, xs):
         lp, ck, cv = xs
-        h, nck, ncv = cached_block(cfg, h, lp, ck, cv, positions, pos, pad_bias)
+        h, nck, ncv = cached_block(cfg, h, lp, ck, cv, positions, pos, pad_bias,
+                                   mlp_fn)
         return h, (nck, ncv)
 
     x, (nk, nv) = jax.lax.scan(run_block, x, (params["layers"], cache["k"], cache["v"]))
