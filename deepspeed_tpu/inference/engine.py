@@ -120,16 +120,6 @@ class InferenceEngine:
                 f"config.moe.ep_size={self._ep_size} but the model has no MoE "
                 "layers; remove the moe section or serve an MoE model")
         if self._is_moe:
-            from deepspeed_tpu.ops.quant import Quantized8 as _Q8
-            pre_quantized = any(isinstance(l, _Q8) for l in jax.tree.leaves(
-                params, is_leaf=lambda x: isinstance(x, _Q8)))
-            if self._weight_quant or pre_quantized:
-                # also catches pre-quantized trees (quantize-on-load), which
-                # would otherwise crash on a Quantized8 matmul operand deep
-                # inside the MoE forward trace
-                raise NotImplementedError(
-                    "int8 weight-only quantisation of MoE expert weights is not "
-                    "implemented; serve MoE models in bf16/fp16")
             n_experts = int(getattr(model.moe, "num_experts", 0))
             if self._ep_size > 1 and n_experts % self._ep_size:
                 raise ValueError(

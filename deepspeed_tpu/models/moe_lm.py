@@ -120,17 +120,18 @@ class MoECausalLM:
                                                      moe.drop_tokens, rng=rng)
 
         def expert(p, xe):
-            h = xe @ p["w_up"] + p["b_up"]
-            return jax.nn.gelu(h, approximate=True) @ p["w_down"] + p["b_down"]
+            # T._w dequantises int8 Quantized8 expert weights transparently
+            h = xe @ T._w(p["w_up"], xe) + p["b_up"]
+            return jax.nn.gelu(h, approximate=True) @ T._w(p["w_down"], xe) + p["b_down"]
 
         eps = {k: lp[k] for k in ("w_up", "b_up", "w_down", "b_down")}
         combined = dispatch_combine(tokens, combine, dispatch, expert, eps, mesh=self.mesh)
         if moe.use_residual:
             # PR-MoE blend (reference moe/layer.py:115-123): dense MLP +
             # 2-way softmax coefficient over [moe, dense]
-            h = jax.nn.gelu(tokens @ lp["res_w_up"] + lp["res_b_up"],
+            h = jax.nn.gelu(tokens @ T._w(lp["res_w_up"], tokens) + lp["res_b_up"],
                             approximate=True)
-            res = h @ lp["res_w_down"] + lp["res_b_down"]
+            res = h @ T._w(lp["res_w_down"], tokens) + lp["res_b_down"]
             coef = jax.nn.softmax(tokens @ lp["coef_w"] + lp["coef_b"], axis=-1)
             combined = combined * coef[..., 0:1] + res * coef[..., 1:2]
         return combined.reshape(B, S, D), l_aux
@@ -170,7 +171,7 @@ class MoECausalLM:
         if cfg.tie_embeddings:
             logits = x @ params["embed"]["tokens"].T
         else:
-            logits = x @ params["lm_head"]
+            logits = x @ T._w(params["lm_head"], x)
         return logits, aux_total / cfg.n_layer
 
     # -------------------- KV-cache serving path -------------------- #

@@ -67,7 +67,11 @@ def maybe_dequant(w: Any, dtype=jnp.bfloat16):
     return w
 
 
-_QUANTIZABLE = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+# res_* are the PR-MoE dense-branch projections; the tiny gate/coef
+# matrices stay dense (their cost is negligible and routing is
+# numerically sensitive)
+_QUANTIZABLE = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                "res_w_up", "res_w_down")
 
 
 def quantize_params(params, groups: int = 1, include_embed: bool = False):

@@ -21,7 +21,7 @@ from deepspeed_tpu.ops.quant import quantize_int8
 
 # zoo matmul leaves (under "layers"), mirroring ops.quant._QUANTIZABLE
 _ATTN_KEYS = ("wq", "wk", "wv", "wo")
-_MLP_KEYS = ("w_gate", "w_up", "w_down")
+_MLP_KEYS = ("w_gate", "w_up", "w_down", "res_w_up", "res_w_down")
 
 
 class WeightQuantization:

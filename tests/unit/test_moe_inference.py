@@ -96,12 +96,29 @@ class TestMoEServing:
                 model, params=params,
                 config={"moe": {"ep_size": 2, "type": "residual"}})
 
-    def test_int8_moe_raises(self):
+    def test_int8_moe_serves_close_to_fp32(self):
+        """int8 expert weights serve (the reject is gone): logits stay close
+        to fp32 and the expert weights really rest as Quantized8."""
+        from deepspeed_tpu.ops.quant import Quantized8
         model = _moe_model()
         params = model.init_params(jax.random.key(6))
-        with pytest.raises(NotImplementedError, match="int8"):
-            deepspeed_tpu.init_inference(model, params=params,
-                                         config={"dtype": "int8"})
+        toks = np.asarray(jax.random.randint(jax.random.key(7), (2, 32), 0, 128))
+        ref_eng = deepspeed_tpu.init_inference(model, params=params,
+                                               config={"dtype": "fp32"})
+        ref = np.asarray(ref_eng.forward(toks), np.float32)
+        dist.set_mesh(None)
+        eng = deepspeed_tpu.init_inference(
+            model, params=params,
+            config={"dtype": "int8", "quant": {"weight": {"q_groups": 8}},
+                    "moe": {"ep_size": 4}})
+        wq = eng.params["layers"]["mlp"]["w_up"]
+        assert isinstance(wq, Quantized8)
+        out = np.asarray(eng.forward(toks), np.float32)
+        assert np.abs(out - ref).max() < 0.2 * max(1.0, np.abs(ref).max())
+        # int8 experts also decode through the compiled KV-cache loop
+        gen = np.asarray(eng.generate(np.asarray([[5, 9, 2]], np.int32),
+                                      max_new_tokens=3))
+        assert gen.shape == (1, 6)
 
 
 class TestMegatronMoEIngestion:
@@ -212,13 +229,16 @@ class TestMoEGuards:
 
 class TestMoEGuards2:
 
-    def test_prequantized_moe_params_raise_clearly(self):
+    def test_prequantized_moe_params_serve(self):
         from deepspeed_tpu.ops.quant import quantize_params
         model = _moe_model()
-        params = quantize_params(model.init_params(jax.random.key(12)), groups=8)
-        with pytest.raises(NotImplementedError, match="int8"):
-            deepspeed_tpu.init_inference(model, params=params,
-                                         config={"dtype": "bf16"})
+        raw = model.init_params(jax.random.key(12))
+        params = quantize_params(raw, groups=8)
+        eng = deepspeed_tpu.init_inference(model, params=params,
+                                           config={"dtype": "fp32"})
+        toks = np.asarray(jax.random.randint(jax.random.key(13), (1, 32), 0, 128))
+        out = np.asarray(eng.forward(toks))
+        assert np.isfinite(out).all()
 
     def test_mixed_dense_moe_stacking_raises(self):
         from deepspeed_tpu.module_inject.megatron import map_megatron_params
@@ -391,3 +411,47 @@ def test_moe_prefill_padding_cannot_steal_capacity():
     logits, _ = model.forward(params, jnp.asarray(prompt), train=False)
     want = np.asarray(jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1))
     np.testing.assert_array_equal(out[:, 3], want)
+
+
+def test_int8_residual_moe_serves():
+    """int8 x residual (PR-)MoE: expert AND dense-branch weights rest
+    quantized; logits stay close to fp32 and generate decodes."""
+    from deepspeed_tpu.ops.quant import Quantized8
+    cfg = TransformerConfig(vocab_size=128, n_layer=2, n_head=4, d_model=32,
+                            d_ff=64, max_seq=32, remat=False)
+    model = MoECausalLM(cfg, MoEConfig(num_experts=4, capacity_factor=2.0,
+                                       eval_capacity_factor=2.0,
+                                       expert_ff_mult=2, use_residual=True))
+    params = model.init_params(jax.random.key(20))
+    toks = np.asarray(jax.random.randint(jax.random.key(21), (1, 32), 0, 128))
+    ref_eng = deepspeed_tpu.init_inference(
+        model, params=params,
+        config={"dtype": "fp32", "moe": {"type": "residual"}})
+    ref = np.asarray(ref_eng.forward(toks), np.float32)
+    dist.set_mesh(None)
+    eng = deepspeed_tpu.init_inference(
+        model, params=params,
+        config={"dtype": "int8", "quant": {"weight": {"q_groups": 8}},
+                "moe": {"type": "residual", "ep_size": 4}})
+    assert isinstance(eng.params["layers"]["mlp"]["res_w_up"], Quantized8)
+    out = np.asarray(eng.forward(toks), np.float32)
+    assert np.abs(out - ref).max() < 0.2 * max(1.0, np.abs(ref).max())
+    gen = np.asarray(eng.generate(np.asarray([[3, 1, 4]], np.int32),
+                                  max_new_tokens=3))
+    assert gen.shape == (1, 6)
+
+
+def test_int8_untied_moe_forward():
+    """tie_embeddings=False quantizes lm_head: the MoE full forward must
+    dequant it (x @ T._w), not crash on the Quantized8 operand."""
+    cfg = TransformerConfig(vocab_size=128, n_layer=1, n_head=4, d_model=32,
+                            d_ff=64, max_seq=32, remat=False,
+                            tie_embeddings=False)
+    model = MoECausalLM(cfg, MoEConfig(num_experts=2, expert_ff_mult=2,
+                                       eval_capacity_factor=2.0))
+    params = model.init_params(jax.random.key(22))
+    eng = deepspeed_tpu.init_inference(
+        model, params=params,
+        config={"dtype": "int8", "quant": {"weight": {"q_groups": 8}}})
+    out = np.asarray(eng.forward(np.asarray([[1, 2, 3]], np.int32)))
+    assert np.isfinite(out).all()
