@@ -30,7 +30,7 @@ if os.environ.get("DS_TEST_NO_JAX_CACHE") != "1":
         "DS_TEST_JAX_CACHE_DIR",
         os.path.join(os.path.dirname(__file__), "..", ".jax_test_cache"))
     jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 import numpy as np

@@ -76,6 +76,7 @@ def _tiny_cfg():
         max_position_embeddings=32, type_vocab_size=2)
 
 
+@pytest.mark.nightly
 def test_bert_hidden_and_pooled_match_transformers():
     cfg_hf = _tiny_cfg()
     torch.manual_seed(0)

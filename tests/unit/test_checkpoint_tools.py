@@ -36,8 +36,9 @@ def _tiny_engine(tmp_path, stage=1):
     return engine, model
 
 
-@pytest.fixture
-def saved_checkpoint(tmp_path, devices):
+@pytest.fixture(scope="module")
+def saved_checkpoint(tmp_path_factory, devices):
+    tmp_path = tmp_path_factory.mktemp("ckpt_fixture")
     engine, model = _tiny_engine(tmp_path)
     batch = {"input_ids": np.random.default_rng(0).integers(0, 64, (8, 16)).astype(np.int32)}
     engine.train_batch(batch)

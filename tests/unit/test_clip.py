@@ -93,6 +93,7 @@ def test_vision_encoder_shapes_and_finite():
     assert bool(jnp.isfinite(hidden).all()) and bool(jnp.isfinite(pooled).all())
 
 
+@pytest.mark.nightly
 def test_ds_clip_encoder_jitted_branches():
     text = CLIPTextEncoder(CLIPTextConfig(
         vocab_size=50, max_seq=8, n_layer=1, n_head=2, d_model=16, d_ff=32))

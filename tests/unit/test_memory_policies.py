@@ -93,7 +93,8 @@ class TestLossChunk:
         dist.set_mesh(None)
         yield
 
-    @pytest.mark.parametrize("chunk", [32, 64])
+    @pytest.mark.parametrize("chunk", [
+        32, pytest.param(64, marks=pytest.mark.nightly)])
     def test_chunked_ce_matches_unchunked(self, chunk):
         b = batch()
         m0 = tiny(remat=False, loss_chunk=0)
