@@ -10,10 +10,35 @@ framework exceeds the reference's best published hardware efficiency class.
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 
+def _probe_backend(timeout_s: int = 240):
+    """Probe device init in a SUBPROCESS: a dead TPU relay hangs backend
+    setup indefinitely inside C++ (uninterruptible in-process), which would
+    hang the whole bench run. A bounded probe fails fast instead. Returns
+    None on success, else a diagnostic string."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return (f"device backend did not initialize within {timeout_s}s "
+                "(hung init — TPU relay down?)")
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-15:]
+        return "device backend init failed:\n" + "\n".join(tail)
+    return None
+
+
 def main():
+    if os.environ.get("BENCH_SKIP_PROBE") != "1":
+        err = _probe_backend()
+        if err is not None:
+            print(f"bench: {err}", file=sys.stderr)
+            sys.exit(1)
     import jax
     import jax.numpy as jnp
     import numpy as np

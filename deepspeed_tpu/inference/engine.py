@@ -172,10 +172,6 @@ class InferenceEngine:
         self._stream_nvme = off_dev == "nvme"
         if self._stream_nvme and not off.get("nvme_path"):
             raise ValueError("offload_param device='nvme' requires nvme_path")
-        if self._stream_weights and tp_size > 1:
-            raise NotImplementedError(
-                "ZeRO-Inference weight streaming with tensor_parallel.tp_size > 1 "
-                "is not implemented; stream on tp_size=1 (dp replicas are fine)")
         if self._stream_weights and not (hasattr(model, "config")
                                          and "layers" in params):
             raise ValueError("weight streaming needs a zoo-layout model "
@@ -219,6 +215,23 @@ class InferenceEngine:
                              for a in jax.tree.leaves(lp))
             self._n_stream_layers = L
             self._swapper = None
+            # streaming x TP: the per-layer H2D copy lands SHARDED (each chip
+            # receives its slice of the layer; XLA partitions the block step
+            # and inserts the TP collectives). Non-layer params (embed/head)
+            # stay replicated — they are small next to the layer stack.
+            self._layer_put_shardings = None
+            if tp_size > 1 and tp_specs is not None and "layers" in tp_specs:
+                from deepspeed_tpu.ops.quant import quantized_shardings
+                drop_lead = lambda s: P(*list(s)[1:])  # unstack the layer dim
+                per_layer = jax.tree.map(drop_lead, tp_specs["layers"],
+                                         is_leaf=lambda x: isinstance(x, P))
+                self._layer_put_shardings = quantized_shardings(
+                    self._host_layers[0], per_layer, self.mesh)
+            elif tp_size > 1:
+                logger.warning(
+                    "weight streaming with tp_size>1 but no per-layer TP "
+                    "specs: layers stream REPLICATED (no memory split or "
+                    "speedup from the tp axis)")
             if self._stream_nvme:
                 # leaves ride as raw bytes (dtype restored from in-memory
                 # metadata — bf16 has no stable numpy dtype_str round-trip).
@@ -322,6 +335,13 @@ class InferenceEngine:
     # ------------------------------------------------------------------ #
     # ZeRO-Inference weight streaming: one layer on device at a time
 
+    def _put_layer(self, lp):
+        """H2D copy of one layer's weights — TP-sharded when serving tp>1
+        (each chip receives its slice), replicated otherwise."""
+        if self._layer_put_shardings is None:
+            return jax.device_put(lp)
+        return jax.device_put(lp, self._layer_put_shardings)
+
     def _fetch_layer(self, i: int):
         """Layer i's weight tree on host: RAM list (cpu mode) or an aio
         read from NVMe into pooled aligned buffers (nvme mode)."""
@@ -368,9 +388,9 @@ class InferenceEngine:
         # issuing the next copy before dispatching blk overlaps H2D with
         # compute (the dominant cost split of ZeRO-Inference decode)
         n = self._n_stream_layers
-        nxt = jax.device_put(self._fetch_layer(0))
+        nxt = self._put_layer(self._fetch_layer(0))
         for i in range(n):
-            lp, nxt = nxt, (jax.device_put(self._fetch_layer(i + 1))
+            lp, nxt = nxt, (self._put_layer(self._fetch_layer(i + 1))
                             if i + 1 < n else None)
             x, nk, nv = blk(x, lp, caches[i]["k"], caches[i]["v"],
                             positions, pos, pad_bias)
