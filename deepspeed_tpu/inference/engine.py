@@ -314,12 +314,21 @@ class InferenceEngine:
         """Full-sequence forward → logits."""
         input_ids = jnp.asarray(input_ids, jnp.int32)
         if self._stream_weights:
-            if attention_mask is not None:
-                raise NotImplementedError("attention_mask with weight streaming")
             if input_ids.ndim == 1:
                 input_ids = input_ids[None, :]
+            pad_bias = None
+            if attention_mask is not None:
+                # [B, S] 1=keep mask → additive key-side bias over the cache
+                # slots (the streamed blocks' pad_bias contract); the single
+                # mask→bias producer shared by every attention path
+                from deepspeed_tpu.models.transformer import key_mask_bias
+                mask = jnp.asarray(attention_mask)
+                if mask.ndim == 1:
+                    mask = mask[None, :]
+                pad_bias = key_mask_bias(mask)
             caches = self._stream_caches(input_ids.shape[0], input_ids.shape[1])
-            logits, _ = self._streamed_step(input_ids, caches, jnp.int32(0))
+            logits, _ = self._streamed_step(input_ids, caches, jnp.int32(0),
+                                            pad_bias=pad_bias)
             return logits
         if self._fwd_jit is None:
             fwd = self.module.forward if hasattr(self.module, "forward") else self.module

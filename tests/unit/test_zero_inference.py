@@ -228,3 +228,25 @@ def test_streaming_tp_composes_with_quant_and_nvme(mode, tmp_path):
     else:
         np.testing.assert_allclose(got[:, :10], want[:, :10],
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_streamed_forward_with_attention_mask():
+    """attention_mask now flows into the streamed path as the cache-slot
+    pad bias; logits match the resident engine under the same mask."""
+    model = _model()
+    params = model.init_params(jax.random.key(0))
+    base, eng = _engines(model, params)
+    toks = jnp.asarray(np.random.default_rng(7).integers(0, 64, (2, 10)),
+                       jnp.int32)
+    mask = np.ones((2, 10), np.int32)
+    mask[0, :3] = 0   # left-padded row
+    want = np.asarray(base.forward(toks, attention_mask=mask), np.float32)
+    got = np.asarray(eng.forward(toks, attention_mask=mask), np.float32)
+    # rows/positions whose visible keys are all masked are degenerate; row 0
+    # positions >=3 and all of row 1 are well-defined
+    np.testing.assert_allclose(got[0, 3:10], want[0, 3:10], rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(got[1, :10], want[1, :10], rtol=2e-4, atol=2e-4)
+    # 1-D prompt + 1-D mask broadcast together (no deep IndexError)
+    one = np.asarray(eng.forward(jnp.asarray(toks[0]), attention_mask=mask[0]),
+                     np.float32)
+    np.testing.assert_allclose(one[0, 3:10], got[0, 3:10], rtol=1e-5, atol=1e-5)
