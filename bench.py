@@ -33,14 +33,11 @@ def _probe_backend(timeout_s: int = 240):
     return None
 
 
-def main():
-    if os.environ.get("BENCH_SKIP_PROBE") != "1":
-        err = _probe_backend()
-        if err is not None:
-            print(f"bench: {err}", file=sys.stderr)
-            sys.exit(1)
+def build_bench_engine():
+    """The bench's env knobs → (engine, model, batch_fn, knobs dict). Shared
+    with benchmarks/profile_bench.py so the profile always measures the
+    exact configuration the bench reports."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     import deepspeed_tpu
@@ -49,7 +46,6 @@ def main():
 
     BATCH = int(os.environ.get("BENCH_BATCH", 32))
     SEQ = int(os.environ.get("BENCH_SEQ", 1024))
-    STEPS = int(os.environ.get("BENCH_STEPS", 10))
 
     # Memory/speed knobs (see models/transformer.py): the default is the
     # tuned fast path — "dots" remat (save matmul outputs, recompute the
@@ -83,17 +79,35 @@ def main():
 
     rng = np.random.default_rng(0)
 
-    def batch(seed):
+    def batch_fn():
         return {"input_ids": rng.integers(0, 50257, size=(BATCH, SEQ)).astype(np.int32)}
+
+    return engine, model, batch_fn, dict(BATCH=BATCH, SEQ=SEQ,
+                                         remat_env=remat_env,
+                                         LOSS_CHUNK=LOSS_CHUNK)
+
+
+def main():
+    if os.environ.get("BENCH_SKIP_PROBE") != "1":
+        err = _probe_backend()
+        if err is not None:
+            print(f"bench: {err}", file=sys.stderr)
+            sys.exit(1)
+    import jax
+
+    STEPS = int(os.environ.get("BENCH_STEPS", 10))
+    engine, model, batch, knobs = build_bench_engine()
+    BATCH, SEQ = knobs["BATCH"], knobs["SEQ"]
+    remat_env, LOSS_CHUNK = knobs["remat_env"], knobs["LOSS_CHUNK"]
 
     # warmup/compile; float() forces a host fetch — the only reliable sync
     # point over remote-tunnel device transports (block_until_ready/
     # effects_barrier return before remote execution finishes)
-    float(engine.train_batch(batch(0)))
+    float(engine.train_batch(batch()))
 
     t0 = time.perf_counter()
-    for i in range(STEPS):
-        loss = engine.train_batch(batch(i + 1))
+    for _ in range(STEPS):
+        loss = engine.train_batch(batch())
     loss_val = float(loss)  # chained state => this syncs every step
     dt = time.perf_counter() - t0
 

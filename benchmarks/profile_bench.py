@@ -1,0 +1,87 @@
+"""Profile the bench training step: where the non-MFU time goes.
+
+Runs the same engine/config as ``bench.py`` and prints a cost breakdown
+two ways:
+
+1. XLA's AOT cost analysis of the compiled train step (flops / bytes
+   accessed / estimated optimal seconds) — available everywhere;
+2. a ``jax.profiler`` device trace (written to ``--trace-dir``, viewable
+   in TensorBoard / Perfetto) — meaningful on real hardware.
+
+Usage::
+
+    python benchmarks/profile_bench.py [--steps 5] [--trace-dir /tmp/ds_trace]
+
+Knobs are bench.py's env vars (BENCH_BATCH/SEQ/REMAT/LOSS_CHUNK/OPT...).
+This feeds the PARITY.md perf breakdown (VERDICT r3 ask 1: remat
+recompute vs loss chunking vs optimizer vs input pipeline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--trace-dir", default=None,
+                    help="write a jax.profiler trace here (TPU: perfetto/TB)")
+    args = ap.parse_args()
+
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from bench import _probe_backend, build_bench_engine
+
+    if os.environ.get("BENCH_SKIP_PROBE") != "1":
+        err = _probe_backend()
+        if err is not None:
+            print(f"profile_bench: {err}", file=sys.stderr)
+            sys.exit(1)
+
+    import jax
+    import jax.numpy as jnp
+
+    engine, model, batch, knobs = build_bench_engine()
+    BATCH, SEQ = knobs["BATCH"], knobs["SEQ"]
+
+    # ---- 1. AOT cost analysis of the compiled step ----
+    float(engine.train_batch(batch()))  # compile
+    cost = None
+    try:
+        fn = next(iter(engine._train_batch_jit.values()))
+        # the compiled step takes the batch stacked [gas, B, ...] (gas=1)
+        b = jax.tree.map(lambda x: jnp.asarray(x)[None], batch())
+        cost = fn.lower(engine.state, b,
+                        jax.random.key(0)).compile().cost_analysis()
+    except Exception as e:  # layout varies across jax versions
+        print(f"cost_analysis unavailable: {type(e).__name__}: {e}")
+    if cost:
+        ca = cost[0] if isinstance(cost, (list, tuple)) else cost
+        wanted = {k: ca[k] for k in ("flops", "bytes accessed",
+                                     "optimal_seconds") if k in ca}
+        print(json.dumps(wanted, indent=2, default=float))
+
+    # ---- 2. wall-clock + optional device trace ----
+    t0 = time.perf_counter()
+    if args.trace_dir:
+        with jax.profiler.trace(args.trace_dir):
+            for _ in range(args.steps):
+                loss = engine.train_batch(batch())
+            float(loss)
+        print(f"trace written to {args.trace_dir}")
+    else:
+        for _ in range(args.steps):
+            loss = engine.train_batch(batch())
+        float(loss)
+    dt = (time.perf_counter() - t0) / args.steps
+    toks = BATCH * SEQ / dt
+    print(json.dumps({"seconds_per_step": round(dt, 4),
+                      "tokens_per_sec": round(toks, 1)}))
+
+
+if __name__ == "__main__":
+    main()
