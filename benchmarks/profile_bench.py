@@ -27,10 +27,13 @@ import time
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--steps", type=int, default=5,
+                    help="timed steps (>= 1)")
     ap.add_argument("--trace-dir", default=None,
                     help="write a jax.profiler trace here (TPU: perfetto/TB)")
     args = ap.parse_args()
+    if args.steps < 1:
+        ap.error("--steps must be >= 1")
 
     import sys
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))

@@ -114,7 +114,12 @@ class MoECausalLM:
         if moe.k == 1:
             l_aux, combine, dispatch, _ = top1gating(
                 logits, cf, moe.min_capacity, used_token,
-                moe.noisy_gate_policy if train else None, moe.drop_tokens, moe.use_rts, rng=rng)
+                moe.noisy_gate_policy if train else None, moe.drop_tokens,
+                # RTS is a TRAINING regularizer: eval/serving routes
+                # deterministically (positional capacity priority), matching
+                # the reference's inference kernels — and without the
+                # no-rng fallback warning in every serving process
+                moe.use_rts and train, rng=rng)
         else:
             l_aux, combine, dispatch, _ = top2gating(logits, cf, moe.min_capacity,
                                                      moe.drop_tokens, rng=rng)

@@ -204,7 +204,9 @@ class TopKGate:
         if self.k == 1:
             return top1gating(logits, cf, self.min_capacity, used_token,
                               self.noisy_gate_policy if train else None,
-                              self.drop_tokens, self.use_rts, rng=rng)
+                              # RTS is a training regularizer: eval routes
+                              # deterministically (reference inference kernels)
+                              self.drop_tokens, self.use_rts and train, rng=rng)
         return top2gating(logits, cf, self.min_capacity, self.drop_tokens, rng=rng)
 
 
