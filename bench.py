@@ -96,6 +96,9 @@ def main():
     import jax
 
     STEPS = int(os.environ.get("BENCH_STEPS", 10))
+    if STEPS < 1:
+        print("bench: BENCH_STEPS must be >= 1", file=sys.stderr)
+        sys.exit(1)
     engine, model, batch, knobs = build_bench_engine()
     BATCH, SEQ = knobs["BATCH"], knobs["SEQ"]
     remat_env, LOSS_CHUNK = knobs["remat_env"], knobs["LOSS_CHUNK"]

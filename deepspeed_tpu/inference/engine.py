@@ -221,10 +221,15 @@ class InferenceEngine:
             # stay replicated — they are small next to the layer stack.
             self._layer_put_shardings = None
             if tp_size > 1 and tp_specs is not None and "layers" in tp_specs:
-                from deepspeed_tpu.ops.quant import quantized_shardings
+                from deepspeed_tpu.ops.quant import (align_quant_groups,
+                                                     quantized_shardings)
                 drop_lead = lambda s: P(*list(s)[1:])  # unstack the layer dim
                 per_layer = jax.tree.map(drop_lead, tp_specs["layers"],
                                          is_leaf=lambda x: isinstance(x, P))
+                # regroup int8 scales (lossless subdivision) so the quant
+                # axis stays sharded even when q_groups % tp != 0
+                self._host_layers = [align_quant_groups(lp, per_layer, self.mesh)
+                                     for lp in self._host_layers]
                 self._layer_put_shardings = quantized_shardings(
                     self._host_layers[0], per_layer, self.mesh)
             elif tp_size > 1:
@@ -242,8 +247,13 @@ class InferenceEngine:
                 from deepspeed_tpu.runtime.swap_tensor.async_swapper import \
                     AsyncTensorSwapper
                 os.makedirs(str(off.get("nvme_path")), exist_ok=True)
+                self._sweep_stale_swap_dirs(str(off.get("nvme_path")))
                 swap_dir = tempfile.mkdtemp(dir=str(off.get("nvme_path")),
                                             prefix="zero_inference_")
+                # ownership marker: lets a future engine init reclaim this
+                # model-sized footprint if we die without running finalizers
+                with open(os.path.join(swap_dir, "owner.pid"), "w") as f:
+                    f.write(self._owner_marker())
                 self._swapper = AsyncTensorSwapper(swap_dir)
                 # swap files are engine-lifetime caches of a model-sized
                 # footprint: reclaim them on engine GC / interpreter exit
@@ -272,17 +282,19 @@ class InferenceEngine:
                      else "resident on host")
             log_dist(f"ZeRO-Inference streaming: {L} layers "
                      f"({host_bytes / 2**20:.0f} MiB) {where}; device "
-                     "holds one layer at a time", ranks=[0])
+                     "holds two layers at a time (double-buffered)", ranks=[0])
 
         # quantized param trees (int8 config or quantize-on-load) carry
         # Quantized8 nodes: their payload+scale shardings are derived
         # together so group boundaries align with TP shard boundaries
         # (reference GroupQuantizer x TP slicing, replace_module.py:42-135)
-        from deepspeed_tpu.ops.quant import Quantized8, quantized_shardings
+        from deepspeed_tpu.ops.quant import (Quantized8, align_quant_groups,
+                                             quantized_shardings)
         has_quant_nodes = any(isinstance(l, Quantized8) for l in jax.tree.leaves(
             params, is_leaf=lambda x: isinstance(x, Quantized8)))
         if tp_specs is not None and not self._stream_weights \
                 and (self._weight_quant or has_quant_nodes):
+            params = align_quant_groups(params, tp_specs, self.mesh)
             shardings = quantized_shardings(params, tp_specs, self.mesh)
         elif tp_specs is not None and not self._stream_weights:
             from deepspeed_tpu.runtime.zero.partition import ZeroShardingRules
@@ -344,6 +356,55 @@ class InferenceEngine:
     # ------------------------------------------------------------------ #
     # ZeRO-Inference weight streaming: one layer on device at a time
 
+    @staticmethod
+    def _owner_marker() -> str:
+        """``hostname:boot_id:pid_ns:pid`` — a pid is only meaningful inside
+        its own host + boot + pid namespace (two containers can share a
+        mount, a hostname, AND a boot id), so the liveness probe below
+        refuses to judge markers from any other scope."""
+        try:
+            boot = open("/proc/sys/kernel/random/boot_id").read().strip()
+        except OSError:  # non-Linux: no boot id, host scoping still applies
+            boot = "-"
+        try:
+            pidns = os.readlink("/proc/self/ns/pid")  # e.g. pid:[4026531836]
+        except OSError:
+            pidns = "-"
+        import socket
+        return f"{socket.gethostname()}:{boot}:{pidns}:{os.getpid()}"
+
+    @classmethod
+    def _sweep_stale_swap_dirs(cls, nvme_path: str) -> None:
+        """Reclaim zero_inference_* dirs whose owning process is gone. The
+        weakref finalizer cleans up on normal exit, but a SIGKILLed process
+        leaks a model-sized footprint; each dir carries an ``owner.pid``
+        marker so the next engine init under the same nvme_path can sweep.
+        Dirs owned by another host/boot/pid-namespace scope are never
+        touched — os.kill(pid, 0) can't see across pid namespaces, so 'not
+        found' outside our exact scope proves nothing."""
+        import shutil
+        me_scope, _ = cls._owner_marker().rsplit(":", 1)
+        for name in os.listdir(nvme_path):
+            d = os.path.join(nvme_path, name)
+            if not (name.startswith("zero_inference_") and os.path.isdir(d)):
+                continue
+            try:
+                marker = open(os.path.join(d, "owner.pid")).read().strip()
+                scope, pid = marker.rsplit(":", 1)
+                pid = int(pid)
+            except (OSError, ValueError):
+                continue  # pre-marker dir or mid-creation: leave it alone
+            if scope != me_scope or pid == os.getpid():
+                continue
+            try:
+                os.kill(pid, 0)  # signal 0: existence probe only
+            except ProcessLookupError:
+                logger.warning(f"sweeping stale ZeRO-Inference swap dir {d} "
+                               f"(owner pid {pid} is dead)")
+                shutil.rmtree(d, ignore_errors=True)
+            except OSError:
+                pass  # pid alive but not ours (EPERM): leave it alone
+
     def _put_layer(self, lp):
         """H2D copy of one layer's weights — TP-sharded when serving tp>1
         (each chip receives its slice), replicated otherwise."""
@@ -351,16 +412,26 @@ class InferenceEngine:
             return jax.device_put(lp)
         return jax.device_put(lp, self._layer_put_shardings)
 
-    def _fetch_layer(self, i: int):
-        """Layer i's weight tree on host: RAM list (cpu mode) or an aio
-        read from NVMe into pooled aligned buffers (nvme mode)."""
+    def _fetch_submit(self, i: int):
+        """Kick off layer i's NVMe reads on the aio thread pool and return a
+        handle; the data is NOT ready until :meth:`_fetch_finish`. RAM mode
+        has nothing to overlap, so the handle is just the index."""
         if self._swapper is None:
-            return self._host_layers[i]
+            return i
         treedef, metas = self._layer_meta[i]
-        # submit ALL of the layer's reads, then one barrier — per-leaf
-        # blocking swap_in would serialize the aio thread pool
+        # submit ALL of the layer's reads, then one barrier (in finish) —
+        # per-leaf blocking swap_in would serialize the aio thread pool
         bufs = [self._swapper.swap_in(key, async_op=True)
                 for key, _, _ in metas]
+        return (treedef, metas, bufs)
+
+    def _fetch_finish(self, handle):
+        """Barrier the reads submitted by :meth:`_fetch_submit` and build the
+        layer's weight tree. The swapper's wait() is global, so the caller
+        must finish one submit before issuing the next."""
+        if self._swapper is None:
+            return self._host_layers[handle]
+        treedef, metas, bufs = handle
         self._swapper.wait()
         leaves = []
         for buf, (key, shape, dtype) in zip(bufs, metas):
@@ -393,14 +464,24 @@ class InferenceEngine:
             self._stream_jits = (emb, blk, head)
         emb, blk, head = self._stream_jits
         x, positions = emb(self.params, tokens, pos)
-        # prefetch layer i+1 while layer i computes: device_put is async, so
-        # issuing the next copy before dispatching blk overlaps H2D with
-        # compute (the dominant cost split of ZeRO-Inference decode)
+        # double-buffered layer pipeline (reference analogue:
+        # pipelined_optimizer_swapper.py's read-ahead): while blk(i) runs on
+        # device, layer i+1's H2D copy is in flight (device_put is async) and
+        # layer i+2's NVMe reads ride the aio thread pool — I/O, H2D and
+        # compute all overlap at the cost of two layers resident on device.
         n = self._n_stream_layers
-        nxt = self._put_layer(self._fetch_layer(0))
+        pending = self._fetch_submit(0)
+        host0 = self._fetch_finish(pending)
+        pending = self._fetch_submit(1) if n > 1 else None
+        nxt = self._put_layer(host0)
         for i in range(n):
-            lp, nxt = nxt, (self._put_layer(self._fetch_layer(i + 1))
-                            if i + 1 < n else None)
+            lp, nxt = nxt, None
+            if i + 1 < n:
+                # finish i+1's NVMe reads (hidden behind blk(i-1)), queue
+                # i+2's, and start i+1's H2D — all before dispatching blk(i)
+                host = self._fetch_finish(pending)
+                pending = self._fetch_submit(i + 2) if i + 2 < n else None
+                nxt = self._put_layer(host)
             x, nk, nv = blk(x, lp, caches[i]["k"], caches[i]["v"],
                             positions, pos, pad_bias)
             caches[i] = {"k": nk, "v": nv}

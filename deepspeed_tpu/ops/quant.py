@@ -20,6 +20,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @jax.tree_util.register_dataclass
@@ -99,6 +100,61 @@ def tree_nbytes(params) -> int:
     return sum(l.nbytes for l in jax.tree.leaves(params))
 
 
+def _mesh_axis_size(mesh, entry) -> int:
+    import math
+    axes = entry if isinstance(entry, tuple) else (entry,)
+    return math.prod(mesh.shape.get(a, 1) for a in axes)
+
+
+def _pruned_spec(mesh, shape, spec):
+    """Sanitized per-dim spec entries, padded with None to the array rank."""
+    from deepspeed_tpu.runtime.zero.partition import sanitize_tp_spec
+    out = list(sanitize_tp_spec(mesh, shape, spec) or ())
+    return out + [None] * (len(shape) - len(out))
+
+
+def align_quant_groups(params, tp_specs, mesh):
+    """Subdivide Quantized8 scales so group boundaries align with the TP
+    shard boundaries wherever the payload allows it.
+
+    Splitting a quantisation group into ``r`` equal children with the parent's
+    scale is numerically a no-op for dequantisation, so when the tp axis size
+    does not divide ``q_groups`` the scales are repeated up to
+    ``lcm(q_groups, axis)`` — keeping the quant axis SHARDED instead of hitting
+    :func:`quantized_shardings`'s replicate fallback (a silent perf cliff the
+    reference never has: its GroupQuantizer regroups at partition time,
+    ``replace_module.py:42-135``).
+
+    For any payload the sanitizer lets shard (axis | last) with any valid
+    group count (groups | last), the lcm also divides the axis — alignment
+    always succeeds; the untouched branch below is a safety guard for
+    hand-built leaves that violate the quantize_int8 invariant."""
+    import math
+
+    from jax.sharding import PartitionSpec as P
+
+    def one(leaf, spec):
+        if not isinstance(leaf, Quantized8):
+            return leaf
+        qs = _pruned_spec(mesh, leaf.q.shape, P() if spec is None else spec)
+        n = _mesh_axis_size(mesh, qs[-1]) if qs[-1] is not None else 1
+        groups = leaf.scale.shape[-1]
+        if n <= 1 or groups % n == 0:
+            return leaf
+        g2 = groups * n // math.gcd(groups, n)
+        if leaf.q.shape[-1] % g2:
+            return leaf          # genuinely indivisible: fallback handles it
+        r = g2 // groups
+        rep = np.repeat if isinstance(leaf.scale, np.ndarray) else jnp.repeat
+        return Quantized8(q=leaf.q, scale=rep(leaf.scale, r, axis=-1))
+
+    return jax.tree.map(one, params, tp_specs,
+                        is_leaf=lambda x: isinstance(x, Quantized8))
+
+
+_warned_misaligned: set = set()
+
+
 def quantized_shardings(params, tp_specs, mesh):
     """Sharding tree for a (possibly partially) quantized param tree under
     tensor parallelism — the reference composes ``GroupQuantizer`` output with
@@ -110,34 +166,34 @@ def quantized_shardings(params, tp_specs, mesh):
       way, and its groups axis like the weight's LAST (quantisation) axis —
       group boundaries align with shard boundaries iff the axis size divides
       ``groups``, otherwise the quant-axis sharding is dropped from BOTH so
-      a shard never needs another shard's scales.
+      a shard never needs another shard's scales (callers should run
+      :func:`align_quant_groups` first, which removes this case whenever the
+      payload shape permits; a warning fires once per config if it remains).
 
     Mesh axes absent from the mesh or not dividing a dim are dropped
     (same policy as ``ZeroShardingRules.param_spec``). Returns a tree
     congruent with ``params`` (Quantized8 nodes carry NamedShardings).
     """
-    import math
-
     from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from deepspeed_tpu.runtime.zero.partition import sanitize_tp_spec
-
-    def axis_size(entry):
-        axes = entry if isinstance(entry, tuple) else (entry,)
-        return math.prod(mesh.shape.get(a, 1) for a in axes)
-
-    def prune(shape, spec):
-        out = list(sanitize_tp_spec(mesh, shape, spec) or ())
-        return out + [None] * (len(shape) - len(out))
 
     def one(leaf, spec):
         spec = P() if spec is None else spec
         if not isinstance(leaf, Quantized8):
-            return NamedSharding(mesh, P(*prune(leaf.shape, spec)))
-        qs = prune(leaf.q.shape, spec)
+            return NamedSharding(mesh, P(*_pruned_spec(mesh, leaf.shape, spec)))
+        qs = _pruned_spec(mesh, leaf.q.shape, spec)
         groups = leaf.scale.shape[-1]
         last = qs[-1]
-        if last is not None and groups % axis_size(last):
+        if last is not None and groups % _mesh_axis_size(mesh, last):
+            key = (groups, _mesh_axis_size(mesh, last))
+            if key not in _warned_misaligned:
+                _warned_misaligned.add(key)
+                from deepspeed_tpu.utils.logging import logger
+                logger.warning(
+                    f"int8 x TP: q_groups={groups} not divisible by tp axis "
+                    f"size {key[1]} — quant-axis sharding DROPPED (weights + "
+                    "scales replicated on that axis). Run align_quant_groups "
+                    "on the param tree first (lossless regrouping) or pick "
+                    "q_groups a multiple of the tp size.")
             last = None          # shard/group boundaries misalign: replicate
         qs[-1] = last
         # scale lead dims == q lead dims (scale.shape = q.shape[:-1] + (groups,)),

@@ -907,7 +907,9 @@ class DeepSpeedEngine:
         """A CompressionScheduler transition changes what the model
         computes; compiled programs captured the OLD trace, so drop them
         when the wrapped model's epoch moved. Consulted on every public
-        compute entry (train_batch / forward / eval_batch / step)."""
+        entry that traces the model (train_batch / forward / backward /
+        eval_batch); step() needs no check — _apply_jit only runs the
+        optimizer update, never the model."""
         epoch = getattr(self.client_model, "compression_epoch", None)
         if epoch is not None and epoch != getattr(self, "_compression_epoch_seen", None):
             if getattr(self, "_compression_epoch_seen", None) is not None:
@@ -922,6 +924,7 @@ class DeepSpeedEngine:
         costs the same as grad alone); grads are cached so ``backward()`` just
         accumulates them — the reference's fwd/bwd split without running the
         model twice."""
+        self._check_compression_epoch()
         if self._grad_jit is None:
             def vg_fn(state: TrainState, b, rng):
                 return self._micro_grads(state.params, b, rng, state.scaler.loss_scale)
@@ -939,12 +942,10 @@ class DeepSpeedEngine:
         """Accumulate the grads computed by ``forward()`` (or compute them for
         an explicitly given micro-batch)."""
         if batch is not None:
-            batch = jax.tree.map(jnp.asarray, batch)
-            self._rng, rng = jax.random.split(self._rng)
-            if self._grad_jit is None:
-                self.forward(batch)
-            else:
-                self._losses, self._cached_grads = self._grad_jit(self.state, batch, rng)
+            # forward() owns the whole micro-grad path (compression-epoch
+            # check, batch conversion, rng split, jit build) — delegating
+            # keeps the rng stream identical to the forward()+backward() style
+            self.forward(batch)
         if getattr(self, "_cached_grads", None) is None:
             raise RuntimeError("backward() called before forward(); pass batch= explicitly if needed")
         self._ensure_acc_grads()

@@ -384,3 +384,44 @@ def test_bert_layer_reduction_rebuilds_zoo_cfg():
     # the reduced model actually runs at depth 2
     params = wrapped.model.init_params(jax.random.key(0))
     assert jax.tree.leaves(params["layers"])[0].shape[0] == 2
+
+
+def test_scheduler_transition_retraces_trio_path(devices):
+    """Same retrace guarantee on the reference-shaped forward/backward/step
+    trio: a user driving the engine via forward() (not train_batch) must not
+    keep the stale compiled _grad_jit across a schedule transition."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.compression import CompressionScheduler, init_compression
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.models.transformer import TransformerConfig
+
+    cfg = {"compression_training": {"activation_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 2},
+        "different_groups": {"aq1": {"params": {"bits": 4}}}}}}
+    model = CausalLM(TransformerConfig(vocab_size=64, n_layer=1, n_head=2,
+                                       d_model=32, d_ff=64, max_seq=16,
+                                       remat=False))
+    wrapped = init_compression(model, cfg)
+    sched = CompressionScheduler(wrapped)
+    dist.set_mesh(None)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=wrapped,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "mesh": {"dp": 8}, "steps_per_print": 0})
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 64, (8, 16))}
+    for _ in range(2):  # steps 0/1: plain model traced via the trio
+        engine.forward(batch)
+        engine.backward()
+        engine.step()
+        sched.step()
+    assert wrapped.model.config.act_quant_bits == 4   # transition fired
+    stale = engine._grad_jit
+    assert stale is not None
+    loss = float(engine.forward(batch))               # must drop stale jit
+    assert engine._grad_jit is not stale
+    assert np.isfinite(loss)
+    engine.backward()
+    engine.step()
+    dist.set_mesh(None)

@@ -250,3 +250,95 @@ def test_streamed_forward_with_attention_mask():
     one = np.asarray(eng.forward(jnp.asarray(toks[0]), attention_mask=mask[0]),
                      np.float32)
     np.testing.assert_allclose(one[0, 3:10], got[0, 3:10], rtol=1e-5, atol=1e-5)
+
+
+def test_streamed_step_double_buffers(tmp_path):
+    """Double-buffering contract (reference pipelined swapper read-ahead):
+    before blk(i) is dispatched, layer i+1's H2D copy must already be in
+    flight and layer i+2's NVMe reads submitted — I/O and H2D overlap
+    compute instead of serializing with it."""
+    model = _model()  # 3 layers
+    params = model.init_params(jax.random.key(0))
+    eng = deepspeed_tpu.init_inference(
+        model, dtype="fp32", params=params,
+        zero={"stage": 3, "offload_param": {"device": "nvme",
+                                            "nvme_path": str(tmp_path)}})
+    toks = jnp.asarray([[1, 2, 3]], jnp.int32)
+    eng.generate(toks, max_new_tokens=1)  # build + compile _stream_jits
+
+    events = []
+    submit, finish, put = eng._fetch_submit, eng._fetch_finish, eng._put_layer
+    eng._fetch_submit = lambda i: (events.append(("submit", i)), submit(i))[1]
+    fin_idx = iter(range(10))
+    eng._fetch_finish = lambda h: (events.append(("finish", next(fin_idx))), finish(h))[1]
+    put_idx = iter(range(10))
+    eng._put_layer = lambda lp: (events.append(("put", next(put_idx))), put(lp))[1]
+    emb, blk, head = eng._stream_jits
+    blk_idx = iter(range(10))
+
+    def blk_rec(*a, **kw):
+        events.append(("blk", next(blk_idx)))
+        return blk(*a, **kw)
+    eng._stream_jits = (emb, blk_rec, head)
+
+    eng.generate(toks, max_new_tokens=1)
+    order = {e: i for i, e in enumerate(events)}
+    # layer 1's H2D starts before layer 0's compute is dispatched
+    assert order[("put", 1)] < order[("blk", 0)], events
+    # layer 2's NVMe reads are in flight while layer 0 computes
+    assert order[("submit", 2)] < order[("blk", 0)], events
+    # at most one submit outstanding at any moment: the swapper's wait() is
+    # global, so a second in-flight batch would be silently absorbed by the
+    # wrong finish and the read-ahead overlap would vanish
+    pend = 0
+    for kind, i in events:
+        if kind == "submit":
+            pend += 1
+            assert pend <= 1, events
+        elif kind == "finish":
+            pend -= 1
+    assert pend == 0, events
+
+
+def test_streamed_nvme_sweeps_stale_dirs(tmp_path):
+    """A SIGKILLed process leaks its model-sized swap dir; the next engine
+    init under the same nvme_path reclaims dirs whose owner pid is dead and
+    leaves live-owned or unmarked dirs alone."""
+    import os
+    import subprocess
+    child = subprocess.Popen(["true"])
+    child.wait()  # reaped => the pid no longer exists
+    dead_pid = child.pid
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    me_scope, _ = InferenceEngine._owner_marker().rsplit(":", 1)
+    stale = tmp_path / "zero_inference_stale"
+    stale.mkdir()
+    (stale / "owner.pid").write_text(f"{me_scope}:{dead_pid}")
+    (stale / "L0_0.swp").write_bytes(b"x" * 64)
+    live = tmp_path / "zero_inference_live"
+    live.mkdir()
+    (live / "owner.pid").write_text(InferenceEngine._owner_marker())
+    unmarked = tmp_path / "zero_inference_old"
+    unmarked.mkdir()
+    # a dead pid in ANOTHER scope (host / boot / pid namespace) must never
+    # be judged — os.kill can't see across pid namespaces
+    foreign = tmp_path / "zero_inference_foreign"
+    foreign.mkdir()
+    (foreign / "owner.pid").write_text(f"otherhost:deadbeef:pid:[1]:{dead_pid}")
+
+    model = _model(n_layer=1)
+    params = model.init_params(jax.random.key(0))
+    eng = deepspeed_tpu.init_inference(
+        model, dtype="fp32", params=params,
+        zero={"stage": 3, "offload_param": {"device": "nvme",
+                                            "nvme_path": str(tmp_path)}})
+    assert not stale.exists(), "dead-owner dir not swept"
+    assert live.exists(), "live-owner dir must survive"
+    assert unmarked.exists(), "unmarked dir must survive"
+    assert foreign.exists(), "foreign host/boot dir must survive"
+    # the new engine's own dir carries the marker for future sweeps
+    own = [d for d in tmp_path.glob("zero_inference_*/owner.pid")
+           if d.read_text() == InferenceEngine._owner_marker()
+           and d.parent != live]
+    assert own, "new swap dir missing owner.pid marker"
