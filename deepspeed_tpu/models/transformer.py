@@ -349,10 +349,8 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
                                        DENSE_STREAM_CHUNK, q.dtype,
                                        cfg.attn_scale)
     if out is None:
-        if KV != H:  # dense fallback needs repeated kv
-            rep = H // KV
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        # GQA kv goes in UNREPEATED — mha_attention contracts grouped query
+        # heads [KV, G] against the raw kv, no H/KV× copy
         from deepspeed_tpu.ops.attention import mha_attention
         out = mha_attention(q, k, v,
                             mask_bias=None if mask_bias is None else mask_bias[:, None, None, :],
@@ -693,27 +691,26 @@ def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad
         out = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
         return out, ck, cv
 
-    kk, vv = ck, cv
-    if KV != H:
-        rep = H // KV
-        kk = jnp.repeat(kk, rep, axis=2)
-        vv = jnp.repeat(vv, rep, axis=2)
-
+    # grouped-head einsum against the UNREPEATED cache: query heads reshaped
+    # [KV, G] (head h reads kv head h // G, matching the kernels' index maps)
+    # so off-kernel decode skips the H/KV× cache copy too
+    G = H // KV
     scale = Hd**-0.5 if cfg.attn_scale is None else cfg.attn_scale
-    scores = jnp.einsum("bthd,bshd->bhts", q, kk,
+    q5 = q.reshape(B, T, KV, G, Hd)
+    scores = jnp.einsum("btcgd,bscd->bcgts", q5, ck,
                         preferred_element_type=jnp.float32) * scale
-    kpos = jnp.arange(Smax, dtype=jnp.int32)[None, None, None, :]      # [1,1,1,S]
-    qpos = positions[:, None, :, None]                                 # [B,1,T,1]
-    valid = kpos <= qpos                                               # causal + cache bound
+    kpos = jnp.arange(Smax, dtype=jnp.int32)[None, None, None, None, :]  # [1,1,1,1,S]
+    qpos = positions[:, None, None, :, None]                             # [B,1,1,T,1]
+    valid = kpos <= qpos                                                 # causal + cache bound
     if cfg.pos_embedding == "alibi":
-        slopes = _alibi_slopes(H)
-        scores = scores + slopes[None, :, None, None] * (kpos - qpos).astype(jnp.float32)
+        slopes5 = _alibi_slopes(H).reshape(KV, G)
+        scores = scores + slopes5[None, :, :, None, None] * (kpos - qpos).astype(jnp.float32)
     scores = jnp.where(valid, scores, -1e30)
     if pad_bias is not None:
-        scores = scores + pad_bias[:, None, None, :]
-    probs = jax.nn.softmax(scores, axis=-1).astype(vv.dtype)
-    out = jnp.einsum("bhts,bshd->bthd", probs, vv)
-    out = out.reshape(B, T, H * Hd) @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
+        scores = scores + pad_bias[:, None, None, None, :]
+    probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+    out = jnp.einsum("bcgts,bscd->btcgd", probs, cv).reshape(B, T, H * Hd)
+    out = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
     return out, ck, cv
 
 

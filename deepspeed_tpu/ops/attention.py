@@ -16,28 +16,40 @@ import jax.numpy as jnp
 
 
 def mha_attention(q, k, v, mask_bias=None, causal: bool = True, alibi_slopes=None, scale: Optional[float] = None):
-    """q,k,v: [B, S, H, Hd] → [B, S, H, Hd].
+    """q: [B, S, H, Hd]; k,v: [B, S, KV, Hd] with KV | H → [B, S, H, Hd].
+
+    GQA-native: when KV < H the query heads are reshaped into [KV, G] groups
+    (query head h reads kv head ``h // G`` — ``jnp.repeat`` order, matching
+    the flash/decode kernels' index maps) and contracted against the
+    UNREPEATED kv, so no H/KV× HBM copy of k/v is ever materialised.
 
     Computed in fp32 accumulators (softmax in fp32) with inputs in compute
     dtype; XLA fuses scale+bias+mask+softmax into the attention matmuls.
     """
     B, S, H, Hd = q.shape
+    KV = k.shape[2]
     scale = scale if scale is not None else Hd**-0.5
+    G = H // KV
 
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    # [B, S, KV, G, Hd]: head h = c*G + g, so h // G = c — repeat order
+    q5 = q.reshape(B, S, KV, G, Hd)
+    logits = jnp.einsum("bqcgd,bkcd->bcgqk", q5, k,
+                        preferred_element_type=jnp.float32) * scale
 
     if alibi_slopes is not None:
         # additive linear biases per head: slope * -(q_pos - k_pos)
         qpos = jnp.arange(S)[:, None]
         kpos = jnp.arange(S)[None, :]
         dist = (kpos - qpos).astype(jnp.float32)  # <= 0 in causal region
-        logits = logits + alibi_slopes[None, :, None, None] * dist[None, None, :, :]
+        slopes5 = alibi_slopes.reshape(KV, G)
+        logits = logits + slopes5[None, :, :, None, None] * dist[None, None, None, :, :]
 
     if causal:
         causal_mask = jnp.tril(jnp.ones((S, S), bool))
-        logits = jnp.where(causal_mask[None, None, :, :], logits, -1e9)
+        logits = jnp.where(causal_mask[None, None, None, :, :], logits, -1e9)
     if mask_bias is not None:
-        logits = logits + mask_bias  # [B,1,1,S] broadcast
+        logits = logits + mask_bias[:, None]  # [B,1,1,S] -> [B,1,1,1,S] broadcast
 
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum("bcgqk,bkcd->bqcgd", probs, v)
+    return out.reshape(B, S, H, Hd)

@@ -94,9 +94,9 @@ def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bia
                                    jnp.int32(0), jnp.int32(0),
                                    causal, ULYSSES_KEY_CHUNK, qh.dtype, scale)
     else:
-        if KV != H:  # dense path: broadcast the local kv heads up front
-            kh = jnp.repeat(kh, H // KV, axis=2)
-            vh = jnp.repeat(vh, H // KV, axis=2)
+        # dense path: mha_attention is GQA-native (grouped-head einsum), and
+        # the head-scatter preserves grouping — local query head g still
+        # reads local kv head g // (H/KV) — so kv stays unrepeated here too
         from deepspeed_tpu.ops.attention import mha_attention
         out = mha_attention(qh, kh, vh,
                             mask_bias=None if mask_bias is None else mask_bias[:, None, None, :],
