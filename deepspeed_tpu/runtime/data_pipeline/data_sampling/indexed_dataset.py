@@ -2,10 +2,18 @@
 ``runtime/data_pipeline/data_sampling/indexed_dataset.py`` — the
 Megatron-derived ``MMapIndexedDataset``).
 
-Binary layout (``.bin`` = concatenated sample payloads, ``.idx`` = header +
-per-sample dtype/sizes/offsets) with zero-copy ``np.memmap`` reads — the
-host-side data path that feeds TPU input pipelines without materialising
-the dataset in RAM.
+Two on-disk formats behind one reader:
+
+- ``DSTPUIDX`` — this package's native layout (int64 sizes + byte offsets).
+- ``MMIDIDX``  — the Megatron binary layout the reference reads/writes
+  (``indexed_dataset.py:370`` ``_HDR_MAGIC = b'MMIDIDX\\x00\\x00'``, version
+  ``<Q``, dtype code ``<B``, sequence count ``<Q``, document count ``<Q``,
+  int32 sizes, int64 byte pointers, int64 doc_idx), so corpora preprocessed
+  with Megatron/reference tooling load here unchanged.
+
+``.bin`` is identical in both: concatenated sample payloads, zero-copy
+``np.memmap`` reads — the host-side data path that feeds TPU input pipelines
+without materialising the dataset in RAM.
 """
 
 from __future__ import annotations
@@ -18,10 +26,22 @@ import numpy as np
 
 _MAGIC = b"DSTPUIDX"
 _VERSION = 1
+_MEGATRON_MAGIC = b"MMIDIDX\x00\x00"
 
 _DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
            6: np.float32, 7: np.float64, 8: np.uint16}
 _DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+# the reference's table (indexed_dataset.py:98-110) differs from ours in the
+# float rows — 6 and 7 are BOTH float64 upstream — and extends to uint32/64
+_MEGATRON_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+                    5: np.int64, 6: np.float64, 7: np.float64, 8: np.uint16,
+                    9: np.uint32, 10: np.uint64}
+_MEGATRON_CODES = {np.dtype(np.uint8): 1, np.dtype(np.int8): 2,
+                   np.dtype(np.int16): 3, np.dtype(np.int32): 4,
+                   np.dtype(np.int64): 5, np.dtype(np.float64): 6,
+                   np.dtype(np.uint16): 8, np.dtype(np.uint32): 9,
+                   np.dtype(np.uint64): 10}
 
 
 def data_file_path(prefix: str) -> str:
@@ -33,50 +53,97 @@ def index_file_path(prefix: str) -> str:
 
 
 class MMapIndexedDatasetBuilder:
-    """Streaming writer: ``add_item`` per sample, then ``finalize``."""
+    """Streaming writer: ``add_item`` per sample, ``end_document`` at doc
+    boundaries (meaningful for the megatron format), then ``finalize``.
 
-    def __init__(self, out_prefix: str, dtype=np.int32):
+    ``fmt="dstpu"`` (default) writes the native index; ``fmt="megatron"``
+    writes a reference-compatible ``MMIDIDX`` index that Megatron/DeepSpeed
+    tooling can read back.
+    """
+
+    def __init__(self, out_prefix: str, dtype=np.int32, fmt: str = "dstpu"):
+        if fmt not in ("dstpu", "megatron"):
+            raise ValueError(f"unknown indexed-dataset format {fmt!r}")
         self._prefix = out_prefix
         self._dtype = np.dtype(dtype)
+        self._fmt = fmt
+        if fmt == "megatron" and self._dtype not in _MEGATRON_CODES:
+            raise ValueError(f"dtype {self._dtype} has no megatron code")
         self._data_file = open(data_file_path(out_prefix), "wb")
         self._sizes: List[int] = []
+        self._doc_idx: List[int] = [0]
 
     def add_item(self, tokens: Sequence[int]) -> None:
         arr = np.asarray(tokens, dtype=self._dtype)
         self._data_file.write(arr.tobytes(order="C"))
         self._sizes.append(arr.size)
 
+    def end_document(self) -> None:
+        """Mark a document boundary after the last added sample."""
+        self._doc_idx.append(len(self._sizes))
+
     def merge_file_(self, another_prefix: str) -> None:
+        """Append another dataset's samples, preserving its document
+        boundaries (megatron doc_idx semantics; native datasets are
+        one-doc-per-sample so every sample closes a document)."""
         other = MMapIndexedDataset(another_prefix)
+        bounds = set(int(b) for b in other.doc_idx[1:])
         for i in range(len(other)):
             self.add_item(other[i])
+            if i + 1 in bounds:
+                self.end_document()
 
     def finalize(self) -> None:
         self._data_file.close()
         sizes = np.asarray(self._sizes, dtype=np.int64)
         offsets = np.concatenate([[0], np.cumsum(sizes)])[:-1] * self._dtype.itemsize
+        if self._doc_idx[-1] != len(self._sizes):  # trailing partial document
+            self._doc_idx.append(len(self._sizes))
         with open(index_file_path(self._prefix), "wb") as f:
-            f.write(_MAGIC)
-            f.write(struct.pack("<QBQ", _VERSION, _DTYPE_CODES[self._dtype], len(sizes)))
-            f.write(sizes.tobytes())
-            f.write(offsets.astype(np.int64).tobytes())
+            if self._fmt == "megatron":
+                f.write(_MEGATRON_MAGIC)
+                f.write(struct.pack("<QB", 1, _MEGATRON_CODES[self._dtype]))
+                f.write(struct.pack("<QQ", len(sizes), len(self._doc_idx)))
+                f.write(sizes.astype(np.int32).tobytes())
+                f.write(offsets.astype(np.int64).tobytes())
+                f.write(np.asarray(self._doc_idx, np.int64).tobytes())
+            else:
+                f.write(_MAGIC)
+                f.write(struct.pack("<QBQ", _VERSION, _DTYPE_CODES[self._dtype], len(sizes)))
+                f.write(sizes.tobytes())
+                f.write(offsets.astype(np.int64).tobytes())
 
 
 class MMapIndexedDataset:
-    """Zero-copy random access over a built dataset."""
+    """Zero-copy random access over a built dataset; reads both the native
+    ``DSTPUIDX`` and the reference's ``MMIDIDX`` index layouts (format is
+    auto-detected from the magic)."""
 
     def __init__(self, prefix: str):
         self._prefix = prefix
         with open(index_file_path(prefix), "rb") as f:
-            magic = f.read(len(_MAGIC))
-            if magic != _MAGIC:
+            magic = f.read(len(_MEGATRON_MAGIC))  # longest magic: 9 bytes
+            if magic.startswith(_MEGATRON_MAGIC):
+                version, dtype_code = struct.unpack("<QB", f.read(9))
+                if version != 1:
+                    raise ValueError(f"unsupported MMIDIDX version {version}")
+                count, doc_count = struct.unpack("<QQ", f.read(16))
+                self._dtype = np.dtype(_MEGATRON_DTYPES[dtype_code])
+                self._sizes = np.frombuffer(f.read(4 * count),
+                                            dtype=np.int32).astype(np.int64)
+                self._offsets = np.frombuffer(f.read(8 * count), dtype=np.int64)
+                self._doc_idx = np.frombuffer(f.read(8 * doc_count), dtype=np.int64)
+            elif magic.startswith(_MAGIC):
+                f.seek(len(_MAGIC))
+                version, dtype_code, count = struct.unpack("<QBQ", f.read(17))
+                if version != _VERSION:
+                    raise ValueError(f"unsupported index version {version}")
+                self._dtype = np.dtype(_DTYPES[dtype_code])
+                self._sizes = np.frombuffer(f.read(8 * count), dtype=np.int64)
+                self._offsets = np.frombuffer(f.read(8 * count), dtype=np.int64)
+                self._doc_idx = np.arange(count + 1, dtype=np.int64)
+            else:
                 raise ValueError(f"{index_file_path(prefix)}: bad magic {magic!r}")
-            version, dtype_code, count = struct.unpack("<QBQ", f.read(17))
-            if version != _VERSION:
-                raise ValueError(f"unsupported index version {version}")
-            self._dtype = np.dtype(_DTYPES[dtype_code])
-            self._sizes = np.frombuffer(f.read(8 * count), dtype=np.int64)
-            self._offsets = np.frombuffer(f.read(8 * count), dtype=np.int64)
         self._data = np.memmap(data_file_path(prefix), dtype=self._dtype, mode="r")
 
     def __len__(self) -> int:
@@ -85,6 +152,13 @@ class MMapIndexedDataset:
     @property
     def sizes(self) -> np.ndarray:
         return self._sizes
+
+    @property
+    def doc_idx(self) -> np.ndarray:
+        """Document boundaries as sample indices (megatron semantics: entry d
+        is the first sample of document d; final entry == len(self)). Native
+        datasets default to one document per sample."""
+        return self._doc_idx
 
     def __getitem__(self, idx):
         if isinstance(idx, slice):

@@ -94,6 +94,89 @@ class TestIndexedDataset:
         assert MMapIndexedDataset.exists(prefix)
         assert not MMapIndexedDataset.exists(prefix + "_nope")
 
+    def test_reads_megatron_mmididx_fixture(self, tmp_path):
+        """Token-exact read of a fixture written in the reference's on-disk
+        MMIDIDX layout (indexed_dataset.py:369-430: 9-byte magic, <Q version,
+        <B dtype code, <Q seq count, <Q doc count, int32 sizes, int64 byte
+        pointers, int64 doc_idx) — Megatron-preprocessed corpora load
+        unchanged."""
+        import struct
+        prefix = str(tmp_path / "megatron")
+        samples = [[11, 12, 13], [14], [15, 16], [17, 18, 19, 20]]
+        doc_idx = [0, 2, 4]  # two documents: samples {0,1} and {2,3}
+        flat = np.concatenate([np.asarray(s, np.uint16) for s in samples])
+        with open(prefix + ".bin", "wb") as f:
+            f.write(flat.tobytes())
+        sizes = np.asarray([len(s) for s in samples], np.int32)
+        pointers = np.concatenate(
+            [[0], np.cumsum(sizes[:-1], dtype=np.int64) * 2])  # uint16 = 2B
+        with open(prefix + ".idx", "wb") as f:
+            f.write(b"MMIDIDX\x00\x00")
+            f.write(struct.pack("<Q", 1))
+            f.write(struct.pack("<B", 8))  # megatron code 8 = uint16
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(struct.pack("<Q", len(doc_idx)))
+            f.write(sizes.tobytes())
+            f.write(pointers.astype(np.int64).tobytes())
+            f.write(np.asarray(doc_idx, np.int64).tobytes())
+
+        ds = MMapIndexedDataset(prefix)
+        assert len(ds) == 4
+        assert ds[0].dtype == np.uint16
+        assert list(ds.sizes) == [3, 1, 2, 4]
+        for i, s in enumerate(samples):
+            np.testing.assert_array_equal(ds[i], np.asarray(s, np.uint16))
+        np.testing.assert_array_equal(ds.doc_idx, doc_idx)
+        np.testing.assert_array_equal(ds.get(3, offset=1, length=2), [18, 19])
+
+    def test_megatron_builder_roundtrip(self, tmp_path):
+        """fmt='megatron' writes an MMIDIDX index readable by the same
+        auto-detecting reader (and by reference tooling), with document
+        boundaries preserved."""
+        prefix = str(tmp_path / "out")
+        builder = MMapIndexedDatasetBuilder(prefix, dtype=np.int32,
+                                            fmt="megatron")
+        builder.add_item([1, 2, 3])
+        builder.add_item([4, 5])
+        builder.end_document()
+        builder.add_item([6])
+        builder.end_document()
+        builder.finalize()
+
+        with open(prefix + ".idx", "rb") as f:
+            assert f.read(9) == b"MMIDIDX\x00\x00"
+        ds = MMapIndexedDataset(prefix)
+        assert len(ds) == 3
+        np.testing.assert_array_equal(ds[1], np.asarray([4, 5], np.int32))
+        np.testing.assert_array_equal(ds.doc_idx, [0, 2, 3])
+
+    def test_merge_preserves_doc_boundaries(self, tmp_path):
+        """merge_file_ must carry the source's doc_idx through, not collapse
+        all merged documents into one."""
+        src = str(tmp_path / "src")
+        b = MMapIndexedDatasetBuilder(src, dtype=np.int32, fmt="megatron")
+        b.add_item([1]); b.add_item([2]); b.end_document()
+        b.add_item([3]); b.end_document()
+        b.finalize()
+
+        dst = str(tmp_path / "dst")
+        b2 = MMapIndexedDatasetBuilder(dst, dtype=np.int32, fmt="megatron")
+        b2.add_item([9]); b2.end_document()
+        b2.merge_file_(src)
+        b2.finalize()
+
+        ds = MMapIndexedDataset(dst)
+        assert len(ds) == 4
+        np.testing.assert_array_equal(ds.doc_idx, [0, 1, 3, 4])
+
+    def test_native_dataset_default_doc_idx(self, tmp_path):
+        prefix = str(tmp_path / "native")
+        b = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+        b.add_item([1]); b.add_item([2])
+        b.finalize()
+        ds = MMapIndexedDataset(prefix)
+        np.testing.assert_array_equal(ds.doc_idx, [0, 1, 2])
+
 
 class TestDataAnalyzer:
 
