@@ -25,7 +25,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 _MAGIC = b"DSTPUIDX"
-_VERSION = 1
+_VERSION = 2  # v2 appends doc_idx; v1 (no document boundaries) still reads
 _MEGATRON_MAGIC = b"MMIDIDX\x00\x00"
 
 _DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
@@ -37,11 +37,8 @@ _DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
 _MEGATRON_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
                     5: np.int64, 6: np.float64, 7: np.float64, 8: np.uint16,
                     9: np.uint32, 10: np.uint64}
-_MEGATRON_CODES = {np.dtype(np.uint8): 1, np.dtype(np.int8): 2,
-                   np.dtype(np.int16): 3, np.dtype(np.int32): 4,
-                   np.dtype(np.int64): 5, np.dtype(np.float64): 6,
-                   np.dtype(np.uint16): 8, np.dtype(np.uint32): 9,
-                   np.dtype(np.uint64): 10}
+_MEGATRON_CODES = {np.dtype(v): k for k, v in _MEGATRON_DTYPES.items()
+                   if k != 7}  # float64 has two codes upstream; write 6
 
 
 def data_file_path(prefix: str) -> str:
@@ -54,7 +51,7 @@ def index_file_path(prefix: str) -> str:
 
 class MMapIndexedDatasetBuilder:
     """Streaming writer: ``add_item`` per sample, ``end_document`` at doc
-    boundaries (meaningful for the megatron format), then ``finalize``.
+    boundaries (preserved by both formats), then ``finalize``.
 
     ``fmt="dstpu"`` (default) writes the native index; ``fmt="megatron"``
     writes a reference-compatible ``MMIDIDX`` index that Megatron/DeepSpeed
@@ -84,8 +81,8 @@ class MMapIndexedDatasetBuilder:
 
     def merge_file_(self, another_prefix: str) -> None:
         """Append another dataset's samples, preserving its document
-        boundaries (megatron doc_idx semantics; native datasets are
-        one-doc-per-sample so every sample closes a document)."""
+        boundaries (megatron doc_idx semantics; v1 native datasets carry no
+        boundaries and read back as one-doc-per-sample)."""
         other = MMapIndexedDataset(another_prefix)
         bounds = set(int(b) for b in other.doc_idx[1:])
         for i in range(len(other)):
@@ -112,6 +109,8 @@ class MMapIndexedDatasetBuilder:
                 f.write(struct.pack("<QBQ", _VERSION, _DTYPE_CODES[self._dtype], len(sizes)))
                 f.write(sizes.tobytes())
                 f.write(offsets.astype(np.int64).tobytes())
+                f.write(struct.pack("<Q", len(self._doc_idx)))
+                f.write(np.asarray(self._doc_idx, np.int64).tobytes())
 
 
 class MMapIndexedDataset:
@@ -128,6 +127,9 @@ class MMapIndexedDataset:
                 if version != 1:
                     raise ValueError(f"unsupported MMIDIDX version {version}")
                 count, doc_count = struct.unpack("<QQ", f.read(16))
+                if dtype_code not in _MEGATRON_DTYPES:
+                    raise ValueError(f"{index_file_path(prefix)}: unknown "
+                                     f"MMIDIDX dtype code {dtype_code}")
                 self._dtype = np.dtype(_MEGATRON_DTYPES[dtype_code])
                 self._sizes = np.frombuffer(f.read(4 * count),
                                             dtype=np.int32).astype(np.int64)
@@ -136,12 +138,20 @@ class MMapIndexedDataset:
             elif magic.startswith(_MAGIC):
                 f.seek(len(_MAGIC))
                 version, dtype_code, count = struct.unpack("<QBQ", f.read(17))
-                if version != _VERSION:
+                if version not in (1, 2):
                     raise ValueError(f"unsupported index version {version}")
+                if dtype_code not in _DTYPES:
+                    raise ValueError(f"{index_file_path(prefix)}: unknown "
+                                     f"DSTPUIDX dtype code {dtype_code}")
                 self._dtype = np.dtype(_DTYPES[dtype_code])
                 self._sizes = np.frombuffer(f.read(8 * count), dtype=np.int64)
                 self._offsets = np.frombuffer(f.read(8 * count), dtype=np.int64)
-                self._doc_idx = np.arange(count + 1, dtype=np.int64)
+                if version >= 2:
+                    doc_count, = struct.unpack("<Q", f.read(8))
+                    self._doc_idx = np.frombuffer(f.read(8 * doc_count),
+                                                  dtype=np.int64)
+                else:  # v1 carried no boundaries: one document per sample
+                    self._doc_idx = np.arange(count + 1, dtype=np.int64)
             else:
                 raise ValueError(f"{index_file_path(prefix)}: bad magic {magic!r}")
         self._data = np.memmap(data_file_path(prefix), dtype=self._dtype, mode="r")

@@ -169,12 +169,43 @@ class TestIndexedDataset:
         assert len(ds) == 4
         np.testing.assert_array_equal(ds.doc_idx, [0, 1, 3, 4])
 
-    def test_native_dataset_default_doc_idx(self, tmp_path):
+    def test_native_dataset_doc_idx(self, tmp_path):
+        """DSTPUIDX v2 persists explicit document boundaries; a build with no
+        end_document() is one trailing document (same as the megatron fmt)."""
         prefix = str(tmp_path / "native")
         b = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
         b.add_item([1]); b.add_item([2])
         b.finalize()
         ds = MMapIndexedDataset(prefix)
+        np.testing.assert_array_equal(ds.doc_idx, [0, 2])
+
+        prefix2 = str(tmp_path / "native2")
+        b2 = MMapIndexedDatasetBuilder(prefix2, dtype=np.int32)
+        b2.add_item([1]); b2.add_item([2]); b2.end_document()
+        b2.add_item([3]); b2.end_document()
+        b2.finalize()
+        ds2 = MMapIndexedDataset(prefix2)
+        np.testing.assert_array_equal(ds2.doc_idx, [0, 2, 3])
+
+    def test_native_v1_back_compat(self, tmp_path):
+        """A v1 DSTPUIDX index (no doc section) still loads, defaulting to
+        one document per sample."""
+        import struct
+        prefix = str(tmp_path / "v1")
+        samples = [np.asarray(s, np.int32) for s in ([1, 2], [3])]
+        with open(prefix + ".bin", "wb") as f:
+            for s in samples:
+                f.write(s.tobytes())
+        sizes = np.asarray([2, 1], np.int64)
+        offsets = np.asarray([0, 8], np.int64)
+        with open(prefix + ".idx", "wb") as f:
+            f.write(b"DSTPUIDX")
+            f.write(struct.pack("<QBQ", 1, 4, 2))  # v1, int32, 2 samples
+            f.write(sizes.tobytes())
+            f.write(offsets.tobytes())
+        ds = MMapIndexedDataset(prefix)
+        assert len(ds) == 2
+        np.testing.assert_array_equal(ds[0], [1, 2])
         np.testing.assert_array_equal(ds.doc_idx, [0, 1, 2])
 
 

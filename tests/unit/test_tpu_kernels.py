@@ -142,8 +142,12 @@ def test_gqa_flash_compiles_matches_and_beats_repeat(tpu):
     assert abs(float(ln) - float(lr)) / max(abs(float(lr)), 1.0) < 2e-2
     for a, b, name in zip(gn, gr, "qkv"):
         assert a.shape == b.shape, name
-        err = float(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)).max())
-        assert err < 0.25, (name, err)
+        bf = b.astype(jnp.float32)
+        err = float(jnp.abs(a.astype(jnp.float32) - bf).max())
+        # both operands are bf16 pipelines; bound the drift relative to the
+        # gradient's own scale (sum-loss dv grads reach O(100) at S=2048)
+        tol = 0.02 * max(1.0, float(jnp.abs(bf).max()))
+        assert err < tol, (name, err, tol)
 
     def timeit(fn, *args):
         jax.block_until_ready(fn(*args))
