@@ -1,6 +1,13 @@
-"""Benchmark: GPT-2 125M causal-LM training throughput on one TPU chip.
+"""Benchmark: causal-LM training throughput on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line per metric: {"metric", "value", "unit", "vs_baseline"}.
+
+1. GPT-2 125M, MHA, ZeRO-1 — the historical bench config (every round).
+2. A llama-style GQA model (rope/rmsnorm/swiglu, n_kv_head < n_head) under
+   ZeRO-3 — the BASELINE.md north-star shape (Llama-7B ZeRO-3), sized to
+   the largest that fits one chip, so the driver measures the GQA flash
+   index maps and ZeRO-3 gather-on-use paths, not just the easy config.
+   Disable with BENCH_LLAMA=0.
 
 Baseline: the reference's single-GPU fused-kernel result — BERT-large at
 >50% of V100 peak (docs/_posts/2020-05-28-fastest-bert-training.md, see
@@ -87,6 +94,80 @@ def build_bench_engine():
                                          LOSS_CHUNK=LOSS_CHUNK)
 
 
+def build_llama_bench_engine():
+    """Llama-style GQA + ZeRO-3 bench config (north-star shape, one chip).
+
+    ~500M params: d_model 1536, 12 q heads over 4 kv heads (head_dim 128 —
+    the flash kernel's native GQA envelope), swiglu/rmsnorm/rope, seq 2048.
+    ZeRO-3 so the driver exercises parameter sharding + gather-on-use even
+    at world size 1 (the sharding rules, master-param update, and donation
+    paths are identical; only the collective extent changes)."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models import llama
+
+    BATCH = int(os.environ.get("BENCH_LLAMA_BATCH", 4))
+    SEQ = int(os.environ.get("BENCH_LLAMA_SEQ", 2048))
+    model = llama("tiny", n_layer=16, n_head=12, n_kv_head=4, d_model=1536,
+                  d_ff=4096, max_seq=SEQ,
+                  remat=os.environ.get("BENCH_REMAT", "dots"),
+                  loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", 2048)),
+                  attention_backend=os.environ.get("BENCH_ATTN", "auto"))
+    params = model.init_params(jax.random.key(0))
+
+    dist.set_mesh(None)
+    config = {
+        "train_micro_batch_size_per_gpu": BATCH,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": os.environ.get("BENCH_OPT", "AdamW"),
+                      "params": {"lr": 3e-4, "weight_decay": 0.1}},
+        "zero_optimization": {"stage": 3},
+        "bf16": {"enabled": True},
+        "mesh": {"dp": -1},
+        "steps_per_print": 0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=config)
+
+    rng = np.random.default_rng(0)
+
+    def batch_fn():
+        return {"input_ids": rng.integers(0, 32000, size=(BATCH, SEQ)).astype(np.int32)}
+
+    return engine, model, batch_fn, dict(BATCH=BATCH, SEQ=SEQ)
+
+
+def _run_metric(name, engine, model, batch, BATCH, SEQ, steps, extra_unit):
+    import jax
+    import time as _t
+
+    float(engine.train_batch(batch()))  # warmup/compile; host fetch = sync
+    t0 = _t.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch())
+    loss_val = float(loss)  # chained state => this syncs every step
+    dt = _t.perf_counter() - t0
+
+    tokens_per_sec = BATCH * SEQ * steps / dt
+    achieved_tflops = tokens_per_sec * model.flops_per_token(SEQ) / 1e12
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "unknown").lower()
+    peak = 197.0 if ("v5" in kind and "lite" in kind) or "v5e" in kind else \
+           459.0 if "v5p" in kind else 275.0 if "v4" in kind else 197.0
+    mfu = achieved_tflops / peak
+
+    print(json.dumps({
+        "metric": name,
+        "value": round(tokens_per_sec, 1),
+        "unit": f"tokens/s (bf16, bs{BATCH}xseq{SEQ}, {extra_unit}, {kind}, "
+                f"{achieved_tflops:.1f} TFLOPs, MFU {mfu:.3f}, loss {loss_val:.3f})",
+        "vs_baseline": round(mfu / 0.50, 3),
+    }), flush=True)
+
+
 def main():
     if os.environ.get("BENCH_SKIP_PROBE") != "1":
         err = _probe_backend()
@@ -100,39 +181,24 @@ def main():
         print("bench: BENCH_STEPS must be >= 1", file=sys.stderr)
         sys.exit(1)
     engine, model, batch, knobs = build_bench_engine()
-    BATCH, SEQ = knobs["BATCH"], knobs["SEQ"]
-    remat_env, LOSS_CHUNK = knobs["remat_env"], knobs["LOSS_CHUNK"]
+    # warmup/compile inside _run_metric; float() forces a host fetch — the
+    # only reliable sync point over remote-tunnel device transports
+    # (block_until_ready/effects_barrier return before remote execution
+    # finishes)
+    _run_metric("gpt2_125m_train_tokens_per_sec_per_chip", engine, model,
+                batch, knobs["BATCH"], knobs["SEQ"], STEPS,
+                f"ZeRO-1, remat={knobs['remat_env']}, "
+                f"loss_chunk={knobs['LOSS_CHUNK']}")
 
-    # warmup/compile; float() forces a host fetch — the only reliable sync
-    # point over remote-tunnel device transports (block_until_ready/
-    # effects_barrier return before remote execution finishes)
-    float(engine.train_batch(batch()))
-
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        loss = engine.train_batch(batch())
-    loss_val = float(loss)  # chained state => this syncs every step
-    dt = time.perf_counter() - t0
-
-    tokens_per_sec = BATCH * SEQ * STEPS / dt
-    flops_per_token = model.flops_per_token(SEQ)
-    achieved_tflops = tokens_per_sec * flops_per_token / 1e12
-
-    # peak bf16 TFLOPs for the chip we are on
-    dev = jax.devices()[0]
-    kind = getattr(dev, "device_kind", "unknown").lower()
-    peak = 197.0 if ("v5" in kind and "lite" in kind) or "v5e" in kind else \
-           459.0 if "v5p" in kind else 275.0 if "v4" in kind else 197.0
-    mfu = achieved_tflops / peak
-
-    print(json.dumps({
-        "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": f"tokens/s (bf16, bs{BATCH}xseq{SEQ}, ZeRO-1, remat={remat_env}, "
-                f"loss_chunk={LOSS_CHUNK}, {kind}, "
-                f"{achieved_tflops:.1f} TFLOPs, MFU {mfu:.3f}, loss {loss_val:.3f})",
-        "vs_baseline": round(mfu / 0.50, 3),
-    }))
+    if os.environ.get("BENCH_LLAMA", "1") != "0":
+        # free the first engine's device state before the larger model lands
+        del engine, model, batch
+        import gc
+        gc.collect()
+        engine, model, batch, knobs = build_llama_bench_engine()
+        _run_metric("llama_gqa_500m_zero3_train_tokens_per_sec_per_chip",
+                    engine, model, batch, knobs["BATCH"], knobs["SEQ"],
+                    STEPS, "GQA 12q/4kv hd128, ZeRO-3, remat=dots")
 
 
 if __name__ == "__main__":
