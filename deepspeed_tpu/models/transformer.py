@@ -361,14 +361,32 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
     return checkpoint_name(proj, "wo_out")
 
 
+def _inside_full_manual(mesh) -> bool:
+    """True when every mesh axis of size > 1 is a manual axis of the current
+    trace — i.e. we are inside a shard_map over all partitioned axes, so
+    array data is fully device-local and a bare ``pallas_call`` is legal.
+    This is how attention under the pipeline engine's stage shard_map
+    reaches the flash kernel (runtime/pipe/engine.py)."""
+    for name, size in mesh.shape.items():
+        if size > 1:
+            try:
+                jax.lax.axis_size(name)
+            except NameError:
+                return False
+    return True
+
+
 def _use_flash(cfg: TransformerConfig) -> bool:
-    """Direct (unwrapped) Pallas flash attention: single-device meshes only —
-    a bare pallas_call is not partitionable by XLA. Multi-device meshes go
-    through :func:`_flash_sharded` (shard_map over batch/head axes) instead."""
+    """Direct (unwrapped) Pallas flash attention: single-device meshes, or a
+    fully-manual shard_map context (every partitioned mesh axis already
+    local, e.g. the pipeline engine's stage bodies) — a bare pallas_call is
+    not partitionable by XLA. Other multi-device meshes go through
+    :func:`_flash_sharded` (shard_map over batch/head axes) instead."""
     if cfg.attention_backend not in ("flash", "auto"):
         return False
     import deepspeed_tpu.comm as dist
-    if dist.has_mesh() and dist.get_mesh().devices.size > 1:
+    if dist.has_mesh() and dist.get_mesh().devices.size > 1 \
+            and not _inside_full_manual(dist.get_mesh()):
         return False
     if cfg.attention_backend == "flash":
         return True

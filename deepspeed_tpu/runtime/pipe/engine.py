@@ -39,6 +39,86 @@ import deepspeed_tpu.comm as dist
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine, TrainState
 
 
+def _stage_map_builder(stage_fn, mesh, num_stages: int, batch_size: int):
+    """Build the per-tick stage executors: a shard_map over (pp × dp/fsdp)
+    when the mesh allows it, else plain vmaps over the stage axis.
+
+    Under the shard_map each device's stage body runs on fully LOCAL arrays
+    (stage extent 1, batch already split over dp), so attention inside the
+    stage reaches the Pallas flash kernel — ``_use_flash`` recognises the
+    fully-manual context (models/transformer.py). The vmap form instead
+    relies on the SPMD partitioner, under which a ``pallas_call`` cannot be
+    placed and attention pays the XLA streaming core. The reference's fused
+    kernels are schedule-agnostic (csrc/transformer/inference/csrc/
+    pt_binding.cpp:1668-1793 run unchanged under PP via
+    runtime/pipe/engine.py forward passes); this is the TPU equivalent.
+
+    Eligibility: pp partitions exactly one stage per device, every other
+    partitioned axis is batch-like (dp/fsdp — tp/ep/sp stage bodies need
+    auto-inserted collectives, which a manual context forbids), and the
+    batch divides the dp extent. Returns ``(fwd, bwd)``:
+
+    - ``fwd(stage_params, bufs, aux, keys) -> outs``
+    - ``bwd(stage_params, x, aux, keys, cots, valid) -> (dstage_params, dx)``
+      (vjp w.r.t. params and input, fp32 grads, zeroed where ``not valid``)
+    """
+    def stage_bwd_one(sp, x, aux, key, cot, valid):
+        y, vjp = jax.vjp(lambda sp_, x_: stage_fn(sp_, x_, aux, key), sp, x)
+        dsp, dx = vjp(cot)
+        z = jnp.where(valid, 1.0, 0.0).astype(jnp.float32)
+        dsp = jax.tree.map(lambda a: a.astype(jnp.float32) * z, dsp)
+        return dsp, dx * z.astype(dx.dtype)
+
+    eligible = (
+        mesh is not None
+        and mesh.shape.get("pp", 1) > 1
+        and mesh.shape["pp"] == num_stages
+        and all(size == 1 or name in ("pp", "dp", "fsdp")
+                for name, size in mesh.shape.items())
+    )
+    if eligible:
+        dp_axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+        nb = 1
+        for a in dp_axes:
+            nb *= mesh.shape[a]
+        eligible = batch_size % nb == 0
+    if not eligible:
+        return (jax.vmap(stage_fn, in_axes=(0, 0, 0, 0)),
+                jax.vmap(stage_bwd_one, in_axes=(0, 0, 0, 0, 0, 0)))
+
+    from jax import shard_map
+
+    dp = dp_axes if len(dp_axes) != 1 else dp_axes[0]
+    pspec = P("pp")                      # stage params / keys / valid flags
+    aspec = P("pp", dp or None)          # activations & aux: [stage, batch, ...]
+
+    def local(tree):
+        return jax.tree.map(lambda a: a[0], tree)
+
+    def fwd_body(sp, x, aux, keys):
+        y = stage_fn(local(sp), x[0], local(aux), keys[0])
+        return y[None]
+
+    def bwd_body(sp, x, aux, keys, cots, valid):
+        dsp, dx = stage_bwd_one(local(sp), x[0], local(aux), keys[0],
+                                cots[0], valid[0])
+        if dp_axes:
+            # the local vjp saw only this shard's batch rows; the param grad
+            # is the sum over the dp extent (the SPMD partitioner inserted
+            # this reduction automatically on the vmap path — a manual
+            # context must say it, or each replica keeps a partial grad)
+            dsp = jax.tree.map(lambda a: jax.lax.psum(a, dp_axes), dsp)
+        return jax.tree.map(lambda a: a[None], dsp), dx[None]
+
+    fwd = shard_map(fwd_body, mesh=mesh,
+                    in_specs=(pspec, aspec, aspec, pspec),
+                    out_specs=aspec, check_vma=False)
+    bwd = shard_map(bwd_body, mesh=mesh,
+                    in_specs=(pspec, aspec, aspec, pspec, aspec, pspec),
+                    out_specs=(pspec, aspec), check_vma=False)
+    return fwd, bwd
+
+
 def spmd_pipeline_loss(embed_fn: Callable,
                        stage_fn: Callable,
                        head_loss_fn: Callable,
@@ -100,7 +180,7 @@ def spmd_pipeline_loss(embed_fn: Callable,
     carry0 = {k: jnp.broadcast_to(mb0[k][None], (S,) + mb0[k].shape) for k in carry_keys}
     bufs, carry0 = constrain(bufs), constrain(carry0)
 
-    vstage = jax.vmap(stage_fn, in_axes=(0, 0, 0, None))
+    vstage, _ = _stage_map_builder(stage_fn, mesh, S, x0.shape[0])
 
     def tick(state, t):
         bufs, aux, loss_sum = state
@@ -111,7 +191,9 @@ def spmd_pipeline_loss(embed_fn: Callable,
             aux[k] = aux[k].at[0].set(mb[k])
         bufs, aux = constrain(bufs), constrain(aux)
 
-        outs = vstage(stage_params, bufs, aux, jax.random.fold_in(rng, t))
+        tick_keys = jax.vmap(lambda s: jax.random.fold_in(
+            jax.random.fold_in(rng, t), s))(jnp.arange(S, dtype=jnp.int32))
+        outs = vstage(stage_params, bufs, aux, tick_keys)
         # last stage completes micro-batch t - (S-1); the head (a full vocab
         # matmul) only runs on ticks where one actually exits
         mb_done = mb_at(t - (S - 1))
@@ -225,6 +307,8 @@ def spmd_pipeline_1f1b(embed_fn: Callable,
     gstages0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), stage_params)
     gns0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), nonstage)
 
+    stage_fwd, stage_bwd = _stage_map_builder(stage_fn, mesh, S, x0.shape[0])
+
     def tick(state, t):
         ring, prev_outs, cots, gstages, gns, loss_sum = state
 
@@ -242,9 +326,8 @@ def spmd_pipeline_1f1b(embed_fn: Callable,
         ring = jnp.swapaxes(ring, 0, 1)
 
         fwd_keys = jax.vmap(lambda s: stage_key(s, t - s))(s_idx)
-        outs = jax.vmap(stage_fn, in_axes=(0, 0, 0, 0))(
-            stage_params, bufs_in,
-            {k: aux_in[k] for k in carry_keys}, fwd_keys)
+        outs = stage_fwd(stage_params, bufs_in,
+                         {k: aux_in[k] for k in carry_keys}, fwd_keys)
 
         # ---- head: micro-batch t - (S-1) exits; loss + cotangent seed ----
         mb_h = mb_at(t - (S - 1))
@@ -280,17 +363,8 @@ def spmd_pipeline_1f1b(embed_fn: Callable,
         bwd_keys = jax.vmap(lambda s, m: stage_key(s, m))(s_idx, m_b)
 
         cot_in = cots.at[S - 1].set(cot_head)
-
-        def stage_bwd(sp, x, aux, key, cot, valid):
-            y, vjp = jax.vjp(lambda sp_, x_: stage_fn(sp_, x_, aux, key), sp, x)
-            dsp, dx = vjp(cot)
-            z = jnp.where(valid, 1.0, 0.0).astype(jnp.float32)
-            dsp = jax.tree.map(lambda a: a.astype(jnp.float32) * z, dsp)
-            dx = dx * z.astype(dx.dtype)
-            return dsp, dx
-
-        dsp, dx = jax.vmap(stage_bwd, in_axes=(0, 0, 0, 0, 0, 0))(
-            stage_params, x_saved, aux_saved, bwd_keys, cot_in, valid_b)
+        dsp, dx = stage_bwd(stage_params, x_saved, aux_saved, bwd_keys,
+                            cot_in, valid_b)
         gstages = jax.tree.map(jnp.add, gstages, dsp)
 
         # ---- embed backward: cotangent exiting stage 0 ----

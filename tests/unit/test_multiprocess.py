@@ -1,0 +1,54 @@
+"""Real multi-process distributed execution (reference ``DistributedTest``,
+``tests/unit/common.py:124-210``): the per-node launcher spawns 2 actual
+processes that rendezvous through ``jax.distributed.initialize``, run a
+cross-process collective, train over the global mesh, and round-trip a
+checkpoint. This is the only automated leg that EXECUTES the launcher path
+and the coordinator rendezvous rather than unit-mocking them.
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import encode_world_info
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+_WORKER = os.path.join(os.path.dirname(__file__), "mp_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_world(tmp_path):
+    env = dict(os.environ)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "",          # never touch a real TPU
+        "JAX_PLATFORMS": "cpu",
+        "DS_ACCELERATOR": "cpu",
+        # one CPU device per process (the suite's conftest forces 8 virtual
+        # devices in-process; the workers must not inherit that)
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PYTHONPATH": _REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    cmd = [
+        sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+        "--world_info", encode_world_info({"localhost": [0, 1]}),
+        "--master_addr", "127.0.0.1",
+        "--master_port", str(_free_port()),
+        _WORKER, str(tmp_path / "ckpt"),
+    ]
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=600, cwd=_REPO)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-4000:]
+    markers = dict(re.findall(r"MP_OK rank=(\d+) loss=([\d.]+)", out))
+    assert set(markers) == {"0", "1"}, out[-4000:]
+    # the compiled step is SPMD: every rank computes the same global loss
+    assert markers["0"] == markers["1"], markers

@@ -391,3 +391,96 @@ def test_pipeline_engine_gpipe_schedule_still_works(devices):
     losses = [float(engine.train_batch({"input_ids": tokens})) for _ in range(6)]
     assert losses[-1] < losses[0], losses
     dist.set_mesh(None)
+
+
+def test_pp_stage_attention_runs_flash_kernel(devices, monkeypatch):
+    """Attention inside pipeline stages reaches the Pallas flash kernel under
+    a pp×dp mesh (the stage shard_map makes the body fully device-local, so
+    the bare pallas_call is legal) — proven by a call counter, with loss
+    parity against the xla attention path. Reference capability: the fused
+    kernels run unchanged under PP (csrc/transformer/inference/csrc/
+    pt_binding.cpp:1668-1793 via runtime/pipe/engine.py forward passes)."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    import deepspeed_tpu.ops.pallas as pallas_pkg
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention as real_flash
+
+    calls = {"n": 0}
+
+    def spy(*a, **k):
+        calls["n"] += 1
+        return real_flash(*a, **k)
+
+    # attention() imports the name from the package at call time
+    monkeypatch.setattr(pallas_pkg, "flash_attention", spy)
+
+    def build(backend):
+        dist.set_mesh(None)
+        from deepspeed_tpu.models.pipeline import PipelinedCausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+        cfg = TransformerConfig(vocab_size=64, n_layer=2, n_head=2, d_model=32,
+                                d_ff=64, max_seq=16, pos_embedding="learned",
+                                tie_embeddings=True, remat=False,
+                                attention_backend=backend)
+        model = PipelinedCausalLM(cfg, num_stages=2)
+        params = model.init_params(jax.random.key(0))
+        config = {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 3,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 1},
+            "mesh": {"pp": 2, "dp": -1},
+            "steps_per_print": 0,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config=config)
+        return engine
+
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(0, 64, size=(3 * 2 * 4, 16)).astype(np.int32)
+
+    flash_engine = build("flash")
+    loss_flash = float(flash_engine.train_batch({"input_ids": tokens}))
+    assert calls["n"] > 0, "flash kernel was not dispatched under the pp mesh"
+    n_flash = calls["n"]
+
+    xla_engine = build("xla")
+    loss_xla = float(xla_engine.train_batch({"input_ids": tokens}))
+    assert calls["n"] == n_flash, "xla path unexpectedly reached the kernel"
+    assert abs(loss_flash - loss_xla) < 1e-3, (loss_flash, loss_xla)
+    dist.set_mesh(None)
+
+
+def test_pp_shard_map_grads_match_vmap_path(devices):
+    """The stage shard_map path (pp×dp mesh) must produce the SAME gradients
+    as the plain vmap path — in particular the stage-param grads must carry
+    the full sum over the dp batch shards (the manual context needs an
+    explicit psum where the SPMD partitioner inserted one automatically)."""
+    from deepspeed_tpu.runtime.pipe.engine import spmd_pipeline_1f1b
+    import deepspeed_tpu.comm as dist
+
+    model = _tiny_pipe_model()
+    params = model.init_params(jax.random.key(0))
+    spec = model.pipeline_spec()
+    rng = np.random.default_rng(5)
+    M, B, S = 4, 4, 16  # B=4 splits over dp=2
+    mbs = {"input_ids": jnp.asarray(rng.integers(0, 64, size=(M, B, S)), jnp.int32)}
+    key = jax.random.key(1)
+
+    dist.set_mesh(None)
+    ref_loss, ref_grads = spmd_pipeline_1f1b(
+        spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
+        params, mbs, key, 4, mesh=None)
+
+    mesh = Mesh(np.array(devices[:8]).reshape(4, 2), ("pp", "dp"))
+    dist.set_mesh(mesh)
+    try:
+        loss, grads = spmd_pipeline_1f1b(
+            spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
+            params, mbs, key, 4, mesh=mesh)
+        assert abs(float(loss) - float(ref_loss)) < 1e-4
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=5e-3, atol=5e-5), grads, ref_grads)
+    finally:
+        dist.set_mesh(None)
