@@ -38,7 +38,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.autotuning.config import (AutotuningConfig, METRIC_LATENCY,
-                                             METRIC_THROUGHPUT, TUNER_RANDOM)
+                                             METRIC_THROUGHPUT,
+                                             TUNER_MODELBASED, TUNER_RANDOM)
 from deepspeed_tpu.utils.logging import logger
 
 _GIB = 1024**3
@@ -251,24 +252,47 @@ class Autotuner:
         logger.info(f"autotuning: HBM budget {budget / _GIB:.2f} GiB, "
                     f"metric={self.config.metric}, tuner={self.config.tuner_type}")
 
+        if self.config.tuner_type == TUNER_MODELBASED:
+            best = self._search_model_based()
+        else:
+            best = self._search_sequential()
+
+        if best is None:
+            raise RuntimeError("autotuning: no candidate fit the memory budget")
+        optimal = self.optimal_config(best.candidate)
+        self._write_results(optimal)
+        return optimal
+
+    def _prune_record(self, cand: Candidate) -> Record:
+        fits, est = self.prune(cand)
+        rec = Record(candidate=cand, pruned=not fits, est_bytes=est)
+        self._records.append(rec)
+        if not fits:
+            logger.info(f"autotuning: prune {cand.name()} "
+                        f"(~{est / _GIB:.2f} GiB > budget)")
+        return rec
+
+    def _measure_record(self, rec: Record) -> bool:
+        """Measure one survivor in place; False (and ``pruned``) on failure."""
+        try:
+            rec.metric_val = self.measure(rec.candidate)
+        except Exception as e:  # noqa: BLE001 - record + keep searching
+            logger.warning(f"autotuning: {rec.candidate.name()} failed to run "
+                           f"({e}); skipped")
+            rec.pruned = True
+            return False
+        logger.info(f"autotuning: {rec.candidate.name()} -> {rec.metric_val:.1f} "
+                    f"({self.config.metric})")
+        return True
+
+    def _search_sequential(self) -> Optional[Record]:
+        """Grid/random order: prune + measure candidates as they come."""
         best: Optional[Record] = None
         stale = 0
         for cand in self.candidates():
-            fits, est = self.prune(cand)
-            rec = Record(candidate=cand, pruned=not fits, est_bytes=est)
-            self._records.append(rec)
-            if not fits:
-                logger.info(f"autotuning: prune {cand.name()} "
-                            f"(~{est / _GIB:.2f} GiB > budget)")
+            rec = self._prune_record(cand)
+            if rec.pruned or not self._measure_record(rec):
                 continue
-            try:
-                rec.metric_val = self.measure(cand)
-            except Exception as e:  # noqa: BLE001 - record + keep searching
-                logger.warning(f"autotuning: {cand.name()} failed to run ({e}); skipped")
-                rec.pruned = True
-                continue
-            logger.info(f"autotuning: {cand.name()} -> {rec.metric_val:.1f} "
-                        f"({self.config.metric})")
             if best is None or self._better(rec.metric_val, best.metric_val):
                 best, stale = rec, 0
             else:
@@ -276,12 +300,60 @@ class Autotuner:
                 if stale >= self.config.tuner_early_stopping:
                     logger.info("autotuning: early stopping")
                     break
+        return best
 
-        if best is None:
-            raise RuntimeError("autotuning: no candidate fit the memory budget")
-        optimal = self.optimal_config(best.candidate)
-        self._write_results(optimal)
-        return optimal
+    def _search_model_based(self) -> Optional[Record]:
+        """Cost-model-steered measure order (reference
+        ``autotuning/tuner/model_based_tuner.py`` capability): AOT-prune the
+        whole space, measure a few spread-out seeds, then repeatedly fit the
+        model on everything measured and measure the best-predicted
+        survivor next — reaching the winner in fewer measured trials than
+        walking the grid."""
+        from deepspeed_tpu.autotuning.cost_model import CostModel, featurize
+
+        survivors = [r for r in (self._prune_record(c) for c in self.candidates())
+                     if not r.pruned]
+        if not survivors:
+            return None
+
+        best: Optional[Record] = None
+
+        def run(rec: Record) -> bool:
+            nonlocal best
+            if not self._measure_record(rec):
+                return False
+            if best is None or self._better(rec.metric_val, best.metric_val):
+                best = rec
+                return True
+            return False
+
+        n_seed = min(self.config.tuner_num_seed_trials, len(survivors))
+        seed_idx = sorted({round(i * (len(survivors) - 1) / max(1, n_seed - 1))
+                           for i in range(n_seed)})
+        for i in seed_idx:
+            run(survivors[i])
+
+        model = CostModel()
+        stale, trials = 0, sum(r.metric_val is not None for r in survivors)
+        while trials < self.config.tuner_num_trials:
+            done = [r for r in survivors if r.metric_val is not None]
+            pending = [r for r in survivors
+                       if r.metric_val is None and not r.pruned]
+            if not done or not pending:
+                break
+            model.fit([featurize(r.candidate, r.est_bytes) for r in done],
+                      [r.metric_val for r in done])
+            preds = model.predict([featurize(r.candidate, r.est_bytes)
+                                   for r in pending])
+            pick = int(np.argmax(preds) if self.config.metric == METRIC_THROUGHPUT
+                       else np.argmin(preds))
+            improved = run(pending[pick])
+            trials += 1
+            stale = 0 if improved else stale + 1
+            if stale >= self.config.tuner_early_stopping:
+                logger.info("autotuning: early stopping (model-based)")
+                break
+        return best
 
     def _better(self, a: float, b: float) -> bool:
         return a > b if self.config.metric == METRIC_THROUGHPUT else a < b

@@ -22,13 +22,18 @@ METRIC_LATENCY = "latency"
 
 TUNER_GRIDSEARCH = "gridsearch"
 TUNER_RANDOM = "random"
+TUNER_MODELBASED = "model_based"
 
 
 class AutotuningConfig(ConfigModel):
     enabled: bool = False
     fast: bool = True                      # fast mode: micro-batch only, fixed policies
     metric: str = Field(METRIC_THROUGHPUT, pattern="^(throughput|latency)$")
-    tuner_type: str = Field(TUNER_GRIDSEARCH, pattern="^(gridsearch|random)$")
+    tuner_type: str = Field(TUNER_GRIDSEARCH,
+                            pattern="^(gridsearch|random|model_based)$")
+    # model_based: how many spread-out survivors seed the cost model before
+    # prediction starts steering the measure order
+    tuner_num_seed_trials: int = Field(3, ge=1)
     tuner_num_trials: int = Field(50, ge=1)
     tuner_early_stopping: int = Field(5, ge=1)
     results_dir: str = "autotuning_results"

@@ -109,3 +109,62 @@ class TestTune:
         }, seq_len=32, autotuning_config=cfg)
         val = at.measure(Candidate(stage=1, micro_batch=2, remat="dots", loss_chunk=0))
         assert val > 0
+
+
+class TestModelBasedTuner:
+
+    def _base(self, tmp_path, **over):
+        cfg = AutotuningConfig(
+            fast=False, zero_stages=[1], remat_policies=["none", "dots"],
+            loss_chunks=[0, 2048], min_train_micro_batch_size_per_gpu=1,
+            max_train_micro_batch_size_per_gpu=8,
+            results_dir=str(tmp_path), tuner_num_trials=50, **over)
+        return Autotuner(tiny_model(), base_config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True}, "steps_per_print": 0,
+        }, seq_len=32, autotuning_config=cfg)
+
+    @staticmethod
+    def _fake_measure(measured):
+        # throughput grows with mbs, 'dots' beats 'none', chunking helps:
+        # smooth in the cost model's ordinal features
+        def fake(cand):
+            measured.append(cand.name())
+            return (cand.micro_batch * 100 + (50 if cand.remat == "dots" else 0)
+                    + (5 if cand.loss_chunk else 0))
+        return fake
+
+    def test_same_winner_fewer_trials_than_grid(self, tmp_path, monkeypatch):
+        """The cost model must steer to the grid's winner while measuring
+        fewer candidates (reference model_based_tuner capability)."""
+        grid_measured, mb_measured = [], []
+
+        at_grid = self._base(tmp_path / "grid")
+        monkeypatch.setattr(at_grid, "prune", lambda c: (True, 0))
+        monkeypatch.setattr(at_grid, "measure", self._fake_measure(grid_measured))
+        best_grid = at_grid.tune()
+
+        at_mb = self._base(tmp_path / "mb", tuner_type="model_based",
+                           tuner_early_stopping=2)
+        monkeypatch.setattr(at_mb, "prune", lambda c: (True, 0))
+        monkeypatch.setattr(at_mb, "measure", self._fake_measure(mb_measured))
+        best_mb = at_mb.tune()
+
+        assert best_mb["train_micro_batch_size_per_gpu"] == \
+            best_grid["train_micro_batch_size_per_gpu"] == 8
+        assert best_mb["model_overrides"] == best_grid["model_overrides"]
+        assert len(mb_measured) < len(grid_measured), (mb_measured, grid_measured)
+
+    def test_prediction_steers_measure_order(self, tmp_path, monkeypatch):
+        """After seeding, the next measured candidate is the best-PREDICTED
+        one, not the next grid entry."""
+        measured = []
+        at = self._base(tmp_path, tuner_type="model_based",
+                        tuner_num_seed_trials=3, tuner_early_stopping=3)
+        monkeypatch.setattr(at, "prune", lambda c: (True, 0))
+        monkeypatch.setattr(at, "measure", self._fake_measure(measured))
+        at.tune()
+        n_seed = 3
+        # first post-seed pick: large mbs (the dominant measured trend)
+        assert "mbs8" in measured[n_seed] or "mbs4" in measured[n_seed], measured
