@@ -375,3 +375,68 @@ class TestEngineCurriculum:
         assert engine.curriculum_scheduler.get_current_difficulty() == 32
         assert np.isfinite(l)
         dist.set_mesh(None)
+
+
+class TestDataAnalyzerMapReduce:
+
+    def test_multi_worker_map_reduce(self, tmp_path):
+        """3 file-coordinated workers (the reference's separate-process
+        protocol) must reduce to the same values/index as one worker."""
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+            DataAnalyzer, load_metric_index, load_metric_values)
+
+        rng = np.random.default_rng(1)
+        dataset = [rng.integers(0, 50, size=rng.integers(4, 17)).tolist()
+                   for _ in range(40)]
+
+        solo = str(tmp_path / "solo")
+        DataAnalyzer(dataset, ["seqlen"], [len], solo).run()
+
+        multi = str(tmp_path / "multi")
+        for w in range(3):
+            DataAnalyzer(dataset, ["seqlen"], [len], multi,
+                         num_workers=3, worker_id=w).run_map()
+        DataAnalyzer(dataset, ["seqlen"], [len], multi,
+                     num_workers=3, worker_id=0).run_reduce()
+
+        np.testing.assert_array_equal(load_metric_values(multi, "seqlen"),
+                                      load_metric_values(solo, "seqlen"))
+        assert load_metric_index(multi, "seqlen") == \
+            load_metric_index(solo, "seqlen")
+
+    def test_accumulate_metric_family(self, tmp_path):
+        """accumulate_value_over_samples: worker partial histograms sum to
+        the whole-dataset histogram (reference's second metric family)."""
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+            ACCUMULATE, DataAnalyzer, load_metric_values)
+
+        vocab = 32
+        rng = np.random.default_rng(2)
+        dataset = [rng.integers(0, vocab, size=12).tolist() for _ in range(30)]
+
+        def token_hist(sample):
+            return np.bincount(np.asarray(sample), minlength=vocab)
+
+        path = str(tmp_path / "hist")
+        for w in range(2):
+            DataAnalyzer(dataset, ["tokfreq"], [token_hist], path,
+                         num_workers=2, worker_id=w,
+                         metric_types=[ACCUMULATE]).run_map()
+        DataAnalyzer(dataset, ["tokfreq"], [token_hist], path,
+                     num_workers=2, worker_id=0,
+                     metric_types=[ACCUMULATE]).run_reduce()
+
+        expect = np.zeros(vocab, np.int64)
+        for s in dataset:
+            expect += np.bincount(np.asarray(s), minlength=vocab)
+        np.testing.assert_array_equal(load_metric_values(path, "tokfreq"), expect)
+
+    def test_percentiles(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import (
+            DataAnalyzer, get_metric_value_percentiles)
+
+        dataset = [[0] * n for n in range(1, 101)]  # seqlen 1..100
+        path = str(tmp_path / "pct")
+        DataAnalyzer(dataset, ["seqlen"], [len], path).run()
+        pct = get_metric_value_percentiles(path, "seqlen", (50,))
+        assert abs(pct[50.0] - 50.5) < 1.0
