@@ -115,6 +115,73 @@ class MPICHRunner(MultiNodeRunner):
         return mpirun_cmd + export_cmd + [sys.executable, "-u", self.user_script] + self.user_arguments
 
 
+class MVAPICHRunner(MultiNodeRunner):
+    """MVAPICH2 mpirun (reference ``:253``): one rank per chip, hostfile
+    written from the world layout, MV2 tuning exports. The reference's
+    CUDA-specific flags (MV2_USE_CUDA, GDR detection) have no TPU
+    equivalent and are dropped; the generic MV2 exports are kept."""
+
+    HOSTFILE = "/tmp/deepspeed_tpu_mvapich_hostfile"
+
+    def __init__(self, args, world_info_base64: str):
+        super().__init__(args, world_info_base64)
+        self.add_export("MV2_SMP_USE_CMA", "0")        # CMA absent on Ubuntu
+        self.add_export("MV2_DEBUG_SHOW_BACKTRACE", "1")
+        self.add_export("MV2_SUPPORT_DL", "1")
+        self.add_export("MV2_ENABLE_AFFINITY", "0")    # MPI_THREAD_MULTIPLE
+
+    def backend_exists(self) -> bool:
+        # mpiname ships with mvapich; plain `mpirun` alone could be openmpi
+        return _which("mpiname")
+
+    def get_cmd(self, environment, active_resources):
+        per_node = [len(v) for v in active_resources.values()]
+        if len(set(per_node)) > 1:
+            raise ValueError("mvapich requires the same number of chips per node")
+        total_process_count = sum(per_node)
+        with open(self.HOSTFILE, "w") as fd:
+            for host in active_resources:
+                fd.write(f"{host}\n")
+        mpirun_cmd = [
+            "mpirun", "-np", f"{total_process_count}",
+            "-ppn", f"{per_node[0]}",
+            "--hostfile", self.HOSTFILE,
+        ] + shlex.split(getattr(self.args, "launcher_args", "") or "")
+        self.add_export("MASTER_ADDR", str(self.args.master_addr))
+        self.add_export("MASTER_PORT", str(self.args.master_port))
+        export_cmd = []
+        for k, v in self.exports.items():
+            export_cmd += ["-env", f"{k}={v}"]
+        return mpirun_cmd + export_cmd + [sys.executable, "-u", self.user_script] \
+            + self.user_arguments
+
+
+class IMPIRunner(MultiNodeRunner):
+    """Intel MPI mpirun (reference ``:184``): rank/size via PMI env, per-host
+    -hosts list, -genv exports."""
+
+    def backend_exists(self) -> bool:
+        return _which("mpirun")
+
+    def get_cmd(self, environment, active_resources):
+        per_node = [len(v) for v in active_resources.values()]
+        if len(set(per_node)) > 1:
+            raise ValueError("impi requires the same number of chips per node")
+        total_process_count = sum(per_node)
+        mpirun_cmd = [
+            "mpirun", "-ppn", f"{per_node[0]}",
+            "-n", f"{total_process_count}",
+            "-hosts", ",".join(active_resources.keys()),
+        ] + shlex.split(getattr(self.args, "launcher_args", "") or "")
+        self.add_export("MASTER_ADDR", str(self.args.master_addr))
+        self.add_export("MASTER_PORT", str(self.args.master_port))
+        export_cmd = []
+        for k, v in self.exports.items():
+            export_cmd += ["-genv", k, str(v)]
+        return mpirun_cmd + export_cmd + [sys.executable, "-u", self.user_script] \
+            + self.user_arguments
+
+
 class SlurmRunner(MultiNodeRunner):
     def backend_exists(self) -> bool:
         return _which("sinfo")

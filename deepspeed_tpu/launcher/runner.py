@@ -22,8 +22,8 @@ import sys
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
-from deepspeed_tpu.launcher.multinode_runner import (MPICHRunner, OpenMPIRunner, PDSHRunner,
-                                                     SlurmRunner)
+from deepspeed_tpu.launcher.multinode_runner import (IMPIRunner, MPICHRunner, MVAPICHRunner,
+                                                     OpenMPIRunner, PDSHRunner, SlurmRunner)
 from deepspeed_tpu.utils.logging import logger
 
 DLTS_HOSTFILE = "/job/hostfile"
@@ -49,7 +49,7 @@ def parse_args(args=None):
                         default=int(os.environ.get("DLTS_MASTER_PORT", 29500)))
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--launcher", type=str, default="pdsh",
-                        choices=["pdsh", "openmpi", "mpich", "slurm"])
+                        choices=["pdsh", "openmpi", "mpich", "slurm", "mvapich", "impi"])
     parser.add_argument("--launcher_args", type=str, default="")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("--save_pid", action="store_true")
@@ -208,7 +208,8 @@ def main(args=None):
         return
 
     runner_cls = {"pdsh": PDSHRunner, "openmpi": OpenMPIRunner,
-                  "mpich": MPICHRunner, "slurm": SlurmRunner}[args.launcher]
+                  "mpich": MPICHRunner, "slurm": SlurmRunner,
+                  "mvapich": MVAPICHRunner, "impi": IMPIRunner}[args.launcher]
     runner = runner_cls(args, world_info)
     if not runner.backend_exists():
         raise RuntimeError(f"launcher backend {args.launcher} not installed on this host")

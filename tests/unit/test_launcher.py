@@ -14,8 +14,8 @@ from deepspeed_tpu.elasticity import (ElasticityIncompatibleWorldSize, compute_e
                                       get_candidate_batch_sizes, get_valid_gpus)
 from deepspeed_tpu.launcher import launch as ds_launch
 from deepspeed_tpu.launcher import runner as ds_runner
-from deepspeed_tpu.launcher.multinode_runner import (MPICHRunner, OpenMPIRunner, PDSHRunner,
-                                                     SlurmRunner)
+from deepspeed_tpu.launcher.multinode_runner import (IMPIRunner, MPICHRunner, MVAPICHRunner,
+                                                     OpenMPIRunner, PDSHRunner, SlurmRunner)
 
 
 _REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
@@ -154,6 +154,31 @@ class TestMultinodeRunners:
         export_val = cmd[cmd.index("--export") + 1]
         assert export_val.startswith("ALL,") and "A=b" in export_val
         assert "MASTER_ADDR=worker-0" in export_val  # coordinator rides along
+
+    def test_mvapich_cmd(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(MVAPICHRunner, "HOSTFILE", str(tmp_path / "hosts"))
+        runner = MVAPICHRunner(_Args(), "WORLDINFO")
+        cmd = runner.get_cmd({}, self.RESOURCES)
+        assert cmd[:5] == ["mpirun", "-np", "4", "-ppn", "2"]
+        assert "-env" in cmd and "MV2_SUPPORT_DL=1" in cmd
+        assert "MASTER_ADDR=worker-0" in cmd
+        hosts = (tmp_path / "hosts").read_text().split()
+        assert hosts == ["worker-0", "worker-1"]
+        assert cmd[-4:] == ["-u", "train.py", "--foo", "bar"]
+
+    def test_mvapich_rejects_uneven_nodes(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(MVAPICHRunner, "HOSTFILE", str(tmp_path / "hosts"))
+        runner = MVAPICHRunner(_Args(), "WORLDINFO")
+        with pytest.raises(ValueError, match="same number"):
+            runner.get_cmd({}, {"worker-0": [0, 1], "worker-1": [0]})
+
+    def test_impi_cmd(self):
+        runner = IMPIRunner(_Args(), "WORLDINFO")
+        cmd = runner.get_cmd({}, self.RESOURCES)
+        assert cmd[:5] == ["mpirun", "-ppn", "2", "-n", "4"]
+        assert "-hosts" in cmd and "worker-0,worker-1" in cmd
+        assert "-genv" in cmd and "MASTER_PORT" in cmd
+        assert cmd[-4:] == ["-u", "train.py", "--foo", "bar"]
 
 
 class TestLocalLaunch:
