@@ -451,11 +451,13 @@ def test_pp_stage_attention_runs_flash_kernel(devices, monkeypatch):
     dist.set_mesh(None)
 
 
-def test_pp_shard_map_grads_match_vmap_path(devices):
-    """The stage shard_map path (pp×dp mesh) must produce the SAME gradients
-    as the plain vmap path — in particular the stage-param grads must carry
-    the full sum over the dp batch shards (the manual context needs an
-    explicit psum where the SPMD partitioner inserted one automatically)."""
+@pytest.mark.parametrize("batch_axis", ["dp", "fsdp"])
+def test_pp_shard_map_grads_match_vmap_path(devices, batch_axis):
+    """The stage shard_map path (pp × dp/fsdp mesh) must produce the SAME
+    gradients as the plain vmap path — in particular the stage-param grads
+    must carry the full sum over the batch shards (the manual context needs
+    an explicit psum where the SPMD partitioner inserted one
+    automatically)."""
     from deepspeed_tpu.runtime.pipe.engine import spmd_pipeline_1f1b
     import deepspeed_tpu.comm as dist
 
@@ -472,7 +474,7 @@ def test_pp_shard_map_grads_match_vmap_path(devices):
         spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
         params, mbs, key, 4, mesh=None)
 
-    mesh = Mesh(np.array(devices[:8]).reshape(4, 2), ("pp", "dp"))
+    mesh = Mesh(np.array(devices[:8]).reshape(4, 2), ("pp", batch_axis))
     dist.set_mesh(mesh)
     try:
         loss, grads = spmd_pipeline_1f1b(
