@@ -111,11 +111,15 @@ def build_llama_bench_engine():
 
     BATCH = int(os.environ.get("BENCH_LLAMA_BATCH", 4))
     SEQ = int(os.environ.get("BENCH_LLAMA_SEQ", 2048))
+    blk_q = int(os.environ.get("BENCH_BLOCK_Q", 0)) or None
+    blk_k = int(os.environ.get("BENCH_BLOCK_K", 0)) or None
     model = llama("tiny", n_layer=16, n_head=12, n_kv_head=4, d_model=1536,
                   d_ff=4096, max_seq=SEQ,
                   remat=os.environ.get("BENCH_REMAT", "dots"),
                   loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", 2048)),
-                  attention_backend=os.environ.get("BENCH_ATTN", "auto"))
+                  attention_backend=os.environ.get("BENCH_ATTN", "auto"),
+                  scan_layers=os.environ.get("BENCH_LLAMA_SCAN", "1") == "1",
+                  attn_block_q=blk_q, attn_block_k=blk_k)
     params = model.init_params(jax.random.key(0))
 
     dist.set_mesh(None)
@@ -186,19 +190,22 @@ def main():
     if STEPS < 1:
         print("bench: BENCH_STEPS must be >= 1", file=sys.stderr)
         sys.exit(1)
-    engine, model, batch, knobs = build_bench_engine()
-    # warmup/compile inside _run_metric; float() forces a host fetch — the
-    # only reliable sync point over remote-tunnel device transports
-    # (block_until_ready/effects_barrier return before remote execution
-    # finishes)
-    _run_metric("gpt2_125m_train_tokens_per_sec_per_chip", engine, model,
-                batch, knobs["BATCH"], knobs["SEQ"], STEPS,
-                f"ZeRO-1, remat={knobs['remat_env']}, "
-                f"loss_chunk={knobs['LOSS_CHUNK']}")
+    engine = None
+    if os.environ.get("BENCH_GPT2", "1") != "0":
+        engine, model, batch, knobs = build_bench_engine()
+        # warmup/compile inside _run_metric; float() forces a host fetch —
+        # the only reliable sync point over remote-tunnel device transports
+        # (block_until_ready/effects_barrier return before remote execution
+        # finishes)
+        _run_metric("gpt2_125m_train_tokens_per_sec_per_chip", engine, model,
+                    batch, knobs["BATCH"], knobs["SEQ"], STEPS,
+                    f"ZeRO-1, remat={knobs['remat_env']}, "
+                    f"loss_chunk={knobs['LOSS_CHUNK']}")
 
     if os.environ.get("BENCH_LLAMA", "1") != "0":
         # free the first engine's device state before the larger model lands
-        del engine, model, batch
+        if engine is not None:
+            del engine, model, batch
         import gc
         gc.collect()
         engine, model, batch, knobs = build_llama_bench_engine()

@@ -11,6 +11,7 @@ two ways:
 Usage::
 
     python benchmarks/profile_bench.py [--steps 5] [--trace-dir /tmp/ds_trace]
+                                       [--config gpt2|llama]
 
 Knobs are bench.py's env vars (BENCH_BATCH/SEQ/REMAT/LOSS_CHUNK/OPT...).
 This feeds the PARITY.md perf breakdown (VERDICT r3 ask 1: remat
@@ -31,13 +32,16 @@ def main():
                     help="timed steps (>= 1)")
     ap.add_argument("--trace-dir", default=None,
                     help="write a jax.profiler trace here (TPU: perfetto/TB)")
+    ap.add_argument("--config", choices=("gpt2", "llama"), default="gpt2",
+                    help="which bench metric's engine to profile")
     args = ap.parse_args()
     if args.steps < 1:
         ap.error("--steps must be >= 1")
 
     import sys
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-    from bench import _probe_backend, build_bench_engine
+    from bench import (_probe_backend, build_bench_engine,
+                       build_llama_bench_engine)
 
     if os.environ.get("BENCH_SKIP_PROBE") != "1":
         err = _probe_backend()
@@ -48,7 +52,8 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    engine, model, batch, knobs = build_bench_engine()
+    build = build_llama_bench_engine if args.config == "llama" else build_bench_engine
+    engine, model, batch, knobs = build()
     BATCH, SEQ = knobs["BATCH"], knobs["SEQ"]
 
     # ---- 1. AOT cost analysis of the compiled step ----

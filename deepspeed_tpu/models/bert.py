@@ -110,6 +110,11 @@ class BertModel:
             raise ValueError("model has no MLM head (with_mlm_head=False)")
         x, _ = self(params, input_ids, token_type_ids, attention_mask)
         m = params["mlm"]
-        h = jax.nn.gelu(x @ m["w"] + m["b"], approximate=False)
+        # HF BertPredictionHeadTransform applies config.hidden_act, not a
+        # fixed gelu — relu/gelu_new checkpoints diverge otherwise
+        act = {"gelu_exact": lambda h: jax.nn.gelu(h, approximate=False),
+               "gelu": lambda h: jax.nn.gelu(h, approximate=True),
+               "relu": jax.nn.relu}[self.config.activation]
+        h = act(x @ m["w"] + m["b"])
         h = T._norm(self.zoo_cfg, h, m["ln"])
         return h @ params["embed"]["tokens"].T + m["decoder_bias"]

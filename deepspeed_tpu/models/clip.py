@@ -33,6 +33,9 @@ class CLIPTextConfig:
     d_ff: int = 2048
     norm_eps: float = 1e-5
     projection_dim: Optional[int] = None  # None => no text projection
+    # original CLIP uses quick_gelu; SD2-era OpenCLIP text towers use exact
+    # gelu (HF hidden_act="gelu")
+    activation: str = "quick_gelu"
     # pooled-token selection follows HF CLIPTextModel exactly: with
     # eos_token_id == 2 (or None) the LEGACY rule applies — pool at
     # argmax(token_id), which works because 49407 (eot) is the max id in the
@@ -44,7 +47,7 @@ class CLIPTextConfig:
             vocab_size=self.vocab_size, max_seq=self.max_seq,
             n_layer=self.n_layer, n_head=self.n_head, d_model=self.d_model,
             d_ff=self.d_ff, pos_embedding="learned", norm="layernorm",
-            activation="quick_gelu", causal=True, attn_bias=True,
+            activation=self.activation, causal=True, attn_bias=True,
             # tie_embeddings just suppresses the (unused) lm_head alloc —
             # the encoder never projects to vocab
             norm_eps=self.norm_eps, tie_embeddings=True)
@@ -60,6 +63,7 @@ class CLIPVisionConfig:
     d_ff: int = 3072
     norm_eps: float = 1e-5
     projection_dim: Optional[int] = None
+    activation: str = "quick_gelu"
 
     @property
     def n_patches(self) -> int:
@@ -69,7 +73,7 @@ class CLIPVisionConfig:
         return T.TransformerConfig(
             vocab_size=1, max_seq=self.n_patches + 1, n_layer=self.n_layer,
             n_head=self.n_head, d_model=self.d_model, d_ff=self.d_ff,
-            pos_embedding="none", norm="layernorm", activation="quick_gelu",
+            pos_embedding="none", norm="layernorm", activation=self.activation,
             causal=False, attn_bias=True, norm_eps=self.norm_eps,
             tie_embeddings=True)
 
@@ -94,6 +98,18 @@ class CLIPTextEncoder:
                 k, (self.config.d_model, self.config.projection_dim),
                 jnp.float32) * self.config.d_model**-0.5
         return out
+
+    def forward(self, params, tokens, attn_mask=None):
+        """InferenceEngine-compatible surface (``fwd(params, tokens, mask)``):
+        last hidden states. CLIP's serving flow (SD text conditioning) pads
+        with EOT tokens instead of masking; a mask is rejected loudly rather
+        than silently ignored."""
+        if attn_mask is not None:
+            raise ValueError(
+                "CLIPTextEncoder takes no padding mask (CLIP pads with EOT "
+                "tokens); pass attention_mask=None")
+        hidden, _ = self(params, tokens)
+        return hidden
 
     def __call__(self, params, tokens):
         """tokens [B, S] → (last_hidden [B, S, D], pooled [B, D or proj])."""
@@ -143,6 +159,13 @@ class CLIPVisionEncoder:
                 k4, (c.d_model, c.projection_dim), jnp.float32) * c.d_model**-0.5
         return out
 
+    def forward(self, params, tokens, attn_mask=None):
+        """Reject the generic InferenceEngine forward path loudly: the
+        engine's surface is token ids, a vision tower consumes images."""
+        raise ValueError(
+            "CLIPVisionEncoder serves via __call__(params, images[B,H,W,3]), "
+            "not the generic init_inference forward path")
+
     def _patchify(self, images):
         """[B, H, W, 3] → [B, n_patches, 3*ps*ps] (NHWC, TPU-preferred)."""
         c = self.config
@@ -183,6 +206,14 @@ class DSClipEncoder:
         self.vision = vision
         self._text_fn = jax.jit(lambda p, t: text(p, t))
         self._vision_fn = jax.jit(lambda p, im: vision(p, im)) if vision else None
+
+    def forward(self, params, tokens, attn_mask=None):
+        """Reject the generic InferenceEngine forward path loudly: a
+        two-tower CLIP has no single forward surface."""
+        raise ValueError(
+            "DSClipEncoder serves via encode_text(params['text'], tokens) / "
+            "encode_image(params['vision'], images), not the generic "
+            "init_inference forward path")
 
     def encode_text(self, params, tokens):
         return self._text_fn(params, tokens)

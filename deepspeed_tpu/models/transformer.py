@@ -64,6 +64,12 @@ class TransformerConfig:
     sequence_parallel: str = "none"      # none | ring | ulysses
     # attention kernel: auto = Pallas flash on TPU, XLA einsum elsewhere
     attention_backend: str = "auto"      # auto | flash | xla
+    # flash kernel block sizes on the direct / batch-head-sharded kernel
+    # paths; None = the kernel's measured defaults (whole-sequence blocks at
+    # S <= 1024, 512x512 above). The sp (ring/ulysses) paths keep their own
+    # shard-local block tuning and warn if these are set.
+    attn_block_q: Optional[int] = None
+    attn_block_k: Optional[int] = None
     # cross-entropy in sequence chunks of this many tokens: never
     # materialises the full [B, S, vocab] logits (0 = unchunked)
     loss_chunk: int = 0
@@ -315,6 +321,11 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
         # ulysses all-to-all move H/KV-times less data); the shard bodies
         # broadcast kv heads locally
         from deepspeed_tpu.sequence import sp_attention
+        if cfg.attn_block_q or cfg.attn_block_k:
+            from deepspeed_tpu.utils.logging import warn_once
+            warn_once("attn_block_q/attn_block_k apply to the direct and "
+                      "batch/head-sharded flash paths; the sequence-parallel "
+                      "kernels keep their own shard-local block tuning")
         out = sp_attention(q, k, v, mesh=sp_mesh, impl=cfg.sequence_parallel,
                            causal=cfg.causal, mask_bias=mask_bias,
                            alibi_slopes=slopes, scale=cfg.attn_scale)
@@ -331,7 +342,9 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
                 from deepspeed_tpu.ops.pallas import flash_attention
                 out = flash_attention(q, k, v, mask_bias=mask_bias,
                                       causal=cfg.causal, alibi_slopes=slopes,
-                                      scale=cfg.attn_scale)
+                                      scale=cfg.attn_scale,
+                                      block_q=cfg.attn_block_q,
+                                      block_k=cfg.attn_block_k)
             else:
                 out = _flash_sharded(cfg, q, k, v, mask_bias, slopes, fmesh)
         if out is None and S > DENSE_STREAM_THRESHOLD:
@@ -485,7 +498,9 @@ def _flash_sharded(cfg: TransformerConfig, q, k, v, mask_bias, slopes, mesh):
         ms = rest.pop(0) if mask_bias is not None else None
         ss = rest.pop(0) if slopes is not None else None
         return flash_attention(qs, ks, vs, mask_bias=ms, causal=cfg.causal,
-                               alibi_slopes=ss, scale=cfg.attn_scale)
+                               alibi_slopes=ss, scale=cfg.attn_scale,
+                               block_q=cfg.attn_block_q,
+                               block_k=cfg.attn_block_k)
 
     wrapped = shard_map(inner, mesh=mesh, in_specs=tuple(specs),
                        out_specs=qspec, check_vma=False)

@@ -595,11 +595,289 @@ class DistilBertPolicy(HFPolicy):
         return out
 
 
+class BertPolicy(HFPolicy):
+    """HF ``bert`` (reference ``containers/bert.py`` HFBertLayerPolicy):
+    post-LN encoder with token-type embeddings, optional pooler, optional
+    MLM head (``cls.predictions.*``, decoder tied to the word embeddings).
+    Serves through the zoo BertModel's fill-mask / feature surface."""
+
+    model_type = "bert"
+
+    _ACTS = {"gelu": "gelu_exact", "gelu_new": "gelu",
+             "gelu_pytorch_tanh": "gelu", "relu": "relu"}
+
+    def zoo_config(self, hf):
+        pet = hf.get("position_embedding_type", "absolute")
+        if pet != "absolute":
+            raise ValueError(f"unsupported BERT position_embedding_type {pet!r}")
+        act = self._ACTS.get(hf.get("hidden_act", "gelu"))
+        if act is None:
+            raise ValueError(f"unsupported BERT hidden_act {hf.get('hidden_act')!r}")
+        return TransformerConfig(
+            vocab_size=hf["vocab_size"], n_layer=hf["num_hidden_layers"],
+            n_head=hf["num_attention_heads"], d_model=hf["hidden_size"],
+            d_ff=hf["intermediate_size"],
+            max_seq=hf.get("max_position_embeddings", 512),
+            pos_embedding="learned", norm="layernorm", norm_position="post",
+            activation=act, causal=False, attn_bias=True, tie_embeddings=True,
+            norm_eps=hf.get("layer_norm_eps", 1e-12))
+
+    def build_model(self, cfg, hf, params):
+        from deepspeed_tpu.models.bert import BertConfig, BertModel
+        bc = BertConfig(vocab_size=cfg.vocab_size, max_seq=cfg.max_seq,
+                        n_layer=cfg.n_layer, n_head=cfg.n_head,
+                        d_model=cfg.d_model, d_ff=cfg.d_ff,
+                        type_vocab_size=hf.get("type_vocab_size", 2),
+                        norm_eps=cfg.norm_eps, activation=cfg.activation)
+        return BertModel(bc, with_mlm_head="mlm" in params)
+
+    def map_params(self, raw_get, cfg):
+        L, D = cfg.n_layer, cfg.d_model
+        ls = range(L)
+
+        def get(name):  # task-head checkpoints carry a "bert." prefix
+            try:
+                return raw_get("bert." + name)
+            except KeyError:
+                return raw_get(name)
+
+        lp = "encoder.layer.{}"
+        out = {
+            "embed": {
+                "tokens": np.asarray(get("embeddings.word_embeddings.weight")),
+                "positions": np.asarray(get("embeddings.position_embeddings.weight")),
+                "token_type": np.asarray(get("embeddings.token_type_embeddings.weight")),
+                "ln": {"scale": np.asarray(get("embeddings.LayerNorm.weight")),
+                       "bias": np.asarray(get("embeddings.LayerNorm.bias"))},
+            },
+            "layers": {
+                "ln_attn": {"scale": _stack(get, [lp.format(i) + ".attention.output.LayerNorm.weight" for i in ls]),
+                            "bias": _stack(get, [lp.format(i) + ".attention.output.LayerNorm.bias" for i in ls])},
+                "attn": {
+                    "wq": _stack(get, [lp.format(i) + ".attention.self.query.weight" for i in ls], _t),
+                    "wk": _stack(get, [lp.format(i) + ".attention.self.key.weight" for i in ls], _t),
+                    "wv": _stack(get, [lp.format(i) + ".attention.self.value.weight" for i in ls], _t),
+                    "wo": _stack(get, [lp.format(i) + ".attention.output.dense.weight" for i in ls], _t),
+                    "bq": _stack(get, [lp.format(i) + ".attention.self.query.bias" for i in ls]),
+                    "bk": _stack(get, [lp.format(i) + ".attention.self.key.bias" for i in ls]),
+                    "bv": _stack(get, [lp.format(i) + ".attention.self.value.bias" for i in ls]),
+                    "bo": _stack(get, [lp.format(i) + ".attention.output.dense.bias" for i in ls]),
+                },
+                "ln_mlp": {"scale": _stack(get, [lp.format(i) + ".output.LayerNorm.weight" for i in ls]),
+                           "bias": _stack(get, [lp.format(i) + ".output.LayerNorm.bias" for i in ls])},
+                "mlp": {"w_up": _stack(get, [lp.format(i) + ".intermediate.dense.weight" for i in ls], _t),
+                        "b_up": _stack(get, [lp.format(i) + ".intermediate.dense.bias" for i in ls]),
+                        "w_down": _stack(get, [lp.format(i) + ".output.dense.weight" for i in ls], _t),
+                        "b_down": _stack(get, [lp.format(i) + ".output.dense.bias" for i in ls])},
+            },
+        }
+        try:  # headless / MLM-only checkpoints ship no pooler
+            out["pooler"] = {"w": _t(get("pooler.dense.weight")),
+                             "b": np.asarray(get("pooler.dense.bias"))}
+        except KeyError:
+            from deepspeed_tpu.utils.logging import warn_once
+            warn_once("BERT checkpoint has no pooler (add_pooling_layer="
+                      "False / MLM-only); pooled output will be tanh(0) "
+                      "zeros — use the hidden states or the MLM head")
+            out["pooler"] = {"w": np.zeros((D, D), np.float32),
+                             "b": np.zeros((D,), np.float32)}
+        try:
+            out["mlm"] = {
+                "w": _t(raw_get("cls.predictions.transform.dense.weight")),
+                "b": np.asarray(raw_get("cls.predictions.transform.dense.bias")),
+                "ln": {"scale": np.asarray(raw_get("cls.predictions.transform.LayerNorm.weight")),
+                       "bias": np.asarray(raw_get("cls.predictions.transform.LayerNorm.bias"))},
+                "decoder_bias": np.asarray(raw_get("cls.predictions.bias")),
+            }
+        except KeyError:
+            pass  # plain BertModel checkpoint: no fill-mask head
+        return out
+
+
+class CLIPPolicy(HFPolicy):
+    """HF ``clip`` / ``clip_text_model`` / ``clip_vision_model`` (reference
+    ``containers/clip.py`` HFCLIPLayerPolicy + ``model_implementations/
+    transformers/clip_encoder.py``).
+
+    Which towers exist is probed from the checkpoint itself: a full
+    ``CLIPModel`` maps to ``DSClipEncoder`` with params
+    ``{"text": ..., "vision": ..., ["logit_scale"]}``; a standalone
+    ``CLIPTextModel(WithProjection)`` / ``CLIPVisionModel(WithProjection)``
+    maps to the bare encoder with its own params tree."""
+
+    model_type = "clip"
+
+    _ACTS = {"quick_gelu": "quick_gelu", "gelu": "gelu_exact",
+             "gelu_new": "gelu", "gelu_pytorch_tanh": "gelu"}
+
+    @classmethod
+    def _act(cls, sub):
+        act = cls._ACTS.get(sub.get("hidden_act", "quick_gelu"))
+        if act is None:
+            raise ValueError(f"unsupported CLIP hidden_act {sub.get('hidden_act')!r}")
+        return act
+
+    @classmethod
+    def _text_cfg(cls, tc, projection_dim=None):
+        from deepspeed_tpu.models.clip import CLIPTextConfig
+        return CLIPTextConfig(
+            vocab_size=tc["vocab_size"],
+            max_seq=tc.get("max_position_embeddings", 77),
+            n_layer=tc["num_hidden_layers"], n_head=tc["num_attention_heads"],
+            d_model=tc["hidden_size"], d_ff=tc["intermediate_size"],
+            norm_eps=tc.get("layer_norm_eps", 1e-5), activation=cls._act(tc),
+            projection_dim=projection_dim,
+            eos_token_id=tc.get("eos_token_id", 2))
+
+    @classmethod
+    def _vision_cfg(cls, vc, projection_dim=None):
+        from deepspeed_tpu.models.clip import CLIPVisionConfig
+        return CLIPVisionConfig(
+            image_size=vc.get("image_size", 224),
+            patch_size=vc.get("patch_size", 32),
+            n_layer=vc["num_hidden_layers"], n_head=vc["num_attention_heads"],
+            d_model=vc["hidden_size"], d_ff=vc["intermediate_size"],
+            norm_eps=vc.get("layer_norm_eps", 1e-5), activation=cls._act(vc),
+            projection_dim=projection_dim)
+
+    def zoo_config(self, hf):
+        # the text tower governs the TransformerConfig handed to
+        # config_overrides (vision dims are consumed by build_model
+        # directly); a standalone tower checkpoint carries its fields at the
+        # top level — text is recognised by vocab_size, vision by patch_size
+        tc = hf.get("text_config")
+        vc = hf.get("vision_config")
+        if tc is None and vc is None:
+            tc = hf if "vocab_size" in hf else None
+            vc = hf if tc is None else None
+        if tc is not None:
+            return self._text_cfg(tc).zoo()
+        return self._vision_cfg(vc).zoo()
+
+    def build_model(self, cfg, hf, params):
+        from deepspeed_tpu.models.clip import (CLIPTextEncoder,
+                                               CLIPVisionEncoder, DSClipEncoder)
+        proj = hf.get("projection_dim")
+        text = vision = None
+        tparams = params.get("text", params if "layers" in params else None)
+        if tparams is not None and "embed" in tparams:
+            text = CLIPTextEncoder(self._text_cfg(
+                hf.get("text_config", hf),
+                proj if "text_projection" in tparams else None))
+        vparams = params.get("vision", params if "patch_embed" in params else None)
+        if vparams is not None and "patch_embed" in vparams:
+            vision = CLIPVisionEncoder(self._vision_cfg(
+                hf.get("vision_config", hf),
+                proj if "visual_projection" in vparams else None))
+        if text is not None and vision is not None:
+            return DSClipEncoder(text, vision)
+        return text if text is not None else vision
+
+    @staticmethod
+    def _probe_layers(get, fmt):
+        n = 0
+        while True:
+            try:
+                get(fmt.format(n))
+            except KeyError:
+                return n
+            n += 1
+
+    def _map_tower(self, get, pre):
+        """One encoder tower (same HF layer schema for text and vision)."""
+        lp = pre + "encoder.layers.{}"
+        L = self._probe_layers(get, lp + ".layer_norm1.weight")
+        ls = range(L)
+        return {
+            "ln_attn": {"scale": _stack(get, [lp.format(i) + ".layer_norm1.weight" for i in ls]),
+                        "bias": _stack(get, [lp.format(i) + ".layer_norm1.bias" for i in ls])},
+            "attn": {
+                "wq": _stack(get, [lp.format(i) + ".self_attn.q_proj.weight" for i in ls], _t),
+                "wk": _stack(get, [lp.format(i) + ".self_attn.k_proj.weight" for i in ls], _t),
+                "wv": _stack(get, [lp.format(i) + ".self_attn.v_proj.weight" for i in ls], _t),
+                "wo": _stack(get, [lp.format(i) + ".self_attn.out_proj.weight" for i in ls], _t),
+                "bq": _stack(get, [lp.format(i) + ".self_attn.q_proj.bias" for i in ls]),
+                "bk": _stack(get, [lp.format(i) + ".self_attn.k_proj.bias" for i in ls]),
+                "bv": _stack(get, [lp.format(i) + ".self_attn.v_proj.bias" for i in ls]),
+                "bo": _stack(get, [lp.format(i) + ".self_attn.out_proj.bias" for i in ls]),
+            },
+            "ln_mlp": {"scale": _stack(get, [lp.format(i) + ".layer_norm2.weight" for i in ls]),
+                       "bias": _stack(get, [lp.format(i) + ".layer_norm2.bias" for i in ls])},
+            "mlp": {"w_up": _stack(get, [lp.format(i) + ".mlp.fc1.weight" for i in ls], _t),
+                    "b_up": _stack(get, [lp.format(i) + ".mlp.fc1.bias" for i in ls]),
+                    "w_down": _stack(get, [lp.format(i) + ".mlp.fc2.weight" for i in ls], _t),
+                    "b_down": _stack(get, [lp.format(i) + ".mlp.fc2.bias" for i in ls])},
+        }
+
+    def _map_text(self, get):
+        pre = "text_model."
+        return {
+            "embed": {"tokens": np.asarray(get(pre + "embeddings.token_embedding.weight")),
+                      "positions": np.asarray(get(pre + "embeddings.position_embedding.weight"))},
+            "layers": self._map_tower(get, pre),
+            "ln_f": {"scale": np.asarray(get(pre + "final_layer_norm.weight")),
+                     "bias": np.asarray(get(pre + "final_layer_norm.bias"))},
+        }
+
+    def _map_vision(self, get):
+        pre = "vision_model."
+        # HF conv patch embed [D, C, ps, ps] -> [ps*ps*C, D], matching the
+        # patchify + matmul lowering's (ps_h, ps_w, C) flattening order
+        w = np.asarray(get(pre + "embeddings.patch_embedding.weight"))
+        D = w.shape[0]
+        return {
+            "patch_embed": np.ascontiguousarray(
+                w.transpose(2, 3, 1, 0).reshape(-1, D)),
+            "class_token": np.asarray(get(pre + "embeddings.class_embedding")),
+            "positions": np.asarray(get(pre + "embeddings.position_embedding.weight")),
+            # sic: HF's attribute really is spelled "pre_layrnorm"
+            "ln_pre": {"scale": np.asarray(get(pre + "pre_layrnorm.weight")),
+                       "bias": np.asarray(get(pre + "pre_layrnorm.bias"))},
+            "layers": self._map_tower(get, pre),
+            "ln_f": {"scale": np.asarray(get(pre + "post_layernorm.weight")),
+                     "bias": np.asarray(get(pre + "post_layernorm.bias"))},
+        }
+
+    def map_params(self, get, cfg):
+        def has(name):
+            try:
+                get(name)
+                return True
+            except KeyError:
+                return False
+
+        has_text = has("text_model.embeddings.token_embedding.weight")
+        has_vision = has("vision_model.embeddings.class_embedding")
+        if not (has_text or has_vision):
+            raise KeyError("neither text_model.* nor vision_model.* weights found")
+        if has_text and has_vision:      # full CLIPModel
+            out = {"text": self._map_text(get), "vision": self._map_vision(get)}
+            if has("text_projection.weight"):
+                out["text"]["text_projection"] = _t(get("text_projection.weight"))
+            if has("visual_projection.weight"):
+                out["vision"]["visual_projection"] = _t(get("visual_projection.weight"))
+            if has("logit_scale"):
+                out["logit_scale"] = np.asarray(get("logit_scale"))
+            return out
+        if has_text:                     # CLIPTextModel(WithProjection)
+            out = self._map_text(get)
+            if has("text_projection.weight"):
+                out["text_projection"] = _t(get("text_projection.weight"))
+            return out
+        out = self._map_vision(get)      # CLIPVisionModel(WithProjection)
+        if has("visual_projection.weight"):
+            out["visual_projection"] = _t(get("visual_projection.weight"))
+        return out
+
+
 POLICIES: Dict[str, HFPolicy] = {
     p.model_type: p() for p in (GPT2Policy, LlamaPolicy, BloomPolicy, OPTPolicy,
                                 GPTNeoXPolicy, GPTJPolicy, GPTNeoPolicy,
-                                DistilBertPolicy)
+                                DistilBertPolicy, BertPolicy, CLIPPolicy)
 }
+# standalone HF tower checkpoints carry their own model_type strings
+POLICIES["clip_text_model"] = POLICIES["clip"]
+POLICIES["clip_vision_model"] = POLICIES["clip"]
 
 
 def policy_for(model_type: str) -> HFPolicy:

@@ -281,3 +281,157 @@ class TestDistilBertPolicy:
         out = np.asarray(eng.forward(np.asarray([[1, 2, 3, 4]], np.int32)))
         assert out.shape == (1, 4, 96)
         assert np.isfinite(out).all()
+
+
+class TestBertPolicy:
+    """HF bert ingestion (reference containers/bert.py HFBertLayerPolicy):
+    post-LN encoder + token types, optional pooler / fill-mask head."""
+
+    def _cfg(self):
+        return transformers.BertConfig(
+            vocab_size=96, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=32, type_vocab_size=2)
+
+    def test_bert_fill_mask(self, tmp_path):
+        cfg = self._cfg()
+        torch.manual_seed(3)
+        hf_model = transformers.BertForMaskedLM(cfg)
+        d = save_hf(hf_model, cfg, tmp_path)
+        model, params = load_hf_checkpoint(d)
+        from deepspeed_tpu.models.bert import BertModel
+        assert isinstance(model, BertModel) and model.with_mlm_head
+        rng = np.random.default_rng(3)
+        tok = rng.integers(0, 96, size=(2, 16)).astype(np.int64)
+        with torch.no_grad():
+            ref = hf_model(input_ids=torch.from_numpy(tok)).logits.float().numpy()
+        got = np.asarray(model.forward(params, jnp.asarray(tok.astype(np.int32))),
+                         np.float32)
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-3)
+        np.testing.assert_array_equal(got.argmax(-1), ref.argmax(-1))
+
+    def test_bert_base_hidden_and_pooled(self, tmp_path):
+        """Headless BertModel checkpoint (no 'bert.' prefix, real pooler)."""
+        cfg = self._cfg()
+        torch.manual_seed(4)
+        hf_model = transformers.BertModel(cfg).eval()
+        d = save_hf(hf_model, cfg, tmp_path)
+        model, params = load_hf_checkpoint(d)
+        rng = np.random.default_rng(4)
+        tok = rng.integers(0, 96, size=(2, 16)).astype(np.int64)
+        tt = rng.integers(0, 2, size=(2, 16)).astype(np.int64)
+        with torch.no_grad():
+            ref = hf_model(input_ids=torch.from_numpy(tok),
+                           token_type_ids=torch.from_numpy(tt))
+        hidden, pooled = model(params, jnp.asarray(tok.astype(np.int32)),
+                               jnp.asarray(tt.astype(np.int32)))
+        np.testing.assert_allclose(np.asarray(hidden),
+                                   ref.last_hidden_state.numpy(),
+                                   rtol=2e-2, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(pooled),
+                                   ref.pooler_output.numpy(),
+                                   rtol=2e-2, atol=2e-3)
+
+    def test_bert_serves_through_init_inference(self, tmp_path):
+        import deepspeed_tpu
+        cfg = self._cfg()
+        d = save_hf(transformers.BertForMaskedLM(cfg), cfg, tmp_path)
+        eng = deepspeed_tpu.init_inference(d, dtype="fp32")
+        out = np.asarray(eng.forward(np.asarray([[1, 2, 3, 4]], np.int32)))
+        assert out.shape == (1, 4, 96)
+        assert np.isfinite(out).all()
+
+    def test_bert_relu_mlm_head(self, tmp_path):
+        """hidden_act also drives the MLM transform (HF
+        BertPredictionHeadTransform), not just the encoder layers."""
+        cfg = self._cfg()
+        cfg.hidden_act = "relu"
+        torch.manual_seed(7)
+        hf_model = transformers.BertForMaskedLM(cfg)
+        d = save_hf(hf_model, cfg, tmp_path)
+        model, params = load_hf_checkpoint(d)
+        rng = np.random.default_rng(7)
+        tok = rng.integers(0, 96, size=(2, 16)).astype(np.int64)
+        with torch.no_grad():
+            ref = hf_model(input_ids=torch.from_numpy(tok)).logits.float().numpy()
+        got = np.asarray(model.forward(params, jnp.asarray(tok.astype(np.int32))),
+                         np.float32)
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-3)
+
+
+class TestCLIPPolicy:
+    """HF clip ingestion (reference containers/clip.py HFCLIPLayerPolicy +
+    model_implementations/transformers/clip_encoder.py): standalone text
+    tower, and the full two-tower CLIPModel -> DSClipEncoder."""
+
+    def test_clip_text_model(self, tmp_path):
+        cfg = transformers.CLIPTextConfig(
+            vocab_size=99, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=16, bos_token_id=1, eos_token_id=2)
+        torch.manual_seed(5)
+        hf_model = transformers.CLIPTextModel(cfg).eval()
+        d = save_hf(hf_model, cfg, tmp_path)
+        model, params = load_hf_checkpoint(d)
+        from deepspeed_tpu.models.clip import CLIPTextEncoder
+        assert isinstance(model, CLIPTextEncoder)
+        rng = np.random.default_rng(5)
+        tok = rng.integers(3, 98, size=(2, 16)).astype(np.int64)
+        tok[:, -1] = 98  # max id last: HF's eos==2 legacy argmax pooling
+        with torch.no_grad():
+            ref = hf_model(input_ids=torch.from_numpy(tok))
+        hidden, pooled = model(params, jnp.asarray(tok.astype(np.int32)))
+        np.testing.assert_allclose(np.asarray(hidden),
+                                   ref.last_hidden_state.numpy(),
+                                   rtol=2e-2, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(pooled),
+                                   ref.pooler_output.numpy(),
+                                   rtol=2e-2, atol=2e-3)
+
+    def test_clip_text_serves_through_init_inference(self, tmp_path):
+        """A standalone text tower rides the generic forward path (last
+        hidden states — the SD conditioning surface)."""
+        import deepspeed_tpu
+        cfg = transformers.CLIPTextConfig(
+            vocab_size=99, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=16, bos_token_id=1, eos_token_id=2)
+        d = save_hf(transformers.CLIPTextModel(cfg), cfg, tmp_path)
+        eng = deepspeed_tpu.init_inference(d, dtype="fp32")
+        out = np.asarray(eng.forward(np.asarray([[1, 2, 3, 4]], np.int32)))
+        assert out.shape == (1, 4, 32)
+        assert np.isfinite(out).all()
+
+    def test_clip_full_model_features(self, tmp_path):
+        """Full CLIPModel: DSClipEncoder with projected text/image features
+        matching get_text_features / get_image_features."""
+        cfg = transformers.CLIPConfig(
+            projection_dim=24,
+            text_config={"vocab_size": 99, "hidden_size": 32,
+                         "intermediate_size": 64, "num_hidden_layers": 2,
+                         "num_attention_heads": 4,
+                         "max_position_embeddings": 16,
+                         "bos_token_id": 1, "eos_token_id": 2},
+            vision_config={"image_size": 8, "patch_size": 4,
+                           "hidden_size": 32, "intermediate_size": 64,
+                           "num_hidden_layers": 2, "num_attention_heads": 4})
+        torch.manual_seed(6)
+        hf_model = transformers.CLIPModel(cfg).eval()
+        d = save_hf(hf_model, cfg, tmp_path)
+        model, params = load_hf_checkpoint(d)
+        from deepspeed_tpu.models.clip import DSClipEncoder
+        assert isinstance(model, DSClipEncoder)
+
+        rng = np.random.default_rng(6)
+        tok = rng.integers(3, 98, size=(2, 16)).astype(np.int64)
+        tok[:, -1] = 98
+        img = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)  # NCHW
+        with torch.no_grad():
+            tfeat = hf_model.get_text_features(input_ids=torch.from_numpy(tok)).numpy()
+            ifeat = hf_model.get_image_features(pixel_values=torch.from_numpy(img)).numpy()
+        _, got_t = model.encode_text(params["text"], jnp.asarray(tok.astype(np.int32)))
+        # zoo vision is NHWC (TPU-preferred layout)
+        _, got_i = model.encode_image(params["vision"],
+                                      jnp.asarray(img.transpose(0, 2, 3, 1)))
+        np.testing.assert_allclose(np.asarray(got_t), tfeat, rtol=2e-2, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(got_i), ifeat, rtol=2e-2, atol=2e-3)
