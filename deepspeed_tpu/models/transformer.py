@@ -70,6 +70,13 @@ class TransformerConfig:
     # shard-local block tuning and warn if these are set.
     attn_block_q: Optional[int] = None
     attn_block_k: Optional[int] = None
+    # block-sparse attention: a SparsityConfig (ops/sparse_attention) whose
+    # layout replaces dense attention in every layer — the model-level
+    # integration the reference does by module surgery
+    # (ops/sparse_attention/sparse_attention_utils.py
+    # replace_model_self_attention_with_sparse_self_attention). TPU runs the
+    # block-sparse flash kernel; elsewhere the exact dense token-bias form.
+    sparse_attention: Optional[Any] = None
     # cross-entropy in sequence chunks of this many tokens: never
     # materialises the full [B, S, vocab] logits (0 = unchunked)
     loss_chunk: int = 0
@@ -314,6 +321,12 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
 
     slopes = _alibi_slopes(H) if cfg.pos_embedding == "alibi" else None
 
+    if cfg.sparse_attention is not None:
+        out = _sparse_model_attention(cfg, q, k, v, mask_bias, slopes)
+        out = checkpoint_name(out.reshape(B, S, H * Hd), "attn_out")
+        proj = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
+        return checkpoint_name(proj, "wo_out")
+
     sp_mesh = _sp_mesh(cfg)
     out = None
     if sp_mesh is not None:
@@ -372,6 +385,53 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
     out = checkpoint_name(out.reshape(B, S, H * Hd), "attn_out")
     proj = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
     return checkpoint_name(proj, "wo_out")
+
+
+def _sparse_model_attention(cfg: TransformerConfig, q, k, v, mask_bias, slopes):
+    """Model-level block-sparse attention (cfg.sparse_attention set): every
+    layer computes softmax over the sparsity layout's support only. TPU
+    single-device/full-manual contexts run the block-sparse flash kernel
+    (zero blocks skipped fwd+bwd); everywhere else the exact dense
+    token-bias einsum, which vmaps and partitions like the other fallbacks.
+    Reference capability: sparse_attention_utils.py module surgery swapping
+    BertSparseSelfAttention into the encoder."""
+    from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+        sparse_attention_core)
+    B, S, H, Hd = q.shape
+    if k.shape[2] != H:
+        raise NotImplementedError(
+            "sparse attention requires n_kv_head == n_head (MHA)")
+    if slopes is not None:
+        raise NotImplementedError("sparse attention does not compose with alibi")
+    if _sp_mesh(cfg) is not None:
+        raise NotImplementedError(
+            "sparse attention does not compose with sequence parallelism")
+    sc = cfg.sparse_attention
+    # Dense/base configs carry no directionality — cfg.causal alone governs
+    mode = getattr(sc, "attention", None)
+    if mode is not None and (mode == "unidirectional") != bool(cfg.causal):
+        raise ValueError(f"sparsity config attention={mode!r} disagrees with "
+                         f"the model's causal={cfg.causal}")
+    layout = sc.make_layout(S)
+    if layout.shape[0] != H:
+        raise ValueError(f"sparsity config num_heads={layout.shape[0]} != "
+                         f"model n_head={H}")
+    # the kernel wants layout blocks that are legal VMEM tiles; smaller
+    # blocks (or CPU) take the exact dense form
+    use_pallas = _use_flash(cfg) and sc.block >= 128 and S % sc.block == 0
+    if not use_pallas and S > DENSE_STREAM_THRESHOLD:
+        # the dense token-bias form materialises [B, H, S, S] logits — at
+        # the long sequences sparsity exists for, that defeats the point;
+        # reject loudly rather than OOM (the kernel path streams by block)
+        raise NotImplementedError(
+            f"sparse attention at S={S} > {DENSE_STREAM_THRESHOLD} needs the "
+            "block-sparse kernel path (TPU, block >= 128, S % block == 0); "
+            "the exact dense fallback would materialise the full score "
+            "matrix")
+    mb = None if mask_bias is None else mask_bias.astype(jnp.float32)
+    return sparse_attention_core(q, k, v, layout, sc.block, bool(cfg.causal),
+                                 mb, scale=cfg.attn_scale,
+                                 use_pallas=use_pallas)
 
 
 def _inside_full_manual(mesh) -> bool:
@@ -793,6 +853,13 @@ def forward_cached(cfg: TransformerConfig, params, tokens, cache, pos, pad_bias=
     if cfg.norm_position == "post":
         raise ValueError("norm_position='post' is not supported by the "
                          "KV-cache decode path (pre-LN only)")
+    if cfg.sparse_attention is not None:
+        # decoding attends position-by-position against the whole cache; a
+        # training-time block layout does not transfer — reject rather than
+        # silently decode dense and diverge from forward()
+        raise NotImplementedError(
+            "sparse_attention is not supported by the KV-cache decode path; "
+            "serve with the dense forward() or drop the sparsity config")
     x, positions = cached_embed(cfg, params, tokens, pos, cache["k"].dtype)
 
     def run_block(h, xs):

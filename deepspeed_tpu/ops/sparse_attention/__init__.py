@@ -7,8 +7,12 @@ Pallas block-sparse flash kernel (splash-attention style) with a dense-mask
 fallback for CPU.
 """
 
+from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import (
+    extend_position_embedding, pad_to_block_size, replace_self_attention,
+    unpad_sequence_output)
 from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (SparseSelfAttention,
-                                                                      layout_to_token_bias)
+                                                                      layout_to_token_bias,
+                                                                      sparse_attention_core)
 from deepspeed_tpu.ops.sparse_attention.sparsity_config import (BigBirdSparsityConfig,
                                                                 BSLongformerSparsityConfig,
                                                                 DenseSparsityConfig,
@@ -21,4 +25,6 @@ __all__ = [
     "SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
     "VariableSparsityConfig", "BigBirdSparsityConfig", "BSLongformerSparsityConfig",
     "LocalSlidingWindowSparsityConfig", "SparseSelfAttention", "layout_to_token_bias",
+    "sparse_attention_core", "pad_to_block_size", "unpad_sequence_output",
+    "extend_position_embedding", "replace_self_attention",
 ]

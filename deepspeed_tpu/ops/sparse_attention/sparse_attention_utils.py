@@ -1,0 +1,114 @@
+"""Sparse-attention integration utilities.
+
+Reference parity: ``deepspeed/ops/sparse_attention/sparse_attention_utils.py``
+(``SparseAttentionUtils``) — padding inputs to the sparsity block size,
+extending position embeddings for longer sequences, and swapping a model's
+self-attention for sparse self-attention.
+
+TPU redesign: the zoo models are functional, so "module surgery" becomes a
+config replacement (``replace_self_attention`` returns a new model whose
+``TransformerConfig.sparse_attention`` carries the layout — every layer then
+dispatches through ``models/transformer.py::_sparse_model_attention``), and
+position extension is a pure params transform.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def pad_to_block_size(block_size: int, input_ids, attention_mask=None,
+                      token_type_ids=None, pad_token_id: int = 0,
+                      ) -> Tuple[int, Any, Any, Any]:
+    """Pad [B, S] inputs along the sequence to a multiple of ``block_size``
+    (reference ``SparseAttentionUtils.pad_to_block_size``). Padded positions
+    get ``pad_token_id`` and attention_mask 0 (a mask is synthesised if the
+    caller had none, so the pad tokens never attend). Returns
+    ``(pad_len, input_ids, attention_mask, token_type_ids)``."""
+    S = input_ids.shape[1]
+    pad_len = (-S) % block_size
+    if pad_len == 0:
+        return 0, input_ids, attention_mask, token_type_ids
+    if attention_mask is None:
+        attention_mask = jnp.ones(input_ids.shape, jnp.int32)
+    widths = ((0, 0), (0, pad_len))
+    input_ids = jnp.pad(input_ids, widths, constant_values=pad_token_id)
+    attention_mask = jnp.pad(attention_mask, widths)
+    if token_type_ids is not None:
+        token_type_ids = jnp.pad(token_type_ids, widths)
+    return pad_len, input_ids, attention_mask, token_type_ids
+
+
+def unpad_sequence_output(pad_len: int, sequence_output):
+    """Strip the padding added by :func:`pad_to_block_size` from a
+    [B, S, ...] output (reference ``unpad_sequence_output``)."""
+    if pad_len == 0:
+        return sequence_output
+    return sequence_output[:, :-pad_len]
+
+
+def extend_position_embedding(params: Dict, new_max_seq: int,
+                              path: Tuple[str, ...] = ("embed", "positions")):
+    """Extend learned position embeddings to ``new_max_seq`` by repeating
+    the trained table (reference ``extend_position_embedding``, which tiles
+    BERT/RoBERTa weights k-fold). Returns a NEW params tree; the caller must
+    also rebuild the model with ``max_seq=new_max_seq`` (functional configs
+    replace the reference's in-place ``config.max_position_embeddings``
+    mutation)."""
+    sub = params
+    for key in path[:-1]:
+        sub = sub[key]
+    old = np.asarray(sub[path[-1]])
+    P, D = old.shape
+    if new_max_seq <= P:
+        raise ValueError(f"new_max_seq={new_max_seq} does not exceed the "
+                         f"current table ({P})")
+    reps = -(-new_max_seq // P)
+    new = np.tile(old, (reps, 1))[:new_max_seq]
+
+    def rebuild(tree, keys):
+        if not keys:
+            return jnp.asarray(new)
+        out = dict(tree)
+        out[keys[0]] = rebuild(tree[keys[0]], keys[1:])
+        return out
+
+    return rebuild(params, list(path))
+
+
+def replace_self_attention(model, sparsity_config,
+                           max_seq: Optional[int] = None):
+    """Return a new model whose every layer runs block-sparse attention over
+    ``sparsity_config``'s layout (reference
+    ``replace_model_self_attention_with_sparse_self_attention``). Supports
+    the zoo ``CausalLM`` and ``BertModel`` families; ``max_seq`` optionally
+    raises the sequence limit at the same time (pair with
+    :func:`extend_position_embedding`)."""
+    from deepspeed_tpu.models.bert import BertModel
+    from deepspeed_tpu.models.causal_lm import CausalLM
+
+    if isinstance(model, BertModel):
+        bc = model.config
+        if max_seq is not None:
+            bc = dataclasses.replace(bc, max_seq=max_seq)
+        out = BertModel(bc, with_mlm_head=model.with_mlm_head)
+        out.zoo_cfg = dataclasses.replace(out.zoo_cfg,
+                                          sparse_attention=sparsity_config)
+        return out
+    if isinstance(model, CausalLM):
+        from deepspeed_tpu.models.pipeline import PipelinedCausalLM
+        cfg = model.config
+        over = {"sparse_attention": sparsity_config}
+        if max_seq is not None:
+            over["max_seq"] = max_seq
+        cfg = dataclasses.replace(cfg, **over)
+        if isinstance(model, PipelinedCausalLM):
+            return type(model)(cfg, model.num_stages,
+                               param_dtype=model.param_dtype)
+        return type(model)(cfg, model.param_dtype)
+    raise TypeError(f"cannot sparsify {type(model).__name__}; expected a zoo "
+                    "CausalLM or BertModel")

@@ -74,23 +74,41 @@ class SparseSelfAttention:
             else:
                 mask_bias = jnp.where(key_padding_mask > 0, 0.0, -1e9).astype(jnp.float32)
 
-        if self._use_pallas():
-            from deepspeed_tpu.ops.pallas import flash_attention
-            return flash_attention(query, key, value, mask_bias=mask_bias, causal=causal,
-                                   block_layout=jnp.asarray(layout, jnp.float32))
-
-        # dense fallback: token-level layout bias
-        bias = layout_to_token_bias(layout, self.sparsity_config.block, S)  # [H, S, S]
-        scale = Hd**-0.5
-        logits = jnp.einsum("bqhd,bkhd->bhqk", query.astype(jnp.float32),
-                            key.astype(jnp.float32)) * scale
-        logits = logits + bias[None, :, :, :]
-        if causal:
-            cm = jnp.tril(jnp.ones((S, S), bool))
-            logits = jnp.where(cm[None, None], logits, -1e9)
-        if mask_bias is not None:
-            logits = logits + mask_bias[:, None, None, :]
+        extra = None
         if attn_mask is not None:
-            logits = logits + jnp.where(attn_mask > 0, 0.0, -1e9).astype(jnp.float32)
-        probs = jax.nn.softmax(logits, axis=-1).astype(query.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs, value)
+            extra = jnp.where(attn_mask > 0, 0.0, -1e9).astype(jnp.float32)
+        return sparse_attention_core(
+            query, key, value, layout, self.sparsity_config.block, causal,
+            mask_bias, use_pallas=self._use_pallas(), attn_bias=extra)
+
+
+def sparse_attention_core(q, k, v, layout, block: int, causal: bool,
+                          mask_bias=None, *, scale: Optional[float] = None,
+                          use_pallas: bool, attn_bias=None):
+    """Shared execution core: q/k/v [B, S, H, Hd] + [H, nb, nb] layout →
+    [B, S, H, Hd]. Drives the block-sparse flash kernel when ``use_pallas``
+    (zero blocks skipped fwd+bwd), else the exact dense token-bias einsum
+    (pure jnp — vmappable and partitionable, the pipeline/CPU path). Used by
+    :class:`SparseSelfAttention` and the model-level ``sparse_attention``
+    config (models/transformer.py)."""
+    B, S, H, Hd = q.shape
+    if use_pallas and attn_bias is None:
+        from deepspeed_tpu.ops.pallas import flash_attention
+        return flash_attention(q, k, v, mask_bias=mask_bias, causal=causal,
+                               scale=scale,
+                               block_layout=jnp.asarray(layout, jnp.float32))
+
+    bias = layout_to_token_bias(layout, block, S)  # [H, S, S]
+    scale = Hd**-0.5 if scale is None else scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    logits = logits + bias[None, :, :, :]
+    if causal:
+        cm = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(cm[None, None], logits, -1e9)
+    if mask_bias is not None:
+        logits = logits + mask_bias[:, None, None, :]
+    if attn_bias is not None:
+        logits = logits + attn_bias
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

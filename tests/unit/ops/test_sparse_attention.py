@@ -146,3 +146,144 @@ class TestSparseSelfAttention:
         bias = jnp.where(keep > 0, 0.0, -1e9)[:, None, None, :]
         ref = mha_attention(q, k, v, mask_bias=bias, causal=False)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+class TestSparseAttentionUtils:
+    """Reference SparseAttentionUtils (sparse_attention_utils.py): padding,
+    position-embedding extension, and model-level sparsification."""
+
+    def test_pad_and_unpad_round_trip(self):
+        from deepspeed_tpu.ops.sparse_attention import (pad_to_block_size,
+                                                        unpad_sequence_output)
+        ids = jnp.arange(2 * 10, dtype=jnp.int32).reshape(2, 10)
+        pad, pids, mask, tt = pad_to_block_size(16, ids, None, None,
+                                                pad_token_id=7)
+        assert pad == 6 and pids.shape == (2, 16) and tt is None
+        assert int(pids[0, -1]) == 7
+        # a mask is synthesised so pad tokens never attend
+        np.testing.assert_array_equal(np.asarray(mask[:, 10:]), 0)
+        np.testing.assert_array_equal(np.asarray(mask[:, :10]), 1)
+        out = unpad_sequence_output(pad, pids[:, :, None])
+        assert out.shape == (2, 10, 1)
+        # already aligned: no-op
+        pad2, pids2, m2, _ = pad_to_block_size(5, ids, None, None)
+        assert pad2 == 0 and pids2 is ids and m2 is None
+
+    def test_extend_position_embedding_tiles(self):
+        from deepspeed_tpu.ops.sparse_attention import extend_position_embedding
+        params = {"embed": {"positions": np.arange(8.0)[:, None] * np.ones((1, 4))}}
+        new = extend_position_embedding(params, 13)
+        got = np.asarray(new["embed"]["positions"])
+        assert got.shape == (13, 4)
+        np.testing.assert_array_equal(got[8:13], got[0:5])  # tiled copies
+        # original tree untouched
+        assert np.asarray(params["embed"]["positions"]).shape == (8, 4)
+        with pytest.raises(ValueError, match="does not exceed"):
+            extend_position_embedding(params, 8)
+
+    def _tiny_lm(self, **over):
+        from deepspeed_tpu.models import CausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+        kw = dict(vocab_size=64, n_layer=2, n_head=4, d_model=32,
+                  max_seq=32, attention_backend="xla")
+        kw.update(over)
+        return CausalLM(TransformerConfig(**kw))
+
+    def test_replace_self_attention_dense_layout_matches(self):
+        """An all-ones layout must reproduce dense attention exactly."""
+        from deepspeed_tpu.ops.sparse_attention import (DenseSparsityConfig,
+                                                        replace_self_attention)
+        model = self._tiny_lm()
+        params = model.init_params(jax.random.key(0))
+        sparse = replace_self_attention(model, DenseSparsityConfig(num_heads=4, block=8))
+        assert sparse.config.sparse_attention is not None
+        tok = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 16)),
+                          jnp.int32)
+        ref = np.asarray(model.forward(params, tok), np.float32)
+        got = np.asarray(sparse.forward(params, tok), np.float32)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    def test_sparse_layout_changes_attention(self):
+        """A genuinely sparse layout must differ from dense attention, and
+        training through the engine must still descend."""
+        from deepspeed_tpu.ops.sparse_attention import (FixedSparsityConfig,
+                                                        replace_self_attention)
+        import deepspeed_tpu
+        import deepspeed_tpu.comm as dist
+        model = self._tiny_lm()
+        params = model.init_params(jax.random.key(1))
+        sc = FixedSparsityConfig(num_heads=4, block=4, num_local_blocks=2,
+                                 num_global_blocks=1, attention="unidirectional")
+        sparse = replace_self_attention(model, sc)
+        tok = jnp.asarray(np.random.default_rng(1).integers(0, 64, (2, 32)),
+                          jnp.int32)
+        ref = np.asarray(model.forward(params, tok), np.float32)
+        got = np.asarray(sparse.forward(params, tok), np.float32)
+        assert np.abs(got - ref).max() > 1e-4  # sparsity actually applied
+
+        dist.set_mesh(None)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=sparse, model_parameters=params, config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "mesh": {"dp": -1}})
+        batch = {"input_ids": np.tile(np.asarray(tok), (4, 1))}
+        losses = [float(engine.train_batch(batch)) for _ in range(4)]
+        assert losses[-1] < losses[0]
+
+    def test_replace_self_attention_bert(self):
+        from deepspeed_tpu.models.bert import BertConfig, BertModel
+        from deepspeed_tpu.ops.sparse_attention import (FixedSparsityConfig,
+                                                        replace_self_attention)
+        model = BertModel(BertConfig(vocab_size=64, max_seq=16, n_layer=2,
+                                     n_head=4, d_model=32, d_ff=64))
+        params = model.init_params(jax.random.key(2))
+        sc = FixedSparsityConfig(num_heads=4, block=4, num_local_blocks=2)
+        sparse = replace_self_attention(model, sc)
+        assert sparse.zoo_cfg.sparse_attention is sc
+        tok = jnp.asarray(np.random.default_rng(2).integers(0, 64, (2, 16)),
+                          jnp.int32)
+        hidden, pooled = sparse(params, tok)
+        assert hidden.shape == (2, 16, 32) and np.isfinite(np.asarray(hidden)).all()
+
+    def test_model_dispatch_reaches_kernel(self):
+        """attention_backend='flash' routes the model-level sparse path
+        through the block-sparse Pallas kernel (interpret on CPU) and
+        matches the dense token-bias form."""
+        from deepspeed_tpu.ops.sparse_attention import (FixedSparsityConfig,
+                                                        replace_self_attention)
+        sc = FixedSparsityConfig(num_heads=4, block=128, num_local_blocks=1,
+                                 attention="unidirectional")
+        dense_m = replace_self_attention(self._tiny_lm(max_seq=256), sc)
+        flash_m = replace_self_attention(
+            self._tiny_lm(max_seq=256, attention_backend="flash"), sc)
+        params = dense_m.init_params(jax.random.key(5))
+        tok = jnp.asarray(np.random.default_rng(5).integers(0, 64, (1, 256)),
+                          jnp.int32)
+        ref = np.asarray(dense_m.forward(params, tok), np.float32)
+        got = np.asarray(flash_m.forward(params, tok), np.float32)
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-3)
+
+    def test_rejections(self):
+        from deepspeed_tpu.ops.sparse_attention import (FixedSparsityConfig,
+                                                        replace_self_attention)
+        # causal model with a bidirectional layout: loud mismatch
+        model = self._tiny_lm()
+        params = model.init_params(jax.random.key(3))
+        sparse = replace_self_attention(
+            model, FixedSparsityConfig(num_heads=4, block=4,
+                                       attention="bidirectional"))
+        tok = jnp.zeros((1, 16), jnp.int32)
+        with pytest.raises(ValueError, match="disagrees"):
+            sparse.forward(params, tok)
+        # GQA is rejected
+        gqa = self._tiny_lm(n_kv_head=2)
+        gp = gqa.init_params(jax.random.key(4))
+        sgqa = replace_self_attention(
+            gqa, FixedSparsityConfig(num_heads=4, block=4,
+                                     attention="unidirectional"))
+        with pytest.raises(NotImplementedError, match="n_kv_head"):
+            sgqa.forward(gp, tok)
+        # non-zoo models are rejected
+        with pytest.raises(TypeError, match="cannot sparsify"):
+            replace_self_attention(object(), FixedSparsityConfig(num_heads=4))
