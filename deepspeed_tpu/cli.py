@@ -7,6 +7,7 @@ Subcommands mirror the reference's script family:
 - ``dscli bench``                   — ``ds_bench`` collective micro-benchmarks
 - ``dscli elastic <config>``        — ``ds_elastic`` elastic-config inspector
 - ``dscli autotune <config>``       — ``deepspeed --autotuning`` config search
+- ``dscli ssh [-f hostfile] cmd``   — ``ds_ssh`` run a command on every host
 """
 
 from __future__ import annotations
@@ -75,21 +76,55 @@ def _autotune(argv):
     print(json.dumps(best, indent=2))
 
 
+def _ssh(argv):
+    """Broadcast a shell command to every hostfile host over pdsh
+    (reference ``bin/ds_ssh``)."""
+    import argparse
+    import os
+    import shutil
+    import subprocess
+
+    parser = argparse.ArgumentParser(description="run a command on all hosts")
+    parser.add_argument("-f", "--hostfile", type=str, default=None,
+                        help=f"hostfile path (default {_dlts_hostfile()})")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="command to run on every host")
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+    if shutil.which("pdsh") is None:
+        raise RuntimeError("cannot find pdsh; install it (apt-get install pdsh)")
+
+    from deepspeed_tpu.launcher.runner import fetch_hostfile
+    resources = fetch_hostfile(args.hostfile or _dlts_hostfile())
+    if not resources:
+        raise RuntimeError(f"missing or empty hostfile "
+                           f"{args.hostfile or _dlts_hostfile()}")
+    hosts = ",".join(resources)
+    env = dict(os.environ, PDSH_RCMD_TYPE="ssh")
+    return subprocess.call(["pdsh", "-w", hosts] + args.command, env=env)
+
+
+def _dlts_hostfile():
+    from deepspeed_tpu.launcher.runner import DLTS_HOSTFILE
+    return DLTS_HOSTFILE
+
+
 _COMMANDS = {"run": _run, "report": _report, "bench": _bench, "elastic": _elastic,
-             "autotune": _autotune}
+             "autotune": _autotune, "ssh": _ssh}
 
 
 def main():
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
         print(__doc__)
-        print("usage: dscli {run|report|bench|elastic} [args...]")
+        print("usage: dscli {run|report|bench|elastic|autotune|ssh} [args...]")
         return 0
     cmd = sys.argv[1]
     if cmd not in _COMMANDS:
         print(f"unknown command {cmd!r}; expected one of {sorted(_COMMANDS)}")
         return 2
-    _COMMANDS[cmd](sys.argv[2:])
-    return 0
+    rc = _COMMANDS[cmd](sys.argv[2:])
+    return 0 if rc is None else rc
 
 
 if __name__ == "__main__":

@@ -82,6 +82,12 @@ class SparseSelfAttention:
             mask_bias, use_pallas=self._use_pallas(), attn_bias=extra)
 
 
+# beyond this, the exact dense fallback's [B, H, S, S] logits defeat the
+# purpose of sparsity — reject loudly (matches models/transformer.py's
+# DENSE_STREAM_THRESHOLD for the non-sparse fallbacks)
+DENSE_SPARSE_MAX_SEQ = 4096
+
+
 def sparse_attention_core(q, k, v, layout, block: int, causal: bool,
                           mask_bias=None, *, scale: Optional[float] = None,
                           use_pallas: bool, attn_bias=None):
@@ -97,6 +103,17 @@ def sparse_attention_core(q, k, v, layout, block: int, causal: bool,
         return flash_attention(q, k, v, mask_bias=mask_bias, causal=causal,
                                scale=scale,
                                block_layout=jnp.asarray(layout, jnp.float32))
+    if S > DENSE_SPARSE_MAX_SEQ:
+        # the dense form materialises [B, H, S, S] f32 logits — at the long
+        # sequences sparsity exists for, that defeats the point; reject
+        # loudly rather than OOM (the kernel path streams by block; a dense
+        # attn_bias is incompatible with it, pre-fold it into the layout or
+        # key-side mask instead)
+        raise NotImplementedError(
+            f"sparse attention at S={S} > {DENSE_SPARSE_MAX_SEQ} needs the "
+            "block-sparse kernel path (TPU, block >= 128, no dense "
+            "attn_mask); the exact dense fallback would materialise the "
+            "full score matrix")
 
     bias = layout_to_token_bias(layout, block, S)  # [H, S, S]
     scale = Hd**-0.5 if scale is None else scale

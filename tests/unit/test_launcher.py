@@ -309,3 +309,31 @@ class TestElasticPlannerReferenceParity:
         assert get_candidate_batch_sizes([8], 10000) == [6720]
         assert get_candidate_batch_sizes([8, 12, 16, 17], 10000) == \
             sorted({840 * 8, 720 * 12, 360 * 16, 360 * 17})
+
+
+class TestDscliSsh:
+    """``dscli ssh`` (reference bin/ds_ssh): pdsh broadcast over the
+    hostfile's hosts."""
+
+    def test_ssh_invokes_pdsh_with_hosts(self, tmp_path, monkeypatch):
+        hf = tmp_path / "hostfile"
+        hf.write_text("nodeA slots=4\nnodeB slots=4\n")
+        fake = tmp_path / "pdsh"
+        log = tmp_path / "pdsh.log"
+        fake.write_text(f"#!/bin/sh\necho \"$@\" > {log}\n")
+        fake.chmod(0o755)
+        monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+
+        from deepspeed_tpu.cli import _ssh
+        rc = _ssh(["-f", str(hf), "hostname", "-f"])
+        assert rc == 0
+        assert log.read_text().strip() == "-w nodeA,nodeB hostname -f"
+
+    def test_ssh_missing_hostfile(self, tmp_path, monkeypatch):
+        fake = tmp_path / "pdsh"
+        fake.write_text("#!/bin/sh\n")
+        fake.chmod(0o755)
+        monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+        from deepspeed_tpu.cli import _ssh
+        with pytest.raises(RuntimeError, match="hostfile"):
+            _ssh(["-f", str(tmp_path / "nope"), "true"])
