@@ -418,16 +418,10 @@ def _sparse_model_attention(cfg: TransformerConfig, q, k, v, mask_bias, slopes):
                          f"model n_head={H}")
     # the kernel wants layout blocks that are legal VMEM tiles; smaller
     # blocks (or CPU) take the exact dense form (make_layout already
-    # rejected S not divisible by the block)
+    # rejected S not divisible by the block; the core rejects dense
+    # fallbacks past its DENSE_SPARSE_MAX_SEQ — single guard, single
+    # message)
     use_pallas = _use_flash(cfg) and sc.block >= 128
-    if not use_pallas and S > DENSE_STREAM_THRESHOLD:
-        # the dense token-bias form materialises [B, H, S, S] logits — at
-        # the long sequences sparsity exists for, that defeats the point;
-        # reject loudly rather than OOM (the kernel path streams by block)
-        raise NotImplementedError(
-            f"sparse attention at S={S} > {DENSE_STREAM_THRESHOLD} needs the "
-            "block-sparse kernel path (TPU, block >= 128); the exact dense "
-            "fallback would materialise the full score matrix")
     mb = None if mask_bias is None else mask_bias.astype(jnp.float32)
     return sparse_attention_core(q, k, v, layout, sc.block, bool(cfg.causal),
                                  mb, scale=cfg.attn_scale,
