@@ -1,0 +1,108 @@
+"""NVMe/disk async-I/O perf sweep over the native aio engine.
+
+Reference parity: ``csrc/aio/py_test/aio_bench_perf_sweep.py`` — sweep
+the engine's real knobs for read and write, report GB/s, and print the
+best configuration (the numbers users feed into ``aio`` config sections
+for ZeRO-Infinity / ZeRO-Inference NVMe streaming). The native engine is
+a thread-pool over pread/pwrite chunks, so its tunables are block_size x
+thread_count; the reference's queue_depth belongs to its libaio
+submission ring and is accepted in configs for parity but has no effect
+here — it is deliberately NOT a sweep dimension.
+
+Usage::
+
+    python benchmarks/aio_bench.py [--dir /path/on/nvme] [--mb 256]
+        [--block-sizes 262144,1048576,4194304] [--threads 1,4] [--json]
+
+Each (read|write, block_size, threads) cell reports the best of two timed
+passes. One JSON line per cell with ``--json``; the summary always prints
+the winning config per direction.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+
+def _parse_ints(s: str):
+    return [int(x) for x in s.split(",") if x]
+
+
+def run_sweep(directory: str, mb: int, block_sizes, threads,
+              emit_json: bool = False):
+    import numpy as np
+
+    from deepspeed_tpu.ops.aio import AsyncIOHandle, aligned_array
+
+    numel = mb * (1 << 20) // 4
+    payload = aligned_array(numel, np.float32)
+    payload[:] = np.random.default_rng(0).random(payload.shape, np.float32)
+    path = os.path.join(directory, "aio_bench.dat")
+    results = []
+    try:
+        for direction in ("write", "read"):
+            for bs in block_sizes:
+                for tc in threads:
+                    h = AsyncIOHandle(block_size=bs, thread_count=tc)
+                    best = None
+                    for _ in range(2):
+                        t0 = time.perf_counter()
+                        if direction == "write":
+                            h.sync_pwrite(payload, path)
+                        else:
+                            h.sync_pread(payload, path)
+                        dt = time.perf_counter() - t0
+                        best = dt if best is None else min(best, dt)
+                    gbps = payload.nbytes / best / 1e9
+                    cell = {"op": direction, "block_size": bs,
+                            "threads": tc, "gbps": round(gbps, 3)}
+                    results.append(cell)
+                    if emit_json:
+                        print(json.dumps(cell), flush=True)
+                    else:
+                        print(f"{direction:5s} bs={bs:>8d} t={tc:>2d}  "
+                              f"{gbps:7.3f} GB/s", flush=True)
+    finally:
+        if os.path.exists(path):
+            os.unlink(path)
+
+    for direction in ("read", "write"):
+        cells = [r for r in results if r["op"] == direction]
+        if cells:
+            best = max(cells, key=lambda r: r["gbps"])
+            print(f"best {direction}: {best['gbps']} GB/s @ "
+                  f"block_size={best['block_size']} "
+                  f"threads={best['threads']}")
+    return results
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=None,
+                    help="target directory (an NVMe mount for real numbers; "
+                         "default: a temp dir)")
+    ap.add_argument("--mb", type=int, default=256, help="payload size in MiB")
+    ap.add_argument("--block-sizes", type=_parse_ints,
+                    default=[1 << 18, 1 << 20, 1 << 22])
+    ap.add_argument("--threads", type=_parse_ints, default=[1, 4])
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON line per sweep cell")
+    args = ap.parse_args(argv)
+
+    if args.dir is not None:
+        run_sweep(args.dir, args.mb, args.block_sizes, args.threads,
+                  args.json)
+    else:
+        with tempfile.TemporaryDirectory() as td:
+            print(f"--dir not given; sweeping {td} (page cache, not NVMe)")
+            run_sweep(td, args.mb, args.block_sizes, args.threads, args.json)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    main()

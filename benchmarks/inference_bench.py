@@ -35,6 +35,12 @@ def main():
     ap.add_argument("--dtype", default="bf16")
     ap.add_argument("--stream", action="store_true",
                     help="ZeRO-Inference weight streaming (host-resident layers)")
+    ap.add_argument("--forward-only", action="store_true",
+                    help="measure engine.forward latency instead of "
+                         "generate — the reference's bert-bench.py shape. "
+                         "Encoder families (bert/distilbert/clip text) are "
+                         "served by passing their HF checkpoint DIRECTORY "
+                         "as --model; the name presets are decoder-only")
     args = ap.parse_args()
 
     import jax
@@ -47,7 +53,12 @@ def main():
     else:
         from deepspeed_tpu.models import gpt2, llama
         fam, _, size = args.model.partition("-")
-        model = {"gpt2": gpt2, "llama": llama}[fam](size or "125m")
+        presets = {"gpt2": gpt2, "llama": llama}
+        if fam not in presets:
+            ap.error(f"unknown preset family {fam!r} (presets: "
+                     f"{sorted(presets)}; other architectures: pass an HF "
+                     "checkpoint directory path)")
+        model = presets[fam](size or "125m")
         kw = {"params": model.init_params(jax.random.key(0))}
     if args.stream:
         kw["zero"] = {"stage": 3, "offload_param": {"device": "cpu"}}
@@ -56,6 +67,22 @@ def main():
     rng = np.random.default_rng(0)
     vocab = getattr(engine.module.config, "vocab_size", 50257)
     prompt = rng.integers(0, vocab, size=(args.batch, args.prompt_len)).astype(np.int32)
+
+    if args.forward_only:
+        np.asarray(engine.forward(prompt))  # warmup/compile
+        fwd = []
+        for _ in range(args.trials):
+            t0 = time.perf_counter()
+            np.asarray(engine.forward(prompt))  # host fetch = device sync
+            fwd.append(time.perf_counter() - t0)
+        print(json.dumps({
+            "model": args.model, "batch": args.batch,
+            "seq_len": args.prompt_len, "dtype": args.dtype,
+            "forward_ms": {q: round(pct(fwd, p) * 1e3, 2)
+                           for q, p in (("p50", 50), ("p90", 90), ("p99", 99))},
+            "samples_per_s": round(args.batch / pct(fwd, 50), 1),
+        }))
+        return
 
     # warmup (compile prefill + decode)
     engine.generate(prompt, max_new_tokens=2)
@@ -87,4 +114,7 @@ def main():
 
 
 if __name__ == "__main__":
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
     main()

@@ -121,3 +121,16 @@ def test_optimizer_swapper_steps_with_cpu_adam(tmp_path):
         ref_opt.begin_step()
         ref_opt.step(k, ref, grads[k])
         np.testing.assert_allclose(sw.read_master(k), ref, rtol=1e-6, atol=1e-7)
+
+
+def test_aio_bench_sweep(tmp_path):
+    """The perf-sweep tool (reference aio_bench_perf_sweep.py) produces one
+    cell per (op, block, depth, threads) with positive bandwidth."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+    from benchmarks.aio_bench import run_sweep
+    cells = run_sweep(str(tmp_path), mb=2, block_sizes=[1 << 18],
+                      threads=[1, 2])
+    assert len(cells) == 4  # 2 ops x 1 block size x 2 thread counts
+    assert all(c["gbps"] > 0 for c in cells)
+    assert not any(tmp_path.iterdir())  # payload file cleaned up
