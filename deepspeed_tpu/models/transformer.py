@@ -421,11 +421,23 @@ def _sparse_model_attention(cfg: TransformerConfig, q, k, v, mask_bias, slopes):
     # rejected S not divisible by the block; the core rejects dense
     # fallbacks past its DENSE_SPARSE_MAX_SEQ — single guard, single
     # message)
-    use_pallas = _use_flash(cfg) and sc.block >= 128
     mb = None if mask_bias is None else mask_bias.astype(jnp.float32)
+    if sc.block >= 128 and sc.block % 8 == 0:  # legal VMEM tile sizes only
+        if _use_flash(cfg):
+            return sparse_attention_core(q, k, v, layout, sc.block,
+                                         bool(cfg.causal), mb,
+                                         scale=cfg.attn_scale, use_pallas=True)
+        fmesh = _flash_mesh(cfg)
+        if fmesh is not None:
+            # multi-chip dp/fsdp×tp(×ep) mesh: the layout rides the head
+            # axis through the shard_map so every shard keeps the
+            # block-sparse kernel
+            out = _flash_sharded(cfg, q, k, v, mb, None, fmesh,
+                                 block_layout=layout)
+            if out is not None:
+                return out
     return sparse_attention_core(q, k, v, layout, sc.block, bool(cfg.causal),
-                                 mb, scale=cfg.attn_scale,
-                                 use_pallas=use_pallas)
+                                 mb, scale=cfg.attn_scale, use_pallas=False)
 
 
 def _inside_full_manual(mesh) -> bool:
@@ -507,11 +519,14 @@ def _shard_axes(mesh, B: int, H: int, KV: int = None):
     return batch_axes, head_axis, nb, nh
 
 
-def _flash_sharded(cfg: TransformerConfig, q, k, v, mask_bias, slopes, mesh):
+def _flash_sharded(cfg: TransformerConfig, q, k, v, mask_bias, slopes, mesh,
+                   block_layout=None):
     """Flash attention under a dp/fsdp×tp mesh: shard_map over the batch and
     head axes (no cross-shard communication — attention is pointwise in batch
     and head), so the Pallas kernel runs per-shard instead of silently
     falling back to O(S²) einsum attention on multi-chip meshes.
+    ``block_layout`` [H, nb, nb] rides the head axis, so block-SPARSE
+    attention keeps the kernel on multi-chip meshes too.
     Returns None when the shard sizes don't divide (caller falls back)."""
     from jax import shard_map
 
@@ -546,15 +561,20 @@ def _flash_sharded(cfg: TransformerConfig, q, k, v, mask_bias, slopes, mesh):
     if slopes is not None:
         operands.append(jnp.asarray(slopes, jnp.float32).reshape(H))
         specs.append(sspec)
+    if block_layout is not None:
+        operands.append(jnp.asarray(block_layout, jnp.float32))
+        specs.append(P(head_axis))
 
     def inner(qs, ks, vs, *rest):
         rest = list(rest)
         ms = rest.pop(0) if mask_bias is not None else None
         ss = rest.pop(0) if slopes is not None else None
+        bl = rest.pop(0) if block_layout is not None else None
         return flash_attention(qs, ks, vs, mask_bias=ms, causal=cfg.causal,
                                alibi_slopes=ss, scale=cfg.attn_scale,
                                block_q=cfg.attn_block_q,
-                               block_k=cfg.attn_block_k)
+                               block_k=cfg.attn_block_k,
+                               block_layout=bl)
 
     wrapped = shard_map(inner, mesh=mesh, in_specs=tuple(specs),
                        out_specs=qspec, check_vma=False)

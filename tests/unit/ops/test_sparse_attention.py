@@ -287,3 +287,27 @@ class TestSparseAttentionUtils:
         # non-zoo models are rejected
         with pytest.raises(TypeError, match="cannot sparsify"):
             replace_self_attention(object(), FixedSparsityConfig(num_heads=4))
+
+    def test_sparse_kernel_under_mesh(self, mesh_2d):
+        """dp x tp mesh: the block layout rides the head axis through the
+        shard_map'd flash kernel (interpret on CPU) and matches the
+        single-device dense token-bias form."""
+        import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.ops.sparse_attention import (FixedSparsityConfig,
+                                                        replace_self_attention)
+        sc = FixedSparsityConfig(num_heads=4, block=128, num_local_blocks=1,
+                                 attention="unidirectional")
+        dense_m = replace_self_attention(self._tiny_lm(max_seq=256), sc)
+        flash_m = replace_self_attention(
+            self._tiny_lm(max_seq=256, attention_backend="flash"), sc)
+        params = dense_m.init_params(jax.random.key(6))
+        tok = jnp.asarray(np.random.default_rng(6).integers(0, 64, (4, 256)),
+                          jnp.int32)
+        dist.set_mesh(None)
+        ref = np.asarray(dense_m.forward(params, tok), np.float32)
+        try:
+            dist.set_mesh(mesh_2d)  # 4 dp x 2 tp
+            got = np.asarray(flash_m.forward(params, tok), np.float32)
+        finally:
+            dist.set_mesh(None)
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-3)
