@@ -14,6 +14,7 @@ Public API mirrors the reference (``deepspeed/__init__.py``):
 
 from deepspeed_tpu.version import __version__, git_branch, git_hash
 from deepspeed_tpu.runtime import zero  # deepspeed.zero.Init / GatheredParameters parity
+from deepspeed_tpu.utils.init_on_device import OnDevice  # deepspeed.OnDevice parity
 
 
 def initialize(args=None,

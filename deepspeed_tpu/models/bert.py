@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.models import transformer as T
+from deepspeed_tpu.utils.init_on_device import honors_on_device
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,6 +51,7 @@ class BertModel:
         self.zoo_cfg = config.zoo()
         self.with_mlm_head = with_mlm_head
 
+    @honors_on_device
     def init_params(self, rng) -> Dict[str, Any]:
         c = self.config
         p = T.init_params(self.zoo_cfg, rng)

@@ -22,13 +22,14 @@ class CausalLM:
 
     def init_params(self, rng) -> Dict[str, Any]:
         from deepspeed_tpu.runtime import zero
+        from deepspeed_tpu.utils.init_on_device import materialize_params
         ctx = zero.active_init()
         init = lambda r: T.init_params(self.config, r, dtype=self.param_dtype)
         if ctx is not None:
             # inside `with zero.Init(...)`: materialise ZeRO-3-sharded, the
             # full tree never exists on any single device/host
             return ctx.materialize(init, rng, tp_specs=self.tp_specs())
-        return init(rng)
+        return materialize_params(init, rng)
 
     def forward(self, params, tokens, attn_mask=None):
         return T.forward(self.config, params, tokens, attn_mask)

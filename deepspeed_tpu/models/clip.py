@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.models import transformer as T
+from deepspeed_tpu.utils.init_on_device import honors_on_device
 
 
 @dataclasses.dataclass(frozen=True)
@@ -89,6 +90,7 @@ class CLIPTextEncoder:
         self.config = config
         self.zoo_cfg = config.zoo()
 
+    @honors_on_device
     def init_params(self, rng) -> Dict[str, Any]:
         p = T.init_params(self.zoo_cfg, rng)
         out = {"embed": p["embed"], "layers": p["layers"], "ln_f": p["ln_f"]}
@@ -139,6 +141,7 @@ class CLIPVisionEncoder:
         self.config = config
         self.zoo_cfg = config.zoo()
 
+    @honors_on_device
     def init_params(self, rng) -> Dict[str, Any]:
         c = self.config
         p = T.init_params(self.zoo_cfg, rng)

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models import transformer as T
+from deepspeed_tpu.utils.init_on_device import honors_on_device
 from deepspeed_tpu.moe.sharded_moe import dispatch_combine, top1gating, top2gating
 
 
@@ -54,6 +55,7 @@ class MoECausalLM:
 
     # -------------------- params -------------------- #
 
+    @honors_on_device
     def init_params(self, rng) -> Dict[str, Any]:
         cfg, moe = self.config, self.moe
         base = T.init_params(cfg, rng, dtype=self.param_dtype)

@@ -19,6 +19,7 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models import transformer as T
 from deepspeed_tpu.models.causal_lm import CausalLM
+from deepspeed_tpu.utils.init_on_device import honors_on_device
 
 
 class PipelinedCausalLM(CausalLM):
@@ -39,6 +40,7 @@ class PipelinedCausalLM(CausalLM):
 
     # -------------------- params -------------------- #
 
+    @honors_on_device
     def init_params(self, rng) -> Dict[str, Any]:
         p = T.init_params(self.config, rng, dtype=self.param_dtype)
         S, Lps = self.num_stages, self.layers_per_stage

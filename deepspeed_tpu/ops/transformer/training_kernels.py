@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.models import transformer as T
+from deepspeed_tpu.utils.init_on_device import honors_on_device
 
 __all__ = ["DeepSpeedTransformerConfig", "DeepSpeedTransformerLayer"]
 
@@ -97,6 +98,7 @@ class DeepSpeedTransformerLayer:
                                seed=self._seed_offset + seed)
         return self._fwd(params, x, positions, mask_bias)
 
+    @honors_on_device
     def init_params(self, rng):
         full = T.init_params(self._cfg, rng)
         return jax.tree.map(lambda a: a[0], full["layers"])

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.runtime.utils import partition_balanced, partition_uniform
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.init_on_device import honors_on_device
 
 
 class LayerSpec:
@@ -194,6 +195,7 @@ class PipelineModule:
     # ------------------------------------------------------------- #
     # params
 
+    @honors_on_device
     def init_params(self, rng) -> Dict[str, Any]:
         """Per-layer parameter list; tied layers share one entry under
         ``tied[key]`` (first occurrence initializes)."""

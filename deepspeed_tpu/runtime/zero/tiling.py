@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from deepspeed_tpu.utils.init_on_device import honors_on_device
 
 
 class TiledLinear:
@@ -52,6 +53,7 @@ class TiledLinear:
 
     # -------------------- params -------------------- #
 
+    @honors_on_device
     def init_params(self, rng, dtype=jnp.float32) -> Dict[str, Any]:
         scale = self.in_features**-0.5
         w = jax.random.normal(

@@ -1,0 +1,113 @@
+"""Meta-device / dtype-override model initialization.
+
+Reference parity: ``deepspeed/utils/init_on_device.py`` ``OnDevice`` — a
+context manager under which model construction materialises parameters on a
+chosen device, as a chosen dtype, or not at all (``device="meta"``: shapes
+and dtypes only, no memory). The reference monkey-patches
+``Tensor.__new__``; the TPU redesign wraps the zoo's pure ``init_params``
+functions instead: under ``device="meta"`` the init is traced with
+``jax.eval_shape`` (zero FLOPs, zero bytes), otherwise it runs normally and
+floating-point leaves are cast to the requested dtype.
+
+Usage (reference ``OnDevice(dtype=torch.half, device="meta")``)::
+
+    with deepspeed_tpu.OnDevice(dtype=jnp.bfloat16, device="meta"):
+        params = model.init_params(jax.random.key(0))   # ShapeDtypeStructs
+
+    engine = deepspeed_tpu.init_inference(model, params=real_params)
+
+Every zoo model's ``init_params`` honors the context. Meta trees feed
+memory estimation (autotuner AOT analysis, flops profiler) and huge-model
+flows where the real weights arrive from a checkpoint loader instead of an
+RNG.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+_local = threading.local()
+
+
+class OnDevice:
+    """Context manager selecting where/how ``init_params`` materialises.
+
+    ``device``: ``"device"`` (default backend, normal init) or ``"meta"``
+    (no allocation — returns a ``jax.ShapeDtypeStruct`` pytree).
+    ``dtype``: optional override applied to floating-point leaves.
+    """
+
+    def __init__(self, dtype=None, device: str = "device", enabled: bool = True):
+        if device not in ("device", "meta"):
+            raise ValueError(f"device must be 'device' or 'meta', got {device!r}")
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+        self._prev: Optional[OnDevice] = None
+
+    @staticmethod
+    def current() -> Optional["OnDevice"]:
+        ctx = getattr(_local, "ctx", None)
+        return ctx if ctx is not None and ctx.enabled else None
+
+    def __enter__(self):
+        # enabled=False is a no-op wrapper: an active outer context stays in
+        # force (reference semantics — the patch simply isn't applied)
+        if self.enabled:
+            self._prev = getattr(_local, "ctx", None)
+            _local.ctx = self
+        return self
+
+    def __exit__(self, *exc):
+        if self.enabled:
+            _local.ctx = self._prev
+        return False
+
+
+def _cast_floats(tree, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    def leaf(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            if isinstance(x, jax.ShapeDtypeStruct):
+                return jax.ShapeDtypeStruct(x.shape, dtype)
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(leaf, tree)
+
+
+def materialize_params(init_fn, *args) -> Any:
+    """Run a pure params-init function under the active :class:`OnDevice`
+    context (no-op passthrough when none is active). Called by every zoo
+    model's ``init_params``."""
+    import jax
+
+    ctx = OnDevice.current()
+    if ctx is None:
+        return init_fn(*args)
+    if ctx.device == "meta":
+        tree = jax.eval_shape(init_fn, *args)
+    else:
+        tree = init_fn(*args)
+    if ctx.dtype is not None:
+        tree = _cast_floats(tree, ctx.dtype)
+    return tree
+
+
+def honors_on_device(init_method):
+    """Decorator for ``init_params(self, rng, ...)``-shaped methods: the
+    single place that expresses the OnDevice contract (apply to every
+    params-producing entry so new model families can't silently bypass the
+    context). Only the rng is traced; trailing args (e.g. a dtype) ride the
+    closure."""
+    import functools
+
+    @functools.wraps(init_method)
+    def wrapped(self, rng, *args, **kwargs):
+        return materialize_params(
+            lambda r: init_method(self, r, *args, **kwargs), rng)
+
+    return wrapped

@@ -1,0 +1,107 @@
+"""OnDevice meta/dtype init context (reference utils/init_on_device.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import CausalLM, gpt2, llama
+from deepspeed_tpu.models.bert import BertConfig, BertModel
+from deepspeed_tpu.models.moe_lm import MoEConfig, MoECausalLM
+from deepspeed_tpu.models.pipeline import PipelinedCausalLM
+from deepspeed_tpu.models.transformer import TransformerConfig
+
+
+def _leaves(tree):
+    return jax.tree.leaves(tree)
+
+
+def test_meta_init_allocates_nothing():
+    model = gpt2("125m")  # 124M params: would be ~500 MB f32 if materialised
+    with deepspeed_tpu.OnDevice(device="meta"):
+        params = model.init_params(jax.random.key(0))
+    assert all(isinstance(l, jax.ShapeDtypeStruct) for l in _leaves(params))
+    # shapes match the real init exactly
+    real_shapes = jax.eval_shape(
+        lambda r: model.init_params(r), jax.random.key(0))
+    assert jax.tree.map(lambda a: a.shape, params) == \
+           jax.tree.map(lambda a: a.shape, real_shapes)
+
+
+def test_meta_init_dtype_override():
+    model = llama("tiny", n_layer=2, d_model=64, n_head=4, d_ff=128,
+                  vocab_size=128, max_seq=32)
+    with deepspeed_tpu.OnDevice(dtype=jnp.bfloat16, device="meta"):
+        params = model.init_params(jax.random.key(0))
+    assert all(l.dtype == jnp.bfloat16 for l in _leaves(params))
+
+
+def test_device_init_with_dtype_cast():
+    model = CausalLM(TransformerConfig(vocab_size=64, n_layer=1, n_head=2,
+                                       d_model=16, max_seq=16))
+    with deepspeed_tpu.OnDevice(dtype=jnp.bfloat16):
+        params = model.init_params(jax.random.key(0))
+    leaves = _leaves(params)
+    assert all(hasattr(l, "addressable_shards") or hasattr(l, "device")
+               or isinstance(l, jax.Array) for l in leaves)  # real arrays
+    assert all(l.dtype == jnp.bfloat16 for l in leaves)
+
+
+def test_outside_context_is_untouched():
+    model = CausalLM(TransformerConfig(vocab_size=64, n_layer=1, n_head=2,
+                                       d_model=16, max_seq=16))
+    params = model.init_params(jax.random.key(0))
+    assert all(isinstance(l, jax.Array) for l in _leaves(params))
+    assert _leaves(params)[0].dtype == jnp.float32
+
+
+@pytest.mark.parametrize("build", [
+    lambda: PipelinedCausalLM(TransformerConfig(vocab_size=64, n_layer=2,
+                                                n_head=2, d_model=16,
+                                                max_seq=16), 2),
+    lambda: BertModel(BertConfig(vocab_size=64, max_seq=16, n_layer=1,
+                                 n_head=2, d_model=16, d_ff=32)),
+    lambda: MoECausalLM(TransformerConfig(vocab_size=64, n_layer=2, n_head=2,
+                                          d_model=16, max_seq=16),
+                        MoEConfig(num_experts=2)),
+])
+def test_meta_init_every_family(build):
+    model = build()
+    with deepspeed_tpu.OnDevice(device="meta"):
+        params = model.init_params(jax.random.key(0))
+    assert all(isinstance(l, jax.ShapeDtypeStruct) for l in _leaves(params))
+
+
+def test_invalid_device_rejected():
+    with pytest.raises(ValueError, match="meta"):
+        deepspeed_tpu.OnDevice(device="cuda:0")
+
+
+def test_nested_disabled_context_is_noop():
+    """OnDevice(enabled=False) must not cancel an active outer context
+    (reference semantics: the patch simply isn't applied)."""
+    model = CausalLM(TransformerConfig(vocab_size=64, n_layer=1, n_head=2,
+                                       d_model=16, max_seq=16))
+    with deepspeed_tpu.OnDevice(device="meta"):
+        with deepspeed_tpu.OnDevice(dtype=jnp.float16, enabled=False):
+            params = model.init_params(jax.random.key(0))
+    assert all(isinstance(l, jax.ShapeDtypeStruct) for l in _leaves(params))
+    assert _leaves(params)[0].dtype == jnp.float32  # disabled dtype ignored
+
+
+def test_meta_covers_module_level_inits():
+    """PipelineModule / fused layer / TiledLinear init_params honor the
+    context too — not just the model zoo."""
+    from deepspeed_tpu.ops.transformer.training_kernels import (
+        DeepSpeedTransformerLayer, DeepSpeedTransformerConfig)
+    from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+
+    layer = DeepSpeedTransformerLayer(DeepSpeedTransformerConfig(
+        hidden_size=32, heads=4, intermediate_size=64, seq_length=16))
+    tiled = TiledLinear(32, 32, in_splits=2, out_splits=2)
+    with deepspeed_tpu.OnDevice(device="meta"):
+        lp = layer.init_params(jax.random.key(0))
+        tp = tiled.init_params(jax.random.key(0))
+    assert all(isinstance(l, jax.ShapeDtypeStruct) for l in _leaves(lp))
+    assert all(isinstance(l, jax.ShapeDtypeStruct) for l in _leaves(tp))
