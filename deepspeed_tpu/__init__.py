@@ -16,6 +16,46 @@ from deepspeed_tpu.version import __version__, git_branch, git_hash
 from deepspeed_tpu.runtime import zero  # deepspeed.zero.Init / GatheredParameters parity
 from deepspeed_tpu.utils.init_on_device import OnDevice  # deepspeed.OnDevice parity
 
+# Reference top-level surface (deepspeed/__init__.py:14-34), resolved
+# lazily (PEP 562) so `import deepspeed_tpu` stays light and cycle-free.
+_LAZY_EXPORTS = {
+    "DeepSpeedEngine": ("deepspeed_tpu.runtime.engine", "DeepSpeedEngine"),
+    "PipelineEngine": ("deepspeed_tpu.runtime.pipe.engine", "PipelineEngine"),
+    "InferenceEngine": ("deepspeed_tpu.inference.engine", "InferenceEngine"),
+    "DeepSpeedInferenceConfig": ("deepspeed_tpu.inference.config",
+                                 "DeepSpeedInferenceConfig"),
+    "DeepSpeedConfig": ("deepspeed_tpu.config.core", "DeepSpeedConfig"),
+    "DeepSpeedConfigError": ("deepspeed_tpu.config.core", "DeepSpeedConfigError"),
+    "DeepSpeedTransformerLayer": ("deepspeed_tpu.ops.transformer.training_kernels",
+                                  "DeepSpeedTransformerLayer"),
+    "DeepSpeedTransformerConfig": ("deepspeed_tpu.ops.transformer.training_kernels",
+                                   "DeepSpeedTransformerConfig"),
+    "PipelineModule": ("deepspeed_tpu.runtime.pipe.module", "PipelineModule"),
+    "init_distributed": ("deepspeed_tpu.comm", "init_distributed"),
+    "log_dist": ("deepspeed_tpu.utils.logging", "log_dist"),
+    "add_tuning_arguments": ("deepspeed_tpu.runtime.lr_schedules",
+                             "add_tuning_arguments"),
+    "checkpointing": ("deepspeed_tpu.runtime.activation_checkpointing.checkpointing",
+                      None),
+    "module_inject": ("deepspeed_tpu.module_inject", None),
+    "ops": ("deepspeed_tpu.ops", None),
+}
+
+
+def __getattr__(name):
+    entry = _LAZY_EXPORTS.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    mod = importlib.import_module(entry[0])
+    obj = mod if entry[1] is None else getattr(mod, entry[1])
+    globals()[name] = obj
+    return obj
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY_EXPORTS))
+
 
 def initialize(args=None,
                model=None,

@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.utils.init_on_device import honors_on_device
+
 from deepspeed_tpu.moe.experts import ExpertFFN
 from deepspeed_tpu.moe.sharded_moe import MOELayer, TopKGate
 from deepspeed_tpu.utils.logging import log_dist
@@ -60,6 +62,7 @@ class MoE:
         log_dist(f"MoE: {num_experts} experts, k={k}, capacity_factor={capacity_factor}, "
                  f"residual={use_residual}", ranks=[0])
 
+    @honors_on_device
     def init_params(self, rng) -> Dict[str, Any]:
         kg, ke, kr, kc = jax.random.split(rng, 4)
         params: Dict[str, Any] = {"gate": self.gate.init(kg), "experts": self.expert.init(ke)}

@@ -201,3 +201,66 @@ class WarmupDecayLR(_ScheduleBase):
         super().__init__(
             warmup_decay_lr_fn(total_num_steps, warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type),
             last_batch_iteration)
+
+
+# ------------------------------------------------------------------ #
+# CLI tuning arguments (reference lr_schedules.py:52-120
+# add_tuning_arguments / parse_arguments / override_*_params)
+
+def add_tuning_arguments(parser):
+    """Add the LR-schedule tuning CLI group (reference ``:52``). Defaults
+    are ``None`` so :func:`override_params` only overrides what the user
+    actually passed."""
+    group = parser.add_argument_group("Convergence Tuning",
+                                      "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help=f"LR schedule: one of {VALID_LR_SCHEDULES}")
+    # LRRangeTest
+    group.add_argument("--lr_range_test_min_lr", type=float, default=None)
+    group.add_argument("--lr_range_test_step_size", type=int, default=None)
+    group.add_argument("--lr_range_test_step_rate", type=float, default=None)
+    # type=bool would turn ANY non-empty string (incl. "false") into True
+    group.add_argument("--lr_range_test_staircase", default=None,
+                       type=lambda s: s.lower() in ("1", "true", "yes"))
+    # OneCycle
+    group.add_argument("--cycle_first_step_size", type=int, default=None)
+    group.add_argument("--cycle_first_stair_count", type=int, default=None)
+    group.add_argument("--cycle_second_step_size", type=int, default=None)
+    group.add_argument("--cycle_second_stair_count", type=int, default=None)
+    group.add_argument("--decay_step_size", type=int, default=None)
+    group.add_argument("--cycle_min_lr", type=float, default=None)
+    group.add_argument("--cycle_max_lr", type=float, default=None)
+    group.add_argument("--decay_lr_rate", type=float, default=None)
+    # Warmup(Decay)LR
+    group.add_argument("--warmup_min_lr", type=float, default=None)
+    group.add_argument("--warmup_max_lr", type=float, default=None)
+    group.add_argument("--warmup_num_steps", type=int, default=None)
+    group.add_argument("--warmup_type", type=str, default=None)
+    group.add_argument("--total_num_steps", type=int, default=None)
+    return parser
+
+
+def parse_arguments():
+    """Parse only the tuning group from sys.argv (reference ``:114``):
+    returns ``(known_args, unknown_args)``."""
+    import argparse
+    parser = add_tuning_arguments(argparse.ArgumentParser())
+    return parser.parse_known_args()
+
+
+def override_params(args, name: str, params: Dict[str, Any]) -> Dict[str, Any]:
+    """Fold CLI tuning args into a ``scheduler.params`` dict for schedule
+    ``name`` — the single-function form of the reference's four
+    ``override_*_params`` helpers. Only non-None args override."""
+    if name not in VALID_LR_SCHEDULES:
+        raise ValueError(f"Unknown LR schedule {name}; valid: {VALID_LR_SCHEDULES}")
+    import inspect
+    fn = {LR_RANGE_TEST: lr_range_test_fn, ONE_CYCLE: one_cycle_fn,
+          WARMUP_LR: warmup_lr_fn, WARMUP_DECAY_LR: warmup_decay_lr_fn}[name]
+    accepted = set(inspect.signature(fn).parameters)
+    out = dict(params)
+    for key in accepted:
+        val = getattr(args, key, None)
+        if val is not None:
+            out[key] = val
+    return out

@@ -148,3 +148,46 @@ class TestMisc:
         assert cfg.checkpoint_tag_validation_enabled and cfg.checkpoint_tag_validation_fail
         with pytest.raises(DeepSpeedConfigError):
             DeepSpeedConfig({"train_batch_size": 8, "checkpoint": {"tag_validation": "bogus"}}, world_size=1)
+
+
+class TestLRTuningArguments:
+    """add_tuning_arguments / parse_arguments / override_params
+    (reference lr_schedules.py:52-200)."""
+
+    def test_add_and_override(self):
+        import argparse
+        from deepspeed_tpu.runtime.lr_schedules import (add_tuning_arguments,
+                                                        override_params)
+        parser = add_tuning_arguments(argparse.ArgumentParser())
+        args = parser.parse_args(["--lr_schedule", "WarmupLR",
+                                  "--warmup_max_lr", "0.01",
+                                  "--warmup_num_steps", "50"])
+        params = override_params(args, args.lr_schedule,
+                                 {"warmup_min_lr": 0.001})
+        assert params == {"warmup_min_lr": 0.001, "warmup_max_lr": 0.01,
+                          "warmup_num_steps": 50}
+        # untouched args never override
+        assert "warmup_type" not in params
+
+    def test_override_params_feed_schedules(self):
+        import argparse
+        from deepspeed_tpu.runtime.lr_schedules import (WarmupDecayLR,
+                                                        add_tuning_arguments,
+                                                        override_params)
+        parser = add_tuning_arguments(argparse.ArgumentParser())
+        args = parser.parse_args(["--total_num_steps", "100",
+                                  "--warmup_num_steps", "10",
+                                  "--warmup_max_lr", "0.1"])
+        params = override_params(args, "WarmupDecayLR", {})
+        sched = WarmupDecayLR(**params)
+        lrs = [float(sched._fn(s)) for s in (0, 10, 100)]
+        assert abs(lrs[1] - 0.1) < 1e-6 and lrs[2] < 1e-6
+
+    def test_unknown_schedule_rejected(self):
+        import argparse
+        from deepspeed_tpu.runtime.lr_schedules import (add_tuning_arguments,
+                                                        override_params)
+        args = add_tuning_arguments(argparse.ArgumentParser()).parse_args([])
+        import pytest
+        with pytest.raises(ValueError, match="Unknown LR schedule"):
+            override_params(args, "Cosine", {})

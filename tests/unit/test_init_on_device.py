@@ -105,3 +105,11 @@ def test_meta_covers_module_level_inits():
         tp = tiled.init_params(jax.random.key(0))
     assert all(isinstance(l, jax.ShapeDtypeStruct) for l in _leaves(lp))
     assert all(isinstance(l, jax.ShapeDtypeStruct) for l in _leaves(tp))
+
+
+def test_meta_covers_moe_layer():
+    from deepspeed_tpu.moe.layer import MoE
+    moe = MoE(hidden_size=16, num_experts=2)
+    with deepspeed_tpu.OnDevice(device="meta"):
+        params = moe.init_params(jax.random.key(0))
+    assert all(isinstance(l, jax.ShapeDtypeStruct) for l in _leaves(params))
