@@ -538,6 +538,23 @@ class InferenceEngine:
         input_ids = jnp.asarray(input_ids, jnp.int32)
         if input_ids.ndim == 1:
             input_ids = input_ids[None, :]
+        from deepspeed_tpu.models.bert import BertModel
+        from deepspeed_tpu.models.clip import (CLIPTextEncoder,
+                                               CLIPVisionEncoder,
+                                               DSClipEncoder)
+        zoo_cfg = getattr(self.module, "zoo_cfg",
+                          getattr(self.module, "config", None))
+        if (isinstance(self.module, (BertModel, CLIPTextEncoder,
+                                     CLIPVisionEncoder, DSClipEncoder))
+                or getattr(zoo_cfg, "causal", True) is False):
+            # encoders run autoregressively emit nonsense (bidirectional
+            # attention, or hidden states instead of vocab logits) — reject
+            # loudly (the reference's engine.generate delegates to
+            # module.generate, which encoder models don't have either)
+            raise ValueError(
+                f"{type(self.module).__name__} is an encoder; generate() "
+                "requires a causal LM — use engine.forward for hidden "
+                "states / MLM logits")
         max_new = max_new_tokens if max_new_tokens is not None else self._config.max_out_tokens
         max_len = input_ids.shape[1] + max_new
         cfg = getattr(self.module, "config", None)

@@ -252,12 +252,10 @@ def override_params(args, name: str, params: Dict[str, Any]) -> Dict[str, Any]:
     """Fold CLI tuning args into a ``scheduler.params`` dict for schedule
     ``name`` — the single-function form of the reference's four
     ``override_*_params`` helpers. Only non-None args override."""
-    if name not in VALID_LR_SCHEDULES:
+    if name not in SCHEDULE_FNS:
         raise ValueError(f"Unknown LR schedule {name}; valid: {VALID_LR_SCHEDULES}")
     import inspect
-    fn = {LR_RANGE_TEST: lr_range_test_fn, ONE_CYCLE: one_cycle_fn,
-          WARMUP_LR: warmup_lr_fn, WARMUP_DECAY_LR: warmup_decay_lr_fn}[name]
-    accepted = set(inspect.signature(fn).parameters)
+    accepted = set(inspect.signature(SCHEDULE_FNS[name]).parameters)
     out = dict(params)
     for key in accepted:
         val = getattr(args, key, None)

@@ -1,0 +1,65 @@
+"""Train a llama-style model with ZeRO-3 + bf16 on every available chip.
+
+The condensed form of docs/tutorials/getting-started.md, runnable as-is:
+
+    python examples/train_zero3.py [--steps 50] [--size tiny]
+
+(On CPU for a quick look: JAX_PLATFORMS=cpu DS_ACCELERATOR=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=8 python examples/train_zero3.py)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", default="tiny", help="llama preset size")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--micro-batch", type=int, default=1)
+    ap.add_argument("--seq", type=int, default=256)
+    ap.add_argument("--save", default=None, help="checkpoint dir")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+
+    model = llama(args.size, max_seq=args.seq, remat="dots", loss_chunk=args.seq)
+    params = model.init_params(jax.random.key(0))
+
+    engine, _, _, scheduler = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": args.micro_batch,
+            "optimizer": {"type": "AdamW",
+                          "params": {"lr": 3e-4, "weight_decay": 0.1}},
+            "scheduler": {"type": "WarmupLR",
+                          "params": {"warmup_max_lr": 3e-4,
+                                     "warmup_num_steps": 10}},
+            "zero_optimization": {"stage": 3},
+            "bf16": {"enabled": True},
+            "gradient_clipping": 1.0,
+            "mesh": {"dp": -1},
+        })
+
+    vocab = model.config.vocab_size
+    bs = engine.train_batch_size()
+    rng = np.random.default_rng(0)
+    for step in range(args.steps):
+        batch = {"input_ids": rng.integers(0, vocab, (bs, args.seq)).astype(np.int32)}
+        loss = engine.train_batch(batch)
+        if step % 5 == 0 or step == args.steps - 1:
+            print(f"step {step:4d}  loss {float(loss):.4f}  lr {engine.get_lr()[0]:.2e}")
+    if args.save:
+        engine.save_checkpoint(args.save, tag="final")
+        print(f"saved checkpoint to {args.save}/final")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Shared helper: save a transformers model as an HF checkpoint directory
+(safetensors + config.json) with tied/duplicated tensors deduplicated —
+used by the module-inject parity tests, int8 serving tests, and example
+smoke tests."""
+
+import os
+
+
+def save_hf(model, cfg, d):
+    d = str(d)
+    model.eval()
+    sd = model.state_dict()
+    from safetensors.torch import save_file
+    sd = {k: v.contiguous() for k, v in sd.items() if "rotary_emb.inv_freq" not in k}
+    # drop tied/duplicated references for safetensors
+    seen, out = {}, {}
+    for k, v in sd.items():
+        key = v.data_ptr()
+        if key in seen:
+            continue
+        seen[key] = k
+        out[k] = v
+    save_file(out, os.path.join(d, "model.safetensors"))
+    with open(os.path.join(d, "config.json"), "w") as f:
+        f.write(cfg.to_json_string())
+    return d

@@ -234,3 +234,29 @@ class TestGroupAlignment:
                    for s in wq.scale.sharding.spec)
         lo8 = np.asarray(e8.forward(tok), np.float32)
         assert np.abs(lo8 - lo1).max() < 0.05 * max(1.0, np.abs(lo1).max())
+
+
+class TestInt8EncoderServing:
+    def test_int8_bert_argmax_parity(self, tmp_path):
+        """int8 weight-only composes with the encoder (BERT) serving path:
+        fill-mask argmax matches fp32."""
+        transformers = pytest.importorskip("transformers")
+        torch = pytest.importorskip("torch")
+        from .hf_fixtures import save_hf
+
+        cfg = transformers.BertConfig(
+            vocab_size=96, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=32)
+        torch.manual_seed(11)
+        save_hf(transformers.BertForMaskedLM(cfg), cfg, tmp_path)
+
+        dist.set_mesh(None)
+        eng32 = deepspeed_tpu.init_inference(str(tmp_path), dtype="fp32")
+        dist.set_mesh(None)
+        eng8 = deepspeed_tpu.init_inference(str(tmp_path), dtype="int8")
+        tok = np.asarray([[5, 6, 7, 8, 9, 10]], np.int32)
+        o32 = np.asarray(eng32.forward(tok))
+        o8 = np.asarray(eng8.forward(tok))
+        np.testing.assert_array_equal(o32.argmax(-1), o8.argmax(-1))
+        np.testing.assert_allclose(o8, o32, rtol=0.1, atol=0.05)

@@ -29,24 +29,7 @@ def no_mesh():
     yield
 
 
-def save_hf(model, cfg, tmp_path):
-    d = str(tmp_path)
-    model.eval()
-    sd = model.state_dict()
-    from safetensors.torch import save_file
-    sd = {k: v.contiguous() for k, v in sd.items() if "rotary_emb.inv_freq" not in k}
-    # drop tied/duplicated references for safetensors
-    seen, out = {}, {}
-    for k, v in sd.items():
-        key = v.data_ptr()
-        if key in seen:
-            continue
-        seen[key] = k
-        out[k] = v
-    save_file(out, os.path.join(d, "model.safetensors"))
-    with open(os.path.join(d, "config.json"), "w") as f:
-        f.write(cfg.to_json_string())
-    return d
+from .hf_fixtures import save_hf  # noqa: E402  (shared checkpoint writer)
 
 
 def parity(tmp_path, hf_model, hf_cfg, rtol=2e-2, atol=2e-3):
