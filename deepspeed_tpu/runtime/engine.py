@@ -1088,6 +1088,25 @@ class DeepSpeedEngine:
     def get_lr(self):
         return [float(self._lr_fn(self.state.global_steps))]
 
+    def get_type(self):
+        """Optimizer type per param group (reference engine.py:2171)."""
+        return [self._optimizer_name]
+
+    def get_mom(self):
+        """Momentum per param group (reference engine.py:2174): SGD-family
+        reports ``momentum``, Adam-family ``betas``; a client-supplied optax
+        chain reports [None] (its momenta are not introspectable)."""
+        from deepspeed_tpu.runtime.optimizers import optimizer_momenta
+        return [optimizer_momenta(self._optimizer_name,
+                                  self._config.optimizer_params)]
+
+    def get_pld_theta(self):
+        """Current progressive-layer-drop theta, or None when PLD is off
+        (reference engine.py:2180)."""
+        if self.progressive_layer_drop is not None:
+            return self.progressive_layer_drop.get_theta()
+        return None
+
     def get_global_grad_norm(self) -> float:
         if self.state.acc_grads == ():  # gas==1 fused path keeps no buffers
             return 0.0

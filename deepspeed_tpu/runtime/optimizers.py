@@ -109,3 +109,21 @@ def build_optimizer(name: Optional[str],
         )
 
     raise ValueError(f"Unknown optimizer: {name}")
+
+
+def optimizer_momenta(name: Optional[str], params: Optional[Dict[str, Any]]):
+    """The momenta ``build_optimizer`` actually applies for this config —
+    shares the builder's key lookups and defaults so engine.get_mom() can
+    never report values the optimizer ignored. Returns a ``momentum`` float
+    for the SGD family, a ``(b1, b2)`` tuple for the Adam family, or None
+    for a client-supplied optax chain (not introspectable)."""
+    if name is None or name == "client":
+        return None
+    params = params or {}
+    lname = name.lower()
+    if lname in ("sgd", "rmsprop"):
+        return params.get("momentum", 0.0)
+    if lname == "lion":
+        return tuple(params.get("betas", (0.9, 0.99)))
+    # adam / adamw / fusedadam / lamb / fusedlamb / onebit* default alike
+    return tuple(params.get("betas", (0.9, 0.999)))

@@ -277,3 +277,22 @@ def test_checkpoint_elastic_world_reshard(tmp_path):
     l2 = float(dst.train_batch(global_batch(dst, seed=7)))
     # same math, different reduction topology: loose bf16 tolerance
     assert abs(l1 - l2) < 2e-2, (l1, l2)
+
+
+def test_optimizer_introspection_accessors():
+    """get_type / get_mom / get_pld_theta (reference engine.py:2168-2185)."""
+    engine = make_engine(stage=0, extra={
+        "optimizer": {"type": "Adam",
+                      "params": {"lr": 1e-2, "betas": [0.8, 0.95]}}})
+    assert engine.get_type() == ["adam"] or engine.get_type() == ["Adam"]
+    assert engine.get_mom() == [(0.8, 0.95)]
+    assert engine.get_pld_theta() is None
+
+    sgd = make_engine(stage=0, extra={
+        "optimizer": {"type": "SGD", "params": {"lr": 1e-2, "momentum": 0.9}}})
+    assert sgd.get_mom() == [0.9]
+
+    pld = make_engine(stage=0, extra={
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                   "gamma": 0.001}})
+    assert pld.get_pld_theta() is not None

@@ -175,3 +175,20 @@ class TestKVCacheDecode:
         out = engine.generate(prompt, max_new_tokens=5, temperature=0.8, top_k=10, seed=3)
         assert out.shape == (2, 8)
         assert int(out.min()) >= 0 and int(out.max()) < 64
+
+
+def test_generate_rejects_encoder_modules():
+    """generate() on an encoder (bidirectional BERT) must raise the loud
+    causal-LM error instead of emitting autoregressive nonsense."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models.bert import BertConfig, BertModel
+    import jax
+
+    dist.set_mesh(None)
+    model = BertModel(BertConfig(vocab_size=64, max_seq=16, n_layer=1,
+                                 n_head=2, d_model=16, d_ff=32))
+    eng = deepspeed_tpu.init_inference(
+        model, params=model.init_params(jax.random.key(0)), dtype="fp32")
+    with pytest.raises(ValueError, match="requires a causal LM"):
+        eng.generate(np.asarray([[1, 2, 3]], np.int32), max_new_tokens=2)
