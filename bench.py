@@ -40,6 +40,13 @@ def _probe_backend(timeout_s: int = 240):
     return None
 
 
+def _parse_remat(env: str):
+    """BENCH_REMAT accepts 1/true/full/0/false/none or a policy name —
+    shared by every bench builder."""
+    return {"1": True, "true": True, "full": True,
+            "0": False, "false": False, "none": False}.get(env.lower(), env)
+
+
 def build_bench_engine():
     """The bench's env knobs → (engine, model, batch_fn, knobs dict). Shared
     with benchmarks/profile_bench.py so the profile always measures the
@@ -60,8 +67,7 @@ def build_bench_engine():
     # recompute) + chunked cross-entropy (never materialises the
     # [B, S, vocab] fp32 logits) + unrolled layers.
     remat_env = os.environ.get("BENCH_REMAT", "dots")
-    REMAT = {"1": True, "true": True, "full": True,
-             "0": False, "false": False, "none": False}.get(remat_env.lower(), remat_env)
+    REMAT = _parse_remat(remat_env)
     LOSS_CHUNK = int(os.environ.get("BENCH_LOSS_CHUNK", 2048))
     ATTN = os.environ.get("BENCH_ATTN", "auto")
     SCAN = os.environ.get("BENCH_SCAN", "0") == "1"  # unrolled: XLA schedules
@@ -115,7 +121,7 @@ def build_llama_bench_engine():
     blk_k = int(os.environ.get("BENCH_BLOCK_K", 0)) or None
     model = llama("tiny", n_layer=16, n_head=12, n_kv_head=4, d_model=1536,
                   d_ff=4096, max_seq=SEQ,
-                  remat=os.environ.get("BENCH_REMAT", "dots"),
+                  remat=_parse_remat(os.environ.get("BENCH_REMAT", "dots")),
                   loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", 2048)),
                   attention_backend=os.environ.get("BENCH_ATTN", "auto"),
                   scan_layers=os.environ.get("BENCH_LLAMA_SCAN", "1") == "1",
@@ -139,6 +145,54 @@ def build_llama_bench_engine():
 
     def batch_fn():
         return {"input_ids": rng.integers(0, 32000, size=(BATCH, SEQ)).astype(np.int32)}
+
+    return engine, model, batch_fn, dict(BATCH=BATCH, SEQ=SEQ)
+
+
+def build_bert_bench_engine():
+    """BERT-large MLM (the reference's headline fastest-BERT-training
+    benchmark: 53 TFLOPS = >50% of V100 peak at seq 512,
+    docs/_posts/2020-05-28-fastest-bert-training.md): 24L/1024d/16h,
+    seq 512, ZeRO-2, bf16. Off by default (BENCH_BERT=1 enables) until a
+    chip-measured configuration is recorded."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models.bert import BertConfig, BertModel
+
+    BATCH = int(os.environ.get("BENCH_BERT_BATCH", 16))
+    SEQ = int(os.environ.get("BENCH_BERT_SEQ", 512))
+    model = BertModel(BertConfig(vocab_size=30522, max_seq=SEQ, n_layer=24,
+                                 n_head=16, d_model=1024, d_ff=4096,
+                                 remat=_parse_remat(os.environ.get("BENCH_REMAT", "dots")),
+                                 loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", 2048))),
+                      with_mlm_head=True)
+    params = model.init_params(jax.random.key(0))
+
+    dist.set_mesh(None)
+    config = {
+        "train_micro_batch_size_per_gpu": BATCH,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": os.environ.get("BENCH_OPT", "AdamW"),
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "zero_optimization": {"stage": 2},
+        "bf16": {"enabled": True},
+        "mesh": {"dp": -1},
+        "steps_per_print": 0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=config)
+
+    rng = np.random.default_rng(0)
+
+    def batch_fn():
+        ids = rng.integers(0, 30522, size=(BATCH, SEQ)).astype(np.int32)
+        labels = np.full_like(ids, -100)
+        pos = rng.random((BATCH, SEQ)) < 0.15
+        labels[pos] = ids[pos]
+        ids[pos] = 103  # [MASK]
+        return {"input_ids": ids, "labels": labels}
 
     return engine, model, batch_fn, dict(BATCH=BATCH, SEQ=SEQ)
 
@@ -212,6 +266,16 @@ def main():
         _run_metric("llama_gqa_500m_zero3_train_tokens_per_sec_per_chip",
                     engine, model, batch, knobs["BATCH"], knobs["SEQ"],
                     STEPS, "GQA 12q/4kv hd128, ZeRO-3, remat=dots")
+
+    if os.environ.get("BENCH_BERT", "0") == "1":
+        if engine is not None:
+            del engine, model, batch
+        import gc
+        gc.collect()
+        engine, model, batch, knobs = build_bert_bench_engine()
+        _run_metric("bert_large_mlm_train_tokens_per_sec_per_chip",
+                    engine, model, batch, knobs["BATCH"], knobs["SEQ"],
+                    STEPS, "MLM, ZeRO-2")
 
 
 if __name__ == "__main__":

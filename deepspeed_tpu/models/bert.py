@@ -31,6 +31,12 @@ class BertConfig:
     type_vocab_size: int = 2
     norm_eps: float = 1e-12
     activation: str = "gelu_exact"   # HF 'gelu' (erf); distilbert may use relu
+    # training memory/speed knobs (models/transformer.py semantics);
+    # loss_chunk streams the MLM vocab head over token chunks so the
+    # [B, S, vocab] fp32 logits are never materialised (0 = unchunked)
+    remat: Any = True
+    attention_backend: str = "auto"
+    loss_chunk: int = 0
 
     def zoo(self) -> T.TransformerConfig:
         return T.TransformerConfig(
@@ -38,7 +44,8 @@ class BertConfig:
             n_layer=self.n_layer, n_head=self.n_head, d_model=self.d_model,
             d_ff=self.d_ff, pos_embedding="learned", norm="layernorm",
             norm_position="post", activation=self.activation, causal=False,
-            attn_bias=True, norm_eps=self.norm_eps, tie_embeddings=True)
+            attn_bias=True, norm_eps=self.norm_eps, tie_embeddings=True,
+            remat=self.remat, attention_backend=self.attention_backend)
 
 
 class BertModel:
@@ -105,6 +112,82 @@ class BertModel:
             return self.mlm_logits(params, tokens, attention_mask=attn_mask)
         hidden, _ = self(params, tokens, attention_mask=attn_mask)
         return hidden
+
+    @property
+    def num_parameters(self) -> int:
+        c = self.config
+        # per block: qkv/out + 2 FFN mats; biases bq/bk/bv/bo + b_down +
+        # two LNs = 9*d_model, b_up = d_ff
+        block = (4 * c.d_model * c.d_model + 2 * c.d_model * c.d_ff
+                 + 9 * c.d_model + c.d_ff)
+        n = (c.vocab_size + c.max_seq + c.type_vocab_size) * c.d_model
+        n += 2 * c.d_model                               # embedding LN
+        n += c.n_layer * block
+        n += c.d_model * c.d_model + c.d_model           # pooler w + b
+        if self.with_mlm_head:
+            n += c.d_model * c.d_model + 3 * c.d_model + c.vocab_size
+        return n
+
+    def flops_per_token(self, seq_len=None) -> float:
+        """Approximate training FLOPs/token (6N + attention term), the
+        CausalLM accounting on the encoder dims."""
+        c = self.config
+        s = seq_len or c.max_seq
+        return 6.0 * self.num_parameters + 12.0 * c.n_layer * c.d_model * s
+
+    def loss(self, params, batch):
+        """Masked-LM training loss — makes BertModel a first-class
+        ``deepspeed_tpu.initialize`` model (the reference's headline
+        fastest-BERT-training workload, docs/_posts/2020-05-28). batch:
+        dict(input_ids [B,S], labels [B,S] with -100 on unmasked positions,
+        optional token_type_ids / attention_mask). NSP is omitted by
+        design (RoBERTa-style MLM-only pretraining)."""
+        if not self.with_mlm_head:
+            raise ValueError("training needs the MLM head: "
+                             "BertModel(cfg, with_mlm_head=True)")
+        x, _ = self(params, batch["input_ids"],
+                    batch.get("token_type_ids"), batch.get("attention_mask"))
+        m = params["mlm"]
+        act = {"gelu_exact": lambda h: jax.nn.gelu(h, approximate=False),
+               "gelu": lambda h: jax.nn.gelu(h, approximate=True),
+               "relu": jax.nn.relu}[self.config.activation]
+        h = T._norm(self.zoo_cfg, act(x @ m["w"] + m["b"]), m["ln"])
+        w = params["embed"]["tokens"].T
+
+        labels = batch["labels"]
+        valid = (labels != -100)
+        safe = jnp.where(valid, labels, 0)
+
+        B, S, D = h.shape
+        hb = m["decoder_bias"]
+        chunk = self.config.loss_chunk
+        if chunk <= 0 or (B * S) % chunk != 0:
+            # logsumexp form: no second full-size log_softmax buffer
+            logits = (h @ w + hb).astype(jnp.float32)
+            nll, n = T._token_ce(logits.reshape(B * S, -1),
+                                 safe.reshape(-1),
+                                 valid.reshape(-1).astype(jnp.float32))
+            return nll / jnp.maximum(n, 1)
+
+        # stream the vocab head over token chunks inside a rematerialised
+        # scan — the [B, S, vocab] fp32 logits never exist (the CausalLM
+        # lm_loss machinery, applied to the MLM head)
+        nc = (B * S) // chunk
+        hf = h.reshape(nc, chunk, D)
+        lf = safe.reshape(nc, chunk)
+        vf = valid.reshape(nc, chunk).astype(jnp.float32)
+
+        def body(carry, inp):
+            hc, lc, vc = inp
+            logits = (hc @ w + hb).astype(jnp.float32)
+            nll, n = T._token_ce(logits, lc, vc)
+            s_nll, s_n = carry
+            return (s_nll + nll, s_n + n), None
+
+        body = jax.checkpoint(body, prevent_cse=False)
+        (nll, n), _ = jax.lax.scan(body, (jnp.float32(0), jnp.float32(0)),
+                                   (hf, lf, vf))
+        return nll / jnp.maximum(n, 1)
 
     def mlm_logits(self, params, input_ids, token_type_ids=None, attention_mask=None):
         """Masked-LM logits [B, S, vocab] (HF BertForMaskedLM head)."""
