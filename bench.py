@@ -234,7 +234,16 @@ def _run_metric(name, engine, model, batch, BATCH, SEQ, steps, extra_unit):
 
 def main():
     if os.environ.get("BENCH_SKIP_PROBE") != "1":
+        # one retry after a short pause: a relay mid-restart (ports up,
+        # backend briefly unresponsive) should not cost the round's number
+        retries = int(os.environ.get("BENCH_PROBE_RETRIES", 1))
         err = _probe_backend()
+        while err is not None and retries > 0:
+            print(f"bench: probe failed ({err}); retrying in 60s",
+                  file=sys.stderr)
+            time.sleep(60)
+            retries -= 1
+            err = _probe_backend()
         if err is not None:
             print(f"bench: {err}", file=sys.stderr)
             sys.exit(1)

@@ -9,16 +9,19 @@ Usage mirrors the reference's ``deepspeed.comm``::
 """
 
 from deepspeed_tpu.comm.comm import (ReduceOp, all_gather, all_gather_into_tensor, all_reduce, all_to_all_single,
-                                     axis_index, barrier, broadcast, comms_logger, configure, get_local_rank,
-                                     get_mesh, get_rank, get_world_size, has_mesh, inference_all_reduce,
-                                     init_distributed, init_mesh, is_initialized, log_summary, monitored_barrier,
-                                     recv, reduce_scatter, reduce_scatter_tensor, ring_send_recv, send, set_mesh)
+                                     axis_index, barrier, broadcast, comms_logger, configure, destroy_process_group,
+                                     gather, get_global_rank, get_local_rank, get_mesh, get_rank, get_world_group,
+                                     get_world_size, has_mesh, inference_all_reduce, init_distributed, init_mesh,
+                                     irecv, is_available, is_initialized, isend, log_summary, monitored_barrier,
+                                     new_group, recv, reduce, reduce_scatter, reduce_scatter_tensor, ring_send_recv,
+                                     scatter, send, set_mesh)
 from deepspeed_tpu.comm.mesh import axis_size, build_hybrid_mesh, build_mesh, data_parallel_axes
 
 __all__ = [
     "ReduceOp", "all_gather", "all_gather_into_tensor", "all_reduce", "all_to_all_single", "axis_index", "barrier",
-    "broadcast", "comms_logger", "configure", "get_local_rank", "get_mesh", "get_rank", "get_world_size", "has_mesh",
-    "inference_all_reduce", "init_distributed", "init_mesh", "is_initialized", "log_summary", "monitored_barrier",
-    "recv", "reduce_scatter", "reduce_scatter_tensor", "ring_send_recv", "send", "set_mesh", "axis_size",
-    "build_hybrid_mesh", "build_mesh", "data_parallel_axes",
+    "broadcast", "comms_logger", "configure", "destroy_process_group", "gather", "get_global_rank", "get_local_rank",
+    "get_mesh", "get_rank", "get_world_group", "get_world_size", "has_mesh", "inference_all_reduce",
+    "init_distributed", "init_mesh", "irecv", "is_available", "is_initialized", "isend", "log_summary",
+    "monitored_barrier", "new_group", "recv", "reduce", "reduce_scatter", "reduce_scatter_tensor", "ring_send_recv",
+    "scatter", "send", "set_mesh", "axis_size", "build_hybrid_mesh", "build_mesh", "data_parallel_axes",
 ]

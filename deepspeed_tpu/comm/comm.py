@@ -483,3 +483,149 @@ def get_model_parallel_world_size():
 
 def log_summary(show_straggler: bool = False):
     return comms_logger().log_all(print_log=True, show_straggler=show_straggler)
+
+
+# ------------------------------------------------------------------ #
+# Remaining reference-surface functions (deepspeed/comm/comm.py). SPMD
+# semantics notes: rooted collectives (reduce/gather with a dst) compute
+# the same value on EVERY rank — XLA collectives have no single-receiver
+# form, and the extra copies are free under SPMD. The dst/src arguments
+# are accepted for call-shape parity.
+
+def is_available() -> bool:
+    """Reference torch.distributed.is_available analogue — the JAX
+    collective machinery is always importable."""
+    return True
+
+
+def get_world_group() -> GroupLike:
+    """The world "process group": the all-axes GroupLike (None)."""
+    return None
+
+
+def reduce(tensor, dst: int = 0, op: ReduceOp = ReduceOp.SUM,
+           group: GroupLike = None, async_op: bool = False):
+    """Rooted reduce. SPMD form: every rank holds the reduced value (see
+    module note); ``dst`` is accepted for parity."""
+    return all_reduce(tensor, op=op, group=group, async_op=async_op)
+
+
+def gather(tensor, gather_list=None, dst: int = 0, group: GroupLike = None,
+           axis: int = 0, async_op: bool = False):
+    """Rooted gather. SPMD form: every rank holds the gathered tensor
+    (= all_gather); ``gather_list``/``dst`` accepted for parity."""
+    return all_gather(tensor, group=group, axis=axis, async_op=async_op)
+
+
+@_log_wrap("scatter", group_pos=1)
+def scatter(tensor, src: int = 0, group: GroupLike = None, axis: int = 0,
+            async_op: bool = False):
+    """Scatter the src rank's tensor along ``axis``: group rank r keeps
+    chunk r. Under SPMD scatter IS a resharding — the global value stays
+    the full tensor, and the sharding carries the split:
+
+    - traced (inside a shard_map over the group axes): a true dynamic
+      slice by the device's own group index;
+    - eager: the same array resharded over the group axis along ``axis``
+      (each device's local view is its chunk).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    axes = _resolve_axes(group)
+    if not axes:
+        return tensor
+    ws = get_world_size(group)
+    if tensor.shape[axis] % ws:
+        raise ValueError(f"scatter: axis {axis} size {tensor.shape[axis]} "
+                         f"not divisible by group size {ws}")
+    ax = axes if len(axes) > 1 else axes[0]
+    if _is_traced(tensor):
+        idx = lax.axis_index(ax)
+        size = tensor.shape[axis] // ws
+        return lax.dynamic_slice_in_dim(tensor, idx * size, size, axis=axis)
+    spec = P(*([None] * axis + [ax]))
+    return jax.device_put(jnp.asarray(tensor),
+                          NamedSharding(get_mesh(), spec))
+
+
+def get_global_rank(group: GroupLike = None, group_rank: int = 0) -> int:
+    """Translate a group-local rank to the global rank (reference
+    utils/groups-style lookup): ranks enumerate mesh coordinates in axis
+    order; non-group axes take the calling process's own coordinates (the
+    first mesh position owned by this process)."""
+    import jax
+
+    mesh = get_mesh()
+    axes = _resolve_axes(group)
+    gsize = 1
+    for name in axes:
+        gsize *= mesh.shape[name]
+    if not 0 <= group_rank < gsize:
+        raise ValueError(f"group_rank {group_rank} out of range for group "
+                         f"{axes} of size {gsize}")
+    devs = np.asarray(mesh.devices)
+    names = list(mesh.shape)
+    base = None
+    for pos, dev in np.ndenumerate(devs):
+        if dev.process_index == jax.process_index():
+            base = pos
+            break
+    coords = {n: (int(base[i]) if base is not None else 0)
+              for i, n in enumerate(names)}
+    rem = group_rank
+    for name in reversed(axes):
+        coords[name] = rem % mesh.shape[name]
+        rem //= mesh.shape[name]
+    flat = 0
+    for name in names:
+        flat = flat * mesh.shape[name] + coords[name]
+    return flat
+
+
+def new_group(ranks=None):
+    """Reference ``new_group(ranks)``. Mesh axes ARE the process groups
+    here: the world list returns the world group; any other rank subset
+    must be expressed as a mesh axis (build the mesh with that axis)."""
+    if ranks is None or sorted(ranks) == list(range(get_world_size())):
+        return None
+    raise NotImplementedError(
+        "arbitrary rank subsets are not representable as mesh collectives; "
+        "declare the grouping as a mesh axis (config mesh={...}) and pass "
+        "the axis name as the group")
+
+
+def destroy_process_group(group: GroupLike = None) -> None:
+    """Groups are mesh axes — nothing to tear down. Clearing the world
+    group drops the cached mesh (reference destroy_process_group)."""
+    if group is None:
+        set_mesh(None)
+
+
+class _CompletedWork:
+    """Handle returned by isend/irecv: XLA dispatch is asynchronous by
+    nature, so the 'work' is complete from the caller's perspective."""
+
+    def __init__(self, result=None):
+        self.result = result
+
+    def wait(self, timeout=None) -> bool:
+        return True
+
+    def is_completed(self) -> bool:
+        return True
+
+
+def isend(tensor, dst: int, group: GroupLike = None, tag: int = 0):
+    """Async send. Same contract as :func:`send`: arbitrary-rank p2p is not
+    an SPMD primitive — raises with the ring_send_recv/pipeline guidance.
+    (Kept so reference code fails loudly at the call site, not on import.)"""
+    return _CompletedWork(send(tensor, dst, group=group, tag=tag))
+
+
+def irecv(tensor, src: int, group: GroupLike = None, tag: int = 0):
+    """Async recv; same loud contract as :func:`recv` (see isend)."""
+    return _CompletedWork(recv(tensor, src, group=group, tag=tag))

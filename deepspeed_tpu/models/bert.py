@@ -147,59 +147,30 @@ class BertModel:
                              "BertModel(cfg, with_mlm_head=True)")
         x, _ = self(params, batch["input_ids"],
                     batch.get("token_type_ids"), batch.get("attention_mask"))
-        m = params["mlm"]
-        act = {"gelu_exact": lambda h: jax.nn.gelu(h, approximate=False),
-               "gelu": lambda h: jax.nn.gelu(h, approximate=True),
-               "relu": jax.nn.relu}[self.config.activation]
-        h = T._norm(self.zoo_cfg, act(x @ m["w"] + m["b"]), m["ln"])
-        w = params["embed"]["tokens"].T
+        h = self._mlm_transform(params, x)
 
         labels = batch["labels"]
         valid = (labels != -100)
         safe = jnp.where(valid, labels, 0)
+        # the CausalLM chunked-CE machinery on the MLM head: with
+        # cfg.loss_chunk the [B, S, vocab] fp32 logits never materialise
+        return T.chunked_vocab_ce(h, params["embed"]["tokens"].T,
+                                  params["mlm"]["decoder_bias"], safe, valid,
+                                  self.config.loss_chunk)
 
-        B, S, D = h.shape
-        hb = m["decoder_bias"]
-        chunk = self.config.loss_chunk
-        if chunk <= 0 or (B * S) % chunk != 0:
-            # logsumexp form: no second full-size log_softmax buffer
-            logits = (h @ w + hb).astype(jnp.float32)
-            nll, n = T._token_ce(logits.reshape(B * S, -1),
-                                 safe.reshape(-1),
-                                 valid.reshape(-1).astype(jnp.float32))
-            return nll / jnp.maximum(n, 1)
-
-        # stream the vocab head over token chunks inside a rematerialised
-        # scan — the [B, S, vocab] fp32 logits never exist (the CausalLM
-        # lm_loss machinery, applied to the MLM head)
-        nc = (B * S) // chunk
-        hf = h.reshape(nc, chunk, D)
-        lf = safe.reshape(nc, chunk)
-        vf = valid.reshape(nc, chunk).astype(jnp.float32)
-
-        def body(carry, inp):
-            hc, lc, vc = inp
-            logits = (hc @ w + hb).astype(jnp.float32)
-            nll, n = T._token_ce(logits, lc, vc)
-            s_nll, s_n = carry
-            return (s_nll + nll, s_n + n), None
-
-        body = jax.checkpoint(body, prevent_cse=False)
-        (nll, n), _ = jax.lax.scan(body, (jnp.float32(0), jnp.float32(0)),
-                                   (hf, lf, vf))
-        return nll / jnp.maximum(n, 1)
+    def _mlm_transform(self, params, x):
+        """HF BertPredictionHeadTransform: dense + config.hidden_act + LN
+        (NOT a fixed gelu — relu/gelu_new checkpoints diverge otherwise)."""
+        m = params["mlm"]
+        act = {"gelu_exact": lambda h: jax.nn.gelu(h, approximate=False),
+               "gelu": lambda h: jax.nn.gelu(h, approximate=True),
+               "relu": jax.nn.relu}[self.config.activation]
+        return T._norm(self.zoo_cfg, act(x @ m["w"] + m["b"]), m["ln"])
 
     def mlm_logits(self, params, input_ids, token_type_ids=None, attention_mask=None):
         """Masked-LM logits [B, S, vocab] (HF BertForMaskedLM head)."""
         if "mlm" not in params:
             raise ValueError("model has no MLM head (with_mlm_head=False)")
         x, _ = self(params, input_ids, token_type_ids, attention_mask)
-        m = params["mlm"]
-        # HF BertPredictionHeadTransform applies config.hidden_act, not a
-        # fixed gelu — relu/gelu_new checkpoints diverge otherwise
-        act = {"gelu_exact": lambda h: jax.nn.gelu(h, approximate=False),
-               "gelu": lambda h: jax.nn.gelu(h, approximate=True),
-               "relu": jax.nn.relu}[self.config.activation]
-        h = act(x @ m["w"] + m["b"])
-        h = T._norm(self.zoo_cfg, h, m["ln"])
-        return h @ params["embed"]["tokens"].T + m["decoder_bias"]
+        h = self._mlm_transform(params, x)
+        return h @ params["embed"]["tokens"].T + params["mlm"]["decoder_bias"]

@@ -948,6 +948,39 @@ def _token_ce(logits, labels, valid):
     return jnp.sum((lse - gold) * valid), jnp.sum(valid)
 
 
+def chunked_vocab_ce(h, w, hb, safe_labels, valid, chunk: int):
+    """Mean token cross-entropy for a vocab head ``h @ w + hb`` from
+    [B, S, D] features. With ``chunk > 0`` dividing B*S, the projection +
+    CE stream over token chunks inside a rematerialised scan, so the
+    [B, S, vocab] fp32 logits are never materialised — shared by the
+    causal ``lm_loss`` and the BERT MLM loss."""
+    B, S, D = h.shape
+    vf = valid.astype(jnp.float32)
+    if chunk <= 0 or (B * S) % chunk != 0:
+        logits = (h @ w + hb).astype(jnp.float32)
+        nll, n = _token_ce(logits.reshape(B * S, -1),
+                           safe_labels.reshape(-1), vf.reshape(-1))
+        return nll / jnp.maximum(n, 1)
+
+    nc = (B * S) // chunk
+    hf = h.reshape(nc, chunk, D)
+    lf = safe_labels.reshape(nc, chunk)
+    vff = vf.reshape(nc, chunk)
+
+    def body(carry, inp):
+        hc, lc, vc = inp
+        logits = (hc @ w + hb).astype(jnp.float32)
+        nll, n = _token_ce(logits, lc, vc)
+        s_nll, s_n = carry
+        return (s_nll + nll, s_n + n), None
+
+    # full remat: the chunk logits are recomputed in backward, never stored
+    body = jax.checkpoint(body, prevent_cse=False)
+    (nll, n), _ = jax.lax.scan(body, (jnp.float32(0), jnp.float32(0)),
+                               (hf, lf, vff))
+    return nll / jnp.maximum(n, 1)
+
+
 def lm_loss(cfg: TransformerConfig, params, batch, ignore_index: int = -100):
     """Next-token cross-entropy. batch: dict(input_ids[B,S], optional
     labels[B,S], optional attention_mask[B,S]).
@@ -968,26 +1001,4 @@ def lm_loss(cfg: TransformerConfig, params, batch, ignore_index: int = -100):
     safe_labels = jnp.where(valid, labels, 0)
 
     hb = _head_bias(params)
-    chunk = cfg.loss_chunk
-    if chunk <= 0 or (B * S) % chunk != 0:
-        logits = (x @ w + hb).astype(jnp.float32)
-        nll, n = _token_ce(logits.reshape(B * S, -1),
-                           safe_labels.reshape(-1), valid.reshape(-1).astype(jnp.float32))
-        return nll / jnp.maximum(n, 1)
-
-    nc = (B * S) // chunk
-    xf = x.reshape(nc, chunk, D)
-    lf = safe_labels.reshape(nc, chunk)
-    vf = valid.reshape(nc, chunk).astype(jnp.float32)
-
-    def body(carry, inp):
-        xc, lc, vc = inp
-        logits = (xc @ w + hb).astype(jnp.float32)
-        nll, n = _token_ce(logits, lc, vc)
-        s_nll, s_n = carry
-        return (s_nll + nll, s_n + n), None
-
-    # full remat: the chunk logits are recomputed in backward, never stored
-    body = jax.checkpoint(body, prevent_cse=False)
-    (nll, n), _ = jax.lax.scan(body, (jnp.float32(0), jnp.float32(0)), (xf, lf, vf))
-    return nll / jnp.maximum(n, 1)
+    return chunked_vocab_ce(x, w, hb, safe_labels, valid, cfg.loss_chunk)

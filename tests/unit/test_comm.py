@@ -155,3 +155,66 @@ class TestMeshBuild:
     def test_two_wildcards_raise(self, devices):
         with pytest.raises(ValueError):
             dist.build_mesh({"dp": -1, "tp": -1}, devices=devices[:8])
+
+
+class TestReferenceSurfaceParity:
+    """The remaining reference comm functions (deepspeed/comm/comm.py):
+    rooted collectives under SPMD semantics, group helpers, async handles."""
+
+    def test_reduce_and_gather_spmd_forms(self):
+        # eager convention: leading dim stacks per-rank slices over the axis
+        total = dist.reduce(jnp.full((4,), 3.0), dst=0, group="dp")
+        np.testing.assert_allclose(np.asarray(total), np.full((4,), 12.0))
+        g = dist.gather(jnp.arange(2.0), dst=0, group="tp")
+        assert g.shape[0] == 2  # tp=2 concat, replicated everywhere
+
+    def test_scatter_reshards_eagerly(self):
+        """Eager scatter = resharding: the global value is unchanged, each
+        dp rank's local shard is its chunk."""
+        x = jnp.arange(8.0)
+        out = dist.scatter(x, src=0, group="dp")  # dp=4 -> chunks of 2
+        assert out.shape == (8,)
+        assert not out.sharding.is_fully_replicated
+        shards = {s.device: np.asarray(s.data) for s in out.addressable_shards}
+        assert len(shards) >= 4 and all(v.shape == (2,) for v in shards.values())
+        with pytest.raises(ValueError, match="not divisible"):
+            dist.scatter(jnp.arange(6.0), group="dp")
+
+    def test_scatter_traced_slices_by_device_rank(self):
+        """Inside a shard_map over the group, each device slices its own
+        chunk by lax.axis_index — not the host process index."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+        mesh = dist.get_mesh()
+        x = jnp.arange(8.0)
+
+        def body(t):
+            return dist.scatter(t, group="dp")
+
+        out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(P(),),
+                                    out_specs=P("dp"), check_vma=False))(x)
+        np.testing.assert_array_equal(np.asarray(out), np.arange(8.0))
+
+    def test_global_rank_translation(self):
+        # mesh is 4 dp x 2 tp; tp group-local rank 1 at dp-coord 0 -> global 1
+        assert dist.get_global_rank("tp", 1) == 1
+        # dp group-local rank 2 at tp-coord 0 -> global 2*2
+        assert dist.get_global_rank("dp", 2) == 4
+        # world group enumerates directly
+        assert dist.get_global_rank(None, 5) == 5
+
+    def test_group_helpers(self):
+        assert dist.is_available() is True
+        assert dist.get_world_group() is None
+        assert dist.new_group(list(range(dist.get_world_size()))) is None
+        with pytest.raises(NotImplementedError, match="mesh axis"):
+            dist.new_group([0, 3])
+
+    def test_async_p2p_same_loud_contract_as_sync(self):
+        """isend/irecv propagate send/recv's loud not-an-SPMD-primitive
+        reject instead of pretending to deliver."""
+        x = jnp.arange(4.0)
+        with pytest.raises(NotImplementedError, match="ring_send_recv"):
+            dist.isend(x, dst=1, group="dp")
+        with pytest.raises(NotImplementedError, match="ring_send_recv"):
+            dist.irecv(x, src=1, group="dp")
