@@ -753,6 +753,9 @@ class DeepSpeedEngine:
         batch), or pass ``data_iter`` yielding ``gas`` micro-batches of
         ``micro_bs * dp_size`` samples each."""
         self._check_compression_epoch()
+        # snapshot for was_step_applied: +0 makes a fresh buffer so the
+        # donated state array's invalidation can't reach it (no host sync)
+        self._skipped_before_step = self.state.skipped_steps + 0
         gas = self.gradient_accumulation_steps()
         micro_bs = self.train_micro_batch_size_per_gpu()
         dp = dist.get_world_size(dist.data_parallel_axes(self.mesh))
@@ -984,6 +987,7 @@ class DeepSpeedEngine:
         (no-op otherwise, matching reference engine.py:1990)."""
         if not self.is_gradient_accumulation_boundary():
             return
+        self._skipped_before_step = self.state.skipped_steps + 0
         if self._offload is not None:
             metrics = self._host_step()
             self._write_monitor_events(metrics)
@@ -1072,6 +1076,154 @@ class DeepSpeedEngine:
 
     def zero_enabled(self) -> bool:
         return self._config.zero_enabled
+
+    # -- reference surface conveniences (engine.py:479-858, 2168-2510) -- #
+
+    def zero_optimization(self) -> bool:
+        return self._config.zero_optimization_stage > 0
+
+    def optimizer_name(self):
+        return self._optimizer_name
+
+    def scheduler_name(self):
+        return self._config.scheduler_name
+
+    def scheduler_params(self):
+        return self._config.scheduler_params
+
+    def dynamic_loss_scale(self) -> bool:
+        # static fp16 (loss_scale != 0) reports False, like the reference
+        return self.fp16_enabled() and self._config.fp16_config.dynamic_loss_scale
+
+    def wall_clock_breakdown(self) -> bool:
+        return bool(self._config.wall_clock_breakdown)
+
+    def pld_enabled(self) -> bool:
+        return bool(self._config.pld_enabled)
+
+    def curriculum_enabled_legacy(self) -> bool:
+        return bool(self._config.curriculum_enabled_legacy)
+
+    def random_ltd_enabled(self) -> bool:
+        cfg = getattr(self._config, "data_efficiency_config", {}) or {}
+        return bool(cfg.get("data_routing", {}).get("random_ltd",
+                                                    {}).get("enabled", False))
+
+    def get_batch_info(self):
+        """(train_batch_size, micro_batch_size, gradient_accumulation_steps)
+        — reference engine.py get_batch_info."""
+        return (self.train_batch_size(),
+                self.train_micro_batch_size_per_gpu(),
+                self.gradient_accumulation_steps())
+
+    def train(self, mode: bool = True):
+        """torch-style mode toggle kept for port compatibility. The zoo is
+        functional — train/eval behavior is chosen per call (e.g. MoE
+        forward(train=...), eval_batch) — so this records intent only."""
+        self._training_mode = bool(mode)
+        return self
+
+    def eval(self):
+        return self.train(False)
+
+    def was_step_applied(self) -> bool:
+        """True when the most recent boundary step updated params (i.e. was
+        not an fp16 overflow skip) — reference engine.py was_step_applied."""
+        before = getattr(self, "_skipped_before_step", None)
+        if before is None:
+            return False
+        return int(self.state.skipped_steps) == int(before)
+
+    def module_state_dict(self):
+        """The module parameters (reference module_state_dict: the
+        checkpoint-shaped weights view)."""
+        return self.state.params
+
+    def zero_grad(self) -> None:
+        """Zero the gradient-accumulation buffers (reference zero_grad /
+        optimizer.zero_grad between trio steps)."""
+        if self.state.acc_grads != ():
+            # the donated reset path reuses the buffers in place (no
+            # transient second accumulation tree)
+            if self._reset_acc_jit is None:
+                self._reset_acc_jit = jax.jit(
+                    lambda acc: jax.tree.map(jnp.zeros_like, acc),
+                    donate_argnums=(0,))
+            self.state = self.state._replace(
+                acc_grads=self._reset_acc_jit(self.state.acc_grads))
+        self._cached_grads = None
+
+    def empty_partition_cache(self) -> None:
+        """Reference frees gathered ZeRO-3 params here; gathers live inside
+        the compiled step under XLA's allocator, so there is no persistent
+        partition cache to free. Kept as an explicit no-op."""
+
+    def memory_breakdown(self):
+        """Live-buffer breakdown per device (reference memory_breakdown /
+        see_memory_usage)."""
+        out = {}
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:  # backend without memory stats (CPU)
+                stats = {}
+            out[str(d)] = {k: stats[k] for k in ("bytes_in_use",
+                                                 "peak_bytes_in_use",
+                                                 "bytes_limit") if k in stats}
+        return out
+
+    def dump_state(self) -> None:
+        """Log a one-shot engine state summary (reference dump_state)."""
+        log_dist(
+            f"DeepSpeedEngine state: optimizer={self._optimizer_name}, "
+            f"dtype={self.compute_dtype.__name__}, mesh={dict(self.mesh.shape)}, "
+            f"batch={self.get_batch_info()}, zero_stage={self.zero_optimization_stage()}, "
+            f"global_steps={self.global_steps}, skipped={self.skipped_steps}, "
+            f"loss_scale={self.loss_scale}", ranks=[0])
+
+    def save_16bit_model(self, save_dir, save_filename: str = "model_16bit.npz",
+                         exclude_frozen_parameters: bool = False):
+        """Write the module weights as a single 16-bit flat-key .npz
+        (reference save_16bit_model / zero3 consolidated fp16 save — params
+        here are full logical arrays, so no cross-rank gather is needed).
+        Returns the written path."""
+        import os
+
+        from deepspeed_tpu.utils.pytree import leaf_paths
+
+        os.makedirs(save_dir, exist_ok=True)
+        path = os.path.join(save_dir, save_filename)
+        flat = {}
+        for key, leaf in leaf_paths(self.state.params).items():
+            a = np.asarray(leaf)
+            if a.dtype == np.float32:
+                import ml_dtypes
+                a = a.astype(ml_dtypes.bfloat16)
+            # npz has no bf16: store raw bits + dtype tag
+            if a.dtype.name == "bfloat16":
+                flat[key + "::bf16"] = a.view(np.uint16)
+            else:
+                flat[key] = a
+        np.savez(path, **flat)
+        log_dist(f"saved 16-bit model weights to {path}", ranks=[0])
+        return path
+
+    def save_fp16_model(self, save_dir, save_filename: str = "model_16bit.npz"):
+        """Reference alias for save_16bit_model."""
+        return self.save_16bit_model(save_dir, save_filename)
+
+    def destroy(self) -> None:
+        """Drop compiled executables and large state references (reference
+        engine.destroy): the engine is unusable afterwards."""
+        self._train_batch_jit = {}
+        self._grad_jit = None
+        self._apply_jit = None
+        self._eval_jit = None
+        self._acc_jit = None
+        self._reset_acc_jit = None
+        self._cached_grads = None
+        self._offload = None
+        self.state = None
 
     @property
     def global_steps(self) -> int:

@@ -296,3 +296,48 @@ def test_optimizer_introspection_accessors():
         "progressive_layer_drop": {"enabled": True, "theta": 0.5,
                                    "gamma": 0.001}})
     assert pld.get_pld_theta() is not None
+
+
+def test_reference_surface_conveniences(tmp_path):
+    """The engine convenience surface (reference engine.py:479-858,
+    2168-2510): batch info, mode toggles, state dict, 16-bit export,
+    was_step_applied, zero_grad, dump/destroy."""
+    engine = make_engine(stage=1, precision="bf16", gas=1, micro_bs=2)
+    assert engine.get_batch_info() == (2 * dp_world(engine), 2, 1)
+    assert engine.zero_optimization() and engine.zero_optimization_stage() == 1
+    assert engine.optimizer_name() == "adam"
+    assert engine.scheduler_name() is None
+    assert engine.dynamic_loss_scale() is False  # bf16, not fp16
+    assert engine.pld_enabled() is False
+    assert engine.curriculum_enabled_legacy() is False
+    assert engine.random_ltd_enabled() is False
+    assert engine.train() is engine and engine.eval() is engine
+    assert isinstance(engine.memory_breakdown(), dict)
+    engine.dump_state()
+
+    engine.train_batch(global_batch(engine, seed=0))
+    assert engine.was_step_applied() is True
+    assert engine.module_state_dict() is engine.state.params
+
+    path = engine.save_16bit_model(str(tmp_path))
+    import numpy as np
+    loaded = np.load(path)
+    keys = [k for k in loaded.files]
+    assert any(k.endswith("::bf16") for k in keys)  # 16-bit payloads
+    total = sum(loaded[k].size for k in keys)
+    assert total == sum(int(np.prod(l.shape))
+                        for l in jax.tree.leaves(engine.state.params))
+
+    engine.zero_grad()  # gas==1 fused path: buffers may be absent; no crash
+    engine.destroy()
+    assert engine.state is None
+
+
+def test_was_step_applied_false_on_fp16_skip():
+    engine = make_engine(stage=0, precision="fp16")
+    engine.train_batch(global_batch(engine, seed=0))
+    assert engine.was_step_applied() is True
+    bad = global_batch(engine, seed=1)
+    bad["x"] = bad["x"] * np.float32(1e30)
+    engine.train_batch(bad)
+    assert engine.was_step_applied() is False
