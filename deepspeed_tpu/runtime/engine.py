@@ -550,9 +550,7 @@ class DeepSpeedEngine:
         else:
             new_params = self.state.params
 
-        if self._reset_acc_jit is None:
-            self._reset_acc_jit = jax.jit(lambda acc: jax.tree.map(jnp.zeros_like, acc), donate_argnums=(0,))
-        zero_acc = self._reset_acc_jit(self.state.acc_grads)
+        zero_acc = self._zeroed_acc(self.state.acc_grads)
         overflow_arr = jnp.asarray(overflow)
         new_scaler = scaler_update(self.state.scaler, overflow_arr) if self.fp16_enabled() else self.state.scaler
         self.state = self.state._replace(
@@ -760,6 +758,10 @@ class DeepSpeedEngine:
         micro_bs = self.train_micro_batch_size_per_gpu()
         dp = dist.get_world_size(dist.data_parallel_axes(self.mesh))
         expected = gas * micro_bs * dp
+        if batch is not None and getattr(self, "_batch_fn", None) is not None:
+            # reference semantics: batch_fn normalizes the raw batch BEFORE
+            # any shape validation or splitting
+            batch = self._batch_fn(batch)
         if batch is not None:
             lead = jax.tree.leaves(batch)[0].shape[0]
             if lead != expected:
@@ -768,10 +770,14 @@ class DeepSpeedEngine:
 
         if batch is None:
             if data_iter is None:
+                data_iter = getattr(self, "_data_iterator", None)
+            if data_iter is None:
                 if self.training_dataloader is None:
                     raise ValueError("train_batch needs a batch, a data_iter, or engine training_data")
                 data_iter = iter(self.training_dataloader)
             micros = [next(data_iter) for _ in range(gas)]
+            if getattr(self, "_batch_fn", None) is not None:
+                micros = [self._batch_fn(m) for m in micros]
             batch = jax.tree.map(lambda *xs: jnp.stack(xs), *micros)
         else:
             batch = jax.tree.map(lambda x: jnp.reshape(jnp.asarray(x), (gas, -1) + tuple(x.shape[1:])), batch)
@@ -979,6 +985,14 @@ class DeepSpeedEngine:
                 self.state.params, self._grad_shardings)
             self.state = self.state._replace(acc_grads=acc)
 
+    def _zeroed_acc(self, acc):
+        """Zero the accumulation buffers through the donated reset jit —
+        reuses the buffers in place (no transient second tree)."""
+        if self._reset_acc_jit is None:
+            self._reset_acc_jit = jax.jit(
+                lambda a: jax.tree.map(jnp.zeros_like, a), donate_argnums=(0,))
+        return self._reset_acc_jit(acc)
+
     def is_gradient_accumulation_boundary(self) -> bool:
         return int(self.state.micro_steps) % self.gradient_accumulation_steps() == 0
 
@@ -1139,18 +1153,72 @@ class DeepSpeedEngine:
         checkpoint-shaped weights view)."""
         return self.state.params
 
+    def load_module_state_dict(self, state_dict, strict: bool = True):
+        """Replace the module parameters with ``state_dict``, resharded
+        onto the engine's param shardings; fp32 masters (device or
+        host-offloaded) follow so the optimizer continues from the new
+        weights (reference load_module_state_dict). ``strict=False``
+        overlays only the leaves present in ``state_dict`` (by path),
+        keeping the rest."""
+        from deepspeed_tpu.utils.pytree import leaf_paths
+
+        if strict:
+            import jax.tree_util as jtu
+            if jtu.tree_structure(state_dict) != jtu.tree_structure(self.state.params):
+                raise ValueError("state_dict structure does not match module "
+                                 "parameters (pass strict=False to overlay "
+                                 "matching leaves only)")
+            new_params = jax.tree.map(
+                lambda a, p: jax.device_put(jnp.asarray(a, p.dtype), p.sharding),
+                state_dict, self.state.params)
+        else:
+            overlay = leaf_paths(state_dict)
+            cur = leaf_paths(self.state.params)
+            flat = {k: (overlay[k] if k in overlay else v)
+                    for k, v in cur.items()}
+            treedef = jax.tree_util.tree_structure(self.state.params)
+            keys = list(leaf_paths(self.state.params))
+            new_params = jax.tree_util.tree_unflatten(
+                treedef, [jax.device_put(jnp.asarray(flat[k], p.dtype), p.sharding)
+                          for k, p in zip(keys, jax.tree.leaves(self.state.params))])
+        replace = {"params": new_params}
+        if self.state.master is not None:
+            replace["master"] = jax.tree.map(
+                lambda a, m: jax.device_put(
+                    jnp.asarray(np.asarray(a), jnp.float32), m.sharding),
+                new_params, self.state.master)
+        self.state = self.state._replace(**replace)
+        if self._offload is not None:
+            # host/NVMe fp32 masters are the authoritative weights for the
+            # next step — refresh them or the load is silently reverted
+            from deepspeed_tpu.utils.pytree import leaf_key
+            flat_new = jax.tree_util.tree_flatten_with_path(new_params)[0]
+            self._offload.load_masters(
+                {leaf_key(path): np.asarray(jax.device_get(leaf), np.float32).ravel()
+                 for path, leaf in flat_new})
+
+    def set_dataloader(self, loader) -> None:
+        """Reference pipe-engine surface: replace the training dataloader
+        consumed when train_batch is called without a batch."""
+        self.training_dataloader = loader
+        self._data_iterator = None
+
+    def set_dataiterator(self, iterator) -> None:
+        """Reference pipe-engine surface: a standing iterator yielding
+        micro-batches for batchless train_batch calls."""
+        self._data_iterator = iterator
+
+    def set_batch_fn(self, fn) -> None:
+        """Post-process every batch (or micro-batch from an iterator)
+        before it enters the compiled step (reference set_batch_fn)."""
+        self._batch_fn = fn
+
     def zero_grad(self) -> None:
         """Zero the gradient-accumulation buffers (reference zero_grad /
         optimizer.zero_grad between trio steps)."""
         if self.state.acc_grads != ():
-            # the donated reset path reuses the buffers in place (no
-            # transient second accumulation tree)
-            if self._reset_acc_jit is None:
-                self._reset_acc_jit = jax.jit(
-                    lambda acc: jax.tree.map(jnp.zeros_like, acc),
-                    donate_argnums=(0,))
             self.state = self.state._replace(
-                acc_grads=self._reset_acc_jit(self.state.acc_grads))
+                acc_grads=self._zeroed_acc(self.state.acc_grads))
         self._cached_grads = None
 
     def empty_partition_cache(self) -> None:
@@ -1190,6 +1258,11 @@ class DeepSpeedEngine:
         import os
 
         from deepspeed_tpu.utils.pytree import leaf_paths
+
+        if exclude_frozen_parameters:
+            raise NotImplementedError(
+                "exclude_frozen_parameters: the functional engine has no "
+                "frozen-parameter registry; filter the tree before saving")
 
         os.makedirs(save_dir, exist_ok=True)
         path = os.path.join(save_dir, save_filename)

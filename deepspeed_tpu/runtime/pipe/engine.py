@@ -439,6 +439,33 @@ class PipelineEngine(DeepSpeedEngine):
     def is_pipe_parallel(self) -> bool:
         return True
 
+    # ---- reference surface (pipe/engine.py) under SPMD semantics ---- #
+
+    def is_first_stage(self) -> bool:
+        """Reference gates data loading on stage membership; under the
+        single-controller SPMD schedule every process drives every stage,
+        so membership is always true (ported code keeps working: it loads
+        data everywhere, which is exactly what SPMD needs)."""
+        return True
+
+    def is_last_stage(self) -> bool:
+        """See is_first_stage — loss is computed by this process too."""
+        return True
+
+    def set_has_attention_mask(self, value: bool) -> None:
+        """Reference toggles mask transmission between stages; masks ride
+        the carry automatically here (pipeline_spec carry_keys). No-op."""
+
+    def reset_activation_shape(self) -> None:
+        """Reference re-exchanges activation shape metadata; XLA shapes are
+        static per compiled program and recompile on change. No-op."""
+
+    def mem_status(self, msg: str = "", print_rank: int = -1,
+                   reset_max: bool = False) -> None:
+        """Log the device-memory breakdown (reference mem_status)."""
+        from deepspeed_tpu.utils.logging import log_dist
+        log_dist(f"mem_status {msg}: {self.memory_breakdown()}", ranks=[0])
+
     def _build_train_batch_fn(self, gas: int) -> Callable:
         spec = self._pipe_spec
         schedule = str(self._config.pipeline.get("schedule", "1f1b")).lower()
