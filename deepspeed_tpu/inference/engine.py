@@ -322,8 +322,36 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------ #
 
+    def profile_model_time(self, use_cuda_events: bool = True) -> None:
+        """Start recording per-forward model latency (reference
+        profile_model_time; ``use_cuda_events`` accepted for parity — the
+        timing here is a device-synchronized wall clock)."""
+        self._model_profile_enabled = True
+        self._model_times = []
+
+    def model_times(self):
+        """Drain the recorded per-forward latencies in seconds (reference
+        model_times: asserts profiling was enabled first)."""
+        if not getattr(self, "_model_profile_enabled", False):
+            raise RuntimeError(
+                "model profiling is not enabled; call profile_model_time() "
+                "before forward")
+        times = self._model_times
+        self._model_times = []
+        return times
+
     def forward(self, input_ids, attention_mask=None):
         """Full-sequence forward → logits."""
+        if getattr(self, "_model_profile_enabled", False):
+            import time as _t
+            t0 = _t.perf_counter()
+            out = self._forward_impl(input_ids, attention_mask)
+            jax.block_until_ready(out)
+            self._model_times.append(_t.perf_counter() - t0)
+            return out
+        return self._forward_impl(input_ids, attention_mask)
+
+    def _forward_impl(self, input_ids, attention_mask=None):
         input_ids = jnp.asarray(input_ids, jnp.int32)
         if self._stream_weights:
             if input_ids.ndim == 1:

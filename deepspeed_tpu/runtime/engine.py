@@ -1160,7 +1160,7 @@ class DeepSpeedEngine:
         weights (reference load_module_state_dict). ``strict=False``
         overlays only the leaves present in ``state_dict`` (by path),
         keeping the rest."""
-        from deepspeed_tpu.utils.pytree import leaf_paths
+        from deepspeed_tpu.utils.pytree import leaf_key, leaf_paths
 
         if strict:
             import jax.tree_util as jtu
@@ -1172,20 +1172,21 @@ class DeepSpeedEngine:
                 lambda a, p: jax.device_put(jnp.asarray(a, p.dtype), p.sharding),
                 state_dict, self.state.params)
         else:
+            # pair by PATH KEY, never by flatten order (dict flattening is
+            # key-sorted while leaf_paths preserves insertion order)
             overlay = leaf_paths(state_dict)
-            cur = leaf_paths(self.state.params)
-            flat = {k: (overlay[k] if k in overlay else v)
-                    for k, v in cur.items()}
-            treedef = jax.tree_util.tree_structure(self.state.params)
-            keys = list(leaf_paths(self.state.params))
-            new_params = jax.tree_util.tree_unflatten(
-                treedef, [jax.device_put(jnp.asarray(flat[k], p.dtype), p.sharding)
-                          for k, p in zip(keys, jax.tree.leaves(self.state.params))])
+            leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(
+                self.state.params)
+            new_leaves = [
+                jax.device_put(jnp.asarray(overlay.get(leaf_key(path), p),
+                                           p.dtype), p.sharding)
+                for path, p in leaves_with_path]
+            new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
         replace = {"params": new_params}
         if self.state.master is not None:
+            # cast on device: no host round-trip for model-sized trees
             replace["master"] = jax.tree.map(
-                lambda a, m: jax.device_put(
-                    jnp.asarray(np.asarray(a), jnp.float32), m.sharding),
+                lambda a, m: jax.device_put(a.astype(jnp.float32), m.sharding),
                 new_params, self.state.master)
         self.state = self.state._replace(**replace)
         if self._offload is not None:
@@ -1199,9 +1200,11 @@ class DeepSpeedEngine:
 
     def set_dataloader(self, loader) -> None:
         """Reference pipe-engine surface: replace the training dataloader
-        consumed when train_batch is called without a batch."""
+        and start a STANDING iterator over it (successive batchless
+        train_batch calls consume successive micro-batches, not the first
+        gas items forever)."""
         self.training_dataloader = loader
-        self._data_iterator = None
+        self._data_iterator = iter(loader) if loader is not None else None
 
     def set_dataiterator(self, iterator) -> None:
         """Reference pipe-engine surface: a standing iterator yielding

@@ -415,3 +415,41 @@ def test_load_module_state_dict_nonstrict_and_offload():
         off.train_batch(global_batch(off, seed=1))  # lr=0: params must stay
         got = np.asarray(off.state.params["layer_0"]["w"].astype(jnp.float32))
         np.testing.assert_allclose(got, 1.0, atol=1e-2)
+
+
+def test_nonstrict_overlay_pairs_by_path_not_order():
+    """Regression: dict flattening is key-sorted while leaf_paths preserves
+    insertion order — the overlay must pair by PATH. Distinct values per
+    leaf prove no silent swap."""
+    from deepspeed_tpu.utils.pytree import leaf_paths
+
+    engine = make_engine(stage=0)
+    params = engine.state.params
+    marked = {k: np.full_like(np.asarray(v), float(i + 1))
+              for i, (k, v) in enumerate(leaf_paths(params).items())}
+    # overlay leaf-by-leaf through single-leaf nested dicts: each partial
+    # tree's flatten order trivially disagrees with the full tree's, so a
+    # by-order pairing would scatter the markers
+    for k, v in marked.items():
+        parts = k.split("/")
+        nested = v
+        for p in reversed(parts):
+            nested = {p: nested}
+        engine.load_module_state_dict(nested, strict=False)
+    got = leaf_paths(engine.state.params)
+    for i, k in enumerate(marked):
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.full_like(np.asarray(got[k]),
+                                                   float(i + 1)), err_msg=k)
+
+
+def test_set_dataloader_standing_iterator():
+    engine = make_engine(stage=0, gas=1, micro_bs=2)
+    per = 2 * dp_world(engine)
+    batches = [random_batch(per, HIDDEN, seed=i) for i in range(4)]
+    engine.set_dataloader(batches)
+    l1 = float(engine.train_batch())
+    l2 = float(engine.train_batch())
+    # consumed successive batches (same batch twice would give the exact
+    # same input; losses differ across distinct random batches)
+    assert l1 != l2

@@ -192,3 +192,28 @@ def test_generate_rejects_encoder_modules():
         model, params=model.init_params(jax.random.key(0)), dtype="fp32")
     with pytest.raises(ValueError, match="requires a causal LM"):
         eng.generate(np.asarray([[1, 2, 3]], np.int32), max_new_tokens=2)
+
+
+def test_profile_model_time_surface():
+    """profile_model_time / model_times (reference inference engine
+    latency profiling surface)."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    import jax
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.models.transformer import TransformerConfig
+
+    dist.set_mesh(None)
+    model = CausalLM(TransformerConfig(vocab_size=64, n_layer=1, n_head=2,
+                                       d_model=16, max_seq=16))
+    eng = deepspeed_tpu.init_inference(
+        model, params=model.init_params(jax.random.key(0)), dtype="fp32")
+    with pytest.raises(RuntimeError, match="not enabled"):
+        eng.model_times()
+    eng.profile_model_time()
+    tok = np.asarray([[1, 2, 3]], np.int32)
+    eng.forward(tok)
+    eng.forward(tok)
+    times = eng.model_times()
+    assert len(times) == 2 and all(t > 0 for t in times)
+    assert eng.model_times() == []  # drained
