@@ -13,6 +13,23 @@ Baseline: the reference's single-GPU fused-kernel result — BERT-large at
 >50% of V100 peak (docs/_posts/2020-05-28-fastest-bert-training.md, see
 BASELINE.md). vs_baseline = achieved MFU / 0.50, i.e. >1.0 means this
 framework exceeds the reference's best published hardware efficiency class.
+
+Env knobs (defaults are the chip-measured fast path):
+  BENCH_STEPS=10           timed steps per window (best of two windows)
+  BENCH_GPT2/LLAMA=1       enable metric 1 / 2; BENCH_BERT=0 gates the
+                           bert-large MLM metric (flip after measuring)
+  BENCH_BATCH=64 BENCH_SEQ=1024            gpt2 metric shape
+  BENCH_LLAMA_BATCH=4 BENCH_LLAMA_SEQ=2048 llama metric shape
+  BENCH_BERT_BATCH=16 BENCH_BERT_SEQ=512   bert metric shape
+  BENCH_REMAT=dots         1/true/full | 0/false/none | dots | selective...
+  BENCH_LOSS_CHUNK=2048    vocab-head streaming chunk (0 = off)
+  BENCH_ATTN=auto          auto | flash | xla
+  BENCH_OPT=AdamW          AdamW | FusedAdam | ...
+  BENCH_SCAN=0             gpt2 layer stacking (0 = unrolled, measured
+                           ~12% faster); BENCH_LLAMA_SCAN=1 for metric 2
+  BENCH_BLOCK_Q/K=0        flash kernel block override (0 = tuned default)
+  BENCH_SKIP_PROBE=0       skip the subprocess backend probe
+  BENCH_PROBE_RETRIES=1    probe retries before giving up on the backend
 """
 
 import json
