@@ -75,8 +75,33 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         self._jax.effects_barrier()
 
     # ------------------------- RNG --------------------------------- #
+    # Functional RNG: there is no mutable global generator — seeding
+    # returns a fresh key the caller threads explicitly (reference
+    # abstract_accelerator.py:44-67 surface, functional semantics).
     def random_seed(self, seed: int):
+        self._seed = int(seed)
         return self._jax.random.key(seed)
+
+    manual_seed = random_seed
+    manual_seed_all = random_seed
+
+    def initial_seed(self) -> int:
+        """The last seed passed to manual_seed/random_seed (reference
+        ``initial_seed()``: no arguments, returns the current seed)."""
+        return getattr(self, "_seed", 0)
+
+    def random(self):
+        """The RNG namespace (reference ``accelerator.random`` returns
+        ``torch.random``); here it is ``jax.random``."""
+        return self._jax.random
+
+    def is_available(self) -> bool:
+        """True when the REQUESTED platform has devices (the generic
+        device fallback would otherwise make this unconditionally true)."""
+        try:
+            return len(self._jax.devices(self._name)) > 0
+        except RuntimeError:
+            return False
 
     def default_generator(self, device_index: int):
         # Functional RNG: the "generator" is just a key derived per device.

@@ -1,0 +1,37 @@
+"""Accelerator abstraction surface (reference accelerator/
+abstract_accelerator.py + real_accelerator.py get_accelerator)."""
+
+import jax
+import pytest
+
+from deepspeed_tpu.accelerator import get_accelerator
+
+
+def test_core_surface():
+    a = get_accelerator()
+    assert a.is_available()
+    assert a.device_count() >= 1
+    assert isinstance(a.device_name(), str)
+    assert a.communication_backend_name()
+    assert a.is_bf16_supported()
+    a.synchronize()
+
+
+def test_functional_rng_surface():
+    """manual_seed/initial_seed return keys the caller threads (functional
+    RNG has no mutable global generator); random() is the jax.random
+    namespace."""
+    a = get_accelerator()
+    k1 = a.manual_seed(7)
+    k2 = a.manual_seed_all(7)
+    assert float(jax.random.normal(k1, ())) == float(jax.random.normal(k2, ()))
+    assert a.random() is jax.random
+    # reference surface: initial_seed() takes no args, returns the seed
+    assert a.initial_seed() == 7
+
+
+def test_op_builder_hooks():
+    a = get_accelerator()
+    b = a.create_op_builder("FusedAdamBuilder")
+    assert b is not None and hasattr(b, "load")
+    assert a.get_op_builder("FusedAdamBuilder") is not None
