@@ -337,3 +337,23 @@ class TestDscliSsh:
         from deepspeed_tpu.cli import _ssh
         with pytest.raises(RuntimeError, match="hostfile"):
             _ssh(["-f", str(tmp_path / "nope"), "true"])
+
+
+def test_bin_scripts_run_from_checkout(tmp_path):
+    """bin/dscli and bin/ds_report work straight from a checkout with no
+    install and no PYTHONPATH (they bootstrap the repo root)."""
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               DS_ACCELERATOR="cpu")
+    env.pop("PYTHONPATH", None)
+    for args, marker in ((["bin/ds_report"], "device count"),
+                         (["bin/dscli", "report"], "device count")):
+        r = subprocess.run([sys.executable] + [os.path.join(_repo_root(), a)
+                                               for a in args[:1]] + args[1:],
+                           env=env, cwd=str(tmp_path), capture_output=True,
+                           text=True, timeout=300)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert marker in r.stdout
+
+
+def _repo_root():
+    return os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
