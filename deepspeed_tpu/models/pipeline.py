@@ -72,7 +72,31 @@ class PipelinedCausalLM(CausalLM):
 
     def _stage(self, stage_params, x, aux, rng):
         """One pipeline stage: scan over its layers_per_stage blocks."""
+        return self._stage_with(self.config, stage_params, x, aux, rng)
+
+    def manual_tp_stage_fn(self, axis: str, size: int):
+        """Stage body for the pipeline engine's manual (pp × dp × tp)
+        shard_map: weights enter pre-sliced over ``axis`` (whole heads /
+        ff columns per shard) and the blocks run Megatron-style with
+        explicit f/g collectives (transformer.py ``manual_tp``) — so
+        attention still reaches the bare Pallas flash kernel inside the
+        fully-manual stage bodies. Returns None when this config cannot
+        shard that way (the engine then keeps the vmap/SPMD path)."""
+        import dataclasses
         cfg = self.config
+        if (cfg.sparse_attention is not None
+                or cfg.sequence_parallel != "none"
+                or cfg.n_head % size or cfg.kv_heads % size
+                or cfg.ff_dim % size):
+            return None
+        mcfg = dataclasses.replace(cfg, manual_tp=axis)
+
+        def stage_fn(stage_params, x, aux, rng):
+            return self._stage_with(mcfg, stage_params, x, aux, rng)
+
+        return stage_fn
+
+    def _stage_with(self, cfg, stage_params, x, aux, rng):
         B, S, D = x.shape
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
         mask_bias = T.key_mask_bias(aux.get("attention_mask"))
@@ -110,6 +134,10 @@ class PipelinedCausalLM(CausalLM):
             "head_loss_fn": self._head_loss,
             "num_stages": self.num_stages,
             "carry_keys": ("attention_mask",),
+            # manual-tp hooks: let the stage shard_map cover a tp axis too
+            # (runtime/pipe/engine.py _stage_map_builder)
+            "stage_fn_tp": self.manual_tp_stage_fn,
+            "stage_tp_specs": self.tp_specs()["stages"],
         }
 
     # -------------------- sequential path (eval / pp=1) -------------------- #

@@ -89,6 +89,17 @@ class TransformerConfig:
     # section (reference compression/basic_layer.py:118-860 QuantAct)
     act_quant_bits: int = 0
     act_quant_sym: bool = True
+    # Megatron-style MANUAL tensor parallelism: the mesh axis name over which
+    # attention/mlp weights arrive pre-sliced (column-parallel qkv/up,
+    # row-parallel out/down) and the blocks insert the f/g collectives
+    # explicitly (_mtp_in/_mtp_out). Set only by the pipeline engine's
+    # manual-tp stage factory (models/pipeline.py manual_tp_stage_fn) for
+    # execution inside a fully-manual (pp × dp × tp) stage shard_map, where
+    # the SPMD partitioner — which otherwise inserts these collectives from
+    # the sharding specs — is not available. Reference capability: fused
+    # kernels + TP run unchanged under PP (csrc/transformer/inference/csrc/
+    # pt_binding.cpp:1668-1793 via deepspeed/runtime/pipe/engine.py:596).
+    manual_tp: Optional[str] = None
     # init
     init_std: float = 0.02
 
@@ -297,12 +308,46 @@ DENSE_STREAM_THRESHOLD = 4096
 DENSE_STREAM_CHUNK = 1024
 
 
+def _mtp_in(x, axis):
+    """Megatron's ``f`` operator: identity forward, psum backward. Inside a
+    manual-tp region the cotangents arriving from the column-parallel
+    consumers (qkv / up projections) are per-shard partials; summing them
+    here hands the replicated upstream land (residual, LN, embed) a full
+    gradient."""
+    @jax.custom_vjp
+    def f(x):
+        return x
+    f.defvjp(lambda x: (x, None), lambda _, g: (jax.lax.psum(g, axis),))
+    return f(x)
+
+
+def _mtp_out(x, axis):
+    """Megatron's ``g`` operator: psum forward (complete the row-parallel
+    matmul's contraction over the sharded inner dim), identity backward (the
+    downstream cotangent is already replicated over the axis)."""
+    @jax.custom_vjp
+    def g(x):
+        return jax.lax.psum(x, axis)
+    g.defvjp(lambda x: (jax.lax.psum(x, axis), None), lambda _, ct: (ct,))
+    return g(x)
+
+
 def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
     """Einsum-form multi-head attention; XLA maps the batched matmuls onto
     the MXU and fuses softmax. (A Pallas flash-attention kernel can be slotted
-    in via deepspeed_tpu.ops — see ops/transformer.)"""
+    in via deepspeed_tpu.ops — see ops/transformer.)
+
+    With ``cfg.manual_tp`` set the weights arrive pre-sliced over the tp
+    mesh axis (whole heads per shard) and the block runs Megatron-style:
+    f at the input, local-head attention (which reaches the bare flash
+    kernel — the context is fully manual), g after the out projection."""
     B, S, D = x.shape
     H, KV, Hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
+    if cfg.manual_tp:
+        tp = jax.lax.axis_size(cfg.manual_tp)
+        H //= tp
+        KV //= tp
+        x = _mtp_in(x, cfg.manual_tp)
 
     from jax.ad_checkpoint import checkpoint_name
     x = _maybe_act_quant(cfg, x)
@@ -319,9 +364,22 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
         q = _rope(q, positions, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
         k = _rope(k, positions, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
 
-    slopes = _alibi_slopes(H) if cfg.pos_embedding == "alibi" else None
+    if cfg.pos_embedding == "alibi":
+        # slope values follow the GLOBAL head index; a manual-tp shard
+        # carries heads [r*H, (r+1)*H) of the full set
+        slopes = _alibi_slopes(cfg.n_head)
+        if cfg.manual_tp:
+            r = jax.lax.axis_index(cfg.manual_tp)
+            slopes = jax.lax.dynamic_slice_in_dim(slopes, r * H, H)
+    else:
+        slopes = None
 
     if cfg.sparse_attention is not None:
+        if cfg.manual_tp:
+            raise NotImplementedError(
+                "sparse attention does not compose with manual-tp pipeline "
+                "stages (the stage factory refuses this config; pp×tp runs "
+                "the vmap/SPMD path instead)")
         out = _sparse_model_attention(cfg, q, k, v, mask_bias, slopes)
         out = checkpoint_name(out.reshape(B, S, H * Hd), "attn_out")
         proj = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
@@ -383,7 +441,12 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
                             causal=cfg.causal, alibi_slopes=slopes,
                             scale=cfg.attn_scale)
     out = checkpoint_name(out.reshape(B, S, H * Hd), "attn_out")
-    proj = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
+    proj = out @ _w(lp["wo"], out)
+    if cfg.manual_tp:
+        # row-parallel wo: each shard contracted its local heads only —
+        # complete the sum, then add the replicated bias ONCE
+        proj = _mtp_out(proj, cfg.manual_tp)
+    proj = proj + (lp["bo"] if cfg.attn_bias else 0)
     return checkpoint_name(proj, "wo_out")
 
 
@@ -675,8 +738,12 @@ def _maybe_act_quant(cfg: TransformerConfig, x):
 def mlp(cfg: TransformerConfig, x, lp):
     from jax.ad_checkpoint import checkpoint_name
     x = _maybe_act_quant(cfg, x)
+    if cfg.manual_tp:
+        x = _mtp_in(x, cfg.manual_tp)
     if cfg.activation == "swiglu":
         out = (jax.nn.silu(x @ _w(lp["w_gate"], x)) * (x @ _w(lp["w_up"], x))) @ _w(lp["w_down"], x)
+        if cfg.manual_tp:
+            out = _mtp_out(out, cfg.manual_tp)
         return checkpoint_name(out, "ff_down")
     h = x @ _w(lp["w_up"], x) + lp["b_up"]
     if cfg.activation == "gelu":
@@ -687,7 +754,11 @@ def mlp(cfg: TransformerConfig, x, lp):
         h = h * jax.nn.sigmoid(1.702 * h)  # CLIP's QuickGELU
     else:
         h = jax.nn.relu(h)
-    return checkpoint_name(h @ _w(lp["w_down"], x) + lp["b_down"], "ff_down")
+    out = h @ _w(lp["w_down"], x)
+    if cfg.manual_tp:
+        # row-parallel w_down: sum the per-shard partials, replicated bias once
+        out = _mtp_out(out, cfg.manual_tp)
+    return checkpoint_name(out + lp["b_down"], "ff_down")
 
 
 def block(cfg: TransformerConfig, x, lp, positions, mask_bias):

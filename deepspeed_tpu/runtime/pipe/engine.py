@@ -39,9 +39,10 @@ import deepspeed_tpu.comm as dist
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine, TrainState
 
 
-def _stage_map_builder(stage_fn, mesh, num_stages: int, batch_size: int):
-    """Build the per-tick stage executors: a shard_map over (pp × dp/fsdp)
-    when the mesh allows it, else plain vmaps over the stage axis.
+def _stage_map_builder(stage_fn, mesh, num_stages: int, batch_size: int,
+                       tp_stage=None):
+    """Build the per-tick stage executors: a shard_map over (pp × dp/fsdp
+    [× tp]) when the mesh allows it, else plain vmaps over the stage axis.
 
     Under the shard_map each device's stage body runs on fully LOCAL arrays
     (stage extent 1, batch already split over dp), so attention inside the
@@ -54,14 +55,43 @@ def _stage_map_builder(stage_fn, mesh, num_stages: int, batch_size: int):
     runtime/pipe/engine.py forward passes); this is the TPU equivalent.
 
     Eligibility: pp partitions exactly one stage per device, every other
-    partitioned axis is batch-like (dp/fsdp — tp/ep/sp stage bodies need
-    auto-inserted collectives, which a manual context forbids), and the
-    batch divides the dp extent. Returns ``(fwd, bwd)``:
+    partitioned axis is batch-like (dp/fsdp) — or ``tp`` when the model
+    provides manual-tp hooks via ``tp_stage = (stage_fn_tp, stage_specs)``:
+    ``stage_fn_tp(axis, size)`` returns a stage body that runs on tp-sliced
+    weights with explicit Megatron f/g collectives (or None to refuse), and
+    ``stage_specs`` is the per-leaf PartitionSpec tree for the stacked stage
+    params (leading ``pp`` dim + the tp placement). ep/sp stage bodies have
+    no manual form — those compositions keep the vmap path. The batch must
+    divide the dp extent. Returns ``(fwd, bwd)``:
 
     - ``fwd(stage_params, bufs, aux, keys) -> outs``
     - ``bwd(stage_params, x, aux, keys, cots, valid) -> (dstage_params, dx)``
       (vjp w.r.t. params and input, fp32 grads, zeroed where ``not valid``)
     """
+    tp_size = mesh.shape.get("tp", 1) if mesh is not None else 1
+
+    eligible = (
+        mesh is not None
+        and mesh.shape.get("pp", 1) > 1
+        and mesh.shape["pp"] == num_stages
+        and all(size == 1 or name in ("pp", "dp", "fsdp", "tp")
+                for name, size in mesh.shape.items())
+    )
+    param_specs = P("pp")                # uniform: params replicated off-pp
+    if eligible:
+        dp_axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
+        nb = 1
+        for a in dp_axes:
+            nb *= mesh.shape[a]
+        eligible = batch_size % nb == 0
+    if eligible and tp_size > 1:
+        fn = tp_stage[0]("tp", tp_size) if tp_stage and tp_stage[0] else None
+        if fn is None or tp_stage[1] is None:
+            eligible = False
+        else:
+            stage_fn = fn
+            param_specs = tp_stage[1]    # per-leaf P("pp", ..., "tp", ...)
+
     def stage_bwd_one(sp, x, aux, key, cot, valid):
         y, vjp = jax.vjp(lambda sp_, x_: stage_fn(sp_, x_, aux, key), sp, x)
         dsp, dx = vjp(cot)
@@ -69,19 +99,6 @@ def _stage_map_builder(stage_fn, mesh, num_stages: int, batch_size: int):
         dsp = jax.tree.map(lambda a: a.astype(jnp.float32) * z, dsp)
         return dsp, dx * z.astype(dx.dtype)
 
-    eligible = (
-        mesh is not None
-        and mesh.shape.get("pp", 1) > 1
-        and mesh.shape["pp"] == num_stages
-        and all(size == 1 or name in ("pp", "dp", "fsdp")
-                for name, size in mesh.shape.items())
-    )
-    if eligible:
-        dp_axes = tuple(a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1)
-        nb = 1
-        for a in dp_axes:
-            nb *= mesh.shape[a]
-        eligible = batch_size % nb == 0
     if not eligible:
         return (jax.vmap(stage_fn, in_axes=(0, 0, 0, 0)),
                 jax.vmap(stage_bwd_one, in_axes=(0, 0, 0, 0, 0, 0)))
@@ -89,7 +106,7 @@ def _stage_map_builder(stage_fn, mesh, num_stages: int, batch_size: int):
     from jax import shard_map
 
     dp = dp_axes if len(dp_axes) != 1 else dp_axes[0]
-    pspec = P("pp")                      # stage params / keys / valid flags
+    pspec = P("pp")                      # keys / valid flags
     aspec = P("pp", dp or None)          # activations & aux: [stage, batch, ...]
 
     def local(tree):
@@ -110,12 +127,15 @@ def _stage_map_builder(stage_fn, mesh, num_stages: int, batch_size: int):
             dsp = jax.tree.map(lambda a: jax.lax.psum(a, dp_axes), dsp)
         return jax.tree.map(lambda a: a[None], dsp), dx[None]
 
+    # param_specs: P("pp") uniformly, or the per-leaf tp spec tree — grads
+    # mirror the placement (tp-sharded leaves return local shards; leaves
+    # replicated over tp return identical copies, asserted by the spec)
     fwd = shard_map(fwd_body, mesh=mesh,
-                    in_specs=(pspec, aspec, aspec, pspec),
+                    in_specs=(param_specs, aspec, aspec, pspec),
                     out_specs=aspec, check_vma=False)
     bwd = shard_map(bwd_body, mesh=mesh,
-                    in_specs=(pspec, aspec, aspec, pspec, aspec, pspec),
-                    out_specs=(pspec, aspec), check_vma=False)
+                    in_specs=(param_specs, aspec, aspec, pspec, aspec, pspec),
+                    out_specs=(param_specs, aspec), check_vma=False)
     return fwd, bwd
 
 
@@ -180,6 +200,11 @@ def spmd_pipeline_loss(embed_fn: Callable,
     carry0 = {k: jnp.broadcast_to(mb0[k][None], (S,) + mb0[k].shape) for k in carry_keys}
     bufs, carry0 = constrain(bufs), constrain(carry0)
 
+    # NO manual-tp hooks here: this GPipe form is differentiated THROUGH
+    # (jax.grad over the whole scan), and shard_map's AD transpose psums the
+    # cotangents of tp-unmentioned inputs over tp — double-counting against
+    # the explicit f/g collectives. The 1F1B schedule takes its vjps INSIDE
+    # the manual region and states every placement, so manual tp lives there.
     vstage, _ = _stage_map_builder(stage_fn, mesh, S, x0.shape[0])
 
     def tick(state, t):
@@ -222,7 +247,8 @@ def spmd_pipeline_1f1b(embed_fn: Callable,
                        num_stages: int,
                        mesh=None,
                        carry_keys: tuple = (),
-                       cot_scale=1.0):
+                       cot_scale=1.0,
+                       tp_stage=None):
     """1F1B pipelined loss AND grads in one forward-only ``lax.scan``.
 
     Reference parity: ``deepspeed/runtime/pipe/schedule.py:186-296``
@@ -307,7 +333,8 @@ def spmd_pipeline_1f1b(embed_fn: Callable,
     gstages0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), stage_params)
     gns0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), nonstage)
 
-    stage_fwd, stage_bwd = _stage_map_builder(stage_fn, mesh, S, x0.shape[0])
+    stage_fwd, stage_bwd = _stage_map_builder(stage_fn, mesh, S, x0.shape[0],
+                                              tp_stage=tp_stage)
 
     def tick(state, t):
         ring, prev_outs, cots, gstages, gns, loss_sum = state
@@ -479,7 +506,8 @@ class PipelineEngine(DeepSpeedEngine):
                 loss, grads = spmd_pipeline_1f1b(
                     spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
                     state.params, batch, rng, spec["num_stages"], mesh=self.mesh,
-                    carry_keys=tuple(spec.get("carry_keys", ())), cot_scale=scale)
+                    carry_keys=tuple(spec.get("carry_keys", ())), cot_scale=scale,
+                    tp_stage=(spec.get("stage_fn_tp"), spec.get("stage_tp_specs")))
                 grads = jax.lax.with_sharding_constraint(
                     jax.tree.map(lambda g: g.astype(self.grad_acc_dtype), grads),
                     self._grad_shardings)

@@ -486,3 +486,192 @@ def test_pp_shard_map_grads_match_vmap_path(devices, batch_axis):
             rtol=5e-3, atol=5e-5), grads, ref_grads)
     finally:
         dist.set_mesh(None)
+
+
+# --------------------------------------------------------------------- #
+# manual tensor parallelism inside pipeline stages (pp × dp × tp)
+
+def _gqa_pipe_model(**over):
+    from deepspeed_tpu.models.pipeline import PipelinedCausalLM
+    from deepspeed_tpu.models.transformer import TransformerConfig
+    kw = dict(vocab_size=64, n_layer=4, n_head=4, n_kv_head=2, d_model=32,
+              d_ff=64, max_seq=16, pos_embedding="rope", activation="swiglu",
+              norm="rmsnorm", tie_embeddings=True, remat=False,
+              attention_backend="xla")
+    kw.update(over)
+    return PipelinedCausalLM(TransformerConfig(**kw), num_stages=2)
+
+
+@pytest.mark.parametrize("over", [
+    {},                                                      # GQA swiglu/rope
+    {"pos_embedding": "alibi", "activation": "gelu",         # alibi slope
+     "norm": "layernorm", "attn_bias": True,                 # slicing + biases
+     "n_kv_head": None},                                     # added once
+    {"remat": True},                                         # remat composes
+])
+def test_pp_tp_1f1b_grads_match_reference(devices, over):
+    """1F1B under a pp×dp×tp mesh — stage bodies run MANUAL Megatron tp
+    (weights pre-sliced by the shard_map, explicit f/g collectives,
+    transformer.py _mtp_in/_mtp_out) — must reproduce the unsharded
+    reference gradients exactly. Covers GQA head slicing, alibi slope
+    slicing by global head index, and bias-after-psum placement.
+    Reference capability: TP composes with PP under the fused kernels
+    (deepspeed/runtime/pipe/engine.py:596 forward passes)."""
+    from deepspeed_tpu.runtime.pipe.engine import spmd_pipeline_1f1b
+    import deepspeed_tpu.comm as dist
+
+    model = _gqa_pipe_model(**over)
+    params = model.init_params(jax.random.key(0))
+    spec = model.pipeline_spec()
+    rng = np.random.default_rng(5)
+    M, B, S = 4, 4, 16
+    mbs = {"input_ids": jnp.asarray(rng.integers(0, 64, size=(M, B, S)), jnp.int32)}
+    key = jax.random.key(1)
+
+    dist.set_mesh(None)
+    ref_loss, ref_grads = spmd_pipeline_1f1b(
+        spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
+        params, mbs, key, 2, mesh=None)
+
+    mesh = Mesh(np.array(devices[:8]).reshape(2, 2, 2), ("pp", "dp", "tp"))
+    dist.set_mesh(mesh)
+    try:
+        loss, grads = spmd_pipeline_1f1b(
+            spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
+            params, mbs, key, 2, mesh=mesh,
+            tp_stage=(spec["stage_fn_tp"], spec["stage_tp_specs"]))
+        assert abs(float(loss) - float(ref_loss)) < 1e-4
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=5e-3, atol=5e-5), grads, ref_grads)
+    finally:
+        dist.set_mesh(None)
+
+
+def test_pp_tp_gpipe_keeps_auto_path(devices):
+    """The GPipe schedule is differentiated THROUGH (jax.grad over the whole
+    scan), where shard_map's AD transpose would double-count against the
+    explicit f/g collectives — so it deliberately does NOT take the
+    manual-tp hooks (runtime/pipe/engine.py spmd_pipeline_loss). Under a
+    pp×tp mesh it keeps the vmap/SPMD path; loss and grads must still match
+    the sequential reference (auto-partitioned tp)."""
+    from deepspeed_tpu.runtime.pipe.engine import spmd_pipeline_loss
+    import deepspeed_tpu.comm as dist
+
+    model = _gqa_pipe_model()
+    params = model.init_params(jax.random.key(0))
+    spec = model.pipeline_spec()
+    rng = np.random.default_rng(7)
+    M, B, S = 4, 2, 16
+    mbs = {"input_ids": jnp.asarray(rng.integers(0, 64, size=(M, B, S)), jnp.int32)}
+    key = jax.random.key(2)
+
+    dist.set_mesh(None)
+    ref = spmd_pipeline_loss(spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
+                             params, mbs, key, 2, mesh=None)
+    gref = jax.grad(lambda p: spmd_pipeline_loss(
+        spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
+        p, mbs, key, 2, mesh=None))(params)
+    mesh = Mesh(np.array(devices[:4]).reshape(2, 2), ("pp", "tp"))
+    dist.set_mesh(mesh)
+    try:
+        tp_loss = spmd_pipeline_loss(
+            spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
+            params, mbs, key, 2, mesh=mesh)
+        assert abs(float(tp_loss) - float(ref)) < 1e-4
+
+        g = jax.grad(lambda p: spmd_pipeline_loss(
+            spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
+            p, mbs, key, 2, mesh=mesh))(params)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=5e-3, atol=5e-5), g, gref)
+    finally:
+        dist.set_mesh(None)
+
+
+def test_pp_tp_indivisible_heads_fall_back(devices):
+    """kv_heads % tp != 0: the manual-tp factory refuses, the builder keeps
+    the vmap/SPMD path, and the result is still correct (just without the
+    manual stage bodies)."""
+    from deepspeed_tpu.runtime.pipe.engine import spmd_pipeline_1f1b
+    import deepspeed_tpu.comm as dist
+
+    model = _gqa_pipe_model(n_kv_head=1)  # 1 % 2 != 0
+    assert model.manual_tp_stage_fn("tp", 2) is None
+    params = model.init_params(jax.random.key(0))
+    spec = model.pipeline_spec()
+    rng = np.random.default_rng(9)
+    mbs = {"input_ids": jnp.asarray(rng.integers(0, 64, size=(3, 2, 16)), jnp.int32)}
+    key = jax.random.key(3)
+
+    dist.set_mesh(None)
+    ref_loss, _ = spmd_pipeline_1f1b(
+        spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
+        params, mbs, key, 2, mesh=None)
+    mesh = Mesh(np.array(devices[:8]).reshape(2, 2, 2), ("pp", "dp", "tp"))
+    dist.set_mesh(mesh)
+    try:
+        loss, _ = spmd_pipeline_1f1b(
+            spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
+            params, mbs, key, 2, mesh=mesh,
+            tp_stage=(spec["stage_fn_tp"], spec["stage_tp_specs"]))
+        assert abs(float(loss) - float(ref_loss)) < 1e-4
+    finally:
+        dist.set_mesh(None)
+
+
+def test_pp_tp_stage_attention_runs_flash_kernel(devices, monkeypatch):
+    """Attention inside pipeline stages STILL reaches the Pallas flash
+    kernel when the stage shard_map also covers a tp axis (manual Megatron
+    stage bodies are fully device-local, so the bare pallas_call stays
+    legal) — call counter + loss parity vs the xla attention path, through
+    the full engine."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    import deepspeed_tpu.ops.pallas as pallas_pkg
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention as real_flash
+
+    calls = {"n": 0}
+
+    def spy(*a, **k):
+        calls["n"] += 1
+        return real_flash(*a, **k)
+
+    monkeypatch.setattr(pallas_pkg, "flash_attention", spy)
+
+    def build(backend):
+        dist.set_mesh(None)
+        from deepspeed_tpu.models.pipeline import PipelinedCausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+        cfg = TransformerConfig(vocab_size=64, n_layer=2, n_head=4, n_kv_head=2,
+                                d_model=32, d_ff=64, max_seq=16,
+                                pos_embedding="learned", tie_embeddings=True,
+                                remat=False, attention_backend=backend)
+        model = PipelinedCausalLM(cfg, num_stages=2)
+        params = model.init_params(jax.random.key(0))
+        config = {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 3,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 1},
+            "mesh": {"pp": 2, "tp": 2, "dp": -1},
+            "steps_per_print": 0,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config=config)
+        return engine
+
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(0, 64, size=(3 * 2 * 2, 16)).astype(np.int32)
+
+    flash_engine = build("flash")
+    loss_flash = float(flash_engine.train_batch({"input_ids": tokens}))
+    assert calls["n"] > 0, "flash kernel was not dispatched under the pp×tp mesh"
+    n_flash = calls["n"]
+
+    xla_engine = build("xla")
+    loss_xla = float(xla_engine.train_batch({"input_ids": tokens}))
+    assert calls["n"] == n_flash, "xla path unexpectedly reached the kernel"
+    assert abs(loss_flash - loss_xla) < 1e-3, (loss_flash, loss_xla)
+    dist.set_mesh(None)
