@@ -32,7 +32,7 @@ def main():
                     help="timed steps (>= 1)")
     ap.add_argument("--trace-dir", default=None,
                     help="write a jax.profiler trace here (TPU: perfetto/TB)")
-    ap.add_argument("--config", choices=("gpt2", "llama"), default="gpt2",
+    ap.add_argument("--config", choices=("gpt2", "llama", "bert"), default="gpt2",
                     help="which bench metric's engine to profile")
     args = ap.parse_args()
     if args.steps < 1:
@@ -41,7 +41,7 @@ def main():
     import sys
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
     from bench import (_probe_backend, build_bench_engine,
-                       build_llama_bench_engine)
+                       build_bert_bench_engine, build_llama_bench_engine)
 
     if os.environ.get("BENCH_SKIP_PROBE") != "1":
         err = _probe_backend()
@@ -52,7 +52,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    build = build_llama_bench_engine if args.config == "llama" else build_bench_engine
+    build = {"llama": build_llama_bench_engine,
+             "bert": build_bert_bench_engine,
+             "gpt2": build_bench_engine}[args.config]
     engine, model, batch, knobs = build()
     BATCH, SEQ = knobs["BATCH"], knobs["SEQ"]
 
