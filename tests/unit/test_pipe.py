@@ -502,14 +502,15 @@ def _gqa_pipe_model(**over):
     return PipelinedCausalLM(TransformerConfig(**kw), num_stages=2)
 
 
-@pytest.mark.parametrize("over", [
-    {},                                                      # GQA swiglu/rope
-    {"pos_embedding": "alibi", "activation": "gelu",         # alibi slope
-     "norm": "layernorm", "attn_bias": True,                 # slicing + biases
-     "n_kv_head": None},                                     # added once
-    {"remat": True},                                         # remat composes
+@pytest.mark.parametrize("over,batch_axis", [
+    ({}, "dp"),                                              # GQA swiglu/rope
+    ({"pos_embedding": "alibi", "activation": "gelu",        # alibi slope
+      "norm": "layernorm", "attn_bias": True,                # slicing + biases
+      "n_kv_head": None}, "dp"),                             # added once
+    ({"remat": True}, "dp"),                                 # remat composes
+    ({}, "fsdp"),                                            # ZeRO-3 batch axis
 ])
-def test_pp_tp_1f1b_grads_match_reference(devices, over):
+def test_pp_tp_1f1b_grads_match_reference(devices, over, batch_axis):
     """1F1B under a pp×dp×tp mesh — stage bodies run MANUAL Megatron tp
     (weights pre-sliced by the shard_map, explicit f/g collectives,
     transformer.py _mtp_in/_mtp_out) — must reproduce the unsharded
@@ -533,7 +534,7 @@ def test_pp_tp_1f1b_grads_match_reference(devices, over):
         spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
         params, mbs, key, 2, mesh=None)
 
-    mesh = Mesh(np.array(devices[:8]).reshape(2, 2, 2), ("pp", "dp", "tp"))
+    mesh = Mesh(np.array(devices[:8]).reshape(2, 2, 2), ("pp", batch_axis, "tp"))
     dist.set_mesh(mesh)
     try:
         loss, grads = spmd_pipeline_1f1b(
