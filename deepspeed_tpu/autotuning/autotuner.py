@@ -51,6 +51,10 @@ class Candidate:
     micro_batch: int
     remat: Any
     loss_chunk: int
+    # None = keep the model's setting (dimension not searched)
+    scan_layers: Any = None
+    # 0 = kernel-default flash blocks; else attn_block_q == attn_block_k
+    attn_block: int = 0
 
     def config_overrides(self) -> Dict[str, Any]:
         return {
@@ -67,7 +71,24 @@ class Candidate:
         return cfg
 
     def name(self) -> str:
-        return f"z{self.stage}_mbs{self.micro_batch}_remat-{self.remat}_chunk{self.loss_chunk}"
+        n = f"z{self.stage}_mbs{self.micro_batch}_remat-{self.remat}_chunk{self.loss_chunk}"
+        if self.scan_layers is not None:
+            n += f"_scan{int(bool(self.scan_layers))}"
+        if self.attn_block:
+            n += f"_blk{self.attn_block}"
+        return n
+
+    def model_override_extras(self, model_cfg) -> Dict[str, Any]:
+        """The optional model-config overrides this candidate carries, keyed
+        by the dataclass fields the model actually has — the single source
+        for both the measured variant and ds_config_optimal.json."""
+        extra: Dict[str, Any] = {}
+        if self.scan_layers is not None and hasattr(model_cfg, "scan_layers"):
+            extra["scan_layers"] = bool(self.scan_layers)
+        if self.attn_block and hasattr(model_cfg, "attn_block_q"):
+            extra["attn_block_q"] = self.attn_block
+            extra["attn_block_k"] = self.attn_block
+        return extra
 
 
 @dataclasses.dataclass
@@ -115,11 +136,14 @@ class Autotuner:
         return {"input_ids": rng.integers(0, self.vocab, size=(mbs, self.seq_len)).astype(np.int32)}
 
     def _variant(self, cand: Candidate):
-        """Model with the candidate's remat/loss_chunk applied."""
+        """Model with the candidate's remat/loss_chunk (and, when searched,
+        scan_layers / flash block) applied."""
         if not self._tunable_model:
             return self.model
         remat = {"none": False, "full": True}.get(cand.remat, cand.remat)
-        cfg = dataclasses.replace(self.model.config, remat=remat, loss_chunk=cand.loss_chunk)
+        cfg = dataclasses.replace(self.model.config, remat=remat,
+                                  loss_chunk=cand.loss_chunk,
+                                  **cand.model_override_extras(self.model.config))
         return type(self.model)(cfg)
 
     def _loss_fn(self, model):
@@ -234,11 +258,20 @@ class Autotuner:
             else list(self.config.remat_policies)
         chunks = [0] if self.config.fast or not self._tunable_model \
             else list(self.config.loss_chunks)
-        cands = [Candidate(stage=s, micro_batch=m, remat=r, loss_chunk=c)
+        scans = [None] if self.config.fast or not self._tunable_model \
+            or not hasattr(getattr(self.model, "config", None), "scan_layers") \
+            else list(self.config.scan_layers_options)
+        blocks = [0] if self.config.fast or not self._tunable_model \
+            or not hasattr(getattr(self.model, "config", None), "attn_block_q") \
+            else list(self.config.attn_blocks)
+        cands = [Candidate(stage=s, micro_batch=m, remat=r, loss_chunk=c,
+                           scan_layers=sc, attn_block=b)
                  for s in self.config.zero_stages
                  for m in self._mbs_list()
                  for r in remats
-                 for c in chunks]
+                 for c in chunks
+                 for sc in scans
+                 for b in blocks]
         if self.config.tuner_type == TUNER_RANDOM and len(cands) > self.config.tuner_num_trials:
             cands = random.Random(0).sample(cands, self.config.tuner_num_trials)
         # gridsearch is NOT truncated by tuner_num_trials — a stage-major cut
@@ -362,7 +395,8 @@ class Autotuner:
         cfg = cand.apply_to(self.base_config)
         cfg.pop("autotuning", None)
         if self._tunable_model:
-            cfg["model_overrides"] = {"remat": cand.remat, "loss_chunk": cand.loss_chunk}
+            cfg["model_overrides"] = {"remat": cand.remat, "loss_chunk": cand.loss_chunk,
+                                      **cand.model_override_extras(self.model.config)}
         return cfg
 
     def _write_results(self, optimal: Dict[str, Any]) -> None:

@@ -49,6 +49,13 @@ class AutotuningConfig(ConfigModel):
     zero_stages: List[int] = [1, 2, 3]
     remat_policies: List[str] = ["none", "dots", "selective", "full"]
     loss_chunks: List[int] = [0, 2048]
+    # layer-stacking search: None keeps the model's setting out of the grid;
+    # chip measurements show unrolled (False) beats the scan by ~12% on every
+    # bench config, so both options are searched by default
+    scan_layers_options: List = [True, False]
+    # flash-attention block override candidates (0 = the kernel's default);
+    # e.g. [0, 512, 1024] re-discovers the measured 1024-block win at S=2048
+    attn_blocks: List[int] = [0]
 
     # per-device HBM budget for the static prune; None = ask the device,
     # fall back to 16 GiB

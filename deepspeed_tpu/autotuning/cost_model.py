@@ -31,6 +31,11 @@ def featurize(cand, est_bytes: int) -> List[float]:
         _REMAT_ORD.get(cand.remat, 1.5),
         float(np.log2(cand.loss_chunk + 1)),
         est_bytes / float(1024**3),
+        # scan_layers: None (not searched) sits between True/False so the
+        # model stays indifferent until the dimension is actually in play
+        0.5 if getattr(cand, "scan_layers", None) is None
+        else float(bool(cand.scan_layers)),
+        float(np.log2(getattr(cand, "attn_block", 0) + 1)),
     ]
 
 

@@ -114,10 +114,14 @@ class TestTune:
 class TestModelBasedTuner:
 
     def _base(self, tmp_path, **over):
+        # scan/block dimensions pinned: the fake measure below is
+        # insensitive to them, so searching them would only create winner
+        # ties (their own search is covered by test_scan_and_block_dimensions_searched)
         cfg = AutotuningConfig(
             fast=False, zero_stages=[1], remat_policies=["none", "dots"],
             loss_chunks=[0, 2048], min_train_micro_batch_size_per_gpu=1,
             max_train_micro_batch_size_per_gpu=8,
+            scan_layers_options=[None], attn_blocks=[0],
             results_dir=str(tmp_path), tuner_num_trials=50, **over)
         return Autotuner(tiny_model(), base_config={
             "train_micro_batch_size_per_gpu": 1,
@@ -168,3 +172,41 @@ class TestModelBasedTuner:
         n_seed = 3
         # first post-seed pick: large mbs (the dominant measured trend)
         assert "mbs8" in measured[n_seed] or "mbs4" in measured[n_seed], measured
+
+
+@pytest.mark.parametrize("tuner_type", ["gridsearch", "model_based"])
+def test_scan_and_block_dimensions_searched(tmp_path, monkeypatch, tuner_type):
+    """scan_layers / flash-block candidates enter the grid for models whose
+    config carries them, the winner's settings land in model_overrides, and
+    the measured variants actually differ (the 13.5%-unrolled / 1024-block
+    wins from the chip sweep become automatically discoverable)."""
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+    from deepspeed_tpu.autotuning.config import AutotuningConfig
+
+    cfg = AutotuningConfig(
+        fast=False, zero_stages=[1], remat_policies=["dots"], loss_chunks=[0],
+        min_train_micro_batch_size_per_gpu=2,
+        max_train_micro_batch_size_per_gpu=2,
+        scan_layers_options=[True, False], attn_blocks=[0, 512],
+        tuner_type=tuner_type,
+        results_dir=str(tmp_path), tuner_num_trials=50)
+    at = Autotuner(tiny_model(), base_config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True}, "steps_per_print": 0,
+    }, seq_len=32, autotuning_config=cfg)
+
+    cands = at.candidates()
+    assert {(c.scan_layers, c.attn_block) for c in cands} ==         {(True, 0), (True, 512), (False, 0), (False, 512)}
+    # variants reflect the candidate settings
+    v = at._variant([c for c in cands if c.scan_layers is False
+                     and c.attn_block == 512][0])
+    assert v.config.scan_layers is False and v.config.attn_block_q == 512
+
+    monkeypatch.setattr(at, "prune", lambda c: (True, 0))
+    monkeypatch.setattr(at, "measure",
+                        lambda c: 100 + (20 if not c.scan_layers else 0)
+                        + (10 if c.attn_block == 512 else 0))
+    best = at.tune()
+    assert best["model_overrides"]["scan_layers"] is False
+    assert best["model_overrides"]["attn_block_q"] == 512
