@@ -150,12 +150,19 @@ def test_gqa_flash_compiles_matches_and_beats_repeat(tpu):
         assert err < tol, (name, err, tol)
 
     def timeit(fn, *args):
+        # best of three 10-iter windows: a single window is exposed to
+        # transient host/tunnel stalls (observed flaking this assertion
+        # when run mid-tier); the min is the hardware's number
         jax.block_until_ready(fn(*args))
-        t0 = time.perf_counter()
-        for _ in range(10):
-            out = fn(*args)
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / 10
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            w = (time.perf_counter() - t0) / 10
+            best = w if best is None else min(best, w)
+        return best
 
     tn = timeit(native, q, k, v)
     tr = timeit(repeat, q, k, v)
