@@ -62,11 +62,15 @@ def _stage_map_builder(stage_fn, mesh, num_stages: int, batch_size: int,
     ``stage_specs`` is the per-leaf PartitionSpec tree for the stacked stage
     params (leading ``pp`` dim + the tp placement). ep/sp stage bodies have
     no manual form — those compositions keep the vmap path. The batch must
-    divide the dp extent. Returns ``(fwd, bwd)``:
+    divide the dp extent. Returns ``(fwd, bwd, manual)``:
 
     - ``fwd(stage_params, bufs, aux, keys) -> outs``
     - ``bwd(stage_params, x, aux, keys, cots, valid) -> (dstage_params, dx)``
       (vjp w.r.t. params and input, fp32 grads, zeroed where ``not valid``)
+    - ``manual``: True when the shard_map path engaged — the pair must then
+      NOT be differentiated through (shard_map's AD transpose would re-sum
+      replicated-leaf cotangents); callers either call ``bwd`` explicitly
+      (1F1B) or wrap the pair in a custom_vjp (GPipe's ``run_stages``).
     """
     tp_size = mesh.shape.get("tp", 1) if mesh is not None else 1
 
@@ -101,7 +105,7 @@ def _stage_map_builder(stage_fn, mesh, num_stages: int, batch_size: int,
 
     if not eligible:
         return (jax.vmap(stage_fn, in_axes=(0, 0, 0, 0)),
-                jax.vmap(stage_bwd_one, in_axes=(0, 0, 0, 0, 0, 0)))
+                jax.vmap(stage_bwd_one, in_axes=(0, 0, 0, 0, 0, 0)), False)
 
     from jax import shard_map
 
@@ -136,7 +140,7 @@ def _stage_map_builder(stage_fn, mesh, num_stages: int, batch_size: int,
     bwd = shard_map(bwd_body, mesh=mesh,
                     in_specs=(param_specs, aspec, aspec, pspec, aspec, pspec),
                     out_specs=(param_specs, aspec), check_vma=False)
-    return fwd, bwd
+    return fwd, bwd, True
 
 
 def spmd_pipeline_loss(embed_fn: Callable,
@@ -147,7 +151,8 @@ def spmd_pipeline_loss(embed_fn: Callable,
                        rng,
                        num_stages: int,
                        mesh=None,
-                       carry_keys: tuple = ()) -> jnp.ndarray:
+                       carry_keys: tuple = (),
+                       tp_stage=None) -> jnp.ndarray:
     """Run a GPipe-style pipelined forward over ``num_stages`` and return the
     mean loss over micro-batches.
 
@@ -160,6 +165,10 @@ def spmd_pipeline_loss(embed_fn: Callable,
     - ``carry_keys``: micro-batch dict keys whose values must travel with the
       activations through the pipeline (e.g. attention_mask) — they are
       injected at stage 0 and rotated alongside ``x``.
+    - ``tp_stage``: optional ``(stage_fn_tp, stage_tp_specs)`` manual-tp
+      hooks (the model's ``pipeline_spec()["stage_fn_tp"/"stage_tp_specs"]``)
+      enabling Megatron-manual stage bodies — and the flash kernel — under
+      pp×tp meshes; see ``_stage_map_builder``.
 
     Total ticks T = M + num_stages - 1; the (S-1)/T bubble is the standard
     GPipe cost and shrinks with more micro-batches.
@@ -200,12 +209,43 @@ def spmd_pipeline_loss(embed_fn: Callable,
     carry0 = {k: jnp.broadcast_to(mb0[k][None], (S,) + mb0[k].shape) for k in carry_keys}
     bufs, carry0 = constrain(bufs), constrain(carry0)
 
-    # NO manual-tp hooks here: this GPipe form is differentiated THROUGH
-    # (jax.grad over the whole scan), and shard_map's AD transpose psums the
-    # cotangents of tp-unmentioned inputs over tp — double-counting against
-    # the explicit f/g collectives. The 1F1B schedule takes its vjps INSIDE
-    # the manual region and states every placement, so manual tp lives there.
-    vstage, _ = _stage_map_builder(stage_fn, mesh, S, x0.shape[0])
+    # This GPipe form is differentiated THROUGH (jax.grad over the whole
+    # scan). shard_map's AD transpose would psum the cotangents of
+    # tp-unmentioned inputs over tp — double-counting against the explicit
+    # f/g collectives — so when the manual path engages, each tick wraps the
+    # stage executor in a custom_vjp that routes the backward through the
+    # builder's explicit manual bwd (the same placements 1F1B uses) instead
+    # of letting AD transpose the shard_map.
+    vstage, vbwd, vmanual = _stage_map_builder(stage_fn, mesh, S, x0.shape[0],
+                                               tp_stage=tp_stage)
+
+    def _zero_tan(x):
+        # cotangent for a non-differentiable primal (int aux, PRNG keys)
+        import numpy as _np
+        aval = jax.typeof(x)
+        if jnp.issubdtype(aval.dtype, jnp.inexact):
+            return jnp.zeros(aval.shape, aval.dtype)
+        return _np.zeros(aval.shape, jax.dtypes.float0)
+
+    @jax.custom_vjp
+    def _manual_stages(sp, bufs, aux, keys):
+        return vstage(sp, bufs, aux, keys)
+
+    def _manual_fwd(sp, bufs, aux, keys):
+        return _manual_stages(sp, bufs, aux, keys), (sp, bufs, aux, keys)
+
+    def _manual_bwd(res, cot):
+        sp, bufs, aux, keys = res
+        # built here, not closed over: an outer jit would otherwise bake a
+        # tracer into the custom_vjp bwd closure (S is static, so this is a
+        # compile-time constant either way)
+        dsp, dx = vbwd(sp, bufs, aux, keys, cot, jnp.ones((S,), bool))
+        dsp = jax.tree.map(lambda g, p: g.astype(p.dtype), dsp, sp)
+        return (dsp, dx.astype(bufs.dtype),
+                jax.tree.map(_zero_tan, aux), _zero_tan(keys))
+
+    _manual_stages.defvjp(_manual_fwd, _manual_bwd)
+    run_stages = _manual_stages if vmanual else vstage
 
     def tick(state, t):
         bufs, aux, loss_sum = state
@@ -218,7 +258,7 @@ def spmd_pipeline_loss(embed_fn: Callable,
 
         tick_keys = jax.vmap(lambda s: jax.random.fold_in(
             jax.random.fold_in(rng, t), s))(jnp.arange(S, dtype=jnp.int32))
-        outs = vstage(stage_params, bufs, aux, tick_keys)
+        outs = run_stages(stage_params, bufs, aux, tick_keys)
         # last stage completes micro-batch t - (S-1); the head (a full vocab
         # matmul) only runs on ticks where one actually exits
         mb_done = mb_at(t - (S - 1))
@@ -333,8 +373,8 @@ def spmd_pipeline_1f1b(embed_fn: Callable,
     gstages0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), stage_params)
     gns0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), nonstage)
 
-    stage_fwd, stage_bwd = _stage_map_builder(stage_fn, mesh, S, x0.shape[0],
-                                              tp_stage=tp_stage)
+    stage_fwd, stage_bwd, _ = _stage_map_builder(stage_fn, mesh, S, x0.shape[0],
+                                                 tp_stage=tp_stage)
 
     def tick(state, t):
         ring, prev_outs, cots, gstages, gns, loss_sum = state
@@ -524,7 +564,9 @@ class PipelineEngine(DeepSpeedEngine):
             def scaled_loss(p):
                 loss = spmd_pipeline_loss(spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
                                           p, batch, rng, spec["num_stages"], mesh=self.mesh,
-                                          carry_keys=tuple(spec.get("carry_keys", ())))
+                                          carry_keys=tuple(spec.get("carry_keys", ())),
+                                          tp_stage=(spec.get("stage_fn_tp"),
+                                                    spec.get("stage_tp_specs")))
                 # _apply_update divides by scale*gas; loss is already the
                 # micro-batch mean, so pre-multiply to cancel
                 return loss * scale * gas, loss

@@ -549,13 +549,19 @@ def test_pp_tp_1f1b_grads_match_reference(devices, over, batch_axis):
         dist.set_mesh(None)
 
 
-def test_pp_tp_gpipe_keeps_auto_path(devices):
+@pytest.mark.parametrize("with_hooks,axes", [
+    (True, ("pp", "tp")),    # manual tp via custom_vjp
+    (False, ("pp", "tp")),   # no hooks: vmap/SPMD fallback
+    (True, ("pp", "dp")),    # manual path WITHOUT tp: dp psum branch of
+                             # bwd_body under the GPipe custom_vjp wrapper
+])
+def test_pp_tp_gpipe_grads_match_reference(devices, with_hooks, axes):
     """The GPipe schedule is differentiated THROUGH (jax.grad over the whole
-    scan), where shard_map's AD transpose would double-count against the
-    explicit f/g collectives — so it deliberately does NOT take the
-    manual-tp hooks (runtime/pipe/engine.py spmd_pipeline_loss). Under a
-    pp×tp mesh it keeps the vmap/SPMD path; loss and grads must still match
-    the sequential reference (auto-partitioned tp)."""
+    scan). With the manual-tp hooks, each tick's stage executor is wrapped
+    in a custom_vjp routing the backward through the builder's explicit
+    manual bwd — shard_map's AD transpose (which would double-count against
+    the f/g collectives) never sees the manual region. Without hooks the
+    vmap/SPMD path applies. Both must match the sequential reference."""
     from deepspeed_tpu.runtime.pipe.engine import spmd_pipeline_loss
     import deepspeed_tpu.comm as dist
 
@@ -566,6 +572,8 @@ def test_pp_tp_gpipe_keeps_auto_path(devices):
     M, B, S = 4, 2, 16
     mbs = {"input_ids": jnp.asarray(rng.integers(0, 64, size=(M, B, S)), jnp.int32)}
     key = jax.random.key(2)
+    hooks = (spec["stage_fn_tp"], spec["stage_tp_specs"]) if with_hooks else None
+    assert B % 2 == 0  # divides the dp extent for the ("pp", "dp") case
 
     dist.set_mesh(None)
     ref = spmd_pipeline_loss(spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
@@ -573,17 +581,17 @@ def test_pp_tp_gpipe_keeps_auto_path(devices):
     gref = jax.grad(lambda p: spmd_pipeline_loss(
         spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
         p, mbs, key, 2, mesh=None))(params)
-    mesh = Mesh(np.array(devices[:4]).reshape(2, 2), ("pp", "tp"))
+    mesh = Mesh(np.array(devices[:4]).reshape(2, 2), axes)
     dist.set_mesh(mesh)
     try:
         tp_loss = spmd_pipeline_loss(
             spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
-            params, mbs, key, 2, mesh=mesh)
+            params, mbs, key, 2, mesh=mesh, tp_stage=hooks)
         assert abs(float(tp_loss) - float(ref)) < 1e-4
 
         g = jax.grad(lambda p: spmd_pipeline_loss(
             spec["embed_fn"], spec["stage_fn"], spec["head_loss_fn"],
-            p, mbs, key, 2, mesh=mesh))(params)
+            p, mbs, key, 2, mesh=mesh, tp_stage=hooks))(params)
         jax.tree.map(lambda a, b: np.testing.assert_allclose(
             np.asarray(a, np.float32), np.asarray(b, np.float32),
             rtol=5e-3, atol=5e-5), g, gref)
