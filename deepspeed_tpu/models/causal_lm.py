@@ -37,8 +37,11 @@ class CausalLM:
     def __call__(self, params, tokens, attn_mask=None):
         return self.forward(params, tokens, attn_mask)
 
-    def loss(self, params, batch):
-        return T.lm_loss(self.config, params, batch)
+    def loss(self, params, batch, rng=None):
+        """Training loss; ``rng`` (threaded by the engine's train path)
+        enables cfg.dropout — eval/inference paths pass None and stay
+        deterministic."""
+        return T.lm_loss(self.config, params, batch, rng=rng)
 
     def tp_specs(self) -> Dict[str, Any]:
         return T.tp_specs(self.config)

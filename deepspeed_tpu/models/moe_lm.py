@@ -145,10 +145,16 @@ class MoECausalLM:
 
     def _block(self, x, lp, positions, mask_bias, rng, train: bool):
         cfg = self.config
+        k_route = ka = km = None
+        if rng is not None:
+            if cfg.dropout and train:
+                k_route, ka, km = jax.random.split(rng, 3)
+            else:
+                k_route = rng
         a = T.attention(cfg, T._norm(cfg, x, lp["ln_attn"]), lp["attn"], positions, mask_bias)
-        x = x + a
-        m, l_aux = self._moe_mlp(lp["mlp"], T._norm(cfg, x, lp["ln_mlp"]), rng, train)
-        return x + m, l_aux
+        x = x + T._dropout(cfg, a, ka)
+        m, l_aux = self._moe_mlp(lp["mlp"], T._norm(cfg, x, lp["ln_mlp"]), k_route, train)
+        return x + T._dropout(cfg, m, km), l_aux
 
     def forward(self, params, tokens, attn_mask=None, rng=None, train: bool = True):
         cfg = self.config

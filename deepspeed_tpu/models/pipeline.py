@@ -68,7 +68,7 @@ class PipelinedCausalLM(CausalLM):
         x = params["embed"]["tokens"][tokens]
         if cfg.pos_embedding == "learned":
             x = x + params["embed"]["positions"][:S][None, :, :]
-        return x
+        return T._dropout(cfg, x, rng if cfg.dropout else None)
 
     def _stage(self, stage_params, x, aux, rng):
         """One pipeline stage: scan over its layers_per_stage blocks."""
@@ -97,17 +97,15 @@ class PipelinedCausalLM(CausalLM):
         return stage_fn
 
     def _stage_with(self, cfg, stage_params, x, aux, rng):
+        """One stage = run_layers over this stage's layers_per_stage stacked
+        blocks — the same key-threaded scan/remat machinery as the
+        non-pipelined path (transformer.py), so dropout placement and remat
+        policies cannot diverge between them."""
         B, S, D = x.shape
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
         mask_bias = T.key_mask_bias(aux.get("attention_mask"))
-
-        def run_block(h, lp):
-            return T.block(cfg, h, lp, positions, mask_bias), None
-
-        if cfg.remat:
-            run_block = jax.checkpoint(run_block, prevent_cse=False)
-        x, _ = jax.lax.scan(run_block, x, stage_params)
-        return x
+        rng = rng if cfg.dropout else None
+        return T.run_layers(cfg, x, stage_params, positions, mask_bias, rng=rng)
 
     def _head_loss(self, params, x, mb, rng, ignore_index: int = -100):
         cfg = self.config
@@ -144,7 +142,8 @@ class PipelinedCausalLM(CausalLM):
 
     def loss(self, params, batch):
         """Non-pipelined loss with identical math — used for eval_batch and
-        correctness tests against the pipelined path."""
+        correctness tests against the pipelined path. No rng: dropout (if
+        configured) is OFF here, matching reference module.eval()."""
         aux = {k: batch[k] for k in ("attention_mask",) if k in batch}
         x = self._embed(params, batch, None)
         Lps = self.layers_per_stage

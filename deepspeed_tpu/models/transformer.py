@@ -52,6 +52,12 @@ class TransformerConfig:
     rope_interleaved: bool = False       # GPT-J pairing vs NeoX half-split
     lm_head_bias: bool = False           # GPT-J's lm_head carries a bias
     norm_eps: float = 1e-5
+    # hidden dropout (embedding sum + both residual-branch outputs, GPT-2
+    # placement), applied only when the loss path is given an rng — eval and
+    # inference paths pass none and stay deterministic. Attention-PROBS
+    # dropout is deliberately not implemented: the flash kernel family
+    # cannot apply it and a silent einsum-only fallback would change
+    # numerics between paths (modern recipes train attention undropped).
     dropout: float = 0.0
     # memory: activation checkpointing per layer. False/"none" = save all
     # activations; True/"full" = save only layer inputs (reference
@@ -306,6 +312,18 @@ def key_mask_bias(attn_mask):
 # don't pad a near-full chunk of dead keys
 DENSE_STREAM_THRESHOLD = 4096
 DENSE_STREAM_CHUNK = 1024
+
+
+def _dropout(cfg: TransformerConfig, x, key):
+    """Inverted dropout; identity when the rate is 0 or no key is given
+    (eval / inference). Reference capability: the fused training layer's
+    hidden-dropout ratios (csrc/transformer/ds_transformer_cuda.cpp
+    dropout kernels; config attn_dropout_ratio/hidden_dropout_ratio)."""
+    if not cfg.dropout or key is None:
+        return x
+    keep = 1.0 - cfg.dropout
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0).astype(x.dtype)
 
 
 def _mtp_in(x, axis):
@@ -761,20 +779,24 @@ def mlp(cfg: TransformerConfig, x, lp):
     return checkpoint_name(out + lp["b_down"], "ff_down")
 
 
-def block(cfg: TransformerConfig, x, lp, positions, mask_bias):
+def block(cfg: TransformerConfig, x, lp, positions, mask_bias, rng=None):
+    ka = km = None
+    if rng is not None and cfg.dropout:
+        ka, km = jax.random.split(rng)
     if cfg.norm_position == "post":
         # BERT-style add&norm: residual first, LN after (reference's fused
         # encoder layer, csrc/transformer/ds_transformer_cuda.cpp pre/post
         # layernorm modes)
-        x = _norm(cfg, x + attention(cfg, x, lp["attn"], positions, mask_bias),
-                  lp["ln_attn"])
-        return _norm(cfg, x + mlp(cfg, x, lp["mlp"]), lp["ln_mlp"])
-    a = attention(cfg, _norm(cfg, x, lp["ln_attn"]), lp["attn"], positions, mask_bias)
+        a = _dropout(cfg, attention(cfg, x, lp["attn"], positions, mask_bias), ka)
+        x = _norm(cfg, x + a, lp["ln_attn"])
+        return _norm(cfg, x + _dropout(cfg, mlp(cfg, x, lp["mlp"]), km), lp["ln_mlp"])
+    a = _dropout(cfg, attention(cfg, _norm(cfg, x, lp["ln_attn"]), lp["attn"],
+                                positions, mask_bias), ka)
     if cfg.parallel_residual:
-        m = mlp(cfg, _norm(cfg, x, lp["ln_mlp"]), lp["mlp"])
+        m = _dropout(cfg, mlp(cfg, _norm(cfg, x, lp["ln_mlp"]), lp["mlp"]), km)
         return x + a + m
     x = x + a
-    m = mlp(cfg, _norm(cfg, x, lp["ln_mlp"]), lp["mlp"])
+    m = _dropout(cfg, mlp(cfg, _norm(cfg, x, lp["ln_mlp"]), lp["mlp"]), km)
     return x + m
 
 
@@ -958,30 +980,40 @@ def forward_cached(cfg: TransformerConfig, params, tokens, cache, pos, pad_bias=
     return logits, {"k": nk, "v": nv}
 
 
-def run_layers(cfg: TransformerConfig, x, layer_params, positions, mask_bias):
+def run_layers(cfg: TransformerConfig, x, layer_params, positions, mask_bias,
+               rng=None):
     """Run the stacked layer blocks over ``x`` with the config's remat policy
     and scan/unroll choice — shared by :func:`hidden_states` and non-token
-    encoders (e.g. the CLIP vision tower)."""
-    def run_block(h, lp):
-        out = block(cfg, h, lp, positions, mask_bias)
+    encoders (e.g. the CLIP vision tower). ``rng`` (training loss paths
+    only) seeds per-layer dropout keys; None keeps every path deterministic
+    and the traced program identical to the dropout-free form."""
+    with_keys = rng is not None and bool(cfg.dropout)
+    n_layer = jax.tree.leaves(layer_params)[0].shape[0]
+
+    def run_block(h, xs):
+        lp, key = xs if with_keys else (xs, None)
+        out = block(cfg, h, lp, positions, mask_bias, rng=key)
         return out, None
 
     if cfg.remat and cfg.remat != "none":
         run_block = jax.checkpoint(run_block, policy=_remat_policy(cfg.remat),
                                    prevent_cse=False)
 
+    xs = (layer_params, jax.random.split(rng, n_layer)) if with_keys else layer_params
     if cfg.scan_layers:
-        x, _ = jax.lax.scan(run_block, x, layer_params)
+        x, _ = jax.lax.scan(run_block, x, xs)
     else:
-        for i in range(cfg.n_layer):
-            lp = jax.tree.map(lambda a: a[i], layer_params)
-            x, _ = run_block(x, lp)
+        for i in range(n_layer):
+            x, _ = run_block(x, jax.tree.map(lambda a: a[i], xs))
     return x
 
 
-def hidden_states(cfg: TransformerConfig, params, tokens, attn_mask=None):
+def hidden_states(cfg: TransformerConfig, params, tokens, attn_mask=None,
+                  rng=None):
     """tokens [B, S] int32 → final normed hidden states [B, S, D] (the
-    forward body without the vocab projection)."""
+    forward body without the vocab projection). ``rng`` enables dropout
+    (training loss paths); None — the default for forward/inference —
+    is deterministic."""
     if cfg.norm_position == "post":
         # post-LN stacks end inside the last block and have no ln_f; the
         # LM paths here are pre-LN only — build on run_layers directly
@@ -995,8 +1027,13 @@ def hidden_states(cfg: TransformerConfig, params, tokens, attn_mask=None):
         x = x + params["embed"]["positions"][:S][None, :, :]
     if cfg.embed_layernorm:
         x = _norm(cfg, x, params["embed"]["ln"])
+    k_embed = k_layers = None
+    if rng is not None and cfg.dropout:
+        k_embed, k_layers = jax.random.split(rng)
+    x = _dropout(cfg, x, k_embed)
 
-    x = run_layers(cfg, x, params["layers"], positions, key_mask_bias(attn_mask))
+    x = run_layers(cfg, x, params["layers"], positions, key_mask_bias(attn_mask),
+                   rng=k_layers)
     return _norm(cfg, x, params["ln_f"])
 
 
@@ -1052,7 +1089,8 @@ def chunked_vocab_ce(h, w, hb, safe_labels, valid, chunk: int):
     return nll / jnp.maximum(n, 1)
 
 
-def lm_loss(cfg: TransformerConfig, params, batch, ignore_index: int = -100):
+def lm_loss(cfg: TransformerConfig, params, batch, ignore_index: int = -100,
+            rng=None):
     """Next-token cross-entropy. batch: dict(input_ids[B,S], optional
     labels[B,S], optional attention_mask[B,S]).
 
@@ -1064,7 +1102,7 @@ def lm_loss(cfg: TransformerConfig, params, batch, ignore_index: int = -100):
     labels = batch.get("labels")
     if labels is None:
         labels = jnp.concatenate([tokens[:, 1:], jnp.full_like(tokens[:, :1], ignore_index)], axis=1)
-    x = hidden_states(cfg, params, tokens, batch.get("attention_mask"))
+    x = hidden_states(cfg, params, tokens, batch.get("attention_mask"), rng=rng)
     w = _head_weight(cfg, params)
     B, S, D = x.shape
 

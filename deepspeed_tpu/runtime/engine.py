@@ -1018,14 +1018,17 @@ class DeepSpeedEngine:
         self._report_progress(metrics)
 
     def eval_batch(self, batch):
+        """Evaluation loss — DETERMINISTIC: the loss is called with rng=None,
+        which the model zoo's convention reads as "no stochasticity" (dropout
+        off, no MoE routing jitter/RTS draw), matching the reference's
+        module.eval() semantics."""
         self._check_compression_epoch()
         if self._eval_jit is None:
-            def eval_fn(params, b, rng):
-                out = self.loss_fn(params, b, rng)
+            def eval_fn(params, b):
+                out = self.loss_fn(params, b, None)
                 return out[0] if isinstance(out, tuple) else out
             self._eval_jit = jax.jit(eval_fn)
-        self._rng, rng = jax.random.split(self._rng)
-        return self._eval_jit(self.state.params, jax.tree.map(jnp.asarray, batch), rng)
+        return self._eval_jit(self.state.params, jax.tree.map(jnp.asarray, batch))
 
     # ------------------------------------------------------------------ #
     # accessors (reference engine.py:479-858 config properties)

@@ -116,12 +116,22 @@ def _stage_map_builder(stage_fn, mesh, num_stages: int, batch_size: int,
     def local(tree):
         return jax.tree.map(lambda a: a[0], tree)
 
+    def shard_key(keys):
+        # stage keys enter replicated over dp (P("pp") spec) while the
+        # activations are dp-sharded: fold the dp coordinate in so dropout
+        # masks differ per data shard (the vmap/SPMD path draws masks at
+        # global shape and partitions them — this is the manual analogue)
+        k = keys[0]
+        for a in dp_axes:
+            k = jax.random.fold_in(k, jax.lax.axis_index(a))
+        return k
+
     def fwd_body(sp, x, aux, keys):
-        y = stage_fn(local(sp), x[0], local(aux), keys[0])
+        y = stage_fn(local(sp), x[0], local(aux), shard_key(keys))
         return y[None]
 
     def bwd_body(sp, x, aux, keys, cots, valid):
-        dsp, dx = stage_bwd_one(local(sp), x[0], local(aux), keys[0],
+        dsp, dx = stage_bwd_one(local(sp), x[0], local(aux), shard_key(keys),
                                 cots[0], valid[0])
         if dp_axes:
             # the local vjp saw only this shard's batch rows; the param grad

@@ -112,3 +112,84 @@ def test_num_parameters_exact():
     params = model.init_params(jax.random.key(0))
     actual = sum(x.size for x in jax.tree.leaves(params))
     assert model.num_parameters == actual
+
+
+def test_dropout_trains_and_eval_is_deterministic():
+    """cfg.dropout engages on the rng-threaded training loss (embedding +
+    residual-branch placement, reference hidden/attn-output dropout
+    capability) and is OFF wherever no rng flows: rng=None loss equals the
+    dropout-free model, and engine.eval_batch is deterministic across
+    calls (reference module.eval() semantics)."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models.causal_lm import CausalLM
+    from deepspeed_tpu.models.transformer import TransformerConfig
+
+    dist.set_mesh(None)
+    kw = dict(vocab_size=64, n_layer=2, n_head=2, d_model=32, d_ff=64,
+              max_seq=16, remat=False, attention_backend="xla")
+    plain = CausalLM(TransformerConfig(**kw))
+    dropped = CausalLM(TransformerConfig(**kw, dropout=0.3))
+    params = plain.init_params(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(rng.integers(0, 64, size=(4, 16)), jnp.int32)}
+
+    base = float(plain.loss(params, batch))
+    # no rng -> dropout off, identical to the dropout-free model
+    assert abs(float(dropped.loss(params, batch)) - base) < 1e-6
+    # rng -> stochastic, reproducible per key, different across keys
+    l1 = float(dropped.loss(params, batch, jax.random.key(1)))
+    l1b = float(dropped.loss(params, batch, jax.random.key(1)))
+    l2 = float(dropped.loss(params, batch, jax.random.key(2)))
+    assert l1 == l1b
+    assert abs(l1 - base) > 1e-6 and abs(l1 - l2) > 1e-9
+
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"dp": -1},
+        "steps_per_print": 0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=dropped, model_parameters=params, config=config)
+    ebatch = {"input_ids": jnp.asarray(rng.integers(0, 64, size=(8, 16)), jnp.int32)}
+    t1 = float(engine.train_batch(ebatch))
+    assert np.isfinite(t1)
+    e1, e2 = float(engine.eval_batch(ebatch)), float(engine.eval_batch(ebatch))
+    assert e1 == e2, "eval_batch must be deterministic (rng=None)"
+
+
+def test_dropout_through_pipeline_stages(devices):
+    """The pipeline schedules thread per-(tick, stage) keys into the stage
+    bodies, so dropout works under pp meshes too; the sequential loss()
+    (rng-less) stays deterministic for eval."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models.pipeline import PipelinedCausalLM
+    from deepspeed_tpu.models.transformer import TransformerConfig
+
+    dist.set_mesh(None)
+    cfg = TransformerConfig(vocab_size=64, n_layer=2, n_head=2, d_model=32,
+                            d_ff=64, max_seq=16, remat=False, dropout=0.2,
+                            attention_backend="xla")
+    model = PipelinedCausalLM(cfg, num_stages=2)
+    params = model.init_params(jax.random.key(0))
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"pp": 2, "dp": -1},
+        "steps_per_print": 0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=config)
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, 64, size=(2 * 2 * 4, 16)).astype(np.int32)
+    loss = float(engine.train_batch({"input_ids": tokens}))
+    assert np.isfinite(loss)
+    e1 = float(engine.eval_batch({"input_ids": tokens[:4]}))
+    e2 = float(engine.eval_batch({"input_ids": tokens[:4]}))
+    assert e1 == e2
+    dist.set_mesh(None)

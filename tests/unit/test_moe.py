@@ -249,3 +249,29 @@ def test_moe_causal_lm_trains(devices):
     losses = [float(engine.train_batch({"input_ids": toks})) for _ in range(8)]
     assert losses[-1] < losses[0], losses
     dist.set_mesh(None)
+
+
+def test_moe_hidden_dropout():
+    """cfg.dropout applies to the MoE block's residual branches too (keys
+    split off the routing rng); rng=None (eval) stays deterministic and
+    equal to the dropout-free model."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deepspeed_tpu.models.moe_lm import MoECausalLM, MoEConfig
+    from deepspeed_tpu.models.transformer import TransformerConfig
+
+    kw = dict(vocab_size=64, n_layer=2, n_head=2, d_model=32, d_ff=64,
+              max_seq=16, remat=False, attention_backend="xla")
+    moe = MoEConfig(num_experts=2)
+    plain = MoECausalLM(TransformerConfig(**kw), moe)
+    dropped = MoECausalLM(TransformerConfig(**kw, dropout=0.3), moe)
+    params = plain.init_params(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(rng.integers(0, 64, size=(4, 16)), jnp.int32)}
+
+    base = float(plain.loss(params, batch))
+    assert abs(float(dropped.loss(params, batch)) - base) < 1e-6
+    l1 = float(dropped.loss(params, batch, rng=jax.random.key(1)))
+    l1b = float(dropped.loss(params, batch, rng=jax.random.key(1)))
+    assert l1 == l1b and abs(l1 - base) > 1e-6
