@@ -37,6 +37,10 @@ class BertConfig:
     remat: Any = True
     attention_backend: str = "auto"
     loss_chunk: int = 0
+    # HF hidden_dropout_prob equivalent (embedding sum + residual-branch
+    # outputs via the shared backbone); applied only on the rng-threaded
+    # training loss — inference/eval stay deterministic
+    dropout: float = 0.0
     # unrolled layers trade compile time for runtime (chip-measured faster
     # on every bench config; the scan keeps compiles fast for tests)
     scan_layers: bool = True
@@ -59,7 +63,7 @@ class BertConfig:
             norm_position="post", activation=self.activation, causal=False,
             attn_bias=True, norm_eps=self.norm_eps, tie_embeddings=True,
             remat=self.remat, attention_backend=self.attention_backend,
-            scan_layers=self.scan_layers)
+            scan_layers=self.scan_layers, dropout=self.dropout)
 
 
 class BertModel:
@@ -101,8 +105,10 @@ class BertModel:
             }
         return out
 
-    def __call__(self, params, input_ids, token_type_ids=None, attention_mask=None):
-        """→ (last_hidden [B, S, D], pooled [B, D])."""
+    def __call__(self, params, input_ids, token_type_ids=None, attention_mask=None,
+                 rng=None):
+        """→ (last_hidden [B, S, D], pooled [B, D]). ``rng`` (training loss
+        only) enables cfg.dropout; the default None is deterministic."""
         cfg = self.zoo_cfg
         B, S = input_ids.shape
         x = params["embed"]["tokens"][input_ids]
@@ -111,10 +117,14 @@ class BertModel:
             token_type_ids = jnp.zeros_like(input_ids)
         x = x + params["embed"]["token_type"][token_type_ids]
         x = T._norm(cfg, x, params["embed"]["ln"])
+        k_embed = k_layers = None
+        if rng is not None and cfg.dropout:
+            k_embed, k_layers = jax.random.split(rng)
+        x = T._dropout(cfg, x, k_embed)
 
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
         x = T.run_layers(cfg, x, params["layers"], positions,
-                         T.key_mask_bias(attention_mask))
+                         T.key_mask_bias(attention_mask), rng=k_layers)
         # post-LN stacks end inside the last block: no final norm here
         pooled = jnp.tanh(x[:, 0] @ params["pooler"]["w"] + params["pooler"]["b"])
         return x, pooled
@@ -156,7 +166,7 @@ class BertModel:
             f -= 6.0 * head * (1.0 - min(c.mlm_gather_budget, 1.0))
         return f
 
-    def loss(self, params, batch):
+    def loss(self, params, batch, rng=None):
         """Masked-LM training loss — makes BertModel a first-class
         ``deepspeed_tpu.initialize`` model (the reference's headline
         fastest-BERT-training workload, docs/_posts/2020-05-28). batch:
@@ -167,7 +177,8 @@ class BertModel:
             raise ValueError("training needs the MLM head: "
                              "BertModel(cfg, with_mlm_head=True)")
         x, _ = self(params, batch["input_ids"],
-                    batch.get("token_type_ids"), batch.get("attention_mask"))
+                    batch.get("token_type_ids"), batch.get("attention_mask"),
+                    rng=rng)
 
         labels = batch["labels"]
         valid = (labels != -100)

@@ -1089,8 +1089,8 @@ def chunked_vocab_ce(h, w, hb, safe_labels, valid, chunk: int):
     return nll / jnp.maximum(n, 1)
 
 
-def lm_loss(cfg: TransformerConfig, params, batch, ignore_index: int = -100,
-            rng=None):
+def lm_loss(cfg: TransformerConfig, params, batch, rng=None,
+            ignore_index: int = -100):
     """Next-token cross-entropy. batch: dict(input_ids[B,S], optional
     labels[B,S], optional attention_mask[B,S]).
 

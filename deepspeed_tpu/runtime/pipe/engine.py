@@ -213,8 +213,11 @@ def spmd_pipeline_loss(embed_fn: Callable,
         return jax.tree.map(one, x)
 
     # initial buffers: embed of micro-batch 0 broadcast over the stage dim
+    # (shape prefill only — every slot is overwritten or feeds discarded
+    # warmup compute, so no rng: sampling with the ROOT key here would
+    # reuse the key its fold_in children consume)
     mb0 = mb_at(jnp.asarray(0, jnp.int32))
-    x0 = embed_fn(params, mb0, rng)
+    x0 = embed_fn(params, mb0, None)
     bufs = jnp.broadcast_to(x0[None], (S,) + x0.shape).astype(x0.dtype)
     carry0 = {k: jnp.broadcast_to(mb0[k][None], (S,) + mb0[k].shape) for k in carry_keys}
     bufs, carry0 = constrain(bufs), constrain(carry0)
@@ -260,7 +263,9 @@ def spmd_pipeline_loss(embed_fn: Callable,
     def tick(state, t):
         bufs, aux, loss_sum = state
         mb = mb_at(t)
-        x_in = embed_fn(params, mb, jax.random.fold_in(rng, t))
+        # T + t: disjoint from the tick_keys parents fold_in(rng, t) — the
+        # embed dropout draw must not consume a key the stages split
+        x_in = embed_fn(params, mb, jax.random.fold_in(rng, T + t))
         bufs = bufs.at[0].set(x_in.astype(bufs.dtype))
         for k in carry_keys:
             aux[k] = aux[k].at[0].set(mb[k])
@@ -373,9 +378,9 @@ def spmd_pipeline_1f1b(embed_fn: Callable,
     def with_stages(pns):
         return {**pns, "stages": stage_params}
 
-    # shapes
+    # shapes (no rng: value only prefills zero buffers)
     mb0 = mb_at(jnp.asarray(0, jnp.int32))
-    x0 = embed_fn(params, mb0, rng)
+    x0 = embed_fn(params, mb0, None)
 
     ring0 = constrain(jnp.zeros((S, R) + x0.shape, x0.dtype), batch_dim=2)
     outs0 = constrain(jnp.zeros((S,) + x0.shape, x0.dtype))
@@ -391,7 +396,9 @@ def spmd_pipeline_1f1b(embed_fn: Callable,
 
         # ---- forward wave: stage s processes micro-batch t - s ----
         mb = mb_at(t)
-        x_embed = embed_fn(params, mb, stage_key(0, t)).astype(prev_outs.dtype)
+        # stage index S+1: embed's dropout draw must be disjoint from stage
+        # 0's key (which run_layers splits) and the head's (S)
+        x_embed = embed_fn(params, mb, stage_key(S + 1, t)).astype(prev_outs.dtype)
         bufs_in = jnp.roll(prev_outs, 1, axis=0).at[0].set(x_embed)
         # aux travels with activations: stage s sees micro-batch t-s's aux
         aux_in = {k: jax.vmap(lambda s: mb_at(t - s)[k])(s_idx) for k in carry_keys}
@@ -450,7 +457,7 @@ def spmd_pipeline_1f1b(embed_fn: Callable,
 
         def embed_branch():
             _, vjp = jax.vjp(
-                lambda pns: embed_fn(with_stages(pns), mb_b0, stage_key(0, m_b0)),
+                lambda pns: embed_fn(with_stages(pns), mb_b0, stage_key(S + 1, m_b0)),
                 nonstage)
             (gp,) = vjp(dx[0])
             return jax.tree.map(lambda a: a.astype(jnp.float32), gp)

@@ -251,3 +251,30 @@ def test_bert_mlm_gather_budget_matches_full_head():
     assert err < 1e-4, err
     # the budget is reflected in the FLOPs accounting (honest MFU)
     assert gathered.flops_per_token() < full.flops_per_token()
+
+
+def test_bert_dropout_rng_gated():
+    """BertConfig.dropout (HF hidden_dropout_prob) applies on the
+    rng-threaded MLM loss only; rng=None equals the dropout-free model."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deepspeed_tpu.models.bert import BertConfig, BertModel
+
+    kw = dict(vocab_size=200, max_seq=32, n_layer=2, n_head=4, d_model=64,
+              d_ff=128, remat=False)
+    plain = BertModel(BertConfig(**kw), with_mlm_head=True)
+    dropped = BertModel(BertConfig(**kw, dropout=0.3), with_mlm_head=True)
+    params = plain.init_params(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 200, size=(4, 32)).astype(np.int32)
+    labels = np.full_like(ids, -100)
+    pos = rng.random((4, 32)) < 0.15
+    labels[pos] = ids[pos]
+    batch = {"input_ids": jnp.asarray(ids), "labels": jnp.asarray(labels)}
+
+    base = float(plain.loss(params, batch))
+    assert abs(float(dropped.loss(params, batch)) - base) < 1e-6
+    l1 = float(dropped.loss(params, batch, rng=jax.random.key(1)))
+    l1b = float(dropped.loss(params, batch, rng=jax.random.key(1)))
+    assert l1 == l1b and abs(l1 - base) > 1e-6
