@@ -16,8 +16,9 @@ framework exceeds the reference's best published hardware efficiency class.
 
 Env knobs (defaults are the chip-measured fast path):
   BENCH_STEPS=10           timed steps per window (best of two windows)
-  BENCH_GPT2/LLAMA=1       enable metric 1 / 2; BENCH_BERT=0 gates the
-                           bert-large MLM metric (flip after measuring)
+  BENCH_GPT2/LLAMA=1       enable metric 1 / 2; BENCH_BERT=1 enables the
+                           bert-large MLM metric (un-gated now that the
+                           fused CE kernel removes the head bottleneck)
   BENCH_BATCH=64 BENCH_SEQ=1024            gpt2 metric shape
   BENCH_LLAMA_BATCH=4 BENCH_LLAMA_SEQ=2048 llama metric shape
   BENCH_BERT_BATCH=32 BENCH_BERT_SEQ=512   bert metric shape (bs48+ OOMs)
@@ -27,8 +28,12 @@ Env knobs (defaults are the chip-measured fast path):
   BENCH_BERT_GATHER=0.25   MLM masked-position gather budget (fraction of
                            B*S routed through the vocab head; 0 = full)
   BENCH_REMAT=dots         1/true/full | 0/false/none | dots | selective...
-  BENCH_LOSS_CHUNK=2048    vocab-head streaming chunk (0 = off; the bert
-                           metric defaults to 4096, its measured best)
+  BENCH_FUSED_CE=auto      vocab-head CE path: auto = fused logits-free
+                           Pallas kernel on TPU, XLA loss_chunk streaming
+                           elsewhere | on | off
+  BENCH_LOSS_CHUNK=2048    vocab-head streaming chunk when the fused kernel
+                           is off/unavailable (0 = off; the bert metric
+                           defaults to 4096, its measured best)
   BENCH_ATTN=auto          auto | flash | xla
   BENCH_OPT=AdamW          AdamW | FusedAdam | ...
   BENCH_SCAN=0             gpt2 layer stacking (0 = unrolled, measured
@@ -37,6 +42,13 @@ Env knobs (defaults are the chip-measured fast path):
   BENCH_BLOCK_Q/K=0        flash kernel block override (0 = tuned default)
   BENCH_SKIP_PROBE=0       skip the subprocess backend probe
   BENCH_PROBE_RETRIES=1    probe retries before giving up on the backend
+  BENCH_ALLOW_CPU=0        on probe failure, run a tiny CPU smoke metric
+                           instead of just emitting the skip record
+
+A failed backend probe is NOT an error exit: the bench emits one parseable
+JSON skip record per enabled metric ({"metric": ..., "value": 0.0,
+"skipped": true, ...}) and exits 0, so the bench trajectory always has a
+machine-readable data point even on a TPU-less box.
 """
 
 import json
@@ -93,11 +105,12 @@ def build_bench_engine():
     remat_env = os.environ.get("BENCH_REMAT", "dots")
     REMAT = _parse_remat(remat_env)
     LOSS_CHUNK = int(os.environ.get("BENCH_LOSS_CHUNK", 2048))
+    FUSED_CE = os.environ.get("BENCH_FUSED_CE", "auto")
     ATTN = os.environ.get("BENCH_ATTN", "auto")
     SCAN = os.environ.get("BENCH_SCAN", "0") == "1"  # unrolled: XLA schedules
     # the 12 blocks better than a lax.scan (measured ~12% faster)
     model = gpt2("125m", remat=REMAT, loss_chunk=LOSS_CHUNK, attention_backend=ATTN,
-                 scan_layers=SCAN)
+                 scan_layers=SCAN, fused_cross_entropy=FUSED_CE)
     params = model.init_params(jax.random.key(0))
 
     dist.set_mesh(None)
@@ -121,7 +134,8 @@ def build_bench_engine():
 
     return engine, model, batch_fn, dict(BATCH=BATCH, SEQ=SEQ,
                                          remat_env=remat_env,
-                                         LOSS_CHUNK=LOSS_CHUNK)
+                                         LOSS_CHUNK=LOSS_CHUNK,
+                                         FUSED_CE=FUSED_CE)
 
 
 def build_llama_bench_engine():
@@ -147,6 +161,7 @@ def build_llama_bench_engine():
                   d_ff=4096, max_seq=SEQ,
                   remat=_parse_remat(os.environ.get("BENCH_REMAT", "dots")),
                   loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", 2048)),
+                  fused_cross_entropy=os.environ.get("BENCH_FUSED_CE", "auto"),
                   attention_backend=os.environ.get("BENCH_ATTN", "auto"),
                   scan_layers=os.environ.get("BENCH_LLAMA_SCAN", "0") == "1",
                   attn_block_q=blk_q, attn_block_k=blk_k)
@@ -177,8 +192,9 @@ def build_bert_bench_engine():
     """BERT-large MLM (the reference's headline fastest-BERT-training
     benchmark: 53 TFLOPS = >50% of V100 peak at seq 512,
     docs/_posts/2020-05-28-fastest-bert-training.md): 24L/1024d/16h,
-    seq 512, ZeRO-2, bf16. Off by default (BENCH_BERT=1 enables) until a
-    chip-measured configuration is recorded."""
+    seq 512, ZeRO-2, bf16. On by default (BENCH_BERT=0 gates it) now that
+    the fused logits-free CE kernel removes the vocab-head bottleneck the
+    metric was gated on."""
     import jax
     import numpy as np
 
@@ -196,6 +212,7 @@ def build_bert_bench_engine():
                                      "BENCH_BERT_REMAT",
                                      os.environ.get("BENCH_REMAT", "none"))),
                                  loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", 4096)),
+                                 fused_cross_entropy=os.environ.get("BENCH_FUSED_CE", "auto"),
                                  scan_layers=os.environ.get("BENCH_BERT_SCAN", "0") == "1",
                                  mlm_gather_budget=float(os.environ.get("BENCH_BERT_GATHER", "0.25"))),
                       with_mlm_head=True)
@@ -262,6 +279,93 @@ def _run_metric(name, engine, model, batch, BATCH, SEQ, steps, extra_unit):
     }), flush=True)
 
 
+# single registry: (env gate, default, metric name) — consumed by BOTH the
+# run loop in main() and the probe-failure skip records, so the two can
+# never drift apart on names or gate defaults
+BENCH_METRICS = [
+    ("BENCH_GPT2", "1", "gpt2_125m_train_tokens_per_sec_per_chip"),
+    ("BENCH_LLAMA", "1", "llama_gqa_500m_zero3_train_tokens_per_sec_per_chip"),
+    ("BENCH_BERT", "1", "bert_large_mlm_train_tokens_per_sec_per_chip"),
+]
+
+
+def _metric_enabled(env: str) -> bool:
+    default = next(d for e, d, _ in BENCH_METRICS if e == env)
+    return os.environ.get(env, default) != "0"
+
+
+def _metric_name(env: str) -> str:
+    return next(n for e, _, n in BENCH_METRICS if e == env)
+
+
+def _enabled_metrics():
+    return [name for env, _, name in BENCH_METRICS if _metric_enabled(env)]
+
+
+def _emit_skip_records(err: str):
+    """One parseable JSON record per enabled metric so the bench trajectory
+    is never empty: a dead TPU relay is a data point ("skipped"), not a
+    silent rc=1 hole the driver records as ``parsed: null``."""
+    reason = err.strip().splitlines()[0] if err else "backend probe failed"
+    for name in _enabled_metrics():
+        print(json.dumps({
+            "metric": name,
+            "value": 0.0,
+            "unit": f"tokens/s (skipped: {reason})",
+            "vs_baseline": 0.0,
+            "skipped": True,
+        }), flush=True)
+
+
+def _run_cpu_smoke(steps: int):
+    """BENCH_ALLOW_CPU=1 fallback when the device backend is down: a tiny
+    causal-LM config on the CPU backend. Not an MFU number (vs_baseline 0) —
+    it proves the train loop end-to-end and gives the round a real loss."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.models.transformer import TransformerConfig
+
+    BATCH, SEQ = 4, 64
+    model = CausalLM(TransformerConfig(vocab_size=512, n_layer=2, n_head=2,
+                                       d_model=64, max_seq=SEQ, remat=False,
+                                       attention_backend="xla"))
+    import jax
+    params = model.init_params(jax.random.key(0))
+    dist.set_mesh(None)
+    config = {
+        "train_micro_batch_size_per_gpu": BATCH,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"dp": 1},
+        "steps_per_print": 0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                               config=config)
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return {"input_ids": rng.integers(0, 512, size=(BATCH, SEQ)).astype(np.int32)}
+
+    float(engine.train_batch(batch()))  # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch())
+    loss_val = float(loss)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "cpu_smoke_train_tokens_per_sec",
+        "value": round(BATCH * SEQ * steps / dt, 1),
+        "unit": f"tokens/s (cpu fallback, bs{BATCH}xseq{SEQ}, tiny model, "
+                f"loss {loss_val:.3f}; NOT an MFU metric)",
+        "vs_baseline": 0.0,
+    }), flush=True)
+
+
 def main():
     if os.environ.get("BENCH_SKIP_PROBE") != "1":
         # one retry after a short pause: a relay mid-restart (ports up,
@@ -275,8 +379,19 @@ def main():
             retries -= 1
             err = _probe_backend()
         if err is not None:
+            # degrade gracefully: parseable skip records (and optionally a
+            # CPU smoke metric), rc=0 — never an empty bench round
             print(f"bench: {err}", file=sys.stderr)
-            sys.exit(1)
+            _emit_skip_records(err)
+            if os.environ.get("BENCH_ALLOW_CPU") == "1":
+                # best effort only: the skip records above are already the
+                # round's parseable data points, so a broken CPU fallback
+                # must not turn this back into an rc!=0 empty round
+                try:
+                    _run_cpu_smoke(max(1, int(os.environ.get("BENCH_STEPS", 10)) // 5))
+                except Exception as e:  # noqa: BLE001 - never fail the round
+                    print(f"bench: cpu smoke fallback failed: {e}", file=sys.stderr)
+            sys.exit(0)
     import jax
 
     STEPS = int(os.environ.get("BENCH_STEPS", 10))
@@ -284,35 +399,36 @@ def main():
         print("bench: BENCH_STEPS must be >= 1", file=sys.stderr)
         sys.exit(1)
     engine = None
-    if os.environ.get("BENCH_GPT2", "1") != "0":
+    if _metric_enabled("BENCH_GPT2"):
         engine, model, batch, knobs = build_bench_engine()
         # warmup/compile inside _run_metric; float() forces a host fetch —
         # the only reliable sync point over remote-tunnel device transports
         # (block_until_ready/effects_barrier return before remote execution
         # finishes)
-        _run_metric("gpt2_125m_train_tokens_per_sec_per_chip", engine, model,
+        _run_metric(_metric_name("BENCH_GPT2"), engine, model,
                     batch, knobs["BATCH"], knobs["SEQ"], STEPS,
                     f"ZeRO-1, remat={knobs['remat_env']}, "
+                    f"fused_ce={knobs['FUSED_CE']}, "
                     f"loss_chunk={knobs['LOSS_CHUNK']}")
 
-    if os.environ.get("BENCH_LLAMA", "1") != "0":
+    if _metric_enabled("BENCH_LLAMA"):
         # free the first engine's device state before the larger model lands
         if engine is not None:
             del engine, model, batch
         import gc
         gc.collect()
         engine, model, batch, knobs = build_llama_bench_engine()
-        _run_metric("llama_gqa_500m_zero3_train_tokens_per_sec_per_chip",
+        _run_metric(_metric_name("BENCH_LLAMA"),
                     engine, model, batch, knobs["BATCH"], knobs["SEQ"],
                     STEPS, "GQA 12q/4kv hd128, ZeRO-3, remat=dots")
 
-    if os.environ.get("BENCH_BERT", "0") == "1":
+    if _metric_enabled("BENCH_BERT"):
         if engine is not None:
             del engine, model, batch
         import gc
         gc.collect()
         engine, model, batch, knobs = build_bert_bench_engine()
-        _run_metric("bert_large_mlm_train_tokens_per_sec_per_chip",
+        _run_metric(_metric_name("BENCH_BERT"),
                     engine, model, batch, knobs["BATCH"], knobs["SEQ"],
                     STEPS, "MLM, ZeRO-2")
 

@@ -258,6 +258,17 @@ class Autotuner:
             else list(self.config.remat_policies)
         chunks = [0] if self.config.fast or not self._tunable_model \
             else list(self.config.loss_chunks)
+        if len(chunks) > 1:
+            # loss_chunk only matters on the XLA streaming path: with the
+            # fused Pallas CE kernel FORCED on, the chunk values produce
+            # byte-identical programs and the axis would silently multiply
+            # the grid (see config.py tuner_num_trials note) for
+            # meaningless candidates. "auto" keeps the axis: whether the
+            # kernel engages there depends on the TRIAL's mesh/backend
+            # (each trial builds its own mesh), which plan time cannot see.
+            mcfg = getattr(self.model, "config", None)
+            if getattr(mcfg, "fused_cross_entropy", None) == "on":
+                chunks = [0]
         scans = [None] if self.config.fast or not self._tunable_model \
             or not hasattr(getattr(self.model, "config", None), "scan_layers") \
             else list(self.config.scan_layers_options)

@@ -34,6 +34,12 @@ class AutotuningConfig(ConfigModel):
     # model_based: how many spread-out survivors seed the cost model before
     # prediction starts steering the measure order
     tuner_num_seed_trials: int = Field(3, ge=1)
+    # trial cap for random/model_based tuners. gridsearch deliberately
+    # IGNORES it (a stage-major cut would drop whole ZeRO stages) and
+    # measures the full cross product zero_stages × micro-batches ×
+    # remat_policies × loss_chunks × scan_layers_options × attn_blocks —
+    # every extra option in any axis MULTIPLIES wall-time, so widen one
+    # axis at a time (early stopping only bounds the tail, not the grid)
     tuner_num_trials: int = Field(50, ge=1)
     tuner_early_stopping: int = Field(5, ge=1)
     results_dir: str = "autotuning_results"
@@ -49,10 +55,12 @@ class AutotuningConfig(ConfigModel):
     zero_stages: List[int] = [1, 2, 3]
     remat_policies: List[str] = ["none", "dots", "selective", "full"]
     loss_chunks: List[int] = [0, 2048]
-    # layer-stacking search: None keeps the model's setting out of the grid;
-    # chip measurements show unrolled (False) beats the scan by ~12% on every
-    # bench config, so both options are searched by default
-    scan_layers_options: List = [True, False]
+    # layer-stacking search: the default [None] keeps the model's setting
+    # out of the grid — searching it DOUBLES every gridsearch (see
+    # tuner_num_trials above), which silently doubled wall-time for every
+    # tunable model when [True, False] was the default. Opt in with
+    # [True, False] to re-discover the chip-measured ~12% unrolled win.
+    scan_layers_options: List = [None]
     # flash-attention block override candidates (0 = the kernel's default);
     # e.g. [0, 512, 1024] re-discovers the measured 1024-block win at S=2048
     attn_blocks: List[int] = [0]

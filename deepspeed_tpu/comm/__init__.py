@@ -15,7 +15,9 @@ from deepspeed_tpu.comm.comm import (ReduceOp, all_gather, all_gather_into_tenso
                                      irecv, is_available, is_initialized, isend, log_summary, monitored_barrier,
                                      new_group, recv, reduce, reduce_scatter, reduce_scatter_tensor, ring_send_recv,
                                      scatter, send, set_mesh)
-from deepspeed_tpu.comm.mesh import axis_size, build_hybrid_mesh, build_mesh, data_parallel_axes
+from deepspeed_tpu.comm.mesh import (axis_size, bound_axis_size,
+                                     build_hybrid_mesh, build_mesh,
+                                     data_parallel_axes)
 
 __all__ = [
     "ReduceOp", "all_gather", "all_gather_into_tensor", "all_reduce", "all_to_all_single", "axis_index", "barrier",
@@ -23,5 +25,5 @@ __all__ = [
     "get_mesh", "get_rank", "get_world_group", "get_world_size", "has_mesh", "inference_all_reduce",
     "init_distributed", "init_mesh", "irecv", "is_available", "is_initialized", "isend", "log_summary",
     "monitored_barrier", "new_group", "recv", "reduce", "reduce_scatter", "reduce_scatter_tensor", "ring_send_recv",
-    "scatter", "send", "set_mesh", "axis_size", "build_hybrid_mesh", "build_mesh", "data_parallel_axes",
+    "scatter", "send", "set_mesh", "axis_size", "bound_axis_size", "build_hybrid_mesh", "build_mesh", "data_parallel_axes",
 ]

@@ -230,6 +230,8 @@ def _eager_collective(x, axes: tuple, body: Callable, key=None, in_spec=None, ou
     import jax
     from jax.sharding import PartitionSpec as P
 
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
     mesh = get_mesh()
     cache_key = (mesh, key, axes, x.shape, str(x.dtype)) if key is not None else None
     fn = _eager_cache.get(cache_key)
@@ -237,7 +239,7 @@ def _eager_collective(x, axes: tuple, body: Callable, key=None, in_spec=None, ou
         spec_in = in_spec if in_spec is not None else P(axes if len(axes) > 1 else axes[0])
         spec_out = out_spec if out_spec is not None else spec_in
         fn = jax.jit(
-            jax.shard_map(body, mesh=mesh, in_specs=(spec_in,), out_specs=spec_out, check_vma=False))
+            shard_map(body, mesh=mesh, in_specs=(spec_in,), out_specs=spec_out, check_vma=False))
         if cache_key is not None:
             if len(_eager_cache) > 512:
                 _eager_cache.clear()

@@ -17,6 +17,7 @@ from __future__ import annotations
 import math
 from typing import Dict, List, Optional, Sequence
 
+import jax
 import numpy as np
 from jax.sharding import Mesh
 
@@ -99,6 +100,16 @@ def build_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int]) -> Mes
     mesh_devices = mesh_utils.create_hybrid_device_mesh(
         ici_shape, dcn_shape, devices=jax.devices())
     return Mesh(mesh_devices, tuple(names))
+
+
+def bound_axis_size(name) -> int:
+    """Size of a manual/collective axis bound in the CURRENT trace (a
+    shard_map/pmap body). ``jax.lax.axis_size`` where the installed jax has
+    it; on older versions (e.g. 0.4.x) the classic psum-of-1 idiom, which
+    jax constant-folds to the axis size."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(name)
+    return jax.lax.psum(1, name)
 
 
 def axis_size(mesh: Mesh, axis) -> int:

@@ -206,3 +206,10 @@ NUM_GPUS_PER_NODE_DEFAULT = 1
 #############################################
 MESH = "mesh"
 MESH_AXES_DEFAULT = {"dp": -1}
+
+#############################################
+# Vocab-head loss kernel (TPU-native extension): overrides the model
+# config's fused_cross_entropy ("auto"|"on"|"off") when set
+#############################################
+FUSED_CROSS_ENTROPY = "fused_cross_entropy"
+FUSED_CROSS_ENTROPY_DEFAULT = None

@@ -246,6 +246,16 @@ class DeepSpeedConfig:
         # TPU-native mesh axes: {"dp": -1} means "all remaining devices on dp"
         self.mesh_axes: Dict[str, int] = dict(param_dict.get(C.MESH, C.MESH_AXES_DEFAULT))
 
+        # Vocab-head loss kernel override: None leaves the model config's
+        # fused_cross_entropy alone; "auto"/"on"/"off" is pushed into the
+        # client model by the engine (runtime/engine.py)
+        self.fused_cross_entropy = get_scalar_param(param_dict, C.FUSED_CROSS_ENTROPY,
+                                                    C.FUSED_CROSS_ENTROPY_DEFAULT)
+        if self.fused_cross_entropy not in (None, "auto", "on", "off"):
+            raise DeepSpeedConfigError(
+                f"fused_cross_entropy={self.fused_cross_entropy!r} "
+                "(expected 'auto', 'on' or 'off')")
+
         # Sparse attention section (structure configs parsed by ops.sparse_attention)
         self.sparse_attention = param_dict.get(C.SPARSE_ATTENTION, None)
 

@@ -15,9 +15,26 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deepspeed_tpu.models import transformer as T
 from deepspeed_tpu.utils.init_on_device import honors_on_device
+
+
+def _check_gather_budget(n_masked, k, budget):
+    """Host-side (async debug.callback) overflow check for the MLM gather:
+    masked positions beyond the budget are dropped from the loss, which
+    silently biases training — warn once with the sizing fix. The message is
+    built from the STATIC config values only (warn_once dedupes by exact
+    string; a per-batch count would fire every step and grow its cache)."""
+    if int(n_masked) > int(k):
+        from deepspeed_tpu.utils.logging import warn_once
+        warn_once(
+            f"mlm_gather_budget={float(budget):g} gathers {int(k)} positions "
+            "but batches are realising MORE masked labels than that; the "
+            "overflow is DROPPED from the MLM loss (biased gradient). Raise "
+            "the budget — recommended headroom is >= 1.5x the masking rate "
+            "(e.g. 0.25 for 15% masking).")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -33,10 +50,14 @@ class BertConfig:
     activation: str = "gelu_exact"   # HF 'gelu' (erf); distilbert may use relu
     # training memory/speed knobs (models/transformer.py semantics);
     # loss_chunk streams the MLM vocab head over token chunks so the
-    # [B, S, vocab] fp32 logits are never materialised (0 = unchunked)
+    # [B, S, vocab] fp32 logits are never materialised (0 = unchunked);
+    # fused_cross_entropy ("auto"|"on"|"off") instead routes the head through
+    # the fused logits-free Pallas CE kernel (supersedes loss_chunk wherever
+    # it engages — see models/transformer.py vocab_head_ce)
     remat: Any = True
     attention_backend: str = "auto"
     loss_chunk: int = 0
+    fused_cross_entropy: str = "auto"
     # HF hidden_dropout_prob equivalent (embedding sum + residual-branch
     # outputs via the shared backbone); applied only on the rng-threaded
     # training loss — inference/eval stay deterministic
@@ -50,9 +71,12 @@ class BertConfig:
     # head costs budget x instead of 1.0 x of its FLOPs (the head is ~9% of
     # BERT-large training FLOPs at 15% masking). Loss is numerically the
     # same CE over the same masked set as long as the actual masked count
-    # stays within the budget; masked positions beyond it are dropped from
-    # the loss (pick a budget comfortably above the masking rate). 0 = off
-    # (every position goes through the head, reference semantics).
+    # stays within the budget; masked positions beyond it are SILENTLY
+    # dropped from the loss (the loss path warns once at runtime when that
+    # happens). Binomial masking fluctuates around its rate, so leave
+    # headroom: budget >= 1.5x the masking rate (0.25 for the standard 15%)
+    # keeps the overflow probability negligible at bench batch sizes.
+    # 0 = off (every position goes through the head, reference semantics).
     mlm_gather_budget: float = 0.0
 
     def zoo(self) -> T.TransformerConfig:
@@ -63,7 +87,9 @@ class BertConfig:
             norm_position="post", activation=self.activation, causal=False,
             attn_bias=True, norm_eps=self.norm_eps, tie_embeddings=True,
             remat=self.remat, attention_backend=self.attention_backend,
-            scan_layers=self.scan_layers, dropout=self.dropout)
+            scan_layers=self.scan_layers, dropout=self.dropout,
+            loss_chunk=self.loss_chunk,
+            fused_cross_entropy=self.fused_cross_entropy)
 
 
 class BertModel:
@@ -195,21 +221,26 @@ class BertModel:
             k = max(1, int(round(min(budget, 1.0) * B * S)))
             k = -(-k // 128) * 128 if k >= 128 else k  # lane-aligned gather
             flat_v = valid.reshape(-1)
+            # masked positions beyond the budget silently bias the loss —
+            # surface it (once) instead; recommended headroom: budget >=
+            # 1.5x the masking rate (see BertConfig.mlm_gather_budget)
+            jax.debug.callback(_check_gather_budget, jnp.sum(flat_v),
+                               np.int64(k), np.float64(budget))
             idx = jnp.argsort(~flat_v, stable=True)[:k]
             h = self._mlm_transform(params, x.reshape(B * S, D)[idx][None])
-            # chunked_vocab_ce falls back to the unchunked form itself
-            # when loss_chunk doesn't divide the gathered length
-            return T.chunked_vocab_ce(
-                h, params["embed"]["tokens"].T,
+            # the dispatch (fused Pallas CE / chunked XLA) handles the
+            # gathered length's ragged tile shapes itself
+            return T.vocab_head_ce(
+                self.config, h, params["embed"]["tokens"].T,
                 params["mlm"]["decoder_bias"], safe.reshape(-1)[idx][None],
-                flat_v[idx][None], self.config.loss_chunk)
+                flat_v[idx][None])
 
         h = self._mlm_transform(params, x)
-        # the CausalLM chunked-CE machinery on the MLM head: with
-        # cfg.loss_chunk the [B, S, vocab] fp32 logits never materialise
-        return T.chunked_vocab_ce(h, params["embed"]["tokens"].T,
-                                  params["mlm"]["decoder_bias"], safe, valid,
-                                  self.config.loss_chunk)
+        # the CausalLM vocab-head machinery on the MLM head: the fused
+        # Pallas CE (or cfg.loss_chunk streaming) never materialises the
+        # [B, S, vocab] fp32 logits
+        return T.vocab_head_ce(self.config, h, params["embed"]["tokens"].T,
+                               params["mlm"]["decoder_bias"], safe, valid)
 
     def _mlm_transform(self, params, x):
         """HF BertPredictionHeadTransform: dense + config.hidden_act + LN

@@ -40,7 +40,10 @@ class CausalLM:
     def loss(self, params, batch, rng=None):
         """Training loss; ``rng`` (threaded by the engine's train path)
         enables cfg.dropout — eval/inference paths pass None and stay
-        deterministic."""
+        deterministic. The vocab head dispatches per
+        ``cfg.fused_cross_entropy``: the fused logits-free Pallas CE kernel
+        by default on TPU, the ``cfg.loss_chunk`` XLA streaming path
+        elsewhere (transformer.py ``vocab_head_ce``)."""
         return T.lm_loss(self.config, params, batch, rng=rng)
 
     def tp_specs(self) -> Dict[str, Any]:

@@ -84,8 +84,14 @@ class TransformerConfig:
     # block-sparse flash kernel; elsewhere the exact dense token-bias form.
     sparse_attention: Optional[Any] = None
     # cross-entropy in sequence chunks of this many tokens: never
-    # materialises the full [B, S, vocab] logits (0 = unchunked)
+    # materialises the full [B, S, vocab] logits (0 = unchunked). Only
+    # consulted when the fused CE kernel below is off / unavailable.
     loss_chunk: int = 0
+    # vocab-head loss kernel: "auto" = the fused logits-free Pallas
+    # cross-entropy kernel (ops/pallas/fused_cross_entropy) on TPU, the XLA
+    # loss_chunk streaming path elsewhere; "on" forces the kernel (interpret
+    # mode off-TPU — the CPU test tier); "off" keeps the XLA path
+    fused_cross_entropy: str = "auto"
     # attention logit scale; None = head_dim**-0.5. GPT-Neo-family models
     # use UNSCALED attention (1.0)
     attn_scale: Optional[float] = None
@@ -362,7 +368,8 @@ def attention(cfg: TransformerConfig, x, lp, positions, mask_bias):
     B, S, D = x.shape
     H, KV, Hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
     if cfg.manual_tp:
-        tp = jax.lax.axis_size(cfg.manual_tp)
+        from deepspeed_tpu.comm import bound_axis_size
+        tp = bound_axis_size(cfg.manual_tp)
         H //= tp
         KV //= tp
         x = _mtp_in(x, cfg.manual_tp)
@@ -530,23 +537,34 @@ def _inside_full_manual(mesh) -> bool:
     for name, size in mesh.shape.items():
         if size > 1:
             try:
-                jax.lax.axis_size(name)
+                # probe only: axis_index raises NameError iff the axis is
+                # not bound in the current trace (works on every jax
+                # version; lax.axis_size does not exist on older ones)
+                jax.lax.axis_index(name)
             except NameError:
                 return False
     return True
 
 
+def _bare_pallas_legal() -> bool:
+    """Whether a bare (unwrapped) ``pallas_call`` is legal here: single-device
+    meshes, or a fully-manual shard_map context (every partitioned mesh axis
+    already local, e.g. the pipeline engine's stage bodies). Elsewhere XLA's
+    SPMD partitioner would have to partition the call, which it cannot —
+    the single invariant behind both the flash-attention and fused-CE
+    dispatches."""
+    import deepspeed_tpu.comm as dist
+    return not (dist.has_mesh() and dist.get_mesh().devices.size > 1
+                and not _inside_full_manual(dist.get_mesh()))
+
+
 def _use_flash(cfg: TransformerConfig) -> bool:
-    """Direct (unwrapped) Pallas flash attention: single-device meshes, or a
-    fully-manual shard_map context (every partitioned mesh axis already
-    local, e.g. the pipeline engine's stage bodies) — a bare pallas_call is
-    not partitionable by XLA. Other multi-device meshes go through
+    """Direct (unwrapped) Pallas flash attention where a bare pallas_call is
+    legal (:func:`_bare_pallas_legal`). Other multi-device meshes go through
     :func:`_flash_sharded` (shard_map over batch/head axes) instead."""
     if cfg.attention_backend not in ("flash", "auto"):
         return False
-    import deepspeed_tpu.comm as dist
-    if dist.has_mesh() and dist.get_mesh().devices.size > 1 \
-            and not _inside_full_manual(dist.get_mesh()):
+    if not _bare_pallas_legal():
         return False
     if cfg.attention_backend == "flash":
         return True
@@ -577,8 +595,9 @@ def _flash_mesh(cfg: TransformerConfig):
         if size > 1:
             # already inside a shard_map/pmap over this axis (e.g. the 1-bit
             # optimizer step)? a nested shard_map is illegal — use einsum
+            # (axis_index as the bound-axis probe, see _inside_full_manual)
             try:
-                jax.lax.axis_size(name)
+                jax.lax.axis_index(name)
                 return None
             except NameError:
                 pass
@@ -609,7 +628,7 @@ def _flash_sharded(cfg: TransformerConfig, q, k, v, mask_bias, slopes, mesh,
     ``block_layout`` [H, nb, nb] rides the head axis, so block-SPARSE
     attention keeps the kernel on multi-chip meshes too.
     Returns None when the shard sizes don't divide (caller falls back)."""
-    from jax import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
 
     B, S, H, Hd = q.shape
     KV = k.shape[2]
@@ -670,7 +689,7 @@ def _decode_sharded(q1, ck, cv, pos, pad_bias, slopes, mesh, scale=None):
     O(B·H·Smax) einsum with a repeated GQA cache.
     Returns None when shard sizes don't divide or the per-shard shape is
     outside the kernel envelope (caller falls back)."""
-    from jax import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
 
     B, H, Hd = q1.shape
     Smax, KV = ck.shape[1], ck.shape[2]
@@ -1089,15 +1108,49 @@ def chunked_vocab_ce(h, w, hb, safe_labels, valid, chunk: int):
     return nll / jnp.maximum(n, 1)
 
 
+def _use_fused_ce(cfg) -> bool:
+    """Whether the vocab head should run the fused logits-free Pallas CE
+    kernel. ``cfg`` is any config carrying ``fused_cross_entropy`` (the zoo's
+    TransformerConfig or BertConfig). "auto" mirrors the flash-attention
+    dispatch: TPU only, and only where a bare ``pallas_call`` is legal —
+    single-device meshes or a fully-manual shard_map context; multi-device
+    SPMD land falls back to the partitionable XLA streaming path."""
+    mode = getattr(cfg, "fused_cross_entropy", "auto")
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    if mode != "auto":
+        raise ValueError(f"fused_cross_entropy={mode!r} (expected "
+                         "'auto', 'on' or 'off')")
+    return jax.default_backend() == "tpu" and _bare_pallas_legal()
+
+
+def vocab_head_ce(cfg, h, w, hb, safe_labels, valid):
+    """Mean token CE for a vocab head ``h @ w + hb`` — the single dispatch
+    every zoo loss head goes through. With ``cfg.fused_cross_entropy``
+    selecting the kernel (see :func:`_use_fused_ce`), the fused logits-free
+    Pallas CE runs the projection + loss without ever materialising the
+    [tokens, vocab] logits in ANY precision; otherwise the XLA
+    :func:`chunked_vocab_ce` streaming path (``cfg.loss_chunk``) applies."""
+    if _use_fused_ce(cfg):
+        from deepspeed_tpu.ops.pallas.fused_cross_entropy import (
+            fused_cross_entropy)
+        bias = None if isinstance(hb, (int, float)) else hb
+        return fused_cross_entropy(h, w, safe_labels, bias=bias, valid=valid)
+    return chunked_vocab_ce(h, w, hb, safe_labels, valid,
+                            getattr(cfg, "loss_chunk", 0))
+
+
 def lm_loss(cfg: TransformerConfig, params, batch, rng=None,
             ignore_index: int = -100):
     """Next-token cross-entropy. batch: dict(input_ids[B,S], optional
     labels[B,S], optional attention_mask[B,S]).
 
-    With ``cfg.loss_chunk > 0`` the vocab projection + CE run over sequence
-    chunks inside a rematerialised scan, so the [B, S, vocab] logits are
-    never materialised in fp32 — the TPU analogue of the reference's fused
-    softmax-xent kernels (HBM traffic O(B·S·D) instead of O(B·S·V))."""
+    The vocab head goes through :func:`vocab_head_ce`: by default the fused
+    logits-free Pallas CE kernel on TPU (the analogue of the reference's
+    fused softmax-xent kernels — HBM traffic O(B·S·D) instead of O(B·S·V)),
+    else the ``cfg.loss_chunk`` XLA streaming scan."""
     tokens = batch["input_ids"]
     labels = batch.get("labels")
     if labels is None:
@@ -1110,4 +1163,4 @@ def lm_loss(cfg: TransformerConfig, params, batch, rng=None,
     safe_labels = jnp.where(valid, labels, 0)
 
     hb = _head_bias(params)
-    return chunked_vocab_ce(x, w, hb, safe_labels, valid, cfg.loss_chunk)
+    return vocab_head_ce(cfg, x, w, hb, safe_labels, valid)

@@ -2,14 +2,17 @@
 
 TPU-native replacements for the reference's CUDA kernel families
 (SURVEY.md §2.2): attention/softmax (``csrc/transformer/softmax_kernels.cu``,
-inference ``softmax_context``) → :mod:`flash_attention`; quantization with
-stochastic rounding (``csrc/quantization/``) → :mod:`quantization`; fused
-optimizer step (``csrc/adam/multi_tensor_adam.cu``) → :mod:`fused_adam`.
+inference ``softmax_context``) → :mod:`flash_attention`; the vocab head's
+fused softmax-xent (``csrc/transformer/inference`` fused logits) →
+:mod:`fused_cross_entropy`; quantization with stochastic rounding
+(``csrc/quantization/``) → :mod:`quantization`; fused optimizer step
+(``csrc/adam/multi_tensor_adam.cu``) → :mod:`fused_adam`.
 
 Every kernel runs compiled on TPU and in interpreter mode on CPU (that is
 what the unit suite exercises); the wrappers pick automatically.
 """
 
 from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+from deepspeed_tpu.ops.pallas.fused_cross_entropy import fused_cross_entropy
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "fused_cross_entropy"]

@@ -65,7 +65,8 @@ def compressed_allreduce(tensor, worker_error, server_error, axis: str = "dp"):
     error-feedback states ([numel] and [numel / n]). Returns (averaged
     tensor, new_worker_error, new_server_error).
     """
-    n = jax.lax.axis_size(axis)
+    from deepspeed_tpu.comm import bound_axis_size
+    n = bound_axis_size(axis)
     numel = tensor.shape[0]
     if numel % (8 * n) != 0:
         raise ValueError(f"compressed_allreduce needs numel ({numel}) divisible by "

@@ -120,6 +120,19 @@ class DeepSpeedEngine:
         # ---- config ----
         self._config = config_class or DeepSpeedConfig(config, mpu=mpu, mesh=mesh)
         dist.configure(self._config)
+        # vocab-head kernel override: a JSON-level "fused_cross_entropy"
+        # knob beats the model config's default (the same engine-pushes-into-
+        # model pattern the autotuner's model_overrides use), so bench/serve
+        # configs can flip the CE path without rebuilding the model
+        fce = self._config.fused_cross_entropy
+        mcfg = getattr(model, "config", None)
+        if fce is not None and mcfg is not None \
+                and hasattr(mcfg, "fused_cross_entropy"):
+            import dataclasses
+            model.config = dataclasses.replace(mcfg, fused_cross_entropy=fce)
+            if hasattr(model, "zoo_cfg"):
+                # BertModel caches a derived zoo config; keep it coherent
+                model.zoo_cfg = model.config.zoo()
         self.zero_rules = ZeroShardingRules(mesh, self._config.zero_config)
         log_dist(self.zero_rules.describe(), ranks=[0])
 
@@ -629,7 +642,7 @@ class DeepSpeedEngine:
     def _build_onebit_batch_fn(self, gas: int) -> Callable:
         """Whole step inside shard_map over dp: per-rank LOCAL grads feed the
         1-bit optimizer, which performs the (compressed) communication."""
-        from jax import shard_map
+        from deepspeed_tpu.utils.jax_compat import shard_map
 
         opt = self._onebit
         axis = self._onebit_axis

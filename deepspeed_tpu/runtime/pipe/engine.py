@@ -107,7 +107,7 @@ def _stage_map_builder(stage_fn, mesh, num_stages: int, batch_size: int,
         return (jax.vmap(stage_fn, in_axes=(0, 0, 0, 0)),
                 jax.vmap(stage_bwd_one, in_axes=(0, 0, 0, 0, 0, 0)), False)
 
-    from jax import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
 
     dp = dp_axes if len(dp_axes) != 1 else dp_axes[0]
     pspec = P("pp")                      # keys / valid flags
@@ -277,10 +277,15 @@ def spmd_pipeline_loss(embed_fn: Callable,
         # last stage completes micro-batch t - (S-1); the head (a full vocab
         # matmul) only runs on ticks where one actually exits
         mb_done = mb_at(t - (S - 1))
+        # 2*T + t: the head's dropout draw gets its own disjoint range —
+        # stage parents use fold_in(rng, t) ∈ [0, T) and the embed draw
+        # fold_in(rng, T + t) ∈ [T, 2T), so t + T here would REUSE the same
+        # tick's embed key (mirrors 1F1B's stage-key separation, where the
+        # head is stage index S and embed S + 1)
         loss_t = jax.lax.cond(
             t >= S - 1,
             lambda: head_loss_fn(params, outs[S - 1], mb_done,
-                                 jax.random.fold_in(rng, t + T)).astype(jnp.float32),
+                                 jax.random.fold_in(rng, 2 * T + t)).astype(jnp.float32),
             lambda: jnp.float32(0.0))
         loss_sum = loss_sum + loss_t
 

@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.utils.jax_compat import shard_map
+
 
 @functools.lru_cache(maxsize=128)
 def _cached_program(local_fn: Callable, mesh, axis: str, causal: bool, has_mask: bool,
@@ -39,7 +41,7 @@ def _cached_program(local_fn: Callable, mesh, axis: str, causal: bool, has_mask:
         return local_fn(qq, kk, vv, axis=axis, causal=causal, mask_bias=mb,
                         alibi_slopes=slopes, scale=scale)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs), out_specs=qkv_spec,
+    fn = shard_map(body, mesh=mesh, in_specs=tuple(in_specs), out_specs=qkv_spec,
                        axis_names={axis}, check_vma=False)
     # partial-auto shard_map must run under jit; nested jit inlines when traced
     return jax.jit(fn)

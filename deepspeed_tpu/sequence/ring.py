@@ -57,7 +57,8 @@ def ring_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bias=N
     """
     B, Sq, H, Hd = q.shape
     Sk = k.shape[1]
-    sp = jax.lax.axis_size(axis)
+    from deepspeed_tpu.comm import bound_axis_size
+    sp = bound_axis_size(axis)
     my_block = jax.lax.axis_index(axis)
 
     perm = [(i, (i + 1) % sp) for i in range(sp)]

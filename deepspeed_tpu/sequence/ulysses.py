@@ -53,7 +53,8 @@ def ulysses_attention_local(q, k, v, *, axis: str, causal: bool = True, mask_bia
     mask_bias local [B, Sk_loc] additive. H must be divisible by the axis
     size.
     """
-    sp = jax.lax.axis_size(axis)
+    from deepspeed_tpu.comm import bound_axis_size
+    sp = bound_axis_size(axis)
     H, KV = q.shape[2], k.shape[2]
     if H % sp != 0:
         raise ValueError(f"Ulysses SP needs heads ({H}) divisible by sp axis size ({sp})")

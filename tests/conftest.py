@@ -20,12 +20,16 @@ if os.environ.get("DS_TPU_TESTS") != "1":
     # the TPU tier (pytest -m tpu, DS_TPU_TESTS=1) keeps the real device
     jax.config.update("jax_platforms", "cpu")
 
-# Persistent XLA compilation cache: the suite compiles hundreds of
-# near-identical tiny programs; caching them across runs cuts repeat
-# wall-clock several-fold on this single-core box (first run pays full
-# compile cost). DS_TEST_NO_JAX_CACHE=1 opts out (e.g. when bisecting
-# lowering changes).
-if os.environ.get("DS_TEST_NO_JAX_CACHE") != "1":
+# Persistent XLA compilation cache — OPT-IN via DS_TEST_JAX_CACHE=1. It
+# used to be on by default (cuts repeat wall-clock several-fold), but on
+# this box's jaxlib RELOADING cached engine executables intermittently
+# aborts/segfaults the whole pytest process mid-suite (native crash inside
+# compiled train_batch on deserialized executables — observed killing runs
+# at ops/test_fused_optimizers and test_engine; cold compiles of the same
+# programs pass). A deterministic slow suite beats a fast one that dies at
+# a random test, so the cache is off unless explicitly requested.
+if os.environ.get("DS_TEST_JAX_CACHE") == "1" \
+        and os.environ.get("DS_TEST_NO_JAX_CACHE") != "1":
     _cache_dir = os.environ.get(
         "DS_TEST_JAX_CACHE_DIR",
         os.path.join(os.path.dirname(__file__), "..", ".jax_test_cache"))

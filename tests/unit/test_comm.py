@@ -6,6 +6,7 @@ semantics and traced shard_map semantics are both covered.
 """
 
 import jax
+from deepspeed_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -97,7 +98,7 @@ class TestTracedCollectives:
         def body(x):
             return dist.all_reduce(x, group="tp")
 
-        f = jax.jit(jax.shard_map(body, mesh=mesh_2d, in_specs=P("dp", "tp"), out_specs=P("dp", "tp")))
+        f = jax.jit(shard_map(body, mesh=mesh_2d, in_specs=P("dp", "tp"), out_specs=P("dp", "tp")))
         x = jnp.ones((4, 2))
         y = f(x)
         np.testing.assert_allclose(np.asarray(y), np.full((4, 2), 2.0))
@@ -107,7 +108,7 @@ class TestTracedCollectives:
             return dist.all_gather(x, group="dp", axis=0)
 
         f = jax.jit(
-            jax.shard_map(body, mesh=mesh_2d, in_specs=P("dp", None), out_specs=P(None, None), check_vma=False))
+            shard_map(body, mesh=mesh_2d, in_specs=P("dp", None), out_specs=P(None, None), check_vma=False))
         x = jnp.arange(8.0).reshape(4, 2)
         y = f(x)
         np.testing.assert_allclose(np.asarray(y), np.asarray(x))
@@ -116,7 +117,7 @@ class TestTracedCollectives:
         def body(x):
             return dist.reduce_scatter(x, group="dp", axis=0)
 
-        f = jax.jit(jax.shard_map(body, mesh=mesh_2d, in_specs=P(None, None), out_specs=P("dp", None)))
+        f = jax.jit(shard_map(body, mesh=mesh_2d, in_specs=P(None, None), out_specs=P("dp", None)))
         x = jnp.ones((4, 2))
         y = f(x)
         np.testing.assert_allclose(np.asarray(y), np.full((4, 2), 4.0))
@@ -191,7 +192,7 @@ class TestReferenceSurfaceParity:
         def body(t):
             return dist.scatter(t, group="dp")
 
-        out = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(P(),),
+        out = jax.jit(shard_map(body, mesh=mesh, in_specs=(P(),),
                                     out_specs=P("dp"), check_vma=False))(x)
         np.testing.assert_array_equal(np.asarray(out), np.arange(8.0))
 

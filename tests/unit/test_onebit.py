@@ -1,6 +1,7 @@
 """1-bit optimizer + compressed collective tests (reference tests/onebit/)."""
 
 import jax
+from deepspeed_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -16,7 +17,7 @@ def dp_mesh(devices):
 
 
 def _smap(mesh, fn, in_specs, out_specs):
-    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                                  check_vma=False))
 
 
@@ -253,13 +254,13 @@ class TestOnebitEngine:
         x = jnp.zeros((numel,), jnp.float32)
 
         def compressed(x):
-            return jax.shard_map(
+            return shard_map(
                 lambda t: compressed_allreduce(t, jnp.zeros((numel,)),
                                                jnp.zeros((numel // n,)), "dp")[0],
                 mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False)(x)
 
         def dense(x):
-            return jax.shard_map(lambda t: jax.lax.psum(t, "dp"),
+            return shard_map(lambda t: jax.lax.psum(t, "dp"),
                                  mesh=mesh, in_specs=P(), out_specs=P(),
                                  check_vma=False)(x)
 

@@ -6,6 +6,7 @@ allreduce tests.
 """
 
 import jax
+from deepspeed_tpu.utils.jax_compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -89,7 +90,7 @@ class TestSparseTensor:
             red = sparse_all_reduce(st, "dp", average=True)
             return red.to_dense()[None]
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             body, mesh=mesh, in_specs=(P("dp"), P("dp")),
             out_specs=P("dp")))(ids, vals)
         # every rank holds the same averaged dense grad

@@ -1,15 +1,20 @@
-"""Compiled-kernel validation on REAL TPU hardware (opt-in tier).
+"""TPU kernel validation.
 
-Run with ``DS_TPU_TESTS=1 pytest -m tpu tests/unit/test_tpu_kernels.py`` on
-a machine with a TPU attached (the env var stops the conftest from forcing
-the CPU platform; the default suite exercises these kernels in interpret
-mode only — Mosaic lowering itself is what this tier covers).
+Two tiers live here:
+
+* ``tpu``-marked tests (opt in: ``DS_TPU_TESTS=1 pytest -m tpu``) compile
+  the kernels on REAL hardware — Mosaic lowering itself is what that tier
+  covers (the env var stops the conftest from forcing the CPU platform).
+* The ``TestFusedCrossEntropy`` class runs in the DEFAULT CPU tier via
+  ``interpret=True`` — the fused logits-free CE kernel's numerics
+  (forward/backward parity vs the XLA logsumexp reference, ragged tiles,
+  masked labels, custom_vjp under jit) are hardware-independent.
 """
 
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.tpu
+tpu_tier = pytest.mark.tpu
 
 
 @pytest.fixture(scope="module")
@@ -21,6 +26,7 @@ def tpu():
     return devs[0]
 
 
+@tpu_tier
 def test_flash_attention_compiles_and_matches(tpu):
     import jax
     import jax.numpy as jnp
@@ -46,6 +52,7 @@ def test_flash_attention_compiles_and_matches(tpu):
     assert gerr < 0.1, gerr
 
 
+@tpu_tier
 def test_decode_attention_compiles_and_matches(tpu):
     import jax.numpy as jnp
 
@@ -70,6 +77,7 @@ def test_decode_attention_compiles_and_matches(tpu):
     assert err < 0.05, err
 
 
+@tpu_tier
 def test_fused_adam_kernel_compiles_and_matches(tpu):
     import jax.numpy as jnp
 
@@ -92,6 +100,7 @@ def test_fused_adam_kernel_compiles_and_matches(tpu):
     assert float(jnp.abs(kp - ref).max()) < 1e-6
 
 
+@tpu_tier
 def test_sr_quantizer_kernel_compiles_and_unbiased(tpu):
     import jax.numpy as jnp
 
@@ -107,6 +116,7 @@ def test_sr_quantizer_kernel_compiles_and_unbiased(tpu):
     assert float(jnp.abs(outs[0] - outs[1]).max()) > 0  # seeds differ
 
 
+@tpu_tier
 def test_gqa_flash_compiles_matches_and_beats_repeat(tpu):
     """GQA-native kernel (kv enters with KV heads) vs repeat-then-MHA on
     hardware: parity in fwd+bwd, and the native path must not be slower —
@@ -171,6 +181,7 @@ def test_gqa_flash_compiles_matches_and_beats_repeat(tpu):
     assert tn <= tr * 1.10, (tn, tr)
 
 
+@tpu_tier
 def test_decode_attention_alibi_and_pad_bias(tpu):
     """The alibi-slope and pad-bias operands ride their own block specs
     ([KV, P] full-block and [B, 1, Smax]); interpret mode cannot validate
@@ -206,6 +217,7 @@ def test_decode_attention_alibi_and_pad_bias(tpu):
     assert err < 0.05, err
 
 
+@tpu_tier
 def test_flash_attention_masked_gqa(tpu):
     """GQA flash with a key-side pad mask — the mask operand's block spec on
     real Mosaic tiling, fwd + bwd."""
@@ -242,6 +254,7 @@ def test_flash_attention_masked_gqa(tpu):
         assert err < tol, (name, err, tol)
 
 
+@tpu_tier
 def test_fused_lamb_kernel_compiles_and_matches(tpu):
     """The LAMB kernel's SMEM trust-ratio reduction on real Mosaic."""
     import jax.numpy as jnp
@@ -262,6 +275,7 @@ def test_fused_lamb_kernel_compiles_and_matches(tpu):
     assert abs(float(tr) - float(rtr)) < 1e-5
 
 
+@tpu_tier
 def test_blocksparse_flash_compiles_and_matches(tpu):
     """Block-sparse flash (layout-driven block skipping) on real Mosaic vs
     the dense-backend sparse attention reference."""
@@ -294,3 +308,218 @@ def test_blocksparse_flash_compiles_and_matches(tpu):
         qq, k, v).sum())(q)
     gerr = float(jnp.abs(g - gr).max())
     assert gerr < 0.05, gerr
+
+
+# --------------------------------------------------------------------- #
+# Fused logits-free cross-entropy: numerics run in the DEFAULT CPU tier
+# (interpret mode); the class is deliberately NOT tpu-marked.
+
+
+class TestFusedCrossEntropy:
+    @staticmethod
+    def _ref(h, w, b, labels, valid):
+        """XLA logsumexp reference — the exact math chunked_vocab_ce runs."""
+        import jax
+        import jax.numpy as jnp
+        D = h.shape[-1]
+        logits = (h.astype(jnp.float32).reshape(-1, D) @ w.astype(jnp.float32)
+                  + b.astype(jnp.float32))
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels.reshape(-1)[:, None],
+                                   axis=-1)[:, 0]
+        vf = valid.reshape(-1).astype(jnp.float32)
+        return jnp.sum((lse - gold) * vf) / jnp.maximum(jnp.sum(vf), 1)
+
+    @staticmethod
+    def _case(seed, B, S, D, V, dtype, mask_frac=0.3):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(seed)
+        h = jnp.asarray(rng.normal(size=(B, S, D)), dtype)
+        w = jnp.asarray(rng.normal(size=(D, V)) * 0.1, dtype)
+        b = jnp.asarray(rng.normal(size=(V,)) * 0.1, jnp.float32)
+        labels = jnp.asarray(rng.integers(0, V, size=(B, S)), jnp.int32)
+        valid = jnp.asarray(rng.random((B, S)) > mask_frac)
+        return h, w, b, labels, valid
+
+    @pytest.mark.parametrize("B,S,D,V", [
+        (2, 16, 32, 96),     # single tile
+        (2, 300, 64, 1200),  # multiple ragged token AND vocab tiles
+        (1, 77, 48, 517),    # nothing divides anything
+    ])
+    def test_forward_matches_xla_fp32(self, B, S, D, V):
+        import jax.numpy as jnp
+        from deepspeed_tpu.ops.pallas.fused_cross_entropy import fused_cross_entropy
+
+        h, w, b, labels, valid = self._case(0, B, S, D, V, jnp.float32)
+        out = fused_cross_entropy(h, w, labels, bias=b, valid=valid,
+                                  interpret=True)
+        ref = self._ref(h, w, b, labels, valid)
+        assert abs(float(out) - float(ref)) < 1e-5, (float(out), float(ref))
+
+    def test_backward_matches_xla_fp32(self):
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu.ops.pallas.fused_cross_entropy import fused_cross_entropy
+
+        h, w, b, labels, valid = self._case(1, 2, 300, 64, 1200, jnp.float32)
+        gk = jax.grad(lambda h, w, b: fused_cross_entropy(
+            h, w, labels, bias=b, valid=valid, interpret=True),
+            argnums=(0, 1, 2))(h, w, b)
+        gr = jax.grad(lambda h, w, b: self._ref(h, w, b, labels, valid),
+                      argnums=(0, 1, 2))(h, w, b)
+        for name, a, r in zip("h w bias".split(), gk, gr):
+            err = float(jnp.abs(a - r).max())
+            assert err < 1e-5, (name, err)
+
+    def test_forward_backward_bf16_inputs(self):
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu.ops.pallas.fused_cross_entropy import fused_cross_entropy
+
+        h, w, b, labels, valid = self._case(2, 2, 300, 64, 1200, jnp.bfloat16)
+        out = fused_cross_entropy(h, w, labels, bias=b, valid=valid,
+                                  interpret=True)
+        ref = self._ref(h, w, b, labels, valid)
+        assert abs(float(out) - float(ref)) < 2e-2
+
+        gk = jax.grad(lambda h, w: fused_cross_entropy(
+            h, w, labels, bias=b, valid=valid,
+            interpret=True).astype(jnp.float32), argnums=(0, 1))(h, w)
+        gr = jax.grad(lambda h, w: self._ref(h, w, b, labels, valid),
+                      argnums=(0, 1))(h, w)
+        for name, a, r in zip("h w".split(), gk, gr):
+            err = float(jnp.abs((a - r).astype(jnp.float32)).max())
+            assert err < 2e-2, (name, err)
+
+    def test_masked_labels_and_empty_mask(self):
+        import jax.numpy as jnp
+        from deepspeed_tpu.ops.pallas.fused_cross_entropy import fused_cross_entropy
+
+        h, w, b, labels, _ = self._case(3, 2, 24, 32, 96, jnp.float32)
+        # heavy masking (ignore-index style: labels already clamped to 0)
+        valid = jnp.asarray(np.random.default_rng(3).random((2, 24)) > 0.9)
+        out = fused_cross_entropy(h, w, labels, bias=b, valid=valid,
+                                  interpret=True)
+        ref = self._ref(h, w, b, labels, valid)
+        assert abs(float(out) - float(ref)) < 1e-5
+        # all-masked batch: 0 loss, finite (no 0/0), matching _token_ce
+        z = fused_cross_entropy(h, w, labels, bias=b,
+                                valid=jnp.zeros((2, 24), bool), interpret=True)
+        assert float(z) == 0.0
+
+    def test_grad_through_custom_vjp_under_jit(self):
+        """jit(grad(...)) through the custom_vjp, no bias, no mask — the
+        tied-embedding lm_loss shape (grads flow through w's transpose)."""
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu.ops.pallas.fused_cross_entropy import fused_cross_entropy
+
+        h, _, _, labels, _ = self._case(4, 2, 40, 32, 96, jnp.float32)
+        rng = np.random.default_rng(5)
+        embed = jnp.asarray(rng.normal(size=(96, 32)) * 0.1, jnp.float32)
+
+        def fused(h, e):
+            return fused_cross_entropy(h, e.T, labels, interpret=True)
+
+        def ref(h, e):
+            return self._ref(h, e.T, jnp.zeros((96,)), labels,
+                             jnp.ones(labels.shape, bool))
+
+        la, ga = jax.jit(jax.value_and_grad(fused, argnums=(0, 1)))(h, embed)
+        lr, gr = jax.jit(jax.value_and_grad(ref, argnums=(0, 1)))(h, embed)
+        assert abs(float(la) - float(lr)) < 1e-5
+        for name, a, r in zip("h embed".split(), ga, gr):
+            err = float(jnp.abs(a - r).max())
+            assert err < 1e-5, (name, err)
+
+    def test_lm_loss_fused_matches_chunked(self):
+        """End-to-end dispatch: lm_loss with fused_cross_entropy='on'
+        (interpret mode on CPU) equals the 'off' XLA streaming path, values
+        AND grads — the default-selection contract of vocab_head_ce."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                      init_params, lm_loss)
+
+        cfg = TransformerConfig(vocab_size=135, n_layer=2, n_head=2,
+                                d_model=32, max_seq=24, remat=False,
+                                attention_backend="xla",
+                                fused_cross_entropy="off", loss_chunk=16)
+        params = init_params(cfg, jax.random.key(0))
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": jnp.asarray(rng.integers(0, 135, size=(2, 24)),
+                                          jnp.int32)}
+        cfg_on = dataclasses.replace(cfg, fused_cross_entropy="on")
+        l_off, g_off = jax.value_and_grad(lambda p: lm_loss(cfg, p, batch))(params)
+        l_on, g_on = jax.value_and_grad(lambda p: lm_loss(cfg_on, p, batch))(params)
+        assert abs(float(l_off) - float(l_on)) < 1e-5
+        err = max(float(jnp.abs(a - b).max()) for a, b in
+                  zip(jax.tree.leaves(g_off), jax.tree.leaves(g_on)))
+        assert err < 1e-5, err
+
+    def test_bert_mlm_fused_matches_chunked(self):
+        """BERT MLM head (decoder bias + ignore-index labels + gather
+        budget): fused vs XLA paths agree."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu.models.bert import BertConfig, BertModel
+
+        bc = BertConfig(vocab_size=211, max_seq=16, n_layer=2, n_head=2,
+                        d_model=32, d_ff=64, remat=False,
+                        attention_backend="xla", mlm_gather_budget=0.5,
+                        fused_cross_entropy="off")
+        m = BertModel(bc, with_mlm_head=True)
+        p = m.init_params(jax.random.key(1))
+        rng = np.random.default_rng(2)
+        ids = rng.integers(0, 211, size=(2, 16)).astype(np.int32)
+        labels = np.full_like(ids, -100)
+        pos = rng.random((2, 16)) < 0.15
+        labels[pos] = ids[pos]
+        batch = {"input_ids": jnp.asarray(ids), "labels": jnp.asarray(labels)}
+
+        for budget in (0.5, 0.0):
+            m.config = dataclasses.replace(bc, mlm_gather_budget=budget)
+            l_off = m.loss(p, batch)
+            m.config = dataclasses.replace(bc, mlm_gather_budget=budget,
+                                           fused_cross_entropy="on")
+            l_on = m.loss(p, batch)
+            assert abs(float(l_off) - float(l_on)) < 1e-5, budget
+
+
+@tpu_tier
+def test_fused_cross_entropy_compiles_and_matches(tpu):
+    """Mosaic lowering of the fused CE kernel on real hardware (the CPU tier
+    above covers numerics in interpret mode only): fwd + bwd vs the XLA
+    logsumexp reference, on a ragged sub-tile token count (bt < 128 path)
+    AND a multi-tile bf16 shape — the row BlockSpecs, VMEM scratch
+    broadcasts, and the transposed dw grid are exactly what interpret mode
+    cannot validate."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.pallas.fused_cross_entropy import fused_cross_entropy
+
+    for seed, (B, S, D, V), dtype, tol in [
+        (0, (2, 50, 128, 517), jnp.float32, 1e-4),     # ragged bt=104-ish
+        (1, (2, 300, 256, 1200), jnp.bfloat16, 2e-2),  # multi-tile bf16
+    ]:
+        h, w, b, labels, valid = TestFusedCrossEntropy._case(seed, B, S, D, V,
+                                                             dtype)
+        out = fused_cross_entropy(h, w, labels, bias=b, valid=valid,
+                                  interpret=False)
+        ref = TestFusedCrossEntropy._ref(h, w, b, labels, valid)
+        assert abs(float(out) - float(ref)) < tol, (dtype, float(out), float(ref))
+
+        gk = jax.grad(lambda h, w: fused_cross_entropy(
+            h, w, labels, bias=b, valid=valid,
+            interpret=False).astype(jnp.float32), argnums=(0, 1))(h, w)
+        gr = jax.grad(lambda h, w: TestFusedCrossEntropy._ref(h, w, b, labels,
+                                                              valid),
+                      argnums=(0, 1))(h, w)
+        for name, a, r in zip("h w".split(), gk, gr):
+            err = float(jnp.abs((a - r).astype(jnp.float32)).max())
+            assert err < tol, (dtype, name, err)
