@@ -40,6 +40,12 @@ Env knobs (defaults are the chip-measured fast path):
                            ~12% faster); BENCH_LLAMA_SCAN=0 for metric 2
                            (unrolled measured 13.5% faster on-chip)
   BENCH_BLOCK_Q/K=0        flash kernel block override (0 = tuned default)
+  BENCH_DECODE_DENSE/PAGED=1  serving decode metrics: the same mixed
+                           prompt set through the static generate path vs
+                           the paged continuous-batching generate_batch
+                           (the paged record's vs_baseline = speedup over
+                           dense); BENCH_DECODE_REQS=16 BENCH_DECODE_NEW=128
+                           BENCH_DECODE_BLOCK=128 BENCH_DECODE_RUNNING=8
   BENCH_SKIP_PROBE=0       skip the subprocess backend probe
   BENCH_PROBE_RETRIES=1    probe retries before giving up on the backend
   BENCH_ALLOW_CPU=0        on probe failure, run a tiny CPU smoke metric
@@ -286,6 +292,8 @@ BENCH_METRICS = [
     ("BENCH_GPT2", "1", "gpt2_125m_train_tokens_per_sec_per_chip"),
     ("BENCH_LLAMA", "1", "llama_gqa_500m_zero3_train_tokens_per_sec_per_chip"),
     ("BENCH_BERT", "1", "bert_large_mlm_train_tokens_per_sec_per_chip"),
+    ("BENCH_DECODE_DENSE", "1", "gpt2_decode_dense_tokens_per_sec_per_chip"),
+    ("BENCH_DECODE_PAGED", "1", "gpt2_decode_paged_tokens_per_sec_per_chip"),
 ]
 
 
@@ -300,6 +308,76 @@ def _metric_name(env: str) -> str:
 
 def _enabled_metrics():
     return [name for env, _, name in BENCH_METRICS if _metric_enabled(env)]
+
+
+def run_decode_bench():
+    """Serving decode throughput: the same mixed-length prompt set through
+    the static per-request ``generate`` path (dense KV workspace) and the
+    paged continuous-batching ``generate_batch`` path. The paged record's
+    vs_baseline is its speedup over the dense record — the serving layer's
+    trajectory number (BENCH is empty for inference before this)."""
+    import time as _t
+
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models import gpt2
+
+    dist.set_mesh(None)
+    NREQ = int(os.environ.get("BENCH_DECODE_REQS", 16))
+    MAX_NEW = int(os.environ.get("BENCH_DECODE_NEW", 128))
+    BLOCK = int(os.environ.get("BENCH_DECODE_BLOCK", 128))
+    RUNNING = int(os.environ.get("BENCH_DECODE_RUNNING", 8))
+    model = gpt2("125m", remat=False,
+                 attention_backend=os.environ.get("BENCH_ATTN", "auto"))
+    engine = deepspeed_tpu.init_inference(
+        model, dtype="bf16",
+        serving={"block_size": BLOCK, "max_running": RUNNING})
+    rng = np.random.default_rng(0)
+    # mixed prompt lengths: the tail-convoy shape continuous batching wins on
+    prompts = [rng.integers(0, 50257, size=int(n)).astype(np.int32)
+               for n in rng.integers(32, 256, size=NREQ)]
+
+    results = {}
+    for gate, mode in (("BENCH_DECODE_DENSE", "off"),
+                       ("BENCH_DECODE_PAGED", "auto")):
+        if not _metric_enabled(gate):
+            continue
+        name = _metric_name(gate)
+        engine._config.serving.paged = mode
+        # warm ONE prompt per 128-bucket present in the mix (the prefill
+        # program compiles per bucket) with a max_new in the SAME 128-bucket
+        # as the timed MAX_NEW (the dense decode loop's out buffer is keyed
+        # by it) — an uncovered compile landing inside the timed window
+        # would skew the metric
+        buckets = {}
+        for p in prompts:
+            buckets.setdefault(-(-p.size // 128), p)
+        # cheapest max_new in the SAME 128-bucket as MAX_NEW
+        warm_new = 128 * ((MAX_NEW - 1) // 128) + 1
+        warm = engine.generate_batch(list(buckets.values()),
+                                     max_new_tokens=warm_new)
+        jax.block_until_ready(warm)
+        t0 = _t.perf_counter()
+        outs = engine.generate_batch(prompts, max_new_tokens=MAX_NEW)
+        gen_tokens = sum(int(o.shape[0]) - p.size
+                         for p, o in zip(prompts, outs))
+        dt = _t.perf_counter() - t0
+        results[mode] = gen_tokens / dt
+        dev = jax.devices()[0]
+        kind = getattr(dev, "device_kind", "unknown").lower()
+        vs = (round(results["auto"] / results["off"], 3)
+              if mode == "auto" and results.get("off") else 0.0)
+        print(json.dumps({
+            "metric": name,
+            "value": round(gen_tokens / dt, 1),
+            "unit": f"generated tokens/s (bf16, {NREQ} reqs x {MAX_NEW} new, "
+                    f"prompts 32-256, block={BLOCK}, running={RUNNING}, "
+                    f"{kind})",
+            "vs_baseline": vs,
+        }), flush=True)
 
 
 def _emit_skip_records(err: str):
@@ -431,6 +509,13 @@ def main():
         _run_metric(_metric_name("BENCH_BERT"),
                     engine, model, batch, knobs["BATCH"], knobs["SEQ"],
                     STEPS, "MLM, ZeRO-2")
+
+    if _metric_enabled("BENCH_DECODE_DENSE") or _metric_enabled("BENCH_DECODE_PAGED"):
+        if engine is not None:
+            del engine, model, batch
+        import gc
+        gc.collect()
+        run_decode_bench()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,74 @@
+"""KV block allocator for the paged serving path.
+
+The host-side twin of the device pools built by
+``models/transformer.py::init_paged_kv_cache``: the pools are
+``[n_layer, num_blocks, block_size, KV, Hd]`` arrays, and this allocator
+hands out pool block ids to requests and reclaims them when requests retire
+or are preempted. The analogue of vLLM's ``BlockAllocator`` — no
+reference-counted copy-on-write here (no beam search / prefix sharing yet),
+so a block belongs to exactly one request.
+
+Determinism: the free list is FIFO (freed blocks go to the back, allocation
+pops from the front, initial order ascending), so identical request streams
+produce identical block placements — the scheduler tests pin this.
+
+Block 0 is RESERVED as the dummy block: prompt-bucket padding slots and
+inactive decode rows scatter their junk k/v there, and nothing ever reads
+it (the attention masks stop at each request's position). Routing junk to a
+dedicated block keeps out-of-range scatter clipping from corrupting a live
+block.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import List, Optional
+
+DUMMY_BLOCK = 0
+
+
+class BlockAllocator:
+    """FIFO free-list allocator over ``num_blocks`` pool blocks of
+    ``block_size`` tokens; block 0 (``DUMMY_BLOCK``) is never handed out."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks={num_blocks}: need at least one "
+                             "allocatable block besides the reserved dummy")
+        if block_size < 1:
+            raise ValueError(f"block_size={block_size} must be positive")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free = deque(range(1, num_blocks))
+        # companion set: O(1) double-free detection (the deque alone would
+        # make every retirement O(blocks_freed × num_free))
+        self._free_set = set(self._free)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def blocks_for_tokens(self, num_tokens: int) -> int:
+        """Blocks needed to hold ``num_tokens`` cached tokens."""
+        return -(-max(num_tokens, 0) // self.block_size)
+
+    def allocate(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` blocks from the free list, or None (all-or-nothing)
+        when fewer than ``n`` are free."""
+        if n > len(self._free):
+            return None
+        got = [self._free.popleft() for _ in range(n)]
+        self._free_set.difference_update(got)
+        return got
+
+    def free(self, blocks: List[int]) -> None:
+        """Return blocks to the back of the free list."""
+        for b in blocks:
+            if b == DUMMY_BLOCK:
+                raise ValueError("attempted to free the reserved dummy block")
+            if not (0 < b < self.num_blocks):
+                raise ValueError(f"block id {b} out of range")
+            if b in self._free_set:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)
+            self._free_set.add(b)

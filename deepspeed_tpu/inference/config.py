@@ -109,6 +109,24 @@ class QuantizationConfig(ConfigModel):
     qkv: QKVQuantConfig = Field(default_factory=QKVQuantConfig)
 
 
+class ServingConfig(ConfigModel):
+    """Continuous-batching serving config ("serving" section).
+
+    Governs ``InferenceEngine.generate_batch``: the paged KV cache (block
+    pools + per-request block tables) and the iteration-level scheduler.
+    ``paged="auto"`` uses the paged path whenever the model supports it
+    (zoo causal LMs with a paged forward; weight-streaming and MoE engines
+    fall back), ``"on"`` requires it (loud error otherwise), ``"off"``
+    serves each request through the static ``generate`` path sequentially.
+    """
+    block_size: int = 128          # tokens per KV block (128 = kernel path;
+    # smaller blocks pack tighter but decode through the gather fallback)
+    max_num_blocks: int = 0        # pool blocks per layer; 0 = auto-size so
+    # max_running requests can reach the model's max_seq (no eviction)
+    max_running: int = 8           # fused-decode width / running request cap
+    paged: str = "auto"            # auto | on | off
+
+
 class InferenceCheckpointConfig(ConfigModel):
     checkpoint_dir: Optional[str] = None
     save_mp_checkpoint_path: Optional[str] = None
@@ -131,6 +149,7 @@ class DeepSpeedInferenceConfig(ConfigModel):
     set_empty_params: bool = False
     save_mp_checkpoint_path: Optional[str] = None
     checkpoint_config: InferenceCheckpointConfig = Field(default_factory=InferenceCheckpointConfig, alias="ckpt_config")
+    serving: ServingConfig = Field(default_factory=ServingConfig)
     return_tuple: bool = True
     training_mp_size: int = 1
     replace_method: str = Field("auto", json_schema_extra={"deprecated": True})

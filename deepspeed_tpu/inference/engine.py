@@ -316,6 +316,7 @@ class InferenceEngine:
         self._prefill_jit = None
         self._decode_jit = None
         self._stream_jits = None
+        self._paged_jits = None
         log_dist(f"InferenceEngine ready: dtype={self.dtype.__name__}, tp={tp_size}, "
                  f"mesh={dict(self.mesh.shape)}"
                  + (", weight-streaming" if self._stream_weights else ""), ranks=[0])
@@ -554,6 +555,25 @@ class InferenceEngine:
 
     __call__ = forward
 
+    def _reject_encoders(self, what: str) -> None:
+        """Encoders run autoregressively emit nonsense (bidirectional
+        attention, or hidden states instead of vocab logits) — reject
+        loudly (the reference's engine.generate delegates to
+        module.generate, which encoder models don't have either)."""
+        from deepspeed_tpu.models.bert import BertModel
+        from deepspeed_tpu.models.clip import (CLIPTextEncoder,
+                                               CLIPVisionEncoder,
+                                               DSClipEncoder)
+        zoo_cfg = getattr(self.module, "zoo_cfg",
+                          getattr(self.module, "config", None))
+        if (isinstance(self.module, (BertModel, CLIPTextEncoder,
+                                     CLIPVisionEncoder, DSClipEncoder))
+                or getattr(zoo_cfg, "causal", True) is False):
+            raise ValueError(
+                f"{type(self.module).__name__} is an encoder; {what} "
+                "requires a causal LM — use engine.forward for hidden "
+                "states / MLM logits")
+
     def generate(self, input_ids, max_new_tokens: Optional[int] = None, temperature: float = 0.0,
                  top_k: int = 0, seed: int = 0, eos_token_id: Optional[int] = None):
         """Autoregressive generation (greedy or sampled).
@@ -566,23 +586,7 @@ class InferenceEngine:
         input_ids = jnp.asarray(input_ids, jnp.int32)
         if input_ids.ndim == 1:
             input_ids = input_ids[None, :]
-        from deepspeed_tpu.models.bert import BertModel
-        from deepspeed_tpu.models.clip import (CLIPTextEncoder,
-                                               CLIPVisionEncoder,
-                                               DSClipEncoder)
-        zoo_cfg = getattr(self.module, "zoo_cfg",
-                          getattr(self.module, "config", None))
-        if (isinstance(self.module, (BertModel, CLIPTextEncoder,
-                                     CLIPVisionEncoder, DSClipEncoder))
-                or getattr(zoo_cfg, "causal", True) is False):
-            # encoders run autoregressively emit nonsense (bidirectional
-            # attention, or hidden states instead of vocab logits) — reject
-            # loudly (the reference's engine.generate delegates to
-            # module.generate, which encoder models don't have either)
-            raise ValueError(
-                f"{type(self.module).__name__} is an encoder; generate() "
-                "requires a causal LM — use engine.forward for hidden "
-                "states / MLM logits")
+        self._reject_encoders("generate()")
         max_new = max_new_tokens if max_new_tokens is not None else self._config.max_out_tokens
         max_len = input_ids.shape[1] + max_new
         cfg = getattr(self.module, "config", None)
@@ -625,16 +629,25 @@ class InferenceEngine:
 
     def _kv_workspace(self, B: int, need_len: int):
         """Persistent KV workspace (reference ``inference_context.h:49``:
-        one workspace allocated once and reused across calls). Keyed by
-        batch size; grows monotonically in length; reuse is safe because
-        the causal mask hides slots beyond the current position."""
+        one workspace allocated once and reused across calls). Grows
+        monotonically in length AND batch: a call with ``B`` smaller than
+        the allocated batch runs on a sliced copy instead of reallocating
+        (the larger workspace is kept for future calls — ``owned=False``
+        tells the caller not to store the sliced copy back). Reuse is safe
+        because the causal mask hides slots beyond the current position.
+        Returns ``(cache, Smax, owned)``."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         ws = getattr(self, "_workspace", None)
-        if ws is not None and ws[0] == B and ws[1] >= need_len:
+        if ws is not None and ws[0] >= B and ws[1] >= need_len:
             leaves = jax.tree.leaves(ws[2])
             if not any(getattr(a, "is_deleted", lambda: False)() for a in leaves):
-                return ws[2], ws[1]
+                if ws[0] == B:
+                    return ws[2], ws[1], True
+                # smaller batch: slice rows [0, B) of the [L, B0, S, KV, Hd]
+                # cache — a copy, so donating it through prefill/decode
+                # leaves the full workspace intact
+                return jax.tree.map(lambda a: a[:, :B], ws[2]), ws[1], False
         cfg = self.module.config
         Smax = min(cfg.max_seq, max(need_len, int(self._config.max_out_tokens)))
         cache = self.module.init_cache(B, Smax, dtype=self.dtype)
@@ -643,7 +656,7 @@ class InferenceEngine:
         cache = jax.tree.map(
             lambda a: jax.device_put(a, NamedSharding(self.mesh, kv_spec)), cache)
         self._workspace = (B, Smax, cache)
-        return cache, Smax
+        return cache, Smax, True
 
     @staticmethod
     def _bucket(n: int, cap: int) -> int:
@@ -656,7 +669,8 @@ class InferenceEngine:
             return input_ids
         B, prompt_len = input_ids.shape
         cfg = self.module.config
-        cache, Smax = self._kv_workspace(B, min(cfg.max_seq, prompt_len + max_new))
+        cache, Smax, ws_owned = self._kv_workspace(
+            B, min(cfg.max_seq, prompt_len + max_new))
         bucket = self._bucket(prompt_len, Smax)
 
         if self._decode_jit is None:
@@ -681,12 +695,14 @@ class InferenceEngine:
                     lambda: jnp.argmax(logits, axis=-1))
 
             def decode_loop(params, cache, first, pos0, max_new, rng, temperature,
-                            top_k, eos):
+                            top_k, eos, out_cap):
                 """Whole decode loop on device: one host transfer per call,
-                early exit when every row has emitted eos (eos < 0 = never)."""
+                early exit when every row has emitted eos (eos < 0 = never).
+                ``out_cap`` (static, the 128-bucketed max_new) bounds the
+                output buffer — sizing it to the cache capacity wasted HBM
+                and host-transfer bytes on every short generation."""
                 Bd = first.shape[0]
-                cap = cache["k"].shape[2]  # [L, B, Smax, ...]
-                out0 = jnp.zeros((Bd, cap), jnp.int32)
+                out0 = jnp.zeros((Bd, out_cap), jnp.int32)
                 out0 = out0.at[:, 0].set(first)
                 done0 = (first == eos) & (eos >= 0)
 
@@ -713,7 +729,8 @@ class InferenceEngine:
                 return out, step, cache
 
             self._prefill_jit = jax.jit(prefill, donate_argnums=(2,))
-            self._decode_jit = jax.jit(decode_loop, donate_argnums=(1,))
+            self._decode_jit = jax.jit(decode_loop, donate_argnums=(1,),
+                                       static_argnums=(9,))
 
         pad = bucket - prompt_len
         toks = jnp.pad(input_ids, ((0, 0), (0, pad))) if pad else input_ids
@@ -723,14 +740,179 @@ class InferenceEngine:
         first = jnp.asarray(self._sample_host(logits0, temperature, top_k, sub))
 
         eos = jnp.int32(-1 if eos_token_id is None else eos_token_id)
+        # one compile per 128-bucket of max_new (max_new itself stays traced)
+        out_cap = min(Smax, self._bucket(max_new, Smax))
         out, n, cache = self._decode_jit(self.params, cache, first,
                                          jnp.int32(prompt_len), jnp.int32(max_new),
                                          rng, jnp.float32(temperature),
-                                         jnp.int32(top_k), eos)
-        self._workspace = (B, Smax, cache)  # keep the donated-through workspace
+                                         jnp.int32(top_k), eos, out_cap)
+        if ws_owned:
+            self._workspace = (B, Smax, cache)  # keep the donated-through workspace
         n = int(n)
         gen = np.asarray(out)[:, :n]
         return jnp.concatenate([input_ids, jnp.asarray(gen, jnp.int32)], axis=1)
+
+    # ------------------------------------------------------------------ #
+    # Paged KV cache + continuous batching (vLLM PagedAttention / Orca
+    # iteration-level scheduling): KV block pools shared by every in-flight
+    # request, per-request block tables, one fused decode step over ALL
+    # running requests per engine step, finished rows retired and queued
+    # requests admitted in their place. Memory is bounded by tokens in
+    # flight (not B × Smax) and a slow request never convoys the batch.
+
+    def _paged_supported(self) -> bool:
+        return (not self._stream_weights and not self._is_moe
+                and hasattr(self.module, "forward_paged_decode")
+                and hasattr(self.module, "forward_paged_prefill")
+                and hasattr(self.module, "init_paged_cache")
+                and hasattr(self.module, "config"))
+
+    def _paged_pools(self, num_blocks: int, block_size: int):
+        """Persistent paged-pool workspace: same lifecycle contract as
+        :meth:`_kv_workspace` (reuse is safe — every slot a request reads
+        was written by that request in the current call)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        pw = getattr(self, "_paged_workspace", None)
+        if pw is not None and pw[0] == num_blocks and pw[1] == block_size:
+            leaves = jax.tree.leaves(pw[2])
+            if not any(getattr(a, "is_deleted", lambda: False)() for a in leaves):
+                return pw[2]
+        pools = self.module.init_paged_cache(num_blocks, block_size,
+                                             dtype=self.dtype)
+        kv_spec = (P(None, None, None, "tp", None)
+                   if self.mesh.shape.get("tp", 1) > 1 else P())
+        pools = jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(self.mesh, kv_spec)), pools)
+        self._paged_workspace = (num_blocks, block_size, pools)
+        return pools
+
+    def _ensure_paged_jits(self):
+        if self._paged_jits is None:
+            mod = self.module
+            self._paged_jits = (
+                jax.jit(lambda p, t, pools, slots, li:
+                        mod.forward_paged_prefill(p, t, pools, slots, li),
+                        donate_argnums=(2,)),
+                jax.jit(lambda p, t, pools, bt, pos:
+                        mod.forward_paged_decode(p, t, pools, bt, pos),
+                        donate_argnums=(2,)),
+            )
+        return self._paged_jits
+
+    def generate_batch(self, prompts, max_new_tokens: Optional[int] = None,
+                       temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                       eos_token_id: Optional[int] = None):
+        """Serve a batch of variable-length prompts with continuous batching
+        over the paged KV cache. Returns a list of 1-D int32 arrays
+        (prompt + generated tokens, stopping at eos / max_new per request),
+        in the order the prompts were given.
+
+        ``config.serving`` governs the path: ``paged="auto"`` (default)
+        pages whenever the model supports it, ``"on"`` requires it,
+        ``"off"`` — and unsupported models under auto — falls back to the
+        static ``generate`` path per request. Greedy decoding
+        (``temperature=0``) reproduces the static path's tokens exactly.
+        """
+        prompts = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
+        if not prompts:
+            return []
+        self._reject_encoders("generate_batch()")
+        srv = self._config.serving
+        mode = str(srv.paged)
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(f"serving.paged={mode!r} (expected auto|on|off)")
+        supported = self._paged_supported()
+        if mode == "on" and not supported:
+            raise ValueError(
+                "serving.paged='on' but this engine cannot page: the model "
+                "must be a zoo causal LM (forward_paged_decode) and the "
+                "engine must not be weight-streaming or MoE")
+        max_new = (max_new_tokens if max_new_tokens is not None
+                   else self._config.max_out_tokens)
+        if mode == "off" or not supported:
+            # static fallback: each request through the (batched-workspace)
+            # generate path, one at a time — correct for every engine mode.
+            # Per-request seed offset: sampled mode must not hand every
+            # request (or duplicate prompts) the same rng stream
+            return [self.generate(p[None, :], max_new_tokens=max_new,
+                                  temperature=temperature, top_k=top_k,
+                                  seed=seed + i, eos_token_id=eos_token_id)[0]
+                    for i, p in enumerate(prompts)]
+        if max_new <= 0:
+            return [jnp.asarray(p) for p in prompts]
+
+        from deepspeed_tpu.inference.block_allocator import (BlockAllocator,
+                                                             DUMMY_BLOCK)
+        from deepspeed_tpu.inference.scheduler import \
+            ContinuousBatchingScheduler
+
+        cfg = self.module.config
+        bs = int(srv.block_size)
+        W = int(srv.max_running)
+        n_max = -(-cfg.max_seq // bs)          # block-table width
+        num_blocks = int(srv.max_num_blocks) or (W * n_max + 1)
+        for p in prompts:
+            if p.size + max_new > cfg.max_seq:
+                raise ValueError(
+                    f"prompt ({p.size}) + max_new_tokens ({max_new}) exceeds "
+                    f"model max_seq {cfg.max_seq}")
+
+        alloc = BlockAllocator(num_blocks, bs)
+        sched = ContinuousBatchingScheduler(alloc, W, n_max)
+        for p in prompts:
+            sched.add_request(p, max_new, eos_token_id)
+        pools = self._paged_pools(num_blocks, bs)
+        prefill_jit, decode_jit = self._ensure_paged_jits()
+        rng = jax.random.key(seed)
+
+        while True:
+            action = sched.next_action()
+            if action is None:
+                break
+            kind, payload = action
+            if kind == "prefill":
+                req = payload
+                prefix = req.prefix()
+                L = prefix.size
+                Tb = self._bucket(L, cfg.max_seq)
+                toks = np.zeros((1, Tb), np.int32)
+                toks[0, :L] = prefix
+                # flat pool slot per prompt position; bucket pads write
+                # their junk k/v into the dummy block
+                t = np.arange(Tb)
+                table = np.asarray(req.blocks, np.int32)
+                slot = table[np.minimum(t // bs, table.size - 1)] * bs + t % bs
+                slots = np.where(t < L, slot, DUMMY_BLOCK * bs + t % bs)
+                logits, pools = prefill_jit(self.params, jnp.asarray(toks),
+                                            pools,
+                                            jnp.asarray(slots, jnp.int32),
+                                            jnp.int32(L - 1))
+                rng, sub = jax.random.split(rng)
+                tok = self._sample_host(logits.astype(jnp.float32),
+                                        temperature, top_k, sub)
+                sched.record_prefill(req, int(np.asarray(tok)[0]))
+            else:
+                reqs = payload
+                bt = np.zeros((W, n_max), np.int32)       # zeros → dummy
+                pos = np.zeros((W,), np.int32)
+                toks = np.zeros((W, 1), np.int32)
+                for i, r in enumerate(reqs):
+                    bt[i, :len(r.blocks)] = r.blocks
+                    pos[i] = r.pos
+                    toks[i, 0] = r.last_token
+                logits, pools = decode_jit(self.params, jnp.asarray(toks),
+                                           pools, jnp.asarray(bt),
+                                           jnp.asarray(pos))
+                rng, sub = jax.random.split(rng)
+                tok = np.asarray(self._sample_host(
+                    logits.astype(jnp.float32), temperature, top_k, sub))
+                for i, r in enumerate(reqs):
+                    sched.record_decode(r, int(tok[i]))
+
+        self._paged_workspace = (num_blocks, bs, pools)
+        done = sorted(sched.finished, key=lambda r: r.rid)
+        return [jnp.asarray(r.output) for r in done]
 
     @staticmethod
     def _sample_jit(logits, temperature, top_k, rng):

@@ -1,0 +1,221 @@
+"""Continuous-batching scheduler (Orca iteration-level scheduling + vLLM
+eviction, host side).
+
+The engine drives one *step* at a time: :meth:`next_action` returns either
+``("prefill", request)`` — admit the FIFO queue head into freshly allocated
+blocks and run its prompt — or ``("decode", running)`` — one fused decode
+step over every running request. Finished requests retire between steps
+(their blocks return to the pool) and queued requests take their slots, so
+a convoying long request never stalls the batch the way the static
+``generate`` loop does.
+
+Request lifecycle::
+
+    QUEUED --admit(alloc prompt blocks)--> RUNNING --eos/max_new--> FINISHED
+       ^                                      |
+       +------- preempt (free ALL blocks) ----+
+
+Preemption is recompute-style (vLLM's default): when a running request
+needs one more KV block and the pool is dry, the LATEST-admitted running
+request is evicted — its blocks are freed and it re-queues at the FRONT
+with its prompt extended by the tokens it already generated, so its next
+admission prefills the whole prefix again (compute traded for memory;
+generated tokens are never lost, and greedy decoding reproduces the exact
+same continuation). Both the victim choice and the FIFO free list are
+deterministic — identical request streams schedule identically.
+
+Bookkeeping invariant: ``req.pos`` is the number of tokens whose k/v sit in
+the pools; the newest generated token (``req.last_token``) is NOT yet
+cached — it is the next decode step's input, written at slot ``pos`` by
+that step. Hence cached = prompt + generated[:-1], pos = len(prompt) +
+len(generated) - 1 whenever the request is running.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.inference.block_allocator import BlockAllocator
+from deepspeed_tpu.utils.logging import logger
+
+QUEUED, RUNNING, FINISHED = "queued", "running", "finished"
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray              # [P] int32, immutable
+    max_new: int
+    eos: Optional[int] = None
+    state: str = QUEUED
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    pos: int = 0                    # tokens currently cached in the pools
+    generated: List[int] = dataclasses.field(default_factory=list)
+    admit_seq: int = -1             # admission stamp (eviction order)
+    preemptions: int = 0
+
+    def prefix(self) -> np.ndarray:
+        """The token prefix a (re)admission must prefill: the prompt plus
+        every already-generated token. Prefill caches k/v for ALL of them
+        and samples the next (new) token from the last position — so a
+        recomputed request continues exactly where it left off (greedy
+        decoding reproduces the unpreempted continuation)."""
+        if not self.generated:
+            return self.prompt
+        return np.concatenate([self.prompt,
+                               np.asarray(self.generated, np.int32)])
+
+    @property
+    def last_token(self) -> Optional[int]:
+        return self.generated[-1] if self.generated else None
+
+    @property
+    def output(self) -> np.ndarray:
+        return np.concatenate([self.prompt,
+                               np.asarray(self.generated, np.int32)])
+
+
+class ContinuousBatchingScheduler:
+    """FIFO admission, fused decode over all running requests, retire on
+    eos/max_new, recompute-preempt the latest-admitted request on OOM."""
+
+    def __init__(self, allocator: BlockAllocator, max_running: int,
+                 max_blocks_per_seq: int):
+        if max_running < 1:
+            raise ValueError("max_running must be >= 1")
+        self.allocator = allocator
+        self.max_running = max_running
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.waiting: deque = deque()
+        self.running: List[Request] = []   # admission-ordered
+        self.finished: List[Request] = []
+        self._admit_counter = 0
+        self._next_rid = 0
+
+    # ------------------------------------------------------------------ #
+
+    def add_request(self, prompt, max_new: int,
+                    eos: Optional[int] = None) -> Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        total = prompt.size + max_new
+        cap = self.max_blocks_per_seq * self.allocator.block_size
+        if total > cap:
+            raise ValueError(
+                f"request needs {total} KV slots but the block table holds "
+                f"{cap} ({self.max_blocks_per_seq} blocks of "
+                f"{self.allocator.block_size})")
+        req = Request(rid=self._next_rid, prompt=prompt, max_new=max_new,
+                      eos=eos)
+        self._next_rid += 1
+        self.waiting.append(req)
+        return req
+
+    def all_done(self) -> bool:
+        return not self.waiting and not self.running
+
+    # ------------------------------------------------------------------ #
+
+    def next_action(self) -> Optional[Tuple[str, object]]:
+        """Pick the next engine step: admit+prefill the queue head when a
+        slot and its prompt blocks are available (admission has priority —
+        back-fill freed slots immediately), else one fused decode step over
+        the running set. None when everything is finished."""
+        if self.waiting and len(self.running) < self.max_running:
+            req = self.waiting[0]
+            need = self.allocator.blocks_for_tokens(len(req.prefix()))
+            blocks = self.allocator.allocate(need)
+            if blocks is not None:
+                self.waiting.popleft()
+                req.blocks = blocks
+                req.state = RUNNING
+                req.admit_seq = self._admit_counter
+                self._admit_counter += 1
+                self.running.append(req)
+                return ("prefill", req)
+            if not self.running:
+                raise RuntimeError(
+                    f"prompt of request {req.rid} needs {need} KV blocks but "
+                    f"the pool only has {self.allocator.num_free} free and "
+                    "nothing is running to evict; raise "
+                    "serving.max_num_blocks or shrink the prompt")
+        if self.running:
+            self._ensure_decode_capacity()
+            return ("decode", list(self.running))
+        if self.waiting:
+            # slots full but pool dry would have been handled above; here
+            # the running set is empty yet requests wait — impossible unless
+            # max_running slots are all mid-preemption; defensive guard
+            raise RuntimeError("scheduler stuck: waiting requests but "
+                               "nothing runnable")
+        return None
+
+    def _ensure_decode_capacity(self) -> None:
+        """Every running request writes its next token at slot ``pos``;
+        grow its block list when that slot crosses a block boundary,
+        evicting from the back (latest admitted) when the pool is dry."""
+        for req in list(self.running):
+            if req.state != RUNNING:
+                continue  # evicted by an earlier iteration of this loop
+            while req.pos >= len(req.blocks) * self.allocator.block_size:
+                got = self.allocator.allocate(1)
+                if got is not None:
+                    req.blocks.extend(got)
+                    break
+                victim = self.running[-1]
+                if victim is req and len(self.running) == 1:
+                    raise RuntimeError(
+                        f"request {req.rid} needs one more KV block but the "
+                        "pool is exhausted and it is the only running "
+                        "request; raise serving.max_num_blocks")
+                self._preempt(victim)
+                if victim is req:
+                    break  # the requester evicted itself; it re-queued
+
+    def _preempt(self, victim: Request) -> None:
+        logger.warning(
+            f"KV pool exhausted: preempting request {victim.rid} "
+            f"({len(victim.blocks)} blocks freed; will recompute "
+            f"{len(victim.prefix())} tokens on re-admission)")
+        self.running.remove(victim)
+        self.allocator.free(victim.blocks)
+        victim.blocks = []
+        victim.pos = 0
+        victim.state = QUEUED
+        victim.preemptions += 1
+        # FRONT of the queue: the victim was admitted before anything still
+        # waiting, so FIFO fairness re-admits it first
+        self.waiting.appendleft(victim)
+
+    # ------------------------------------------------------------------ #
+    # engine callbacks after each compute step
+
+    def record_prefill(self, req: Request, token: int) -> None:
+        """The engine prefilled ``req.prefix()`` and sampled ``token`` from
+        the last position."""
+        req.pos = len(req.prefix())
+        req.generated.append(int(token))
+        self._maybe_finish(req)
+
+    def record_decode(self, req: Request, token: int) -> None:
+        """One decode step: the previous ``last_token``'s k/v was written at
+        slot ``pos`` and ``token`` sampled from the resulting logits."""
+        req.pos += 1
+        req.generated.append(int(token))
+        self._maybe_finish(req)
+
+    def _maybe_finish(self, req: Request) -> None:
+        done = len(req.generated) >= req.max_new
+        if req.eos is not None and req.generated[-1] == req.eos:
+            done = True
+        if done:
+            req.state = FINISHED
+            self.running.remove(req)
+            self.allocator.free(req.blocks)
+            req.blocks = []
+            self.finished.append(req)

@@ -58,6 +58,21 @@ class CausalLM:
     def forward_cached(self, params, tokens, cache, pos, pad_bias=None):
         return T.forward_cached(self.config, params, tokens, cache, pos, pad_bias)
 
+    # ---- paged KV serving (see transformer.forward_paged_*) ----
+
+    def init_paged_cache(self, num_blocks: int, block_size: int,
+                         dtype=jnp.bfloat16) -> Dict[str, Any]:
+        return T.init_paged_kv_cache(self.config, num_blocks, block_size, dtype)
+
+    def forward_paged_prefill(self, params, tokens, pools, slots, last_idx):
+        return T.forward_paged_prefill(self.config, params, tokens, pools,
+                                       slots, last_idx)
+
+    def forward_paged_decode(self, params, tokens, pools, block_tables, pos,
+                             pad_bias=None):
+        return T.forward_paged_decode(self.config, params, tokens, pools,
+                                      block_tables, pos, pad_bias)
+
     @property
     def num_parameters(self) -> int:
         cfg = self.config

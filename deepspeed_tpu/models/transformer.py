@@ -842,6 +842,55 @@ def init_kv_cache(cfg: TransformerConfig, batch_size: int, max_len: Optional[int
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
+def _qkv_project(cfg: TransformerConfig, x, lp, positions):
+    """Shared decode-side q/k/v projection: act-quant (QAT parity with the
+    training path — or prefill/decode logits diverge from forward()),
+    optional attn biases (attn_bias=True REQUIRES all four bias tensors —
+    loud KeyError on a params tree saved without them), head reshape, rope.
+    Returns (q [B,T,H,Hd], k [B,T,KV,Hd], v [B,T,KV,Hd])."""
+    B, T, D = x.shape
+    H, KV, Hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
+    x = _maybe_act_quant(cfg, x)
+    bq = lp["bq"] if cfg.attn_bias else 0
+    bk = lp["bk"] if cfg.attn_bias else 0
+    bv = lp["bv"] if cfg.attn_bias else 0
+    q = (x @ _w(lp["wq"], x) + bq).reshape(B, T, H, Hd)
+    k = (x @ _w(lp["wk"], x) + bk).reshape(B, T, KV, Hd)
+    v = (x @ _w(lp["wv"], x) + bv).reshape(B, T, KV, Hd)
+    if cfg.pos_embedding == "rope":
+        q = _rope(q, positions, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
+        k = _rope(k, positions, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
+    return q, k, v
+
+
+def _grouped_cache_einsum(cfg: TransformerConfig, q, ck, cv, positions,
+                          pad_bias):
+    """Grouped-head einsum of q [B,T,H,Hd] against an UNREPEATED cache
+    ck/cv [B,S,KV,Hd] with per-row causal masking at ``positions`` (query
+    heads reshaped [KV, G]: head h reads kv head h // G, matching the
+    kernels' index maps — off-kernel decode skips the H/KV× cache copy).
+    The single masked-softmax core shared by the dense-workspace and paged
+    fallback paths. Returns [B, T, H*Hd]."""
+    B, T, H, Hd = q.shape
+    S, KV = ck.shape[1], ck.shape[2]
+    G = H // KV
+    scale = Hd**-0.5 if cfg.attn_scale is None else cfg.attn_scale
+    q5 = q.reshape(B, T, KV, G, Hd)
+    scores = jnp.einsum("btcgd,bscd->bcgts", q5, ck,
+                        preferred_element_type=jnp.float32) * scale
+    kpos = jnp.arange(S, dtype=jnp.int32)[None, None, None, None, :]  # [1,1,1,1,S]
+    qpos = positions[:, None, None, :, None]                          # [B,1,1,T,1]
+    valid = kpos <= qpos                                              # causal + cache bound
+    if cfg.pos_embedding == "alibi":
+        slopes5 = _alibi_slopes(H).reshape(KV, G)
+        scores = scores + slopes5[None, :, :, None, None] * (kpos - qpos).astype(jnp.float32)
+    scores = jnp.where(valid, scores, -1e30)
+    if pad_bias is not None:
+        scores = scores + pad_bias[:, None, None, None, :]
+    probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+    return jnp.einsum("bcgts,bscd->btcgd", probs, cv).reshape(B, T, H * Hd)
+
+
 def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad_bias):
     """Attention for T new tokens against the (updated) KV cache.
 
@@ -854,20 +903,7 @@ def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad
     H, KV, Hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
     Smax = ck.shape[1]
 
-    # QAT parity with the training path: decode must quantize the attention
-    # input too, or prefill/decode logits diverge from forward()
-    x = _maybe_act_quant(cfg, x)
-    # attn_bias=True REQUIRES all four bias tensors (loud KeyError on a
-    # params tree saved without them, consistent with the bo access below)
-    bq = lp["bq"] if cfg.attn_bias else 0
-    bk = lp["bk"] if cfg.attn_bias else 0
-    bv = lp["bv"] if cfg.attn_bias else 0
-    q = (x @ _w(lp["wq"], x) + bq).reshape(B, T, H, Hd)
-    k = (x @ _w(lp["wk"], x) + bk).reshape(B, T, KV, Hd)
-    v = (x @ _w(lp["wv"], x) + bv).reshape(B, T, KV, Hd)
-    if cfg.pos_embedding == "rope":
-        q = _rope(q, positions, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
-        k = _rope(k, positions, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
+    q, k, v = _qkv_project(cfg, x, lp, positions)
 
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
@@ -910,39 +946,40 @@ def _cached_attention(cfg: TransformerConfig, x, lp, positions, pos, ck, cv, pad
         out = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
         return out, ck, cv
 
-    # grouped-head einsum against the UNREPEATED cache: query heads reshaped
-    # [KV, G] (head h reads kv head h // G, matching the kernels' index maps)
-    # so off-kernel decode skips the H/KV× cache copy too
-    G = H // KV
-    scale = Hd**-0.5 if cfg.attn_scale is None else cfg.attn_scale
-    q5 = q.reshape(B, T, KV, G, Hd)
-    scores = jnp.einsum("btcgd,bscd->bcgts", q5, ck,
-                        preferred_element_type=jnp.float32) * scale
-    kpos = jnp.arange(Smax, dtype=jnp.int32)[None, None, None, None, :]  # [1,1,1,1,S]
-    qpos = positions[:, None, None, :, None]                             # [B,1,1,T,1]
-    valid = kpos <= qpos                                                 # causal + cache bound
-    if cfg.pos_embedding == "alibi":
-        slopes5 = _alibi_slopes(H).reshape(KV, G)
-        scores = scores + slopes5[None, :, :, None, None] * (kpos - qpos).astype(jnp.float32)
-    scores = jnp.where(valid, scores, -1e30)
-    if pad_bias is not None:
-        scores = scores + pad_bias[:, None, None, None, :]
-    probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
-    out = jnp.einsum("bcgts,bscd->btcgd", probs, cv).reshape(B, T, H * Hd)
+    out = _grouped_cache_einsum(cfg, q, ck, cv, positions, pad_bias)
     out = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
     return out, ck, cv
 
 
 def cached_embed(cfg: TransformerConfig, params, tokens, pos, dtype):
-    """Embedding for the cached path: tokens [B, T] at cache offset pos."""
+    """Embedding for the cached path: tokens [B, T] at cache offset ``pos``
+    — a scalar (whole-batch offset, the dense workspace path) or a [B]
+    vector (per-request offsets, the paged continuous-batching path)."""
     B, T = tokens.shape
     x = params["embed"]["tokens"][tokens].astype(dtype)
-    positions = pos + jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    positions = jnp.asarray(pos, jnp.int32).reshape(-1, 1) \
+        + jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
     if cfg.pos_embedding == "learned":
         x = x + params["embed"]["positions"][positions].astype(x.dtype)
     if cfg.embed_layernorm:
         x = _norm(cfg, x, params["embed"]["ln"])
     return x, positions
+
+
+def _decode_block(cfg: TransformerConfig, h, lp, attn_fn, mlp_fn=None):
+    """The ONE pre-LN residual wiring of every cache-decode block (dense
+    workspace via :func:`cached_block`, paged prefill and paged decode):
+    ``attn_fn(x_normed)`` returns (attn_out, new cache k, new cache v);
+    ``mlp_fn(cfg, x_normed, lp)`` overrides the dense MLP (MoE)."""
+    mfn = mlp_fn if mlp_fn is not None else (
+        lambda c, xx, lpp: mlp(c, xx, lpp["mlp"]))
+    a, nkp, nvp = attn_fn(_norm(cfg, h, lp["ln_attn"]))
+    if cfg.parallel_residual:
+        m = mfn(cfg, _norm(cfg, h, lp["ln_mlp"]), lp)
+        return h + a + m, nkp, nvp
+    h = h + a
+    m = mfn(cfg, _norm(cfg, h, lp["ln_mlp"]), lp)
+    return h + m, nkp, nvp
 
 
 def cached_block(cfg: TransformerConfig, h, lp, ck, cv, positions, pos,
@@ -952,16 +989,11 @@ def cached_block(cfg: TransformerConfig, h, lp, ck, cv, positions, pos,
     and ZeRO-Inference weight streaming (per-layer host→device loop,
     ``inference/engine.py``). ``mlp_fn(cfg, x_normed, lp)`` overrides the
     dense MLP (the MoE zoo passes its routed experts)."""
-    mfn = mlp_fn if mlp_fn is not None else (
-        lambda c, xx, lpp: mlp(c, xx, lpp["mlp"]))
-    a, nck, ncv = _cached_attention(cfg, _norm(cfg, h, lp["ln_attn"]), lp["attn"],
-                                    positions, pos, ck, cv, pad_bias)
-    if cfg.parallel_residual:
-        m = mfn(cfg, _norm(cfg, h, lp["ln_mlp"]), lp)
-        return h + a + m, nck, ncv
-    h = h + a
-    m = mfn(cfg, _norm(cfg, h, lp["ln_mlp"]), lp)
-    return h + m, nck, ncv
+    return _decode_block(
+        cfg, h, lp,
+        lambda xn: _cached_attention(cfg, xn, lp["attn"], positions, pos,
+                                     ck, cv, pad_bias),
+        mlp_fn)
 
 
 def cached_head(cfg: TransformerConfig, params, x):
@@ -997,6 +1029,173 @@ def forward_cached(cfg: TransformerConfig, params, tokens, cache, pos, pad_bias=
     x, (nk, nv) = jax.lax.scan(run_block, x, (params["layers"], cache["k"], cache["v"]))
     logits = cached_head(cfg, params, x)
     return logits, {"k": nk, "v": nv}
+
+
+# --------------------------------------------------------------------- #
+# Paged KV cache (vLLM PagedAttention / Orca continuous batching, TPU form):
+# KV lives in fixed-size block POOLS [n_layer, num_blocks, block_size, KV, Hd]
+# shared by every in-flight request; each request owns a block table mapping
+# its logical blocks to pool blocks. Memory is bounded by tokens in flight
+# (not B × Smax), requests at different depths decode in one fused step, and
+# retiring a request frees its blocks for the next admission.
+
+def init_paged_kv_cache(cfg: TransformerConfig, num_blocks: int,
+                        block_size: int, dtype=jnp.bfloat16) -> Dict[str, Any]:
+    """Paged KV pools: k/v [n_layer, num_blocks, block_size, kv_heads, Hd].
+    Block 0 is conventionally the allocator's dummy block (padding tokens
+    and inactive decode rows write there; nothing ever reads it)."""
+    shape = (cfg.n_layer, num_blocks, block_size, cfg.kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _pool_scatter(pool, kv_new, slots):
+    """Write per-token k or v [N, KV, Hd] into one layer's pool
+    [num_blocks, bs, KV, Hd] at flat slots [N] (block_id * bs + offset)."""
+    Nb, bs = pool.shape[0], pool.shape[1]
+    flat = pool.reshape(Nb * bs, *pool.shape[2:])
+    return flat.at[slots].set(kv_new.astype(pool.dtype)).reshape(pool.shape)
+
+
+def _paged_gather(pool, block_tables):
+    """Dense [B, max_blocks*bs, KV, Hd] gather of each request's cache via
+    its block table — the einsum fallback when the paged kernel is
+    off-envelope or the mesh/SPMD context forbids a bare pallas_call."""
+    Nb, bs = pool.shape[0], pool.shape[1]
+    flat = pool.reshape(Nb * bs, *pool.shape[2:])
+    B = block_tables.shape[0]
+    idx = (block_tables[:, :, None] * bs
+           + jnp.arange(bs, dtype=jnp.int32)[None, None, :])
+    return flat[idx.reshape(B, -1)]
+
+
+def _paged_decode_attention(cfg: TransformerConfig, x, lp, positions, pos,
+                            kp, vp, block_tables, pad_bias):
+    """One fused decode step over all running requests against the paged
+    pools: x [B, 1, D] (one new token per request), pos [B] per-request
+    cache depths, kp/vp [num_blocks, bs, KV, Hd], block_tables
+    [B, max_blocks]. Returns (out [B, 1, D], new kp, vp)."""
+    B, T, D = x.shape
+    H = cfg.n_head
+    bs = kp.shape[1]
+
+    q, k, v = _qkv_project(cfg, x, lp, positions)
+
+    # each request's new k/v lands at its block-table slot; inactive rows
+    # carry a zeroed table and write into the dummy block
+    slots = block_tables[jnp.arange(B), pos // bs] * bs + pos % bs
+    kp = _pool_scatter(kp, k[:, 0], slots)
+    vp = _pool_scatter(vp, v[:, 0], slots)
+
+    slopes = _alibi_slopes(H) if cfg.pos_embedding == "alibi" else None
+    o = None
+    if _use_flash(cfg):
+        from deepspeed_tpu.ops.pallas.paged_decode_attention import \
+            paged_decode_attention
+        o = paged_decode_attention(q[:, 0], kp, vp, block_tables, pos,
+                                   pad_bias=pad_bias, alibi_slopes=slopes,
+                                   scale=cfg.attn_scale)
+    if o is not None:
+        out = o.reshape(B, 1, H * cfg.head_dim)
+    else:
+        # gather + grouped einsum (the dense cache path's masked-softmax
+        # core with per-request qpos) — partitionable, the CPU tier default
+        out = _grouped_cache_einsum(cfg, q, _paged_gather(kp, block_tables),
+                                    _paged_gather(vp, block_tables),
+                                    positions, pad_bias)
+    out = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
+    return out, kp, vp
+
+
+def _paged_prefill_attention(cfg: TransformerConfig, x, lp, positions,
+                             kp, vp, slots):
+    """Prefill attention of ONE fresh request: causal self-attention over
+    its own prompt (a fresh request has no prior context to read), with the
+    prompt's k/v scattered into the request's pool blocks. x [1, T, D];
+    slots [T] flat pool slots (pad positions routed to the dummy block)."""
+    B, T, D = x.shape
+    H, KV, Hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
+
+    q, k, v = _qkv_project(cfg, x, lp, positions)
+
+    kp = _pool_scatter(kp, k.reshape(T, KV, Hd), slots)
+    vp = _pool_scatter(vp, v.reshape(T, KV, Hd), slots)
+
+    slopes = _alibi_slopes(H) if cfg.pos_embedding == "alibi" else None
+    out = None
+    if _use_flash(cfg):
+        from deepspeed_tpu.ops.pallas import flash_attention
+        out = flash_attention(q, k, v, causal=True, alibi_slopes=slopes,
+                              scale=cfg.attn_scale, block_q=cfg.attn_block_q,
+                              block_k=cfg.attn_block_k)
+    if out is None:
+        from deepspeed_tpu.ops.attention import mha_attention
+        out = mha_attention(q, k, v, causal=True, alibi_slopes=slopes,
+                            scale=cfg.attn_scale)
+    out = out.reshape(B, T, H * Hd)
+    out = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
+    return out, kp, vp
+
+
+def _check_paged_config(cfg: TransformerConfig):
+    if cfg.norm_position == "post" or not cfg.causal:
+        raise ValueError("the paged KV path serves pre-LN causal LMs only")
+    if cfg.sparse_attention is not None:
+        raise NotImplementedError(
+            "sparse_attention is not supported by the paged KV decode path")
+
+
+
+def forward_paged_prefill(cfg: TransformerConfig, params, tokens, pools,
+                          slots, last_idx, mlp_fn=None):
+    """Prefill ONE admitted request into its allocated blocks.
+
+    tokens [1, T] right-padded prompt (T the compile bucket); slots [T]
+    flat pool slots per prompt position (block_table[t // bs] * bs + t % bs,
+    pads routed to the dummy block); last_idx [] int32 index of the last
+    real prompt token. Returns (logits [1, vocab] at last_idx, new pools) —
+    junk pad positions are causally invisible to the sampled position."""
+    _check_paged_config(cfg)
+    x, positions = cached_embed(cfg, params, tokens, jnp.int32(0),
+                                pools["k"].dtype)
+
+    def run_block(h, xs):
+        lp, kp, vp = xs
+        h, nkp, nvp = _decode_block(
+            cfg, h, lp,
+            lambda xn: _paged_prefill_attention(cfg, xn, lp["attn"], positions,
+                                                kp, vp, slots),
+            mlp_fn)
+        return h, (nkp, nvp)
+
+    x, (nk, nv) = jax.lax.scan(run_block, x,
+                               (params["layers"], pools["k"], pools["v"]))
+    # head on the sampled position only: the [1, vocab] projection, not
+    # the whole bucket's [T, vocab]
+    xl = jax.lax.dynamic_slice_in_dim(x, last_idx, 1, axis=1)
+    return cached_head(cfg, params, xl)[:, 0, :], {"k": nk, "v": nv}
+
+
+def forward_paged_decode(cfg: TransformerConfig, params, tokens, pools,
+                         block_tables, pos, pad_bias=None, mlp_fn=None):
+    """One fused decode step over ALL running requests: tokens [B, 1] (each
+    request's last sampled token), block_tables [B, max_blocks], pos [B]
+    per-request cache depths. Returns (logits [B, vocab], new pools)."""
+    _check_paged_config(cfg)
+    x, positions = cached_embed(cfg, params, tokens, pos, pools["k"].dtype)
+
+    def run_block(h, xs):
+        lp, kp, vp = xs
+        h, nkp, nvp = _decode_block(
+            cfg, h, lp,
+            lambda xn: _paged_decode_attention(cfg, xn, lp["attn"], positions,
+                                               pos, kp, vp, block_tables,
+                                               pad_bias),
+            mlp_fn)
+        return h, (nkp, nvp)
+
+    x, (nk, nv) = jax.lax.scan(run_block, x,
+                               (params["layers"], pools["k"], pools["v"]))
+    return cached_head(cfg, params, x)[:, 0, :], {"k": nk, "v": nv}
 
 
 def run_layers(cfg: TransformerConfig, x, layer_params, positions, mask_bias,

@@ -12,7 +12,11 @@ Every kernel runs compiled on TPU and in interpreter mode on CPU (that is
 what the unit suite exercises); the wrappers pick automatically.
 """
 
+from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
 from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 from deepspeed_tpu.ops.pallas.fused_cross_entropy import fused_cross_entropy
+from deepspeed_tpu.ops.pallas.paged_decode_attention import \
+    paged_decode_attention
 
-__all__ = ["flash_attention", "fused_cross_entropy"]
+__all__ = ["decode_attention", "flash_attention", "fused_cross_entropy",
+           "paged_decode_attention"]

@@ -120,6 +120,7 @@ class TestFlashBackward:
 
 class TestModelFlashBackend:
 
+    @pytest.mark.slow
     def test_causal_lm_flash_matches_xla(self):
         """attention_backend='flash' (interpret on CPU) == 'xla' loss + grads."""
         from deepspeed_tpu.models import CausalLM
@@ -147,6 +148,7 @@ class TestShardedFlash:
     single-chip kernel silently fell back to einsum on >1-device meshes
     before; these prove the Pallas path runs and matches)."""
 
+    @pytest.mark.slow
     def test_flash_runs_under_dp_tp_mesh(self, monkeypatch):
         """attention_backend='flash' on a dp×tp mesh must use the Pallas
         kernel (einsum fallback is an error) and match the single-device

@@ -162,6 +162,7 @@ def test_engine_config_name_builds_fused():
     assert tx is not None
 
 
+@pytest.mark.slow
 def test_engine_trains_with_fused_adam(devices):
     """Engine-level: FusedAdam inside the compiled train step matches the
     optax AdamW path step-for-step on a fixed batch (ZeRO-1 over dp)."""

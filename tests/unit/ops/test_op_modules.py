@@ -78,6 +78,7 @@ class TestRandomLTD:
 
 
 class TestTransformerLayer:
+    @pytest.mark.slow
     def test_fused_layer_forward_and_grad(self):
         from deepspeed_tpu.ops.transformer.training_kernels import (
             DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)

@@ -1,0 +1,191 @@
+"""Paged decode-attention kernel vs the dense ``decode_attention`` kernel
+and the einsum reference, on randomized block tables (interpret mode on the
+CPU tier). The ISSUE acceptance pin: parity 1e-5 (fp32) / 2e-2 (bf16)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+from deepspeed_tpu.ops.pallas.paged_decode_attention import \
+    paged_decode_attention
+
+
+def random_paged_case(r, B, KV, Hd, bs, n_max, dtype=jnp.float32):
+    """Pools + per-request non-overlapping random block tables + positions."""
+    H = KV * int(r.choice([1, 2, 4]))
+    num_blocks = B * n_max + 1
+    kp = jnp.asarray(r.normal(size=(num_blocks, bs, KV, Hd)), dtype)
+    vp = jnp.asarray(r.normal(size=(num_blocks, bs, KV, Hd)), dtype)
+    q = jnp.asarray(r.normal(size=(B, H, Hd)), dtype)
+    perm = r.permutation(num_blocks - 1) + 1  # dummy block 0 never mapped
+    bt = jnp.asarray(perm[:B * n_max].reshape(B, n_max), jnp.int32)
+    pos = jnp.asarray(r.integers(0, n_max * bs, size=B), jnp.int32)
+    return q, kp, vp, bt, pos
+
+
+def gather_dense(pool, bt):
+    """Dense per-request cache via the block table (the reference layout
+    decode_attention expects)."""
+    Nb, bs = pool.shape[0], pool.shape[1]
+    flat = pool.reshape(Nb * bs, *pool.shape[2:])
+    idx = (bt[:, :, None] * bs + jnp.arange(bs)[None, None, :])
+    return flat[idx.reshape(bt.shape[0], -1)]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_paged_matches_dense_kernel(seed):
+    """Kernel parity vs decode_attention per request on random tables."""
+    r = np.random.default_rng(200 + seed)
+    B = int(r.integers(1, 4))
+    KV = int(r.choice([1, 2, 4]))
+    Hd = int(r.choice([64, 128]))
+    n_max = int(r.integers(1, 5))
+    q, kp, vp, bt, pos = random_paged_case(r, B, KV, Hd, 128, n_max)
+    with_bias = bool(r.integers(0, 2))
+    with_alibi = bool(r.integers(0, 2))
+    H = q.shape[1]
+    bias = (jnp.asarray(r.normal(size=(B, n_max * 128)) * 0.2, jnp.float32)
+            if with_bias else None)
+    slopes = (jnp.asarray(r.uniform(0.05, 0.4, size=H), jnp.float32)
+              if with_alibi else None)
+
+    out = paged_decode_attention(q, kp, vp, bt, pos, pad_bias=bias,
+                                 alibi_slopes=slopes)
+    ck, cv = gather_dense(kp, bt), gather_dense(vp, bt)
+    for b in range(B):
+        want = decode_attention(
+            q[b:b + 1], ck[b:b + 1], cv[b:b + 1], int(pos[b]),
+            pad_bias=None if bias is None else bias[b:b + 1],
+            alibi_slopes=slopes)
+        err = float(jnp.abs(out[b] - want[0]).max())
+        assert err < 1e-5, (seed, b, err)
+
+
+def test_paged_bf16_pools():
+    r = np.random.default_rng(9)
+    q, kp, vp, bt, pos = random_paged_case(r, 2, 2, 64, 128, 3,
+                                           dtype=jnp.bfloat16)
+    out = paged_decode_attention(q, kp, vp, bt, pos)
+    assert out.dtype == jnp.bfloat16
+    ck, cv = gather_dense(kp, bt), gather_dense(vp, bt)
+    for b in range(2):
+        want = decode_attention(q[b:b + 1].astype(jnp.float32),
+                                ck[b:b + 1].astype(jnp.float32),
+                                cv[b:b + 1].astype(jnp.float32), int(pos[b]))
+        err = float(jnp.abs(out[b].astype(jnp.float32) - want[0]).max())
+        assert err < 2e-2, (b, err)
+
+
+def test_paged_per_request_positions_differ():
+    """Requests at very different depths share one fused call — each row
+    must mask strictly by ITS OWN pos (first token vs nearly-full table)."""
+    r = np.random.default_rng(11)
+    q, kp, vp, bt, _ = random_paged_case(r, 3, 2, 64, 128, 4)
+    pos = jnp.asarray([0, 200, 511], jnp.int32)
+    out = paged_decode_attention(q, kp, vp, bt, pos)
+    ck, cv = gather_dense(kp, bt), gather_dense(vp, bt)
+    for b in range(3):
+        want = decode_attention(q[b:b + 1], ck[b:b + 1], cv[b:b + 1],
+                                int(pos[b]))
+        assert float(jnp.abs(out[b] - want[0]).max()) < 1e-5
+
+
+def test_paged_shared_pool_isolation():
+    """Two requests interleaved in one pool: permuting BOTH tables the same
+    way only relabels storage — outputs must be identical (no request reads
+    another's blocks)."""
+    r = np.random.default_rng(13)
+    q, kp, vp, bt, pos = random_paged_case(r, 2, 2, 64, 128, 3)
+    out = paged_decode_attention(q, kp, vp, bt, pos)
+    # swap two pool blocks AND fix both tables accordingly
+    a, b = 1, 2
+    swap = jnp.arange(kp.shape[0]).at[a].set(b).at[b].set(a)
+    out2 = paged_decode_attention(q, kp[swap], vp[swap], swap[bt], pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_paged_envelope_fallback():
+    """Each envelope rejection independently returns None."""
+    # block size not 128-aligned
+    q = jnp.zeros((1, 4, 64), jnp.float32)
+    kp = jnp.zeros((3, 64, 4, 64), jnp.float32)
+    bt = jnp.zeros((1, 2), jnp.int32)
+    assert paged_decode_attention(q, kp, kp, bt, jnp.zeros(1, jnp.int32)) is None
+    # head dim not lane-aligned
+    q = jnp.zeros((1, 4, 48), jnp.float32)
+    kp = jnp.zeros((3, 128, 4, 48), jnp.float32)
+    assert paged_decode_attention(q, kp, kp, bt, jnp.zeros(1, jnp.int32)) is None
+
+
+def test_paged_traced_pos_and_tables():
+    """pos and block tables may be traced (the serving decode jit carries
+    them as arguments, not constants)."""
+    r = np.random.default_rng(17)
+    q, kp, vp, bt, pos = random_paged_case(r, 2, 2, 64, 128, 2)
+
+    @jax.jit
+    def f(bt, pos):
+        return paged_decode_attention(q, kp, vp, bt, pos)
+
+    out = f(bt, pos)
+    ck, cv = gather_dense(kp, bt), gather_dense(vp, bt)
+    for b in range(2):
+        want = decode_attention(q[b:b + 1], ck[b:b + 1], cv[b:b + 1],
+                                int(pos[b]))
+        assert float(jnp.abs(out[b] - want[0]).max()) < 1e-5
+
+
+def test_forward_paged_matches_forward_cached():
+    """Model-level parity: paged prefill + decode reproduces the dense
+    cached path's logits (GQA + rope) with attention_backend='flash', so
+    the PAGED KERNEL (interpret mode) sits in the decode loop. The xla
+    backend's paged path is pinned bitwise by the test_serving greedy
+    identity tests — not repeated here."""
+    from deepspeed_tpu.models.causal_lm import CausalLM
+    from deepspeed_tpu.models.transformer import TransformerConfig
+
+    import deepspeed_tpu.comm as dist
+    dist.set_mesh(None)
+    r = np.random.default_rng(23)
+    for backend in ("flash",):
+        cfg = TransformerConfig(vocab_size=128, max_seq=256, n_layer=2,
+                                n_head=4, n_kv_head=2, d_model=256,
+                                pos_embedding="rope", norm="rmsnorm",
+                                activation="swiglu", remat=False,
+                                attention_backend=backend)
+        model = CausalLM(cfg)
+        params = model.init_params(jax.random.key(0))
+        plen = 10
+        toks = jnp.asarray(r.integers(0, 128, size=(1, plen)), jnp.int32)
+
+        cache = model.init_cache(1, 256, dtype=jnp.float32)
+        lp, cache = model.forward_cached(params, toks, cache, jnp.int32(0))
+        ref = [lp[:, plen - 1]]
+
+        pools = model.init_paged_cache(4, 128, dtype=jnp.float32)
+        table = np.asarray([2, 1], np.int32)
+        t = np.arange(128)
+        slots = np.where(t < plen, table[t // 128] * 128 + t % 128, t % 128)
+        logits, pools = model.forward_paged_prefill(
+            params, jnp.pad(toks, ((0, 0), (0, 128 - plen))), pools,
+            jnp.asarray(slots, jnp.int32), jnp.int32(plen - 1))
+        got = [logits]
+
+        bt = jnp.asarray(table[None, :], jnp.int32)
+        nxt = jnp.argmax(logits, axis=-1)
+        for step in range(3):
+            pos = plen + step
+            ld, cache = model.forward_cached(
+                params, nxt[:, None].astype(jnp.int32), cache, jnp.int32(pos))
+            lpd, pools = model.forward_paged_decode(
+                params, nxt[:, None].astype(jnp.int32), pools, bt,
+                jnp.asarray([pos], jnp.int32))
+            ref.append(ld[:, 0])
+            got.append(lpd)
+            nxt = jnp.argmax(lpd, axis=-1)
+        for i, (a, b) in enumerate(zip(got, ref)):
+            err = float(jnp.abs(a - b).max())
+            assert err < 1e-3, (backend, i, err)

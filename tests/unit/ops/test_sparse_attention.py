@@ -116,6 +116,7 @@ class TestSparseSelfAttention:
                               block_layout=jnp.asarray(layout, jnp.float32), interpret=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_pallas_blocksparse_grads(self):
         q, k, v = self._qkv(S=128, H=1, Hd=32)
         cfg = LocalSlidingWindowSparsityConfig(num_heads=1, block=32,
@@ -189,6 +190,7 @@ class TestSparseAttentionUtils:
         kw.update(over)
         return CausalLM(TransformerConfig(**kw))
 
+    @pytest.mark.slow
     def test_replace_self_attention_dense_layout_matches(self):
         """An all-ones layout must reproduce dense attention exactly."""
         from deepspeed_tpu.ops.sparse_attention import (DenseSparsityConfig,
@@ -203,6 +205,7 @@ class TestSparseAttentionUtils:
         got = np.asarray(sparse.forward(params, tok), np.float32)
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_sparse_layout_changes_attention(self):
         """A genuinely sparse layout must differ from dense attention, and
         training through the engine must still descend."""
@@ -288,6 +291,7 @@ class TestSparseAttentionUtils:
         with pytest.raises(TypeError, match="cannot sparsify"):
             replace_self_attention(object(), FixedSparsityConfig(num_heads=4))
 
+    @pytest.mark.slow
     def test_sparse_kernel_under_mesh(self, mesh_2d):
         """dp x tp mesh: the block layout rides the head axis through the
         shard_map'd flash kernel (interpret on CPU) and matches the

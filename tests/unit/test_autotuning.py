@@ -30,6 +30,7 @@ def no_mesh():
 
 
 class TestPrune:
+    @pytest.mark.slow
     def test_estimate_scales_with_micro_batch(self):
         from deepspeed_tpu.autotuning.autotuner import Candidate
         at = Autotuner(tiny_model(), base_config={}, seq_len=32)
@@ -37,6 +38,7 @@ class TestPrune:
         big = at.estimate_bytes(Candidate(1, 64, "none", 0))
         assert big > small
 
+    @pytest.mark.slow
     def test_budget_prunes_oversized(self):
         from deepspeed_tpu.autotuning.autotuner import Candidate
         at = Autotuner(tiny_model(), base_config={}, seq_len=32,
@@ -44,6 +46,7 @@ class TestPrune:
         fits, _ = at.prune(Candidate(1, 1, "none", 0))
         assert not fits
 
+    @pytest.mark.slow
     def test_zero_stage_divides_state(self):
         from deepspeed_tpu.autotuning.autotuner import Candidate
         at = Autotuner(tiny_model(), base_config={"mesh": {"dp": 8}}, seq_len=32)

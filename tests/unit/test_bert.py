@@ -96,6 +96,7 @@ def test_bert_hidden_and_pooled_match_transformers():
     assert float(jnp.abs(pooled - ref.pooler_output.numpy()).max()) < 2e-4
 
 
+@pytest.mark.slow
 def test_bert_attention_mask():
     """Padding mask: masked positions must not affect unmasked outputs."""
     cfg_hf = _tiny_cfg()
@@ -155,6 +156,7 @@ def test_bert_serves_through_init_inference():
     dist.set_mesh(None)
 
 
+@pytest.mark.slow
 def test_bert_mlm_trains_through_engine():
     """BertModel is a first-class training model: MLM loss descends under
     the engine (the reference's fastest-BERT-training workload shape)."""
@@ -197,6 +199,7 @@ def test_bert_mlm_trains_through_engine():
         headless.loss(headless.init_params(jax.random.key(1)), fixed)
 
 
+@pytest.mark.slow
 def test_bert_loss_chunked_matches_unchunked_and_param_count():
     import numpy as np
     cfgs = [BertConfig(vocab_size=128, max_seq=32, n_layer=2, n_head=4,
@@ -218,6 +221,7 @@ def test_bert_loss_chunked_matches_unchunked_and_param_count():
     assert abs(l0 - l1) < 1e-5, (l0, l1)
 
 
+@pytest.mark.slow
 def test_bert_mlm_gather_budget_matches_full_head():
     """mlm_gather_budget routes only a static gather of masked positions
     through the prediction head; within budget the loss AND grads are
@@ -253,6 +257,7 @@ def test_bert_mlm_gather_budget_matches_full_head():
     assert gathered.flops_per_token() < full.flops_per_token()
 
 
+@pytest.mark.slow
 def test_bert_dropout_rng_gated():
     """BertConfig.dropout (HF hidden_dropout_prob) applies on the
     rng-threaded MLM loss only; rng=None equals the dropout-free model."""

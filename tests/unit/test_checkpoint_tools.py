@@ -140,6 +140,7 @@ class TestSDLoader:
         assert paths == ["/models/x/a.pt", "/models/x/b.pt"]
         assert version == 2.0
 
+    @pytest.mark.slow
     def test_merge_and_reslice(self, tmp_path):
         import torch
         full_col = np.arange(32.0).reshape(4, 8).astype(np.float32)
@@ -212,6 +213,7 @@ class TestPipelineReshape:
             })
         return engine, model
 
+    @pytest.mark.slow
     def test_pp2_tp2_to_pp4_and_pp1(self, tmp_path, devices):
         from deepspeed_tpu.checkpoint import (reshape_pipeline_checkpoint,
                                               stages_to_layers)
@@ -251,6 +253,7 @@ class TestPipelineReshape:
                                    rtol=2e-5, atol=2e-5)
         dist.set_mesh(None)
 
+    @pytest.mark.slow
     def test_universal_canonicalizes_stages(self, tmp_path, devices):
         """ds_to_universal stores flat layers; loads into BOTH a plain
         CausalLM and a differently-staged pipeline model."""

@@ -54,6 +54,7 @@ def _map_text_params(hf, L):
     }
 
 
+@pytest.mark.slow
 def test_text_encoder_matches_transformers():
     cfg_hf = transformers.CLIPTextConfig(
         vocab_size=99, hidden_size=32, intermediate_size=64,
@@ -80,6 +81,7 @@ def test_text_encoder_matches_transformers():
     assert err_p < 2e-4, err_p
 
 
+@pytest.mark.slow
 def test_vision_encoder_shapes_and_finite():
     cfg = CLIPVisionConfig(image_size=32, patch_size=8, n_layer=2, n_head=4,
                            d_model=32, d_ff=64, projection_dim=16)

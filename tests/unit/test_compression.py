@@ -93,6 +93,7 @@ class TestCompressedTraining:
         }
     }
 
+    @pytest.mark.slow
     def test_wrapped_model_trains_and_scheduler_gates(self, devices):
         from deepspeed_tpu.models import CausalLM
         from deepspeed_tpu.models.transformer import TransformerConfig
@@ -328,6 +329,7 @@ def test_act_quant_decode_matches_forward():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_scheduler_transition_retraces_engine(devices):
     """A schedule transition changes the computation: the engine must drop
     its compiled programs (compression_epoch) or QAT silently never starts."""
@@ -386,6 +388,7 @@ def test_bert_layer_reduction_rebuilds_zoo_cfg():
     assert jax.tree.leaves(params["layers"])[0].shape[0] == 2
 
 
+@pytest.mark.slow
 def test_scheduler_transition_retraces_trio_path(devices):
     """Same retrace guarantee on the reference-shaped forward/backward/step
     trio: a user driving the engine via forward() (not train_batch) must not

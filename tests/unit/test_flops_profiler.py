@@ -56,6 +56,7 @@ class TestJaxprFlops:
 
 class TestGetModelProfile:
 
+    @pytest.mark.slow
     def test_model_profile(self):
         from deepspeed_tpu.models import CausalLM
         from deepspeed_tpu.models.transformer import TransformerConfig
@@ -107,6 +108,7 @@ class TestFlopsProfilerClass:
 
 class TestEngineFlopsProfiler:
 
+    @pytest.mark.slow
     def test_profile_step_fires(self, devices, capsys):
         import deepspeed_tpu
         import deepspeed_tpu.comm as dist

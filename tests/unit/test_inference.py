@@ -156,6 +156,40 @@ class TestKVCacheDecode:
         assert engine._prefill_jit._cache_size() == 1
         assert engine._workspace[1] == ws0[1]  # same workspace capacity reused
 
+    def test_workspace_reused_for_smaller_batch(self):
+        """A call with B smaller than the allocated workspace batch must
+        slice (keeping the larger workspace for future calls), not
+        reallocate — and produce the same per-row tokens."""
+        model = self._model()
+        engine = deepspeed_tpu.init_inference(model, dtype="fp32")
+        prompt = jnp.array([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        out2 = engine.generate(prompt, max_new_tokens=5)
+        ws = engine._workspace
+        out1 = engine.generate(prompt[:1], max_new_tokens=5)
+        assert engine._workspace is ws, (
+            "smaller-batch call replaced the larger workspace")
+        np.testing.assert_array_equal(np.asarray(out1)[0], np.asarray(out2)[0])
+        # and the big batch immediately reuses the kept workspace
+        out2b = engine.generate(prompt, max_new_tokens=5)
+        assert engine._workspace[1] == ws[1]
+        np.testing.assert_array_equal(np.asarray(out2b), np.asarray(out2))
+
+    def test_decode_output_buffer_bounded_by_max_new(self):
+        """The decode loop's token buffer is sized by the (128-bucketed)
+        max_new, not the cache capacity Smax (HBM + host-transfer waste)."""
+        model = self._model(max_seq=256)
+        engine = deepspeed_tpu.init_inference(model, dtype="fp32")
+        engine.generate(jnp.array([[1, 2, 3]], jnp.int32), max_new_tokens=5)
+        assert engine._workspace[1] == 256  # cache capacity stays Smax
+        # compiled decode loop's out buffer: bucket(5) = 128, not 256
+        lowered = engine._decode_jit.lower(
+            engine.params, engine._workspace[2],
+            jnp.zeros((1,), jnp.int32), jnp.int32(3), jnp.int32(5),
+            jax.random.key(0), jnp.float32(0.0), jnp.int32(0),
+            jnp.int32(-1), 128)
+        shapes = str(lowered.out_info)
+        assert "(1, 128)" in shapes and "(1, 256)" not in shapes, shapes
+
     def test_eos_early_exit_on_device(self):
         """The decode loop must stop early at eos without per-token host
         syncs: the output stops at the first eos row-wide."""

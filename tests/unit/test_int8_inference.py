@@ -79,12 +79,14 @@ class TestInt8Engine:
                    for x in jax.tree.leaves(e_i8.params,
                                             is_leaf=lambda x: isinstance(x, Quantized8)))
 
+    @pytest.mark.slow
     def test_int8_generate_runs(self):
         m = tiny()
         eng = deepspeed_tpu.init_inference(m, dtype="int8")
         out = eng.generate(np.array([[1, 2, 3]], np.int32), max_new_tokens=4)
         assert np.asarray(out).shape == (1, 7)
 
+    @pytest.mark.slow
     def test_int8_tp_matches_tp1(self):
         """int8 x TP composes (reference GroupQuantizer + TP slicing,
         replace_module.py:42-135): tp=2 serving matches tp=1 exactly (the
@@ -162,6 +164,7 @@ class TestGroupAlignment:
         assert shardings.q.spec[-1] == "tp", "payload lost tp sharding"
         assert shardings.scale.spec[-1] == "tp", "scales replicated"
 
+    @pytest.mark.slow
     def test_alignment_always_possible_when_shardable(self):
         """Invariant behind the design: if q_groups divides the quant axis
         (quantize_int8's precondition) and the tp axis divides it too
@@ -215,6 +218,7 @@ class TestGroupAlignment:
         warns = [r for r in records if "q_groups=4" in r.getMessage()]
         assert len(warns) == 1, "warning must fire exactly once per config"
 
+    @pytest.mark.slow
     def test_engine_q_groups_4_tp_8_end_to_end(self):
         """Through the real engine: q_groups=4, tp=8 serves correctly and the
         engine's stored scales are subdivided + sharded."""
@@ -237,6 +241,7 @@ class TestGroupAlignment:
 
 
 class TestInt8EncoderServing:
+    @pytest.mark.slow
     def test_int8_bert_argmax_parity(self, tmp_path):
         """int8 weight-only composes with the encoder (BERT) serving path:
         fill-mask argmax matches fp32."""

@@ -185,6 +185,7 @@ class TestLocalLaunch:
     """Real 2-process spawn (the reference's DistributedTest analogue for the
     launcher itself)."""
 
+    @pytest.mark.slow
     def test_two_process_launch(self, tmp_path):
         script = tmp_path / "worker.py"
         script.write_text(
@@ -339,6 +340,7 @@ class TestDscliSsh:
             _ssh(["-f", str(tmp_path / "nope"), "true"])
 
 
+@pytest.mark.slow
 def test_bin_scripts_run_from_checkout(tmp_path):
     """bin/dscli and bin/ds_report work straight from a checkout with no
     install and no PYTHONPATH (they bootstrap the repo root)."""

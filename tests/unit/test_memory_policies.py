@@ -49,7 +49,7 @@ class TestRematPolicies:
 
     @pytest.mark.parametrize("remat", [
         pytest.param(False, marks=pytest.mark.nightly),
-        "dots", "selective",
+        pytest.param("dots", marks=pytest.mark.slow), "selective",
         pytest.param("offload_dots", marks=pytest.mark.nightly)])
     def test_loss_and_grad_parity(self, remat):
         p, b, ref_loss, ref_grads = self.reference()
@@ -61,6 +61,7 @@ class TestRematPolicies:
         jax.tree.map(lambda a, r: np.testing.assert_allclose(
             np.asarray(a), np.asarray(r), rtol=2e-4, atol=2e-5), grads, ref_grads)
 
+    @pytest.mark.slow
     def test_selective_saves_less_than_none(self):
         """Compiled-memory assertion: 'selective' must keep fewer live
         activation bytes than remat=False (save everything)."""
@@ -75,6 +76,7 @@ class TestRematPolicies:
 
         assert peak("selective") < peak(False)
 
+    @pytest.mark.slow
     def test_full_remat_saves_least(self):
         b = batch(B=4, S=64)
 
@@ -116,6 +118,7 @@ class TestLossChunk:
         p = m0.init_params(jax.random.key(0))
         assert np.allclose(float(m0.loss(p, b)), float(mc.loss(p, b)), rtol=1e-5)
 
+    @pytest.mark.slow
     def test_chunked_ce_caps_logits_buffer(self):
         """The whole point of loss_chunk: the [B, S, vocab] logits must never
         be materialised. Compare compiled temp memory against unchunked."""

@@ -75,6 +75,7 @@ def test_scan_matches_unrolled():
                                np.asarray(model_u.forward(params, tokens)), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_tiny_transformer_trains_zero3_tp(mesh_2d):
     """End-to-end: tiny LLaMA-style model, ZeRO-3 + TP on the 4x2 mesh."""
     dist.set_mesh(None)
@@ -114,6 +115,7 @@ def test_num_parameters_exact():
     assert model.num_parameters == actual
 
 
+@pytest.mark.slow
 def test_dropout_trains_and_eval_is_deterministic():
     """cfg.dropout engages on the rng-threaded training loss (embedding +
     residual-branch placement, reference hidden/attn-output dropout

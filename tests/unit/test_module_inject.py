@@ -52,6 +52,7 @@ def parity(tmp_path, hf_model, hf_cfg, rtol=2e-2, atol=2e-3):
 
 
 class TestHFPolicies:
+    @pytest.mark.slow
     def test_gpt2(self, tmp_path):
         cfg = transformers.GPT2Config(vocab_size=96, n_positions=32, n_embd=32,
                                       n_layer=2, n_head=2)

@@ -251,6 +251,7 @@ def test_moe_causal_lm_trains(devices):
     dist.set_mesh(None)
 
 
+@pytest.mark.slow
 def test_moe_hidden_dropout():
     """cfg.dropout applies to the MoE block's residual branches too (keys
     split off the routing rng); rng=None (eval) stays deterministic and

@@ -96,6 +96,7 @@ class TestMoEServing:
                 model, params=params,
                 config={"moe": {"ep_size": 2, "type": "residual"}})
 
+    @pytest.mark.slow
     def test_int8_moe_serves_close_to_fp32(self):
         """int8 expert weights serve (the reject is gone): logits stay close
         to fp32 and the expert weights really rest as Quantized8."""
@@ -162,6 +163,7 @@ class TestMegatronMoEIngestion:
                 sd[f"{ex}.dense_4h_to_h.bias"] = np.asarray(lay["mlp"]["b_down"][i, e])
         return sd
 
+    @pytest.mark.slow
     def test_roundtrip_exact(self):
         from deepspeed_tpu.module_inject.megatron import map_megatron_params
 
@@ -265,6 +267,7 @@ class TestResidualMoE:
                                           eval_capacity_factor=2.0,
                                           expert_ff_mult=2, use_residual=True))
 
+    @pytest.mark.slow
     def test_trains(self):
         import deepspeed_tpu
         model = self._model()
@@ -372,6 +375,7 @@ class TestMoECachedDecode:
                                    np.asarray(full2[:, 8]),
                                    rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
     def test_generate_uses_cache_and_matches_recompute(self):
         model = self._model()
         params = model.init_params(jax.random.key(2))
@@ -390,6 +394,7 @@ class TestMoECachedDecode:
         np.testing.assert_array_equal(out, np.asarray(toks))
 
 
+@pytest.mark.slow
 def test_moe_prefill_padding_cannot_steal_capacity():
     """Bucket padding must not compete with real tokens for expert capacity:
     at TIGHT capacity, generate on a short prompt (heavy right-padding) must
@@ -413,6 +418,7 @@ def test_moe_prefill_padding_cannot_steal_capacity():
     np.testing.assert_array_equal(out[:, 3], want)
 
 
+@pytest.mark.slow
 def test_int8_residual_moe_serves():
     """int8 x residual (PR-)MoE: expert AND dense-branch weights rest
     quantized; logits stay close to fp32 and generate decodes."""

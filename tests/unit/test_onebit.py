@@ -230,7 +230,7 @@ class TestOnebitEngine:
         return engine
 
     @pytest.mark.parametrize("opt", [
-        "OneBitAdam",
+        pytest.param("OneBitAdam", marks=pytest.mark.slow),
         pytest.param("ZeroOneAdam", marks=pytest.mark.nightly),
         pytest.param("OneBitLamb", marks=pytest.mark.nightly)])
     def test_trains_through_compression_phase(self, opt, devices):

@@ -252,6 +252,7 @@ def _tiny_pipe_model(n_layer=4, num_stages=4):
     return PipelinedCausalLM(cfg, num_stages=num_stages)
 
 
+@pytest.mark.slow
 def test_spmd_pipeline_loss_matches_sequential(devices):
     """Pipelined loss over a real pp mesh == sequential loss (same params)."""
     from deepspeed_tpu.runtime.pipe.engine import spmd_pipeline_loss
@@ -393,6 +394,7 @@ def test_pipeline_engine_gpipe_schedule_still_works(devices):
     dist.set_mesh(None)
 
 
+@pytest.mark.slow
 def test_pp_stage_attention_runs_flash_kernel(devices, monkeypatch):
     """Attention inside pipeline stages reaches the Pallas flash kernel under
     a pp×dp mesh (the stage shard_map makes the body fully device-local, so
@@ -630,6 +632,7 @@ def test_pp_tp_indivisible_heads_fall_back(devices):
         dist.set_mesh(None)
 
 
+@pytest.mark.slow
 def test_pp_tp_stage_attention_runs_flash_kernel(devices, monkeypatch):
     """Attention inside pipeline stages STILL reaches the Pallas flash
     kernel when the stage shard_map also covers a tp axis (manual Megatron
@@ -686,6 +689,7 @@ def test_pp_tp_stage_attention_runs_flash_kernel(devices, monkeypatch):
     dist.set_mesh(None)
 
 
+@pytest.mark.slow
 def test_pp_tp_manual_stages_with_dropout(devices):
     """Dropout inside MANUAL (pp×dp×tp) stage bodies: the builder folds the
     dp coordinate into stage keys (data shards draw different masks) but

@@ -194,6 +194,7 @@ class TestGQASequenceParallel:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_gqa_model_loss_with_sp(self, devices):
         """End-to-end: a GQA model trains under ring SP and matches the
         dense-mesh loss."""

@@ -128,6 +128,7 @@ def test_fully_masked_rows_uniform_over_real_keys():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_long_seq_fallback_streams(monkeypatch):
     """attention()'s XLA fallback streams past DENSE_STREAM_THRESHOLD and
     matches the dense path (the stage-vmap batching itself is covered by
@@ -158,6 +159,7 @@ def test_long_seq_fallback_streams(monkeypatch):
                                    rtol=5e-4, atol=5e-5)
 
 
+@pytest.mark.slow
 def test_vmapped_core_matches_per_slice():
     """chunked_attention under jax.vmap (the pipeline engine's stage axis):
     batched application equals per-slice application, through the custom

@@ -75,6 +75,7 @@ def _one_case(seed: int):
         assert gerr < 5e-4, (seed, name, B, Sq, Sk, H, KV, Hd, chunk, causal, gerr)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", range(5))
 def test_streaming_fuzz_smoke(seed):
     _one_case(seed)

@@ -459,6 +459,7 @@ class TestFusedCrossEntropy:
                   zip(jax.tree.leaves(g_off), jax.tree.leaves(g_on)))
         assert err < 1e-5, err
 
+    @pytest.mark.slow
     def test_bert_mlm_fused_matches_chunked(self):
         """BERT MLM head (decoder bias + ignore-index labels + gather
         budget): fused vs XLA paths agree."""
