@@ -89,10 +89,47 @@ def _parse_remat(env: str):
             "0": False, "false": False, "none": False}.get(env.lower(), env)
 
 
+def _reset_telemetry():
+    """Fresh registry/watchdog per metric so each record's embedded
+    telemetry blob describes THAT metric's run only. Must run before the
+    engine is constructed (families created at init would be orphaned)."""
+    from deepspeed_tpu.monitor.metrics import get_registry
+    from deepspeed_tpu.monitor.trace import get_compile_watchdog
+    get_compile_watchdog().reset()
+    get_registry().reset()
+
+
+def _telemetry_blob(engine):
+    """Compact telemetry summary for the result record: compile counts,
+    MFU/step-time (training engines), serving histograms (decode bench)."""
+    snap = engine.telemetry_snapshot() \
+        if hasattr(engine, "telemetry_snapshot") else {}
+    if not snap:
+        return None
+    blob = {"compile_counts": snap.get("compile", {}).get("by_fn", {})}
+    g, h, c = (snap.get("gauges", {}), snap.get("histograms", {}),
+               snap.get("counters", {}))
+    for k in ("train/mfu", "train/tokens_per_sec",
+              "train/achieved_tflops_per_chip", "serving/queue_depth",
+              "serving/kv_block_utilization", "serving/running"):
+        if k in g:
+            blob[k] = round(g[k], 6)
+    for k in ("train/step_time_ms", "serving/ttft_ms", "serving/tpot_ms"):
+        if k in h:
+            blob[k] = {kk: round(float(vv), 3) for kk, vv in h[k].items()}
+    for k in ("serving/preemptions", "serving/recompute_tokens",
+              "serving/prefill_steps", "serving/decode_steps",
+              "serving/generated_tokens"):
+        if k in c:
+            blob[k] = c[k]
+    return blob
+
+
 def build_bench_engine():
     """The bench's env knobs → (engine, model, batch_fn, knobs dict). Shared
     with benchmarks/profile_bench.py so the profile always measures the
     exact configuration the bench reports."""
+    _reset_telemetry()
     import jax
     import numpy as np
 
@@ -130,6 +167,7 @@ def build_bench_engine():
         "bf16": {"enabled": True},
         "mesh": {"dp": -1},
         "steps_per_print": 0,
+        "telemetry": {"enabled": True},
     }
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=config)
 
@@ -152,6 +190,7 @@ def build_llama_bench_engine():
     ZeRO-3 so the driver exercises parameter sharding + gather-on-use even
     at world size 1 (the sharding rules, master-param update, and donation
     paths are identical; only the collective extent changes)."""
+    _reset_telemetry()
     import jax
     import numpy as np
 
@@ -183,6 +222,7 @@ def build_llama_bench_engine():
         "bf16": {"enabled": True},
         "mesh": {"dp": -1},
         "steps_per_print": 0,
+        "telemetry": {"enabled": True},
     }
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=config)
 
@@ -201,6 +241,7 @@ def build_bert_bench_engine():
     seq 512, ZeRO-2, bf16. On by default (BENCH_BERT=0 gates it) now that
     the fused logits-free CE kernel removes the vocab-head bottleneck the
     metric was gated on."""
+    _reset_telemetry()
     import jax
     import numpy as np
 
@@ -234,6 +275,7 @@ def build_bert_bench_engine():
         "bf16": {"enabled": True},
         "mesh": {"dp": -1},
         "steps_per_print": 0,
+        "telemetry": {"enabled": True},
     }
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=config)
 
@@ -272,17 +314,24 @@ def _run_metric(name, engine, model, batch, BATCH, SEQ, steps, extra_unit):
 
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", "unknown").lower()
-    peak = 197.0 if ("v5" in kind and "lite" in kind) or "v5e" in kind else \
-           459.0 if "v5p" in kind else 275.0 if "v4" in kind else 197.0
+    # one peak table for the whole system (accelerator device-kind map +
+    # DS_PEAK_TFLOPS override — the same denominator the telemetry MFU
+    # gauge uses); 197 keeps the historical default for unknown kinds
+    from deepspeed_tpu.accelerator import get_accelerator
+    peak = get_accelerator().peak_tflops() or 197.0
     mfu = achieved_tflops / peak
 
-    print(json.dumps({
+    rec = {
         "metric": name,
         "value": round(tokens_per_sec, 1),
         "unit": f"tokens/s (bf16, bs{BATCH}xseq{SEQ}, {extra_unit}, {kind}, "
                 f"{achieved_tflops:.1f} TFLOPs, MFU {mfu:.3f}, loss {loss_val:.3f})",
         "vs_baseline": round(mfu / 0.50, 3),
-    }), flush=True)
+    }
+    tel = _telemetry_blob(engine)
+    if tel:
+        rec["telemetry"] = tel
+    print(json.dumps(rec), flush=True)
 
 
 # single registry: (env gate, default, metric name) — consumed by BOTH the
@@ -332,8 +381,9 @@ def run_decode_bench():
     RUNNING = int(os.environ.get("BENCH_DECODE_RUNNING", 8))
     model = gpt2("125m", remat=False,
                  attention_backend=os.environ.get("BENCH_ATTN", "auto"))
+    _reset_telemetry()
     engine = deepspeed_tpu.init_inference(
-        model, dtype="bf16",
+        model, dtype="bf16", telemetry=True,
         serving={"block_size": BLOCK, "max_running": RUNNING})
     rng = np.random.default_rng(0)
     # mixed prompt lengths: the tail-convoy shape continuous batching wins on
@@ -346,6 +396,11 @@ def run_decode_bench():
         if not _metric_enabled(gate):
             continue
         name = _metric_name(gate)
+        # per-mode reset: the dense record's blob must not leak into the
+        # paged one (warm-up compiles after the reset are part of that
+        # mode's run and stay). Safe mid-engine: every telemetry handle on
+        # the inference path re-resolves its registry family per use.
+        _reset_telemetry()
         engine._config.serving.paged = mode
         # warm ONE prompt per 128-bucket present in the mix (the prefill
         # program compiles per bucket) with a max_new in the SAME 128-bucket
@@ -370,14 +425,18 @@ def run_decode_bench():
         kind = getattr(dev, "device_kind", "unknown").lower()
         vs = (round(results["auto"] / results["off"], 3)
               if mode == "auto" and results.get("off") else 0.0)
-        print(json.dumps({
+        rec = {
             "metric": name,
             "value": round(gen_tokens / dt, 1),
             "unit": f"generated tokens/s (bf16, {NREQ} reqs x {MAX_NEW} new, "
                     f"prompts 32-256, block={BLOCK}, running={RUNNING}, "
                     f"{kind})",
             "vs_baseline": vs,
-        }), flush=True)
+        }
+        tel = _telemetry_blob(engine)
+        if tel:
+            rec["telemetry"] = tel
+        print(json.dumps(rec), flush=True)
 
 
 def _emit_skip_records(err: str):

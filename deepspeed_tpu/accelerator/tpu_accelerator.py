@@ -137,6 +137,29 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         # XLA owns the allocator; nothing to flush.
         pass
 
+    # per-chip bf16 matmul peak by device kind: the MFU denominator used
+    # by the telemetry gauge and bench.py (DS_PEAK_TFLOPS overrides for
+    # kinds not in the table)
+    _PEAK_TFLOPS = (("v5p", 459.0), ("v5e", 197.0), ("v5lite", 197.0),
+                    ("v4", 275.0), ("v3", 123.0), ("v2", 45.0))
+
+    def peak_tflops(self) -> float:
+        """Per-chip bf16 peak TFLOP/s, or 0.0 when unknown (the MFU gauge
+        then reads 0 rather than fabricating a denominator)."""
+        import os
+        env = os.environ.get("DS_PEAK_TFLOPS")
+        if env:
+            return float(env)
+        try:
+            kind = getattr(self._devices()[0], "device_kind", "").lower()
+        except Exception:
+            return 0.0
+        kind = kind.replace(" ", "")
+        for tag, peak in self._PEAK_TFLOPS:
+            if tag in kind:
+                return peak
+        return 0.0
+
     def memory_stats(self, device_index: Optional[int] = None) -> dict:
         return self._stats(device_index)
 

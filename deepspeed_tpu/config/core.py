@@ -186,6 +186,8 @@ class DeepSpeedConfig:
                                                      C.WALL_CLOCK_BREAKDOWN_DEFAULT)
         self.memory_breakdown = get_scalar_param(param_dict, C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT)
         self.monitor_config: DeepSpeedMonitorConfig = get_monitor_config(param_dict)
+        from deepspeed_tpu.monitor.config import get_telemetry_config
+        self.telemetry_config = get_telemetry_config(param_dict)
 
         self.gradient_accumulation_dtype = param_dict.get(C.DATA_TYPES, {}).get(C.GRAD_ACCUM_DTYPE,
                                                                                 C.GRAD_ACCUM_DTYPE_DEFAULT)

@@ -18,6 +18,7 @@ from typing import Any, Dict, Optional, Union
 from pydantic import Field
 
 from deepspeed_tpu.config.config_utils import ConfigModel
+from deepspeed_tpu.monitor.config import TelemetryConfig
 from deepspeed_tpu.utils.logging import warn_once
 
 
@@ -150,6 +151,10 @@ class DeepSpeedInferenceConfig(ConfigModel):
     save_mp_checkpoint_path: Optional[str] = None
     checkpoint_config: InferenceCheckpointConfig = Field(default_factory=InferenceCheckpointConfig, alias="ckpt_config")
     serving: ServingConfig = Field(default_factory=ServingConfig)
+    # serving telemetry (TTFT/TPOT histograms, queue depth, KV utilization,
+    # preemption counters + the compile watchdog); accepts a dict, a bool,
+    # or "on"/"off" like the training config's section
+    telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     return_tuple: bool = True
     training_mp_size: int = 1
     replace_method: str = Field("auto", json_schema_extra={"deprecated": True})
@@ -175,4 +180,9 @@ class DeepSpeedInferenceConfig(ConfigModel):
             warn_once("enable_cuda_graph has no TPU analogue; jax.jit already captures the graph. Ignoring.")
         if "dtype" in data and data["dtype"] is not None:
             data["dtype"] = DtypeEnum.from_any(data["dtype"])
+        if "telemetry" in data and not isinstance(data["telemetry"],
+                                                  (dict, TelemetryConfig)):
+            from deepspeed_tpu.monitor.config import get_telemetry_config
+            data["telemetry"] = get_telemetry_config(
+                {"telemetry": data["telemetry"]})
         super().__init__(**data)

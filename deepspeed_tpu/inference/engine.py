@@ -317,16 +317,57 @@ class InferenceEngine:
         self._decode_jit = None
         self._stream_jits = None
         self._paged_jits = None
+
+        # ---- telemetry (serving stats + compile watchdog) ----
+        tcfg = getattr(self._config, "telemetry", None)
+        self._telemetry = tcfg if tcfg is not None and tcfg.enabled else None
+        self._serving_tel = None
+        if self._telemetry is not None:
+            from deepspeed_tpu.inference.scheduler import ServingTelemetry
+            from deepspeed_tpu.monitor.metrics import get_registry
+            from deepspeed_tpu.monitor.trace import get_compile_watchdog
+            reg = get_registry()
+            reg.set_enabled(True)
+            self._tel_reg = reg
+            self._tel_watchdog = get_compile_watchdog()
+            self._tel_watchdog.storm_threshold = tcfg.compile_storm_threshold
+            self._serving_tel = ServingTelemetry(reg)
+
         log_dist(f"InferenceEngine ready: dtype={self.dtype.__name__}, tp={tp_size}, "
                  f"mesh={dict(self.mesh.shape)}"
                  + (", weight-streaming" if self._stream_weights else ""), ranks=[0])
 
     # ------------------------------------------------------------------ #
 
+    def _watched(self, fn, name: str):
+        """Route a compiled entry point through the compile watchdog when
+        telemetry is on."""
+        if self._telemetry is None:
+            return fn
+        return self._tel_watchdog.watch(fn, name)
+
+    def telemetry_snapshot(self) -> Dict:
+        """Whole-process registry snapshot plus the compile watchdog's
+        summary. Empty dict when telemetry is off."""
+        if self._telemetry is None:
+            return {}
+        snap = self._tel_reg.snapshot()
+        snap["compile"] = self._tel_watchdog.summary()
+        return snap
+
+    # ------------------------------------------------------------------ #
+
     def profile_model_time(self, use_cuda_events: bool = True) -> None:
         """Start recording per-forward model latency (reference
         profile_model_time; ``use_cuda_events`` accepted for parity — the
-        timing here is a device-synchronized wall clock)."""
+        timing here is a device-synchronized wall clock). Calling it again
+        while already enabled is a no-op (a second enable must not silently
+        drop the latencies recorded since the first)."""
+        if getattr(self, "_model_profile_enabled", False):
+            logger.warning("profile_model_time() called twice; model-time "
+                           "profiling is already enabled — keeping the "
+                           "recorded latencies (read them with model_times())")
+            return
         self._model_profile_enabled = True
         self._model_times = []
 
@@ -380,6 +421,7 @@ class InferenceEngine:
                 self._fwd_jit = jax.jit(lambda p, t, m: fwd(p, t, m, train=False)[0])
             else:
                 self._fwd_jit = jax.jit(lambda p, t, m: fwd(p, t, m))
+            self._fwd_jit = self._watched(self._fwd_jit, "inference.forward")
         return self._fwd_jit(self.params, input_ids, attention_mask)
 
     # ------------------------------------------------------------------ #
@@ -728,9 +770,11 @@ class InferenceEngine:
                 step, _, _, _, _, (cache, out) = jax.lax.while_loop(cond, body, st)
                 return out, step, cache
 
-            self._prefill_jit = jax.jit(prefill, donate_argnums=(2,))
-            self._decode_jit = jax.jit(decode_loop, donate_argnums=(1,),
-                                       static_argnums=(9,))
+            self._prefill_jit = self._watched(
+                jax.jit(prefill, donate_argnums=(2,)), "inference.prefill")
+            self._decode_jit = self._watched(
+                jax.jit(decode_loop, donate_argnums=(1,), static_argnums=(9,)),
+                "inference.decode_loop")
 
         pad = bucket - prompt_len
         toks = jnp.pad(input_ids, ((0, 0), (0, pad))) if pad else input_ids
@@ -791,12 +835,16 @@ class InferenceEngine:
         if self._paged_jits is None:
             mod = self.module
             self._paged_jits = (
-                jax.jit(lambda p, t, pools, slots, li:
-                        mod.forward_paged_prefill(p, t, pools, slots, li),
-                        donate_argnums=(2,)),
-                jax.jit(lambda p, t, pools, bt, pos:
-                        mod.forward_paged_decode(p, t, pools, bt, pos),
-                        donate_argnums=(2,)),
+                self._watched(
+                    jax.jit(lambda p, t, pools, slots, li:
+                            mod.forward_paged_prefill(p, t, pools, slots, li),
+                            donate_argnums=(2,)),
+                    "inference.paged_prefill"),
+                self._watched(
+                    jax.jit(lambda p, t, pools, bt, pos:
+                            mod.forward_paged_decode(p, t, pools, bt, pos),
+                            donate_argnums=(2,)),
+                    "inference.paged_decode"),
             )
         return self._paged_jits
 
@@ -859,7 +907,8 @@ class InferenceEngine:
                     f"model max_seq {cfg.max_seq}")
 
         alloc = BlockAllocator(num_blocks, bs)
-        sched = ContinuousBatchingScheduler(alloc, W, n_max)
+        sched = ContinuousBatchingScheduler(alloc, W, n_max,
+                                            telemetry=self._serving_tel)
         for p in prompts:
             sched.add_request(p, max_new, eos_token_id)
         pools = self._paged_pools(num_blocks, bs)

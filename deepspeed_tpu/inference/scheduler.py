@@ -34,6 +34,7 @@ len(generated) - 1 whenever the request is running.
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from typing import List, Optional, Tuple
 
@@ -43,6 +44,104 @@ from deepspeed_tpu.inference.block_allocator import BlockAllocator
 from deepspeed_tpu.utils.logging import logger
 
 QUEUED, RUNNING, FINISHED = "queued", "running", "finished"
+
+
+class ServingTelemetry:
+    """Registry adapter for the Orca/vLLM-style iteration-level serving
+    stats: the scheduler calls these hooks as its state machine moves and
+    the series land in the process-global metrics registry
+    (``deepspeed_tpu.monitor.metrics``).
+
+    Invariants the tests pin: TTFT is observed exactly ONCE per request —
+    the first token after the ORIGINAL arrival, even when a preemption
+    forces a re-prefill later — and ``serving/preemptions`` equals the
+    number of eviction events (``serving/recompute_tokens`` the prefix
+    tokens those evictions will prefill again)."""
+
+    _SERIES = ("ttft", "tpot", "queue_depth", "running", "kv_blocks_used",
+               "kv_block_utilization", "prefill_steps", "decode_steps",
+               "preemptions", "recompute_tokens", "requests", "finished",
+               "generated_tokens")
+
+    def __init__(self, registry=None):
+        if registry is None:
+            from deepspeed_tpu.monitor.metrics import get_registry
+            registry = get_registry()
+        self.registry = registry
+        self.ensure()
+
+    def ensure(self) -> None:
+        """Pre-create every serving family so zero-valued series (e.g. a
+        run with no preemptions) still appear in snapshots. Re-run by the
+        scheduler per serve call — re-creates after a registry reset."""
+        for name in self._SERIES:
+            getattr(self, name)
+
+    # families resolved per access (get-or-create under the registry
+    # lock; serving events are host-side per engine step, not a jit hot
+    # loop) so a registry reset between bench metrics can't orphan them
+
+    @property
+    def ttft(self):
+        return self.registry.histogram(
+            "serving/ttft_ms", "request arrival -> first generated token")
+
+    @property
+    def tpot(self):
+        return self.registry.histogram(
+            "serving/tpot_ms", "per-output-token latency after the first")
+
+    @property
+    def queue_depth(self):
+        return self.registry.gauge(
+            "serving/queue_depth", "requests waiting for admission")
+
+    @property
+    def running(self):
+        return self.registry.gauge(
+            "serving/running", "running-batch occupancy (fused decode rows)")
+
+    @property
+    def kv_blocks_used(self):
+        return self.registry.gauge(
+            "serving/kv_blocks_used", "allocated pool blocks (excl. dummy)")
+
+    @property
+    def kv_block_utilization(self):
+        return self.registry.gauge(
+            "serving/kv_block_utilization", "used / allocatable pool blocks")
+
+    @property
+    def prefill_steps(self):
+        return self.registry.counter("serving/prefill_steps")
+
+    @property
+    def decode_steps(self):
+        return self.registry.counter(
+            "serving/decode_steps", "fused decode steps (all rows at once)")
+
+    @property
+    def preemptions(self):
+        return self.registry.counter(
+            "serving/preemptions", "recompute-preempt eviction events")
+
+    @property
+    def recompute_tokens(self):
+        return self.registry.counter(
+            "serving/recompute_tokens",
+            "prefix tokens re-prefilled by evictions")
+
+    @property
+    def requests(self):
+        return self.registry.counter("serving/requests")
+
+    @property
+    def finished(self):
+        return self.registry.counter("serving/finished_requests")
+
+    @property
+    def generated_tokens(self):
+        return self.registry.counter("serving/generated_tokens")
 
 
 @dataclasses.dataclass
@@ -57,6 +156,9 @@ class Request:
     generated: List[int] = dataclasses.field(default_factory=list)
     admit_seq: int = -1             # admission stamp (eviction order)
     preemptions: int = 0
+    t_arrival: float = 0.0          # perf_counter at add_request
+    t_first_token: Optional[float] = None   # TTFT stamp (set once, ever)
+    t_last_token: float = 0.0       # previous token's stamp (TPOT base)
 
     def prefix(self) -> np.ndarray:
         """The token prefix a (re)admission must prefill: the prompt plus
@@ -84,17 +186,33 @@ class ContinuousBatchingScheduler:
     eos/max_new, recompute-preempt the latest-admitted request on OOM."""
 
     def __init__(self, allocator: BlockAllocator, max_running: int,
-                 max_blocks_per_seq: int):
+                 max_blocks_per_seq: int,
+                 telemetry: Optional[ServingTelemetry] = None):
         if max_running < 1:
             raise ValueError("max_running must be >= 1")
         self.allocator = allocator
         self.max_running = max_running
         self.max_blocks_per_seq = max_blocks_per_seq
+        self.telemetry = telemetry
+        if telemetry is not None:
+            telemetry.ensure()
         self.waiting: deque = deque()
         self.running: List[Request] = []   # admission-ordered
         self.finished: List[Request] = []
         self._admit_counter = 0
         self._next_rid = 0
+
+    def _tel_gauges(self) -> None:
+        """Refresh the occupancy gauges (queue depth, running rows, KV
+        pool utilization) from current scheduler/allocator state."""
+        t = self.telemetry
+        if t is None:
+            return
+        t.queue_depth.set(len(self.waiting))
+        t.running.set(len(self.running))
+        used = self.allocator.num_blocks - 1 - self.allocator.num_free
+        t.kv_blocks_used.set(used)
+        t.kv_block_utilization.set(used / max(1, self.allocator.num_blocks - 1))
 
     # ------------------------------------------------------------------ #
 
@@ -111,9 +229,12 @@ class ContinuousBatchingScheduler:
                 f"{cap} ({self.max_blocks_per_seq} blocks of "
                 f"{self.allocator.block_size})")
         req = Request(rid=self._next_rid, prompt=prompt, max_new=max_new,
-                      eos=eos)
+                      eos=eos, t_arrival=time.perf_counter())
         self._next_rid += 1
         self.waiting.append(req)
+        if self.telemetry is not None:
+            self.telemetry.requests.inc()
+            self._tel_gauges()
         return req
 
     def all_done(self) -> bool:
@@ -137,6 +258,9 @@ class ContinuousBatchingScheduler:
                 req.admit_seq = self._admit_counter
                 self._admit_counter += 1
                 self.running.append(req)
+                if self.telemetry is not None:
+                    self.telemetry.prefill_steps.inc()
+                    self._tel_gauges()
                 return ("prefill", req)
             if not self.running:
                 raise RuntimeError(
@@ -146,6 +270,9 @@ class ContinuousBatchingScheduler:
                     "serving.max_num_blocks or shrink the prompt")
         if self.running:
             self._ensure_decode_capacity()
+            if self.telemetry is not None:
+                self.telemetry.decode_steps.inc()
+                self._tel_gauges()   # capacity growth/evictions moved blocks
             return ("decode", list(self.running))
         if self.waiting:
             # slots full but pool dry would have been handled above; here
@@ -182,6 +309,9 @@ class ContinuousBatchingScheduler:
             f"KV pool exhausted: preempting request {victim.rid} "
             f"({len(victim.blocks)} blocks freed; will recompute "
             f"{len(victim.prefix())} tokens on re-admission)")
+        if self.telemetry is not None:
+            self.telemetry.preemptions.inc()
+            self.telemetry.recompute_tokens.inc(len(victim.prefix()))
         self.running.remove(victim)
         self.allocator.free(victim.blocks)
         victim.blocks = []
@@ -200,6 +330,7 @@ class ContinuousBatchingScheduler:
         the last position."""
         req.pos = len(req.prefix())
         req.generated.append(int(token))
+        self._record_token_time(req)
         self._maybe_finish(req)
 
     def record_decode(self, req: Request, token: int) -> None:
@@ -207,7 +338,24 @@ class ContinuousBatchingScheduler:
         slot ``pos`` and ``token`` sampled from the resulting logits."""
         req.pos += 1
         req.generated.append(int(token))
+        self._record_token_time(req)
         self._maybe_finish(req)
+
+    def _record_token_time(self, req: Request) -> None:
+        """TTFT once per request (first token after the ORIGINAL arrival —
+        a post-preemption re-prefill token counts as a per-output-token
+        latency, not a second TTFT), TPOT for every token after it."""
+        now = time.perf_counter()
+        t = self.telemetry
+        if t is not None:
+            if req.t_first_token is None:
+                t.ttft.observe((now - req.t_arrival) * 1e3)
+            else:
+                t.tpot.observe((now - req.t_last_token) * 1e3)
+            t.generated_tokens.inc()
+        if req.t_first_token is None:
+            req.t_first_token = now
+        req.t_last_token = now
 
     def _maybe_finish(self, req: Request) -> None:
         done = len(req.generated) >= req.max_new
@@ -219,3 +367,6 @@ class ContinuousBatchingScheduler:
             self.allocator.free(req.blocks)
             req.blocks = []
             self.finished.append(req)
+            if self.telemetry is not None:
+                self.telemetry.finished.inc()
+                self._tel_gauges()

@@ -1,0 +1,17 @@
+from deepspeed_tpu.monitor.config import (DeepSpeedMonitorConfig,
+                                          TelemetryConfig,
+                                          get_monitor_config,
+                                          get_telemetry_config)
+from deepspeed_tpu.monitor.metrics import (MetricsRegistry, get_registry,
+                                           validate_snapshot)
+from deepspeed_tpu.monitor.monitor import MonitorMaster
+from deepspeed_tpu.monitor.trace import (CompileWatchdog, StepTracer,
+                                         get_compile_watchdog, get_tracer,
+                                         watched_jit)
+
+__all__ = [
+    "DeepSpeedMonitorConfig", "TelemetryConfig", "get_monitor_config",
+    "get_telemetry_config", "MetricsRegistry", "get_registry",
+    "validate_snapshot", "MonitorMaster", "CompileWatchdog", "StepTracer",
+    "get_compile_watchdog", "get_tracer", "watched_jit",
+]

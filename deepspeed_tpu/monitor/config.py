@@ -17,6 +17,54 @@ def get_monitor_config(param_dict: dict) -> "DeepSpeedMonitorConfig":
     return DeepSpeedMonitorConfig(**monitor_dict)
 
 
+class TelemetryConfig(ConfigModel):
+    """"telemetry" section: the cross-layer metrics registry + tracing.
+
+    Accepted as a dict, a bool, or the strings ``"on"``/``"off"`` (see
+    :func:`get_telemetry_config`). When enabled, the training engine
+    records per-step time/tokens-per-sec/MFU, the inference engine records
+    serving stats (TTFT/TPOT, queue depth, KV-block utilization,
+    preemptions), and every ``jax.jit`` entry point the engines own runs
+    under the compile watchdog. When disabled nothing is instrumented —
+    the hot paths gate at one flag check, with no host/device syncs.
+    """
+    enabled: bool = False
+    # append a registry snapshot to this JSONL file every
+    # ``steps_per_snapshot`` steps (0 = only on demand / engine exit)
+    jsonl_path: Optional[str] = None
+    steps_per_snapshot: int = 0
+    # also fan snapshots out through the MonitorMaster sinks at the same
+    # cadence (TensorBoard / W&B / CSV, "Telemetry/*" series)
+    publish_to_monitor: bool = True
+    # chrome-trace span export path (written by engine.export_trace())
+    chrome_trace_path: Optional[str] = None
+    # compile watchdog: warn when one entry point compiles this many times
+    # inside its rolling window
+    compile_storm_threshold: int = 8
+    # hardware peak for the MFU gauge, per chip; 0 = auto (DS_PEAK_TFLOPS
+    # env, else the accelerator's device-kind table, else MFU reads 0)
+    peak_tflops_per_chip: float = 0.0
+
+
+def get_telemetry_config(param_dict: dict) -> TelemetryConfig:
+    """Parse the ``telemetry`` section: dict, bool/0/1, "on"/"off", or
+    null (= defaults)."""
+    t = param_dict.get("telemetry", {})
+    if t is None:
+        t = {}
+    elif isinstance(t, str):
+        if t not in ("on", "off"):
+            raise ValueError(f"telemetry={t!r} (expected 'on', 'off', "
+                             "a bool, or a config dict)")
+        t = {"enabled": t == "on"}
+    elif isinstance(t, (bool, int)):
+        t = {"enabled": bool(t)}
+    elif not isinstance(t, dict):
+        raise ValueError(f"telemetry section must be a dict, bool, or "
+                         f"'on'/'off'; got {type(t).__name__}")
+    return TelemetryConfig(**t)
+
+
 class TensorBoardConfig(ConfigModel):
     enabled: bool = False
     output_path: str = ""

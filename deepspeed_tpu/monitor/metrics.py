@@ -1,0 +1,430 @@
+"""Process-local metrics registry (the telemetry substrate).
+
+The serving/training stacks this reproduces are tuned almost entirely
+through iteration-level stats (Orca/vLLM serving counters, PaLM-style MFU
+accounting); this module is the common sink every layer writes into:
+
+- :class:`Counter` / :class:`Gauge` / :class:`Histogram` families with
+  optional label fan-out (``family.labels(op="all_reduce").inc()``),
+  thread-safe behind one registry lock.
+- Histograms keep **streaming quantiles** in constant memory: observations
+  land in geometrically spaced buckets (ratio ``2**0.25`` ≈ ±9 % relative
+  error per quantile) plus exact count/sum/min/max.
+- ``snapshot()`` returns a plain JSON-able dict; ``to_prometheus()`` emits
+  text exposition format; ``write_jsonl()`` appends snapshots to a file;
+  ``publish()`` fans scalar series out through the existing
+  ``MonitorMaster`` sinks (TensorBoard / W&B / CSV).
+- Disabled mode is a per-op flag check and immediate return — **no device
+  work, no ``effects_barrier``, no allocation** — so hot paths can keep
+  their instrumentation calls unconditionally.
+
+One process-global registry (:func:`get_registry`) is shared by the
+training engine, the inference engine/scheduler, the comms logger, and the
+compile watchdog, so one ``snapshot()`` sees the whole system.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import os
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# ------------------------------------------------------------------ #
+# histogram bucketing: geometric ladder covering 1e-9 .. ~1e12 at ratio
+# 2**0.25 (~19% bucket width => quantile relative error ~9%); shared by
+# every histogram so snapshots merge trivially
+_BUCKET_RATIO = 2.0 ** 0.25
+_BUCKET_LO = 1e-9
+_N_BUCKETS = int(math.ceil(math.log(1e12 / _BUCKET_LO, _BUCKET_RATIO))) + 1
+_BOUNDS: List[float] = [_BUCKET_LO * _BUCKET_RATIO ** i for i in range(_N_BUCKETS)]
+
+
+def _label_key(labelnames: Tuple[str, ...], labelvalues: Tuple[str, ...]) -> str:
+    if not labelnames:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in zip(labelnames, labelvalues))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """One concrete series (a family child). Not built directly — ask the
+    registry for a family and (optionally) ``.labels(...)`` it."""
+
+    kind = "untyped"
+
+    def __init__(self, registry: "MetricsRegistry", name: str,
+                 labelnames: Tuple[str, ...] = (),
+                 labelvalues: Tuple[str, ...] = ()):
+        self._reg = registry
+        self.name = name
+        self.labelnames = labelnames
+        self.labelvalues = labelvalues
+
+    @property
+    def series_name(self) -> str:
+        return self.name + _label_key(self.labelnames, self.labelvalues)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if not self._reg._enabled:
+            return
+        if amount < 0:
+            raise ValueError(f"counter {self.name}: inc by negative {amount}")
+        with self._reg._lock:
+            self.value += amount
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        if not self._reg._enabled:
+            return
+        with self._reg._lock:
+            self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        if not self._reg._enabled:
+            return
+        with self._reg._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # sparse bucket map (most series touch a handful of buckets)
+        self._buckets: Dict[int, int] = {}
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, value: float) -> None:
+        if not self._reg._enabled:
+            return
+        value = float(value)
+        idx = bisect.bisect_left(_BOUNDS, value) if value > _BUCKET_LO else 0
+        with self._reg._lock:
+            self._buckets[idx] = self._buckets.get(idx, 0) + 1
+            self.count += 1
+            self.sum += value
+            if value < self.min:
+                self.min = value
+            if value > self.max:
+                self.max = value
+
+    def quantile(self, q: float) -> float:
+        """Streaming quantile estimate (geometric-midpoint of the bucket
+        holding the q-th observation); exact at the recorded min/max ends."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        with self._reg._lock:
+            if self.count == 0:
+                return 0.0
+            target = q * self.count
+            seen = 0
+            for idx in sorted(self._buckets):
+                seen += self._buckets[idx]
+                if seen >= target:
+                    lo = _BOUNDS[idx - 1] if idx > 0 else 0.0
+                    hi = _BOUNDS[idx] if idx < len(_BOUNDS) else self.max
+                    mid = math.sqrt(lo * hi) if lo > 0 else hi / 2.0
+                    # clamp into the exactly-tracked envelope
+                    return min(max(mid, self.min), self.max)
+            return self.max
+
+    def summary(self) -> Dict[str, float]:
+        with self._reg._lock:
+            count, total = self.count, self.sum
+        if count == 0:
+            return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                    "mean": 0.0, "p50": 0.0, "p90": 0.0, "p99": 0.0}
+        return {"count": count, "sum": total,
+                "min": self.min, "max": self.max, "mean": total / count,
+                "p50": self.quantile(0.50), "p90": self.quantile(0.90),
+                "p99": self.quantile(0.99)}
+
+
+class _Family:
+    """A named metric family: either a single unlabeled series (all metric
+    ops proxy to it) or a label fan-out via :meth:`labels`."""
+
+    def __init__(self, registry: "MetricsRegistry", cls, name: str,
+                 help: str, labelnames: Tuple[str, ...]):
+        self._reg = registry
+        self._cls = cls
+        self.name = name
+        self.help = help
+        self.labelnames = labelnames
+        self._children: Dict[Tuple[str, ...], _Metric] = {}
+        if not labelnames:
+            self._default = cls(registry, name)
+            self._children[()] = self._default
+        else:
+            self._default = None
+
+    @property
+    def kind(self) -> str:
+        return self._cls.kind
+
+    def labels(self, **labelvalues) -> _Metric:
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(f"{self.name}: expected labels {self.labelnames}, "
+                             f"got {tuple(labelvalues)}")
+        key = tuple(str(labelvalues[k]) for k in self.labelnames)
+        with self._reg._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._cls(self._reg, self.name, self.labelnames, key)
+                self._children[key] = child
+        return child
+
+    def children(self) -> List[_Metric]:
+        with self._reg._lock:
+            return list(self._children.values())
+
+    # unlabeled convenience proxies
+    def _only(self) -> _Metric:
+        if self._default is None:
+            raise ValueError(f"{self.name} is labeled ({self.labelnames}); "
+                             "use .labels(...)")
+        return self._default
+
+    def inc(self, amount: float = 1.0):
+        self._only().inc(amount)
+
+    def dec(self, amount: float = 1.0):
+        self._only().dec(amount)
+
+    def set(self, value: float):
+        self._only().set(value)
+
+    def observe(self, value: float):
+        self._only().observe(value)
+
+    # single-series reads (used pervasively by tests/tools)
+    @property
+    def value(self):
+        return self._only().value
+
+    def summary(self):
+        return self._only().summary()
+
+    def quantile(self, q: float):
+        return self._only().quantile(q)
+
+
+class MetricsRegistry:
+    """Get-or-create metric families; snapshot/export them."""
+
+    def __init__(self, enabled: bool = True):
+        self._lock = threading.RLock()
+        self._families: Dict[str, _Family] = {}
+        self._enabled = enabled
+
+    # ---- lifecycle ---- #
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        """Disabled mode: every record op returns after one flag check —
+        no locks taken, no allocation, and never any device/jax call."""
+        self._enabled = bool(enabled)
+
+    def reset(self) -> None:
+        """Drop every family (fresh snapshot; used between bench metrics)."""
+        with self._lock:
+            self._families.clear()
+
+    # ---- family constructors (get-or-create, type-checked) ---- #
+
+    def _family(self, cls, name: str, help: str,
+                labelnames: Iterable[str]) -> _Family:
+        labelnames = tuple(labelnames)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = _Family(self, cls, name, help, labelnames)
+                self._families[name] = fam
+            elif fam._cls is not cls or fam.labelnames != labelnames:
+                raise ValueError(
+                    f"metric {name!r} re-registered as {cls.kind} with labels "
+                    f"{labelnames}; existing is {fam.kind} with {fam.labelnames}")
+        return fam
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Iterable[str] = ()) -> _Family:
+        return self._family(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Iterable[str] = ()) -> _Family:
+        return self._family(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Iterable[str] = ()) -> _Family:
+        return self._family(Histogram, name, help, labelnames)
+
+    # ---- export ---- #
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """JSON-able view: ``{"counters": {series: value}, "gauges": {...},
+        "histograms": {series: {count,sum,min,max,mean,p50,p90,p99}}}``."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        with self._lock:
+            fams = list(self._families.values())
+        for fam in fams:
+            for child in fam.children():
+                key = child.series_name
+                if fam.kind == "counter":
+                    out["counters"][key] = child.value
+                elif fam.kind == "gauge":
+                    out["gauges"][key] = child.value
+                else:
+                    out["histograms"][key] = child.summary()
+        return out
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (names sanitized: ``/`` → ``_``)."""
+        lines: List[str] = []
+        with self._lock:
+            fams = list(self._families.values())
+        for fam in fams:
+            pname = _prom_name(fam.name)
+            if fam.help:
+                lines.append(f"# HELP {pname} {fam.help}")
+            lines.append(f"# TYPE {pname} {fam.kind}")
+            for child in fam.children():
+                labels = _label_key(child.labelnames, child.labelvalues)
+                if fam.kind in ("counter", "gauge"):
+                    lines.append(f"{pname}{labels} {_fmt(child.value)}")
+                else:
+                    cum = 0
+                    base = labels[1:-1] if labels else ""
+                    sep = "," if base else ""
+                    with self._lock:
+                        buckets = sorted(child._buckets.items())
+                        count, total = child.count, child.sum
+                    for idx, n in buckets:
+                        cum += n
+                        le = _BOUNDS[idx] if idx < len(_BOUNDS) else math.inf
+                        lines.append(f'{pname}_bucket{{{base}{sep}le="{_fmt(le)}"}} {cum}')
+                    lines.append(f'{pname}_bucket{{{base}{sep}le="+Inf"}} {count}')
+                    lines.append(f"{pname}_sum{labels} {_fmt(total)}")
+                    lines.append(f"{pname}_count{labels} {count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def write_jsonl(self, path: str, step: Optional[int] = None,
+                    extra: Optional[Dict] = None) -> None:
+        """Append one snapshot line to ``path`` (creating parent dirs)."""
+        rec = {"ts": time.time()}
+        if step is not None:
+            rec["step"] = int(step)
+        if extra:
+            rec.update(extra)
+        rec.update(self.snapshot())
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def publish(self, monitor, step: int) -> None:
+        """Fan scalar series out through a ``MonitorMaster`` (counters and
+        gauges as-is; histograms as mean/p50/p99/count sub-series)."""
+        if monitor is None or not getattr(monitor, "enabled", False):
+            return
+        snap = self.snapshot()
+        events = []
+        for key, v in snap["counters"].items():
+            events.append((f"Telemetry/{key}", float(v), step))
+        for key, v in snap["gauges"].items():
+            events.append((f"Telemetry/{key}", float(v), step))
+        for key, s in snap["histograms"].items():
+            for stat in ("mean", "p50", "p99", "count"):
+                events.append((f"Telemetry/{key}/{stat}", float(s[stat]), step))
+        if events:
+            monitor.write_events(events)
+
+
+def _prom_name(name: str) -> str:
+    out = []
+    for i, ch in enumerate(name):
+        ok = ch.isalnum() or ch in "_:"
+        if i == 0 and ch.isdigit():
+            ok = False
+        out.append(ch if ok else "_")
+    return "".join(out)
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+# ------------------------------------------------------------------ #
+# snapshot schema validation (the CI smoke test's contract)
+
+SNAPSHOT_SECTIONS = ("counters", "gauges", "histograms")
+_HIST_KEYS = {"count", "sum", "min", "max", "mean", "p50", "p90", "p99"}
+
+
+def validate_snapshot(snap: Dict) -> None:
+    """Raise ``ValueError`` unless ``snap`` is a structurally valid
+    registry snapshot (the three sections, numeric scalars, complete
+    histogram summaries)."""
+    if not isinstance(snap, dict):
+        raise ValueError(f"snapshot must be a dict, got {type(snap).__name__}")
+    for section in SNAPSHOT_SECTIONS:
+        if section not in snap:
+            raise ValueError(f"snapshot missing section {section!r}")
+        if not isinstance(snap[section], dict):
+            raise ValueError(f"snapshot[{section!r}] must be a dict")
+    for sec in ("counters", "gauges"):
+        for k, v in snap[sec].items():
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                raise ValueError(f"{sec}[{k!r}] is not numeric: {v!r}")
+    for k, s in snap["histograms"].items():
+        if not isinstance(s, dict) or not _HIST_KEYS.issubset(s):
+            raise ValueError(f"histograms[{k!r}] missing keys "
+                             f"{_HIST_KEYS - set(s or ())}")
+
+
+# ------------------------------------------------------------------ #
+# process-global registry
+
+_global_registry: Optional[MetricsRegistry] = None
+_global_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    global _global_registry
+    if _global_registry is None:
+        with _global_lock:
+            if _global_registry is None:
+                _global_registry = MetricsRegistry()
+    return _global_registry

@@ -82,14 +82,20 @@ class csvMonitor(Monitor):
     def write_events(self, event_list):
         if not self.enabled:
             return
+        # group by metric name: each per-metric file is opened/closed ONCE
+        # per call, not once per event (a telemetry snapshot fans out
+        # hundreds of events; per-event open() made this O(events) syscalls)
+        by_name: dict = {}
         for name, value, step in event_list:
+            by_name.setdefault(name, []).append((step, value))
+        for name, rows in by_name.items():
             safe = name.replace("/", "_")
             path = os.path.join(self.output_path, self.job_name, f"{safe}.csv")
             new = not os.path.exists(path)
             with open(path, "a") as f:
                 if new:
                     f.write("step,value\n")
-                f.write(f"{step},{value}\n")
+                f.writelines(f"{step},{value}\n" for step, value in rows)
 
 
 class MonitorMaster(Monitor):

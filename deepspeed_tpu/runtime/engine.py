@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import inspect
 import os
+import time
 from functools import partial
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
@@ -215,6 +216,39 @@ class DeepSpeedEngine:
                                           steps_per_output=self._config.steps_per_print)
         from deepspeed_tpu.monitor.monitor import MonitorMaster
         self.monitor = MonitorMaster(self._config.monitor_config)
+
+        # ---- telemetry (metrics registry + compile watchdog) ----
+        # when off, _telemetry is None and every hot-path hook is a single
+        # attribute check — no timers, no syncs, no registry traffic
+        tcfg = self._config.telemetry_config
+        self._telemetry = tcfg if tcfg.enabled else None
+        self._tel_flops_per_token_v = None
+        if self._telemetry is not None:
+            from deepspeed_tpu.monitor.metrics import get_registry
+            from deepspeed_tpu.monitor.trace import (get_compile_watchdog,
+                                                     get_tracer)
+            reg = get_registry()
+            reg.set_enabled(True)
+            self._tel_reg = reg
+            self._tel_watchdog = get_compile_watchdog()
+            self._tel_watchdog.storm_threshold = tcfg.compile_storm_threshold
+            self._tel_tracer = get_tracer()
+            self._tel_step_hist = reg.histogram(
+                "train/step_time_ms", "whole train_batch wall time")
+            self._tel_phase_hist = reg.histogram(
+                "train/phase_time_ms",
+                "fwd/bwd/step breakdown (forward()/backward()/step() trio; "
+                "fwd = value_and_grad, bwd = accumulate)",
+                labelnames=("phase",))
+            self._tel_tokens_gauge = reg.gauge(
+                "train/tokens_per_sec", "tokens through the last step")
+            self._tel_tflops_gauge = reg.gauge(
+                "train/achieved_tflops_per_chip",
+                "model flops per token x token rate / chips")
+            self._tel_mfu_gauge = reg.gauge(
+                "train/mfu", "achieved / peak flops per chip (PaLM-style)")
+            self._tel_steps_counter = reg.counter("train/steps")
+            self._tel_tokens_counter = reg.counter("train/tokens")
 
         # ---- curriculum learning (reference engine.py:1691 legacy path +
         # data_efficiency data_sampling.curriculum_learning) ----
@@ -853,11 +887,13 @@ class DeepSpeedEngine:
             batch = jax.tree.map(shard_leaf, batch)
 
         self.tput_timer.start()
+        t0 = time.perf_counter() if self._telemetry is not None else 0.0
         self._rng, step_rng = jax.random.split(self._rng)
         if self._offload is not None:
             fn = self._accum_batch_jit.get(gas)
             if fn is None:
-                fn = self._build_accum_batch_fn(gas)
+                fn = self._watched(self._build_accum_batch_fn(gas),
+                                   f"engine.accum_batch[gas={gas}]")
                 self._accum_batch_jit[gas] = fn
             self.state, mean_loss = fn(self.state, batch, step_rng)
             self._losses = mean_loss
@@ -865,10 +901,17 @@ class DeepSpeedEngine:
         else:
             fn = self._train_batch_jit.get(gas)
             if fn is None:
-                fn = self._build_train_batch_fn(gas)
+                fn = self._watched(self._build_train_batch_fn(gas),
+                                   f"engine.train_batch[gas={gas}]")
                 self._train_batch_jit[gas] = fn
             self.state, metrics = fn(self.state, batch, step_rng)
         self.tput_timer.stop(global_step=True)
+        if self._telemetry is not None:
+            # telemetry-on accepts one host sync per step: the wall clock
+            # must bracket the device work for step time / MFU to mean
+            # anything (off-mode never reaches this branch)
+            jax.block_until_ready(metrics["loss"])
+            self._tel_record_step(batch, time.perf_counter() - t0)
         if self.quantizer is not None:
             self._quantize_step(batch)
         self._write_monitor_events(metrics)
@@ -950,10 +993,12 @@ class DeepSpeedEngine:
         if self._grad_jit is None:
             def vg_fn(state: TrainState, b, rng):
                 return self._micro_grads(state.params, b, rng, state.scaler.loss_scale)
-            self._grad_jit = jax.jit(vg_fn)
+            self._grad_jit = self._watched(jax.jit(vg_fn), "engine.forward")
         batch = jax.tree.map(jnp.asarray, batch)
         self._rng, rng = jax.random.split(self._rng)
+        t0 = time.perf_counter()
         loss, grads = self._grad_jit(self.state, batch, rng)
+        self._tel_phase("fwd", t0, loss)
         self._cached_grads = grads
         self._losses = loss
         return loss
@@ -976,9 +1021,12 @@ class DeepSpeedEngine:
             def acc_fn(state: TrainState, grads):
                 acc = self._accumulate(state.acc_grads, grads)
                 return state._replace(acc_grads=acc, micro_steps=state.micro_steps + 1)
-            self._acc_jit = jax.jit(acc_fn, donate_argnums=(0,))
+            self._acc_jit = self._watched(jax.jit(acc_fn, donate_argnums=(0,)),
+                                          "engine.backward")
 
+        t0 = time.perf_counter()
         self.state = self._acc_jit(self.state, self._cached_grads)
+        self._tel_phase("bwd", t0, self.state.micro_steps)
         self._cached_grads = None
         return self._losses
 
@@ -1022,8 +1070,12 @@ class DeepSpeedEngine:
             return
         if self._apply_jit is None:
             gas = self.gradient_accumulation_steps()
-            self._apply_jit = jax.jit(partial(self._apply_update, gas=gas), donate_argnums=(0,))
+            self._apply_jit = self._watched(
+                jax.jit(partial(self._apply_update, gas=gas), donate_argnums=(0,)),
+                "engine.step")
+        t0 = time.perf_counter()
         self.state = self._apply_jit(self.state)
+        self._tel_phase("step", t0, self.state.global_steps)
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         metrics = {"loss": self._losses, "lr": self.get_lr()[0], "loss_scale": self.state.scaler.loss_scale}
@@ -1040,7 +1092,7 @@ class DeepSpeedEngine:
             def eval_fn(params, b):
                 out = self.loss_fn(params, b, None)
                 return out[0] if isinstance(out, tuple) else out
-            self._eval_jit = jax.jit(eval_fn)
+            self._eval_jit = self._watched(jax.jit(eval_fn), "engine.eval_batch")
         return self._eval_jit(self.state.params, jax.tree.map(jnp.asarray, batch))
 
     # ------------------------------------------------------------------ #
@@ -1396,6 +1448,124 @@ class DeepSpeedEngine:
         if step % self.steps_per_print() == 0:
             log_dist(f"step={step}, skipped={self.skipped_steps}, lr={float(metrics['lr']):.3e}, "
                      f"loss={float(metrics['loss']):.4f}", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    # telemetry
+
+    def _watched(self, fn, name: str):
+        """Route a compiled entry point through the compile watchdog when
+        telemetry is on (counts compilations, records compile wall time +
+        input shapes, flags recompilation storms)."""
+        if self._telemetry is None:
+            return fn
+        return self._tel_watchdog.watch(fn, name)
+
+    def _tel_phase(self, phase: str, t0: float, sync_on) -> None:
+        """Record one trio-phase duration (blocks on ``sync_on`` so the
+        wall clock brackets the device work)."""
+        if self._telemetry is None:
+            return
+        jax.block_until_ready(sync_on)
+        self._tel_phase_hist.labels(phase=phase).observe(
+            (time.perf_counter() - t0) * 1e3)
+
+    def _tel_record_step(self, batch, dt_s: float) -> None:
+        """Per-step series: step time, tokens/sec, achieved TFLOPs + MFU
+        (PaLM-style: model flops/token x token rate / peak), plus the
+        periodic JSONL / MonitorMaster flush."""
+        tcfg = self._telemetry
+        self._tel_step_hist.observe(dt_s * 1e3)
+        self._tel_steps_counter.inc()
+        self._tel_tracer.add_event("train_batch",
+                                   time.perf_counter() - dt_s, dt_s)
+        lead = jax.tree.leaves(batch)[0]
+        dims = lead.shape[:3] if lead.ndim >= 3 else lead.shape[:2]
+        tokens = 1
+        for d in dims:
+            tokens *= int(d)
+        tps = tokens / max(dt_s, 1e-9)
+        self._tel_tokens_gauge.set(tps)
+        self._tel_tokens_counter.inc(tokens)
+        fpt = self._tel_flops_per_token(batch)
+        n_chips = max(1, int(np.prod(list(self.mesh.shape.values()))))
+        achieved = tps * fpt / 1e12 / n_chips
+        self._tel_tflops_gauge.set(achieved)
+        peak = self._tel_peak_tflops()
+        self._tel_mfu_gauge.set(achieved / peak if peak > 0 else 0.0)
+        n = tcfg.steps_per_snapshot
+        if n and self._host_global_steps % n == 0:
+            if tcfg.jsonl_path:
+                self._tel_reg.write_jsonl(tcfg.jsonl_path,
+                                          step=self._host_global_steps)
+            if tcfg.publish_to_monitor:
+                self._tel_reg.publish(self.monitor, self._host_global_steps)
+
+    def _tel_flops_per_token(self, batch) -> float:
+        """Training flops per token, computed once per engine: the flops
+        profiler's ``cost_analysis()`` path on the loss forward for ONE
+        sample (x3 for fwd+bwd, plus the configured recompute factor),
+        falling back to the model's analytic ``flops_per_token``."""
+        if self._tel_flops_per_token_v is not None:
+            return self._tel_flops_per_token_v
+        fpt = 0.0
+        try:
+            from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+            prof = FlopsProfiler(model=self.module, ds_engine=self)
+            micro = jax.tree.map(lambda x: x[0][:1], batch)
+            # rng=None: the zoo's deterministic eval convention — dropout
+            # off changes flops negligibly and avoids threading an rng
+            prof.profile_fn(lambda p, b: self.loss_fn(p, b, None),
+                            self.state.params, micro)
+            lead = jax.tree.leaves(micro)[0]
+            micro_tokens = int(np.prod(lead.shape))
+            fwd = float(prof.get_total_flops())
+            if fwd > 0 and micro_tokens > 0:
+                fac = 3.0 + float(getattr(self._config.flops_profiler_config,
+                                          "recompute_fwd_factor", 0.0) or 0.0)
+                fpt = fwd * fac / micro_tokens
+        except Exception as e:  # profiling must never break the step
+            logger.warning(f"telemetry: flops profile failed ({e}); "
+                           "falling back to analytic flops_per_token")
+        if not fpt:
+            try:
+                fpt = float(self.module.flops_per_token())
+            except Exception:
+                fpt = 0.0
+        self._tel_flops_per_token_v = fpt
+        return fpt
+
+    def _tel_peak_tflops(self) -> float:
+        """MFU denominator: config > DS_PEAK_TFLOPS env / accelerator
+        device-kind table > 0 (gauge reads 0 rather than fabricating)."""
+        p = float(self._telemetry.peak_tflops_per_chip or 0.0)
+        if p > 0:
+            return p
+        try:
+            from deepspeed_tpu.accelerator import get_accelerator
+            return float(get_accelerator().peak_tflops())
+        except Exception:
+            return 0.0
+
+    def telemetry_snapshot(self) -> Dict:
+        """Whole-process registry snapshot plus the compile watchdog's
+        summary. Empty dict when telemetry is off."""
+        if self._telemetry is None:
+            return {}
+        snap = self._tel_reg.snapshot()
+        snap["compile"] = self._tel_watchdog.summary()
+        return snap
+
+    def export_trace(self, path: Optional[str] = None) -> Optional[str]:
+        """Write recorded host spans as chrome-trace JSON (view in
+        Perfetto / chrome://tracing); returns the path, or None when
+        telemetry is off."""
+        if self._telemetry is None:
+            return None
+        path = path or self._telemetry.chrome_trace_path
+        if not path:
+            raise ValueError("no trace path: pass one or set "
+                             "telemetry.chrome_trace_path")
+        return self._tel_tracer.export_chrome_trace(path)
 
     # ------------------------------------------------------------------ #
     # checkpointing

@@ -73,6 +73,24 @@ class CommsLogger:
         self.prof_all = defaults.prof_all
         self.enabled = defaults.enabled
 
+    @staticmethod
+    def _tel_handles():
+        """Registry families for the telemetry fan-in. Resolved per call
+        (get-or-create under the registry lock — this is the eager
+        collective path, not a jit hot loop) so a registry reset between
+        bench metrics can't orphan cached handles."""
+        from deepspeed_tpu.monitor.metrics import get_registry
+        reg = get_registry()
+        return (
+            reg.counter("comm/ops", "collective calls", labelnames=("op",)),
+            reg.counter("comm/bytes", "collective payload bytes",
+                        labelnames=("op",)),
+            reg.histogram("comm/latency_ms", "per-collective wall time",
+                          labelnames=("op",)),
+            reg.histogram("comm/busbw_gbps", "per-collective bus bandwidth",
+                          labelnames=("op",)),
+        )
+
     def configure(self, comms_config) -> None:
         self.enabled = comms_config.comms_logger_enabled
         if self.enabled:
@@ -97,6 +115,13 @@ class CommsLogger:
     def append(self, raw_name: str, record_name: str, latency: float, msg_size: int, n_ranks: int) -> None:
         """Add a record. ``latency`` in ms, ``msg_size`` in bytes."""
         algbw, busbw = calc_bw_log(raw_name, msg_size, latency / 1e3, n_ranks)
+        # fan the same record into the telemetry registry so comm costs
+        # land in the unified snapshot next to step/serving series
+        ops, nbytes, lat, bw = self._tel_handles()
+        ops.labels(op=record_name).inc()
+        nbytes.labels(op=record_name).inc(msg_size)
+        lat.labels(op=record_name).observe(latency)
+        bw.labels(op=record_name).observe(busbw * 8)
         if record_name in self.comms_dict:
             if msg_size in self.comms_dict[record_name]:
                 self.comms_dict[record_name][msg_size][0] += 1

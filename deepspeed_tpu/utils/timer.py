@@ -195,6 +195,11 @@ class ThroughputTimer:
         self.global_step_count = 0
         self.total_elapsed_time = 0.0
         self.step_elapsed_time = 0.0
+        # cumulative samples over the TIMED steps: batch_size can be
+        # reassigned mid-run (elastic/curriculum ramp-up via
+        # set_train_batch_size), so the average must sum what each step
+        # actually carried, not multiply the current size by step count
+        self.total_samples = 0
         self.steps_per_output = steps_per_output
         self.monitor_memory = monitor_memory
         self.logging = logging_fn or log_dist
@@ -232,6 +237,7 @@ class ThroughputTimer:
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
             if global_step:
+                self.total_samples += self.batch_size
                 if report_speed and self.steps_per_output and (self.global_step_count % self.steps_per_output == 0):
                     self.logging(f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
                                  f"global_step={self.global_step_count}, RunningAvgSamplesPerSec="
@@ -240,11 +246,12 @@ class ThroughputTimer:
                 self.step_elapsed_time = 0.0
 
     def avg_samples_per_sec(self) -> float:
+        """Running average over the timed window. Uses the CUMULATIVE
+        sample count (one ``batch_size`` summed per timed step), so a
+        ``set_train_batch_size`` ramp mid-run doesn't retroactively skew
+        every earlier step's contribution."""
         if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
-            samples_per_step = self.batch_size
-            total_step_offset = self.global_step_count - self.start_step
-            avg_time_per_step = self.total_elapsed_time / total_step_offset
-            return samples_per_step / avg_time_per_step
+            return self.total_samples / self.total_elapsed_time
         return -1.0
 
 
