@@ -99,6 +99,18 @@ def _reset_telemetry():
     get_registry().reset()
 
 
+def _bench_telemetry():
+    """The train metrics' shared telemetry block: health on in "record"
+    mode with device sentinels OFF — the host detectors (spike / stall /
+    overflow) and anomaly counters ride along without perturbing the
+    measured step (no in-step reductions beyond the grad-norm reuse
+    telemetry records anyway). Fresh dict per call: the engine parses the
+    raw config and a shared literal could alias across builders."""
+    return {"enabled": True,
+            "health": {"enabled": True, "sentinels": False,
+                       "action": "record"}}
+
+
 def _telemetry_blob(engine):
     """Compact telemetry summary for the result record: compile counts,
     MFU/step-time (training engines), serving histograms (decode bench)."""
@@ -110,8 +122,9 @@ def _telemetry_blob(engine):
     g, h, c = (snap.get("gauges", {}), snap.get("histograms", {}),
                snap.get("counters", {}))
     for k in ("train/mfu", "train/tokens_per_sec",
-              "train/achieved_tflops_per_chip", "serving/queue_depth",
-              "serving/kv_block_utilization", "serving/running"):
+              "train/achieved_tflops_per_chip", "train/data_stall_fraction",
+              "serving/queue_depth", "serving/kv_block_utilization",
+              "serving/kv_fragmentation", "serving/running"):
         if k in g:
             blob[k] = round(g[k], 6)
     for k in ("train/step_time_ms", "serving/ttft_ms", "serving/tpot_ms"):
@@ -122,6 +135,23 @@ def _telemetry_blob(engine):
               "serving/generated_tokens"):
         if k in c:
             blob[k] = c[k]
+    # health summary: detector firings (zero-valued on a clean run)
+    from deepspeed_tpu.monitor.health import labeled_series
+    anoms = {k: int(v)
+             for k, v in labeled_series(c, "health/anomalies").items()}
+    if anoms:
+        blob["health_anomalies"] = anoms
+    # peak HBM straight from the accelerator — device truth, present even
+    # when gauge sampling never ran (e.g. telemetry flush cadence 0)
+    try:
+        from deepspeed_tpu.accelerator import get_accelerator
+        acc = get_accelerator()
+        peaks = [acc.max_memory_allocated(i)
+                 for i in range(acc.local_device_count())]
+        if any(peaks):
+            blob["peak_hbm_bytes"] = int(max(peaks))
+    except Exception:
+        pass
     return blob
 
 
@@ -167,7 +197,7 @@ def build_bench_engine():
         "bf16": {"enabled": True},
         "mesh": {"dp": -1},
         "steps_per_print": 0,
-        "telemetry": {"enabled": True},
+        "telemetry": _bench_telemetry(),
     }
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=config)
 
@@ -222,7 +252,7 @@ def build_llama_bench_engine():
         "bf16": {"enabled": True},
         "mesh": {"dp": -1},
         "steps_per_print": 0,
-        "telemetry": {"enabled": True},
+        "telemetry": _bench_telemetry(),
     }
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=config)
 
@@ -275,7 +305,7 @@ def build_bert_bench_engine():
         "bf16": {"enabled": True},
         "mesh": {"dp": -1},
         "steps_per_print": 0,
-        "telemetry": {"enabled": True},
+        "telemetry": _bench_telemetry(),
     }
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=config)
 

@@ -163,6 +163,28 @@ class TPU_Accelerator(DeepSpeedAccelerator):
     def memory_stats(self, device_index: Optional[int] = None) -> dict:
         return self._stats(device_index)
 
+    def memory_report(self) -> dict:
+        """Per-local-device memory summary for the health/env surfaces:
+        ``{device_name: {bytes_in_use, peak_bytes_in_use, bytes_limit,
+        headroom_bytes}}``. Devices whose backend exposes no memory stats
+        (e.g. the CPU test mesh) map to an empty dict — callers render
+        "no stats" rather than fabricated zeros."""
+        out = {}
+        for i in range(self.local_device_count()):
+            stats = self._stats(i)
+            if stats:
+                used = stats.get("bytes_in_use", 0)
+                limit = stats.get("bytes_limit", 0)
+                out[self.device_name(i)] = {
+                    "bytes_in_use": used,
+                    "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+                    "bytes_limit": limit,
+                    "headroom_bytes": max(limit - used, 0),
+                }
+            else:
+                out[self.device_name(i)] = {}
+        return out
+
     def reset_peak_memory_stats(self, device_index: Optional[int] = None) -> None:
         # Not exposed by PJRT; peak stats are monotone per process.
         pass

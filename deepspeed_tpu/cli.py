@@ -3,7 +3,8 @@
 Subcommands mirror the reference's script family:
 
 - ``dscli run <script> [args...]``  — the ``deepspeed`` launcher CLI
-- ``dscli report``                  — ``ds_report`` environment/op report
+- ``dscli report [--telemetry f]``  — ``ds_report`` environment/op/memory report
+- ``dscli health <jsonl> [--once]`` — live health screen over a telemetry sink
 - ``dscli bench``                   — ``ds_bench`` collective micro-benchmarks
 - ``dscli elastic <config>``        — ``ds_elastic`` elastic-config inspector
 - ``dscli autotune <config>``       — ``deepspeed --autotuning`` config search
@@ -21,8 +22,23 @@ def _run(argv):
 
 
 def _report(argv):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="environment / op / device-memory report")
+    parser.add_argument("--telemetry", type=str, default=None,
+                        help="JSONL telemetry sink path; also prints the "
+                             "latest snapshot summary")
+    args = parser.parse_args(argv)
     from deepspeed_tpu import env_report
-    env_report.main()
+    env_report.main(telemetry_path=args.telemetry)
+
+
+def _health(argv):
+    """Live one-screen training/serving health table tailing a JSONL
+    telemetry sink (``telemetry.jsonl_path``); ``--once`` renders once."""
+    from deepspeed_tpu.monitor.health import health_cli
+    return health_cli(argv)
 
 
 def _bench(argv):
@@ -110,14 +126,14 @@ def _dlts_hostfile():
     return DLTS_HOSTFILE
 
 
-_COMMANDS = {"run": _run, "report": _report, "bench": _bench, "elastic": _elastic,
-             "autotune": _autotune, "ssh": _ssh}
+_COMMANDS = {"run": _run, "report": _report, "health": _health, "bench": _bench,
+             "elastic": _elastic, "autotune": _autotune, "ssh": _ssh}
 
 
 def main():
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
         print(__doc__)
-        print("usage: dscli {run|report|bench|elastic|autotune|ssh} [args...]")
+        print("usage: dscli {run|report|health|bench|elastic|autotune|ssh} [args...]")
         return 0
     cmd = sys.argv[1]
     if cmd not in _COMMANDS:

@@ -64,11 +64,57 @@ def device_report():
         print(f"{YELLOW}device query failed: {e}{END}")
 
 
-def main(hide_operator_status: bool = False, hide_errors_and_warnings: bool = False):
+def memory_report():
+    print("-" * 64)
+    print("device memory")
+    print("-" * 64)
+    # host RSS first: it stays printable even when the accelerator
+    # backend is the very thing that is broken
+    from deepspeed_tpu.monitor.health import host_rss_bytes
+    rss = host_rss_bytes()
+    if rss:
+        print(f"  host RSS: {rss / 2.0 ** 30:.2f}GB")
+    try:
+        from deepspeed_tpu.accelerator import get_accelerator
+        rep = get_accelerator().memory_report()
+    except Exception as e:
+        print(f"{YELLOW}device memory query failed: {e}{END}")
+        return
+    for name, st in rep.items():
+        if st:
+            gb = 2.0 ** 30
+            print(f"  {name}: in_use {st['bytes_in_use'] / gb:.2f}GB  "
+                  f"peak {st['peak_bytes_in_use'] / gb:.2f}GB  "
+                  f"limit {st['bytes_limit'] / gb:.2f}GB  "
+                  f"headroom {st['headroom_bytes'] / gb:.2f}GB")
+        else:
+            print(f"  {name}: {YELLOW}no memory stats exposed{END}")
+
+
+def telemetry_report(path: str):
+    """Latest snapshot summary from a JSONL telemetry sink (the same
+    renderer the ``dscli health`` screen uses)."""
+    print("-" * 64)
+    print(f"latest telemetry snapshot ({path})")
+    print("-" * 64)
+    from deepspeed_tpu.monitor.health import (read_last_snapshots,
+                                              render_health_table)
+    recs = read_last_snapshots(path, 2)
+    if not recs:
+        print(f"{YELLOW}no parseable records{END}")
+        return
+    print(render_health_table(recs[-1], recs[-2] if len(recs) > 1 else None))
+
+
+def main(hide_operator_status: bool = False, hide_errors_and_warnings: bool = False,
+         telemetry_path=None):
     if not hide_operator_status:
         op_report(verbose=not hide_errors_and_warnings)
     version_report()
     device_report()
+    memory_report()
+    if telemetry_path:
+        telemetry_report(telemetry_path)
 
 
 def cli_main():

@@ -351,6 +351,8 @@ class InferenceEngine:
         summary. Empty dict when telemetry is off."""
         if self._telemetry is None:
             return {}
+        from deepspeed_tpu.monitor.health import sample_memory_gauges
+        sample_memory_gauges(self._tel_reg)
         snap = self._tel_reg.snapshot()
         snap["compile"] = self._tel_watchdog.summary()
         return snap
@@ -959,6 +961,11 @@ class InferenceEngine:
                 for i, r in enumerate(reqs):
                     sched.record_decode(r, int(tok[i]))
 
+        if self._telemetry is not None:
+            # HBM live/peak + host RSS after the serve (the pools and the
+            # decode workspace are the serving memory story)
+            from deepspeed_tpu.monitor.health import sample_memory_gauges
+            sample_memory_gauges(self._tel_reg)
         self._paged_workspace = (num_blocks, bs, pools)
         done = sorted(sched.finished, key=lambda r: r.rid)
         return [jnp.asarray(r.output) for r in done]

@@ -59,7 +59,8 @@ class ServingTelemetry:
     tokens those evictions will prefill again)."""
 
     _SERIES = ("ttft", "tpot", "queue_depth", "running", "kv_blocks_used",
-               "kv_block_utilization", "prefill_steps", "decode_steps",
+               "kv_blocks_free", "kv_block_utilization", "kv_fragmentation",
+               "prefill_steps", "decode_steps",
                "preemptions", "recompute_tokens", "requests", "finished",
                "generated_tokens")
 
@@ -107,9 +108,21 @@ class ServingTelemetry:
             "serving/kv_blocks_used", "allocated pool blocks (excl. dummy)")
 
     @property
+    def kv_blocks_free(self):
+        return self.registry.gauge(
+            "serving/kv_blocks_free", "free-list pool blocks (excl. dummy)")
+
+    @property
     def kv_block_utilization(self):
         return self.registry.gauge(
             "serving/kv_block_utilization", "used / allocatable pool blocks")
+
+    @property
+    def kv_fragmentation(self):
+        return self.registry.gauge(
+            "serving/kv_fragmentation",
+            "internal fragmentation: unfilled slot fraction of allocated "
+            "blocks (allocated capacity minus cached tokens)")
 
     @property
     def prefill_steps(self):
@@ -212,7 +225,16 @@ class ContinuousBatchingScheduler:
         t.running.set(len(self.running))
         used = self.allocator.num_blocks - 1 - self.allocator.num_free
         t.kv_blocks_used.set(used)
+        t.kv_blocks_free.set(self.allocator.num_free)
         t.kv_block_utilization.set(used / max(1, self.allocator.num_blocks - 1))
+        # internal fragmentation: slots allocated to requests but not yet
+        # holding cached k/v (last-block waste + blocks grown ahead of
+        # pos). A just-admitted request (pos still 0, prefill scheduled)
+        # counts its prefix as cached — its blocks are spoken for, not
+        # wasted, and the gauge would otherwise spike to 1.0 at admission
+        cached = sum(r.pos or len(r.prefix()) for r in self.running)
+        cap = used * self.allocator.block_size
+        t.kv_fragmentation.set(1.0 - cached / cap if cap > 0 else 0.0)
 
     # ------------------------------------------------------------------ #
 

@@ -1,7 +1,13 @@
 from deepspeed_tpu.monitor.config import (DeepSpeedMonitorConfig,
-                                          TelemetryConfig,
+                                          HealthConfig, TelemetryConfig,
                                           get_monitor_config,
                                           get_telemetry_config)
+from deepspeed_tpu.monitor.health import (HealthMonitor, StepHealth,
+                                          compute_sentinels,
+                                          make_bucket_assignment,
+                                          render_health_table,
+                                          sample_memory_gauges,
+                                          sentinel_to_dict)
 from deepspeed_tpu.monitor.metrics import (MetricsRegistry, get_registry,
                                            validate_snapshot)
 from deepspeed_tpu.monitor.monitor import MonitorMaster
@@ -10,8 +16,11 @@ from deepspeed_tpu.monitor.trace import (CompileWatchdog, StepTracer,
                                          watched_jit)
 
 __all__ = [
-    "DeepSpeedMonitorConfig", "TelemetryConfig", "get_monitor_config",
-    "get_telemetry_config", "MetricsRegistry", "get_registry",
-    "validate_snapshot", "MonitorMaster", "CompileWatchdog", "StepTracer",
-    "get_compile_watchdog", "get_tracer", "watched_jit",
+    "DeepSpeedMonitorConfig", "HealthConfig", "TelemetryConfig",
+    "get_monitor_config", "get_telemetry_config", "MetricsRegistry",
+    "get_registry", "validate_snapshot", "MonitorMaster", "CompileWatchdog",
+    "StepTracer", "get_compile_watchdog", "get_tracer", "watched_jit",
+    "HealthMonitor", "StepHealth", "compute_sentinels",
+    "make_bucket_assignment", "render_health_table", "sample_memory_gauges",
+    "sentinel_to_dict",
 ]

@@ -17,6 +17,47 @@ def get_monitor_config(param_dict: dict) -> "DeepSpeedMonitorConfig":
     return DeepSpeedMonitorConfig(**monitor_dict)
 
 
+class HealthConfig(ConfigModel):
+    """"telemetry.health" sub-block: the training health observatory
+    (``monitor/health.py``). Off by default; enabling it turns on the
+    on-device numerics sentinels inside the compiled train step plus the
+    host-side anomaly detectors over the per-step ring buffer."""
+    enabled: bool = False
+    # device-side sentinel collection (non-finite counts, param/update
+    # norms, per-layer-group buckets) inside the compiled step. Off keeps
+    # only the host-side detectors (loss, grad norm, skips, wall times) —
+    # zero in-step overhead beyond the grad-norm reuse telemetry records
+    sentinels: bool = True
+    # what firing detectors do: "record" = counters only, "warn" = + a
+    # rate-limited log line, "dump" = + a debug bundle on disk
+    action: str = "warn"
+    # ring-buffer length AND the per-detector warning/dump rate limit
+    window: int = 50
+    # loss spike: robust z-score against an EWMA mean/variance
+    loss_spike_zscore: float = 6.0
+    loss_ewma_alpha: float = 0.02
+    # spike/explosion detectors hold fire for this many steps
+    warmup_steps: int = 10
+    # grad-norm explosion: fire when norm > factor x its EWMA
+    grad_norm_factor: float = 10.0
+    # plateau: no relative loss improvement for this many steps (0 = off)
+    plateau_steps: int = 0
+    plateau_rel_improvement: float = 1e-3
+    # sustained fp16 overflow: consecutive skipped steps before the alarm
+    # (also the rate limit of the engine's health-off skip warning)
+    overflow_window: int = 25
+    # data stall: wait/(wait+step) above the fraction for this many
+    # consecutive steps means the input pipeline is the bottleneck
+    data_stall_fraction: float = 0.5
+    data_stall_steps: int = 10
+    # debug bundles (action: dump)
+    dump_dir: str = "ds_health_dumps"
+    dump_limit: int = 3
+    keep_last_steps: int = 200
+    # per-layer-group grad-norm buckets in the sentinel vector
+    max_norm_buckets: int = 8
+
+
 class TelemetryConfig(ConfigModel):
     """"telemetry" section: the cross-layer metrics registry + tracing.
 
@@ -44,11 +85,16 @@ class TelemetryConfig(ConfigModel):
     # hardware peak for the MFU gauge, per chip; 0 = auto (DS_PEAK_TFLOPS
     # env, else the accelerator's device-kind table, else MFU reads 0)
     peak_tflops_per_chip: float = 0.0
+    # health observatory sub-block (sentinels + anomaly detectors +
+    # memory gauges + the `dscli health` screen); accepts a dict or a bool
+    health: HealthConfig = Field(default_factory=HealthConfig)
 
 
 def get_telemetry_config(param_dict: dict) -> TelemetryConfig:
     """Parse the ``telemetry`` section: dict, bool/0/1, "on"/"off", or
-    null (= defaults)."""
+    null (= defaults). The ``health`` sub-key accepts a bool shorthand,
+    and enabling health implies telemetry itself unless the user
+    explicitly disabled it (health rides the telemetry substrate)."""
     t = param_dict.get("telemetry", {})
     if t is None:
         t = {}
@@ -62,6 +108,22 @@ def get_telemetry_config(param_dict: dict) -> TelemetryConfig:
     elif not isinstance(t, dict):
         raise ValueError(f"telemetry section must be a dict, bool, or "
                          f"'on'/'off'; got {type(t).__name__}")
+    t = dict(t)
+    health = t.get("health", {})
+    if health is None:
+        health = {}          # null = defaults, like the parent section
+    elif isinstance(health, str):
+        # the same shorthand the parent section accepts
+        if health not in ("on", "off"):
+            raise ValueError(f"telemetry.health={health!r} (expected 'on', "
+                             "'off', a bool, or a config dict)")
+        health = {"enabled": health == "on"}
+    elif isinstance(health, (bool, int)):
+        health = {"enabled": bool(health)}
+    t["health"] = health
+    if isinstance(health, dict) and health.get("enabled") \
+            and "enabled" not in t:
+        t["enabled"] = True
     return TelemetryConfig(**t)
 
 

@@ -1,0 +1,761 @@
+"""Training health observatory: sentinels, anomaly detectors, memory
+telemetry, and the ``dscli health`` screen.
+
+PR 3 built the recording substrate (metrics registry, step tracing,
+compile watchdog); this module *interprets* the numbers, the way
+production-scale training systems treat in-flight diagnostics as a
+first-class subsystem (MegaScale's numerics/straggler sentinels, PaLM's
+loss-spike skip-batch practice):
+
+- **On-device numerics sentinels** (:func:`compute_sentinels`) — a compact
+  per-step summary (non-finite grad/param element counts, pre-clip global
+  grad norm, param/update norms, update/param ratio, per-layer-group norm
+  buckets) computed *inside* the already-compiled train step and returned
+  as ONE small fp32 vector. No extra host round-trips, no extra compiles:
+  the reductions ride the same XLA program as the optimizer update (the
+  same ``lax.cond`` discipline as the fp16 overflow skip).
+
+- **Host-side anomaly detectors** (:class:`HealthMonitor`) over a ring
+  buffer of :class:`StepHealth` records — loss spike (EWMA robust
+  z-score), grad-norm explosion, plateau, sustained fp16 overflow skips,
+  non-finite numerics, and a data-stall detector comparing host wait time
+  against the bracketed device step time. Each firing increments a
+  ``health/anomalies{type=}`` counter and, per the configured action,
+  emits a rate-limited warning and/or a **debug bundle** (telemetry
+  snapshot + chrome trace + last-K step records) to disk.
+
+- **Memory telemetry** (:func:`sample_memory_gauges`) — per-device HBM
+  live/peak/limit/headroom gauges from the accelerator's ``memory_stats``
+  plus host RSS, sampled on the telemetry flush cadence.
+
+- **The ``health`` CLI** (:func:`health_cli`) — tails the JSONL telemetry
+  sink and renders a live one-screen status table (step rate, MFU, loss
+  trend, grad norm, overflow/skip counts, HBM headroom, serving stats).
+
+Everything here is host-side python except :func:`compute_sentinels`,
+which is traced into the engines' compiled step when
+``telemetry.health.enabled`` (and ``sentinels``) are on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import sys
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+# ------------------------------------------------------------------ #
+# on-device sentinels
+
+#: fixed head of the sentinel vector; per-layer-group grad-norm buckets
+#: follow (one slot per bucket name).
+SENTINEL_FIELDS = ("nonfinite_grads", "nonfinite_params", "grad_norm",
+                   "param_norm", "update_norm", "update_ratio")
+
+
+def _path_head(path) -> str:
+    """Top-level pytree key of a leaf path (the "layer group" name)."""
+    if not path:
+        return "params"
+    k = path[0]
+    for attr in ("key", "name", "idx"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def make_bucket_assignment(tree, max_buckets: int) -> Tuple[Tuple[int, ...],
+                                                            Tuple[str, ...]]:
+    """Map each leaf (flatten order) to a layer-group bucket.
+
+    Groups are the top-level pytree keys in first-appearance order; when
+    there are more groups than ``max_buckets``, the tail collapses into an
+    ``"other"`` bucket. Deterministic for a fixed tree structure, so the
+    compiled step can close over the assignment."""
+    import jax
+    leaves_with_path = jax.tree_util.tree_flatten_with_path(tree)[0]
+    heads = []
+    for path, _ in leaves_with_path:
+        h = _path_head(path)
+        if h not in heads:
+            heads.append(h)
+    if max_buckets < 1:
+        max_buckets = 1
+    if len(heads) > max_buckets:
+        names = tuple(heads[:max_buckets - 1]) + ("other",)
+        index = {h: min(i, max_buckets - 1) for i, h in enumerate(heads)}
+    else:
+        names = tuple(heads)
+        index = {h: i for i, h in enumerate(heads)}
+    assignment = tuple(index[_path_head(path)] for path, _ in leaves_with_path)
+    return assignment, names
+
+
+def compute_sentinels(grads, new_params, update_norm, grad_norm,
+                      assignment: Sequence[int], names: Sequence[str]):
+    """The per-step numerics summary, as one fp32 vector of
+    ``len(SENTINEL_FIELDS) + len(names)`` entries. Pure jax — called
+    INSIDE the engines' compiled step (zero extra compiles / host syncs):
+
+    - non-finite element counts over the (unscaled, pre-clip) grads and
+      the post-update params;
+    - the pre-clip global grad norm (reused from ``clip_grad_norm_``'s
+      computation — passed in, never recomputed);
+    - param norm, the applied-update norm (computed by the caller from
+      the optimizer's update vector — NOT ``new - old``, which would pin
+      the pre-update tree past the update and defeat donation aliasing;
+      zero on an fp16 skip step), and the update/param ratio (the
+      classic LR-sanity signal);
+    - per-layer-group grad-norm buckets (:func:`make_bucket_assignment`).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.loss_scaler import count_nonfinite
+    from deepspeed_tpu.runtime.utils import global_norm
+
+    grad_leaves = jax.tree.leaves(grads)
+    if grad_norm is None:
+        grad_norm = global_norm(grads)
+    nf_g = count_nonfinite(grads)
+    nf_p = count_nonfinite(new_params)
+    pn = global_norm(new_params)
+    un = jnp.asarray(update_norm, jnp.float32)
+    ratio = un / (pn + 1e-12)
+
+    sq = [jnp.asarray(0.0, jnp.float32) for _ in names]
+    for leaf, b in zip(grad_leaves, assignment):
+        sq[b] = sq[b] + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    buckets = jnp.sqrt(jnp.stack(sq)) if names else jnp.zeros((0,), jnp.float32)
+
+    base = jnp.stack([jnp.asarray(v, jnp.float32) for v in
+                      (nf_g, nf_p, grad_norm, pn, un, ratio)])
+    return jnp.concatenate([base, buckets.astype(jnp.float32)])
+
+
+def sentinel_to_dict(vec, names: Sequence[str]) -> Dict[str, Any]:
+    """Host-side view of a sentinel vector: named scalars + a
+    ``bucket_norms`` sub-dict."""
+    import numpy as np
+    v = np.asarray(vec, np.float32)
+    out: Dict[str, Any] = {f: float(v[i]) for i, f in enumerate(SENTINEL_FIELDS)}
+    off = len(SENTINEL_FIELDS)
+    out["bucket_norms"] = {n: float(v[off + i]) for i, n in enumerate(names)
+                           if off + i < v.size}
+    return out
+
+
+# ------------------------------------------------------------------ #
+# memory telemetry
+
+
+def host_rss_bytes() -> int:
+    """Resident set size of this process (0 when unavailable)."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        pass
+    try:
+        import resource
+        # ru_maxrss is the PEAK rss — a usable fallback; linux reports
+        # kilobytes, macOS reports bytes
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return rss if sys.platform == "darwin" else rss * 1024
+    except Exception:
+        return 0
+
+
+def sample_memory_gauges(registry=None) -> Dict[str, Any]:
+    """Refresh the ``mem/*`` gauges from the accelerator memory APIs
+    (``memory_stats`` per local device → HBM live/peak/limit/headroom)
+    plus host RSS; returns the sampled report. Devices whose backend
+    exposes no memory stats (e.g. the CPU test mesh) contribute empty
+    entries and no gauges."""
+    if registry is None:
+        from deepspeed_tpu.monitor.metrics import get_registry
+        registry = get_registry()
+    report: Dict[str, Any] = {"devices": {}, "host_rss_bytes": host_rss_bytes()}
+    try:
+        from deepspeed_tpu.accelerator import get_accelerator
+        devmap = get_accelerator().memory_report()
+    except Exception:
+        devmap = {}
+    report["devices"] = devmap
+    in_use = registry.gauge("mem/hbm_bytes_in_use",
+                            "live HBM bytes per device", labelnames=("device",))
+    peak = registry.gauge("mem/hbm_peak_bytes",
+                          "peak HBM bytes per device", labelnames=("device",))
+    limit = registry.gauge("mem/hbm_bytes_limit",
+                           "allocator byte limit per device",
+                           labelnames=("device",))
+    headroom = registry.gauge("mem/hbm_headroom_bytes",
+                              "limit - live bytes per device",
+                              labelnames=("device",))
+    for name, st in devmap.items():
+        if not st:
+            continue
+        in_use.labels(device=name).set(st.get("bytes_in_use", 0))
+        peak.labels(device=name).set(st.get("peak_bytes_in_use", 0))
+        limit.labels(device=name).set(st.get("bytes_limit", 0))
+        headroom.labels(device=name).set(st.get("headroom_bytes", 0))
+    registry.gauge("mem/host_rss_bytes",
+                   "host resident set size").set(report["host_rss_bytes"])
+    return report
+
+
+# ------------------------------------------------------------------ #
+# host-side records + detectors
+
+
+@dataclasses.dataclass
+class StepHealth:
+    """One step's host-side health record (everything a detector reads).
+    ``grad_norm=None`` means "not measured" (skips the norm-based
+    detectors) — a non-finite FLOAT means the grads really blew up."""
+    step: int
+    loss: float
+    grad_norm: Optional[float] = None
+    nonfinite_grads: float = 0.0
+    nonfinite_params: float = 0.0
+    update_ratio: float = 0.0
+    skipped: bool = False               # fp16 overflow skip-update step
+    loss_scale: float = 1.0
+    step_time_s: float = 0.0            # bracketed compiled-step wall time
+    wait_time_s: float = 0.0            # host time since the previous step
+    bucket_norms: Tuple[float, ...] = ()
+
+
+class HealthMonitor:
+    """Ring buffer of :class:`StepHealth` + the anomaly detectors.
+
+    Detector catalogue (all thresholds on :class:`HealthConfig`):
+
+    - ``nonfinite`` — any non-finite grad/param element, loss, or grad
+      norm on a step that was NOT an fp16 skip (skipped steps are the
+      loss scaler doing its job; persistence is ``overflow``'s domain).
+    - ``loss_spike`` — robust z-score of the loss against an EWMA
+      mean/variance exceeds ``loss_spike_zscore`` (after warmup).
+    - ``grad_explosion`` — grad norm > ``grad_norm_factor`` × its EWMA.
+    - ``plateau`` — no relative loss improvement for ``plateau_steps``.
+    - ``overflow`` — ``overflow_window`` CONSECUTIVE fp16 skip steps
+      (re-fires every further window while the run stays stuck).
+    - ``data_stall`` — wait/(wait+step) above ``data_stall_fraction`` for
+      ``data_stall_steps`` consecutive steps: the input pipeline, not the
+      device, is the bottleneck.
+
+    Every firing increments ``health/anomalies{type=}``; ``action``
+    escalates: ``record`` (counters only) → ``warn`` (+ rate-limited log,
+    at most one per detector per ``window`` steps) → ``dump`` (+ a debug
+    bundle via :meth:`dump_bundle`, at most ``dump_limit`` per run)."""
+
+    DETECTORS = ("nonfinite", "loss_spike", "grad_explosion", "plateau",
+                 "overflow", "data_stall")
+    ACTIONS = ("record", "warn", "dump")
+
+    def __init__(self, config, registry=None, bucket_names: Sequence[str] = (),
+                 snapshot_fn: Optional[Callable[[], Dict]] = None,
+                 trace_export_fn: Optional[Callable[[str], str]] = None):
+        if config.action not in self.ACTIONS:
+            raise ValueError(f"telemetry.health.action={config.action!r} "
+                             f"(expected one of {self.ACTIONS})")
+        if registry is None:
+            from deepspeed_tpu.monitor.metrics import get_registry
+            registry = get_registry()
+        self.cfg = config
+        self.registry = registry
+        self.bucket_names = tuple(bucket_names)
+        self._snapshot_fn = snapshot_fn
+        self._trace_export_fn = trace_export_fn
+        self.ring: deque = deque(maxlen=max(config.window,
+                                            config.keep_last_steps))
+        self._n = 0
+        self._ewma_loss: Optional[float] = None
+        self._ewvar_loss = 0.0
+        self._ewma_gnorm: Optional[float] = None
+        self._best_loss = math.inf
+        self._since_best = 0
+        self._consec_skips = 0
+        self._consec_stall = 0
+        self._wait_total = 0.0
+        self._busy_total = 0.0
+        self._fired_counts: Dict[str, int] = {}
+        self._last_warn: Dict[str, int] = {}
+        self._last_dump_step: Optional[int] = None
+        self._dumps = 0
+        self.ensure()
+
+    # families resolved per access (same pattern as ServingTelemetry) so a
+    # registry reset between bench metrics can't orphan them
+
+    @property
+    def anomalies(self):
+        return self.registry.counter(
+            "health/anomalies", "detector firings by type",
+            labelnames=("type",))
+
+    @property
+    def loss_ewma_gauge(self):
+        return self.registry.gauge("health/loss_ewma",
+                                   "EWMA of the training loss")
+
+    @property
+    def grad_norm_gauge(self):
+        return self.registry.gauge("health/grad_norm",
+                                   "last step's pre-clip global grad norm")
+
+    @property
+    def consec_skips_gauge(self):
+        return self.registry.gauge("health/consecutive_skips",
+                                   "consecutive fp16 overflow-skipped steps")
+
+    def ensure(self) -> None:
+        """Pre-create every series (incl. a zero child per detector type)
+        so a clean run's snapshot shows explicit zeros, not absences."""
+        for t in self.DETECTORS:
+            self.anomalies.labels(type=t)
+        self.loss_ewma_gauge, self.grad_norm_gauge
+        self.consec_skips_gauge
+
+    def set_bucket_names(self, names: Sequence[str]) -> None:
+        """Called by the engine once the sentinel bucket layout is known
+        (at trace time of the first compiled step)."""
+        self.bucket_names = tuple(names)
+
+    # ---- the per-step entry point ---- #
+
+    def observe_step(self, rec: StepHealth) -> List[str]:
+        """Feed one step record through every detector; returns the list
+        of detectors that fired (and applies the configured action)."""
+        cfg = self.cfg
+        self._n += 1
+        self.ring.append(rec)
+        fired: List[str] = []
+        loss_ok = math.isfinite(rec.loss)
+        # grad_norm None = "not measured" (norm detectors skip); only a
+        # non-finite MEASURED norm is an anomaly
+        gn_known = rec.grad_norm is not None
+        gn_ok = gn_known and math.isfinite(rec.grad_norm)
+
+        # nonfinite: immediate, but NOT on fp16 skip steps (the scaler
+        # already handled those; sustained skips are `overflow`)
+        if not rec.skipped and (rec.nonfinite_grads > 0
+                                or rec.nonfinite_params > 0
+                                or not loss_ok or (gn_known and not gn_ok)):
+            fired.append("nonfinite")
+
+        # loss spike: robust z-score against EWMA mean/var
+        if loss_ok:
+            if self._ewma_loss is None:
+                self._ewma_loss = rec.loss
+            else:
+                sd = math.sqrt(max(self._ewvar_loss, 0.0))
+                denom = sd + 1e-8 + 1e-3 * abs(self._ewma_loss)
+                if (self._n > cfg.warmup_steps
+                        and (rec.loss - self._ewma_loss) / denom
+                        > cfg.loss_spike_zscore):
+                    fired.append("loss_spike")
+                a = cfg.loss_ewma_alpha
+                delta = rec.loss - self._ewma_loss
+                self._ewma_loss += a * delta
+                self._ewvar_loss = (1 - a) * (self._ewvar_loss + a * delta * delta)
+            self.loss_ewma_gauge.set(self._ewma_loss)
+
+        # grad-norm explosion
+        if gn_ok:
+            if (self._ewma_gnorm is not None and self._n > cfg.warmup_steps
+                    and rec.grad_norm > cfg.grad_norm_factor
+                    * max(self._ewma_gnorm, 1e-12)):
+                fired.append("grad_explosion")
+            a = cfg.loss_ewma_alpha
+            self._ewma_gnorm = (rec.grad_norm if self._ewma_gnorm is None
+                                else self._ewma_gnorm
+                                + a * (rec.grad_norm - self._ewma_gnorm))
+            self.grad_norm_gauge.set(rec.grad_norm)
+
+        # plateau
+        if cfg.plateau_steps and loss_ok:
+            tol = cfg.plateau_rel_improvement * max(abs(self._best_loss), 1e-8)
+            if not math.isfinite(self._best_loss) \
+                    or rec.loss < self._best_loss - tol:
+                self._best_loss = rec.loss
+                self._since_best = 0
+            else:
+                self._since_best += 1
+                if self._since_best >= cfg.plateau_steps:
+                    fired.append("plateau")
+                    self._since_best = 0
+
+        # sustained fp16 overflow
+        self._consec_skips = self._consec_skips + 1 if rec.skipped else 0
+        self.consec_skips_gauge.set(self._consec_skips)
+        if (cfg.overflow_window and self._consec_skips
+                and self._consec_skips % cfg.overflow_window == 0):
+            fired.append("overflow")
+
+        # data stall (the published cumulative gauge is the engine's
+        # train/data_stall_fraction — ONE series; these totals only feed
+        # report() so a standalone monitor still summarizes)
+        self._wait_total += max(rec.wait_time_s, 0.0)
+        self._busy_total += max(rec.step_time_s, 0.0)
+        per_step = rec.wait_time_s / max(rec.wait_time_s + rec.step_time_s,
+                                         1e-9)
+        self._consec_stall = (self._consec_stall + 1
+                              if per_step > cfg.data_stall_fraction else 0)
+        if (cfg.data_stall_steps and self._consec_stall
+                and self._consec_stall % cfg.data_stall_steps == 0):
+            fired.append("data_stall")
+
+        if fired:
+            self._act(fired, rec)
+        return fired
+
+    # ---- actions ---- #
+
+    def _act(self, fired: List[str], rec: StepHealth) -> None:
+        cfg = self.cfg
+        for t in fired:
+            self._fired_counts[t] = self._fired_counts.get(t, 0) + 1
+            self.anomalies.labels(type=t).inc()
+        if cfg.action == "record":
+            return
+        to_warn = [t for t in fired
+                   if rec.step - self._last_warn.get(t, -10**12) >= cfg.window]
+        if to_warn:
+            for t in to_warn:
+                self._last_warn[t] = rec.step
+            gn_s = "n/a" if rec.grad_norm is None else f"{rec.grad_norm:.4g}"
+            logger.warning(
+                f"health: {'+'.join(to_warn)} at step {rec.step} "
+                f"(loss={rec.loss:.4g}, grad_norm={gn_s}, "
+                f"nonfinite_grads={rec.nonfinite_grads:.0f}, "
+                f"skipped={rec.skipped}, loss_scale={rec.loss_scale:.4g}, "
+                f"wait/step={rec.wait_time_s * 1e3:.1f}/"
+                f"{rec.step_time_s * 1e3:.1f}ms). "
+                f"Next warning for these detectors in {cfg.window} steps.")
+        if cfg.action == "dump" and self._dumps < cfg.dump_limit and \
+                (self._last_dump_step is None
+                 or rec.step - self._last_dump_step >= cfg.window):
+            try:
+                self.dump_bundle(fired, rec)
+            except Exception as e:  # diagnostics must never kill the step
+                logger.warning(f"health: debug-bundle dump failed: {e}")
+
+    def dump_bundle(self, fired: Sequence[str], rec: StepHealth) -> str:
+        """Write a debug bundle directory: ``report.json`` (what fired and
+        the triggering record), ``steps.jsonl`` (last-K ring records),
+        ``telemetry.json`` (full registry snapshot) and ``trace.json``
+        (chrome trace) when the engine provided exporters. Returns the
+        bundle path."""
+        path = os.path.join(self.cfg.dump_dir,
+                            f"step{rec.step:08d}_{'+'.join(fired)}")
+        os.makedirs(path, exist_ok=True)
+        report = {"ts": time.time(), "step": rec.step, "fired": list(fired),
+                  "record": dataclasses.asdict(rec),
+                  "anomaly_counts": dict(self._fired_counts),
+                  "bucket_names": list(self.bucket_names),
+                  "config": _config_dict(self.cfg)}
+        with open(os.path.join(path, "report.json"), "w") as f:
+            json.dump(report, f, indent=2)
+        with open(os.path.join(path, "steps.jsonl"), "w") as f:
+            for r in list(self.ring)[-self.cfg.keep_last_steps:]:
+                f.write(json.dumps(dataclasses.asdict(r)) + "\n")
+        if self._snapshot_fn is not None:
+            try:
+                with open(os.path.join(path, "telemetry.json"), "w") as f:
+                    json.dump(self._snapshot_fn(), f, indent=2)
+            except Exception as e:
+                logger.warning(f"health: telemetry snapshot in bundle failed: {e}")
+        if self._trace_export_fn is not None:
+            try:
+                self._trace_export_fn(os.path.join(path, "trace.json"))
+            except Exception as e:
+                logger.warning(f"health: trace export in bundle failed: {e}")
+        self._dumps += 1
+        self._last_dump_step = rec.step
+        logger.warning(f"health: debug bundle written to {path} "
+                       f"({self._dumps}/{self.cfg.dump_limit})")
+        return path
+
+    # ---- reporting ---- #
+
+    def report(self) -> Dict[str, Any]:
+        """One-call health summary: detector counts, EWMAs, stall
+        fraction, the last step record, and a fresh memory sample."""
+        tot = self._wait_total + self._busy_total
+        return {
+            "enabled": True,
+            "steps": self._n,
+            "anomalies": {t: self._fired_counts.get(t, 0)
+                          for t in self.DETECTORS},
+            "ewma_loss": self._ewma_loss,
+            "ewma_grad_norm": self._ewma_gnorm,
+            "consecutive_skips": self._consec_skips,
+            "data_stall_fraction": (self._wait_total / tot) if tot > 0 else 0.0,
+            "last": dataclasses.asdict(self.ring[-1]) if self.ring else None,
+            "bucket_names": list(self.bucket_names),
+            "dumps": self._dumps,
+            "memory": sample_memory_gauges(self.registry),
+        }
+
+
+def _config_dict(cfg) -> Dict:
+    for attr in ("model_dump", "dict"):
+        fn = getattr(cfg, attr, None)
+        if callable(fn):
+            try:
+                return {k: v for k, v in fn().items()
+                        if isinstance(v, (int, float, str, bool, type(None)))}
+            except Exception:
+                pass
+    return {}
+
+
+# ------------------------------------------------------------------ #
+# the `health` CLI: tail the JSONL sink, render one screen
+
+
+def read_last_snapshots(path: str, n: int = 2,
+                        tail_bytes: int = 1 << 19) -> List[Dict]:
+    """Last ``n`` parseable JSONL records of ``path`` (bounded tail read,
+    so multi-GB sinks tail in O(tail_bytes)). Empty list when the file is
+    missing or holds no valid records."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - tail_bytes))
+            chunk = f.read()
+    except OSError:
+        return []
+    if size > tail_bytes:
+        # drop the (possibly mid-record) first line of the tail window
+        chunk = chunk.split(b"\n", 1)[-1]
+    recs: List[Dict] = []
+    for line in chunk.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            recs.append(rec)
+    return recs[-n:]
+
+
+def labeled_series(section: Dict, name: str) -> Dict[str, float]:
+    """``{label_value: value}`` for every ``name{k="v"}`` series in a
+    snapshot section (shared by the CLI renderer and bench.py's blob)."""
+    out = {}
+    prefix = name + "{"
+    for k, v in section.items():
+        if k.startswith(prefix) and k.endswith("}"):
+            inner = k[len(prefix):-1]
+            label = inner.split("=", 1)[-1].strip('"') if "=" in inner else inner
+            out[label] = v
+    return out
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TB"
+
+
+def _fmt(v: Optional[float], spec: str = ".3g", missing: str = "-") -> str:
+    if v is None:
+        return missing
+    try:
+        return format(float(v), spec)
+    except (TypeError, ValueError):
+        return missing
+
+
+def render_health_table(rec: Dict, prev: Optional[Dict] = None) -> str:
+    """One-screen status table from a telemetry JSONL record (a registry
+    snapshot line). ``prev`` (the previous record) sharpens the step-rate
+    and loss-trend readouts. Sections with no data are omitted."""
+    g = rec.get("gauges", {}) or {}
+    c = rec.get("counters", {}) or {}
+    h = rec.get("histograms", {}) or {}
+    lines: List[str] = []
+
+    step = rec.get("step")
+    ts = rec.get("ts")
+    when = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts)) if ts else ""
+    lines.append(f"deepspeed_tpu health — step {step if step is not None else '?'}"
+                 f"  {when}".rstrip())
+    lines.append("-" * 64)
+
+    # ---- train throughput ---- #
+    st = h.get("train/step_time_ms")
+    if st or "train/steps" in c:
+        rate = None
+        if prev and ts and prev.get("ts") and "train/steps" in c \
+                and "train/steps" in (prev.get("counters") or {}):
+            dt = ts - prev["ts"]
+            dsteps = c["train/steps"] - prev["counters"]["train/steps"]
+            if dt > 0 and dsteps > 0:
+                rate = dsteps / dt
+        if rate is None and st and st.get("mean"):
+            rate = 1000.0 / st["mean"]
+        parts = [f"steps {int(c.get('train/steps', 0))}"]
+        if st:
+            parts.append(f"step {st['mean']:.1f}ms (p50 {st['p50']:.1f}, "
+                         f"p99 {st['p99']:.1f})")
+        if rate:
+            parts.append(f"rate {rate:.2f}/s")
+        if "train/tokens_per_sec" in g:
+            parts.append(f"tok/s {g['train/tokens_per_sec']:,.0f}")
+        if "train/mfu" in g:
+            parts.append(f"MFU {g['train/mfu']:.3f}")
+        lines.append("train    " + "   ".join(parts))
+
+    # ---- loss / grad ---- #
+    if "train/loss" in g or "train/grad_norm" in h:
+        parts = []
+        if "train/loss" in g:
+            trend = ""
+            pg = (prev or {}).get("gauges") or {}
+            if "train/loss" in pg:
+                d = g["train/loss"] - pg["train/loss"]
+                trend = " ↓" if d < 0 else (" ↑" if d > 0 else " →")
+            parts.append(f"loss {_fmt(g['train/loss'], '.4g')}{trend}")
+        if "health/loss_ewma" in g:
+            parts.append(f"ewma {_fmt(g['health/loss_ewma'], '.4g')}")
+        gn = h.get("train/grad_norm")
+        if gn and gn.get("count"):
+            cur = g.get("health/grad_norm")
+            cur_s = f"{_fmt(cur)} " if cur is not None else ""
+            parts.append(f"grad_norm {cur_s}(p50 {_fmt(gn['p50'])}, "
+                         f"p99 {_fmt(gn['p99'])})")
+        if parts:
+            lines.append("loss     " + "   ".join(parts))
+
+    # ---- fp16 / skips ---- #
+    if "train/loss_scale" in g or "train/skipped_steps" in g:
+        parts = []
+        if "train/loss_scale" in g:
+            parts.append(f"loss_scale {_fmt(g['train/loss_scale'], '.6g')}")
+        if "train/skipped_steps" in g:
+            # denominator: the snapshot's step stamp (advances on both the
+            # train_batch and trio paths; the train/steps counter is
+            # train_batch-only and would render "N/0" for trio runs)
+            total = rec.get("step") or int(c.get("train/steps", 0))
+            parts.append(f"skipped {int(g['train/skipped_steps'])}"
+                         f"/{int(total)} steps")
+        if "health/consecutive_skips" in g:
+            parts.append(f"consecutive {int(g['health/consecutive_skips'])}")
+        lines.append("fp16     " + "   ".join(parts))
+
+    # ---- anomalies / stall ---- #
+    anoms = labeled_series(c, "health/anomalies")
+    stall = g.get("train/data_stall_fraction")
+    if anoms or stall is not None:
+        nonzero = {k: int(v) for k, v in sorted(anoms.items()) if v}
+        a_s = ", ".join(f"{k}:{v}" for k, v in nonzero.items()) \
+            if nonzero else ("none" if anoms else "-")
+        parts = [f"anomalies {a_s}"]
+        if stall is not None:
+            parts.append(f"data-stall {stall:.1%}")
+        lines.append("health   " + "   ".join(parts))
+
+    # ---- memory ---- #
+    used = labeled_series(g, "mem/hbm_bytes_in_use")
+    lim = labeled_series(g, "mem/hbm_bytes_limit")
+    peak = labeled_series(g, "mem/hbm_peak_bytes")
+    head = labeled_series(g, "mem/hbm_headroom_bytes")
+    rss = g.get("mem/host_rss_bytes")
+    if used or rss:
+        parts = []
+        if used:
+            mx = max(used, key=used.get)
+            u, l2, p = used[mx], lim.get(mx, 0), peak.get(mx, 0)
+            s = f"HBM {_fmt_bytes(u)}"
+            if l2:
+                s += f"/{_fmt_bytes(l2)}"
+            if p:
+                s += f" (peak {_fmt_bytes(p)}"
+                if head.get(mx) is not None:
+                    s += f", headroom {_fmt_bytes(head[mx])}"
+                s += ")"
+            parts.append(s + f" [{mx}]")
+        if rss:
+            parts.append(f"host RSS {_fmt_bytes(rss)}")
+        lines.append("memory   " + "   ".join(parts))
+
+    # ---- serving ---- #
+    ttft = h.get("serving/ttft_ms")
+    if ttft and ttft.get("count") or "serving/queue_depth" in g:
+        parts = []
+        if ttft and ttft.get("count"):
+            parts.append(f"TTFT p50 {ttft['p50']:.1f}ms p99 {ttft['p99']:.1f}ms")
+        tpot = h.get("serving/tpot_ms")
+        if tpot and tpot.get("count"):
+            parts.append(f"TPOT p50 {tpot['p50']:.2f}ms")
+        if "serving/queue_depth" in g:
+            parts.append(f"queue {int(g['serving/queue_depth'])}")
+        if "serving/running" in g:
+            parts.append(f"running {int(g['serving/running'])}")
+        if "serving/kv_block_utilization" in g:
+            s = f"KV util {g['serving/kv_block_utilization']:.2f}"
+            if "serving/kv_blocks_free" in g:
+                s += f" free {int(g['serving/kv_blocks_free'])}"
+            if "serving/kv_fragmentation" in g:
+                s += f" frag {g['serving/kv_fragmentation']:.2f}"
+            parts.append(s)
+        if "serving/preemptions" in c:
+            parts.append(f"preempt {int(c['serving/preemptions'])}")
+        if parts:
+            lines.append("serving  " + "   ".join(parts))
+
+    if len(lines) == 2:
+        lines.append("(no recognized series in this snapshot)")
+    return "\n".join(lines)
+
+
+def health_cli(argv: Optional[List[str]] = None) -> int:
+    """``dscli health <telemetry.jsonl>`` — live one-screen status table
+    tailing the JSONL telemetry sink (``--once`` renders a single table
+    and exits; default follows at ``--interval`` seconds)."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="dscli health",
+        description="live training/serving health screen over a JSONL "
+                    "telemetry sink (telemetry.jsonl_path)")
+    parser.add_argument("path", help="JSONL telemetry sink to tail")
+    parser.add_argument("--once", action="store_true",
+                        help="render one table and exit (no follow loop)")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="refresh period in seconds (default 2)")
+    args = parser.parse_args(argv)
+
+    if args.once:
+        recs = read_last_snapshots(args.path, 2)
+        if not recs:
+            print(f"health: no telemetry records in {args.path}")
+            return 1
+        print(render_health_table(recs[-1], recs[-2] if len(recs) > 1 else None))
+        return 0
+    try:
+        while True:
+            recs = read_last_snapshots(args.path, 2)
+            body = (render_health_table(recs[-1],
+                                        recs[-2] if len(recs) > 1 else None)
+                    if recs else f"health: waiting for records in {args.path} ...")
+            sys.stdout.write("\033[2J\033[H" + body + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0

@@ -223,7 +223,22 @@ class DeepSpeedEngine:
         tcfg = self._config.telemetry_config
         self._telemetry = tcfg if tcfg.enabled else None
         self._tel_flops_per_token_v = None
+        # health observatory: None when off, so every hot-path hook gates
+        # at one attribute check (sentinel collection in the compiled step
+        # is likewise a trace-time constant — no runtime branch at all)
+        self._health = None
+        self._sentinels_on = False
+        self._sentinel_layout = None     # (leaf->bucket assignment, names)
+        self._t_prev_step_end = None     # data-stall wait-time base
+        self._trio_busy_s = 0.0          # per-cycle fwd+bwd+step phase time
+        self._tel_wait_total = 0.0
+        self._tel_busy_total = 0.0
+        self._tel_skip_consec = 0        # health-off sustained-skip warning
+        self._tel_skip_seen = 0
+        self._tel_skipped_prev = None    # health skip detection base
+        self._tel_skipped_cached = None  # per-step skipped_steps fetch
         if self._telemetry is not None:
+            from deepspeed_tpu.monitor.health import sample_memory_gauges
             from deepspeed_tpu.monitor.metrics import get_registry
             from deepspeed_tpu.monitor.trace import (get_compile_watchdog,
                                                      get_tracer)
@@ -233,6 +248,7 @@ class DeepSpeedEngine:
             self._tel_watchdog = get_compile_watchdog()
             self._tel_watchdog.storm_threshold = tcfg.compile_storm_threshold
             self._tel_tracer = get_tracer()
+            self._tel_sample_memory = sample_memory_gauges
             self._tel_step_hist = reg.histogram(
                 "train/step_time_ms", "whole train_batch wall time")
             self._tel_phase_hist = reg.histogram(
@@ -249,6 +265,32 @@ class DeepSpeedEngine:
                 "train/mfu", "achieved / peak flops per chip (PaLM-style)")
             self._tel_steps_counter = reg.counter("train/steps")
             self._tel_tokens_counter = reg.counter("train/tokens")
+            self._tel_loss_gauge = reg.gauge(
+                "train/loss", "last recorded training loss")
+            self._tel_grad_norm_hist = reg.histogram(
+                "train/grad_norm",
+                "pre-clip global gradient norm (reused from the norm "
+                "clip_grad_norm_ computes; recorded even with clipping off)")
+            self._tel_wait_hist = reg.histogram(
+                "train/data_wait_ms",
+                "host time between compiled steps (data loading + host prep)")
+            self._tel_stall_gauge = reg.gauge(
+                "train/data_stall_fraction",
+                "cumulative wait / (wait + device step) time")
+            if self.fp16_enabled():
+                self._tel_skipped_gauge = reg.gauge(
+                    "train/skipped_steps",
+                    "fp16 overflow skip-update steps so far")
+                self._tel_scale_gauge = reg.gauge(
+                    "train/loss_scale", "current dynamic loss scale")
+            hcfg = tcfg.health
+            if hcfg.enabled:
+                from deepspeed_tpu.monitor.health import HealthMonitor
+                self._health = HealthMonitor(
+                    hcfg, registry=reg,
+                    snapshot_fn=self.telemetry_snapshot,
+                    trace_export_fn=self._tel_tracer.export_chrome_trace)
+                self._sentinels_on = bool(hcfg.sentinels)
 
         # ---- curriculum learning (reference engine.py:1691 legacy path +
         # data_efficiency data_sampling.curriculum_learning) ----
@@ -473,8 +515,14 @@ class DeepSpeedEngine:
         # constrain to ZeRO grad shardings: stage>=2 => XLA reduce-scatters
         return jax.lax.with_sharding_constraint(acc, self._grad_shardings)
 
-    def _apply_update(self, state: TrainState, gas: int, acc=None) -> TrainState:
+    def _apply_update(self, state: TrainState, gas: int, acc=None):
         """Unscale, clip, (maybe skip on overflow), optimizer update.
+        Returns ``(new_state, aux)`` where ``aux`` is a (possibly empty)
+        dict of health/telemetry scalars computed inside this same
+        program: ``grad_norm`` (pre-clip, telemetry on) and ``sentinels``
+        (the numerics summary vector, health sentinels on). The gating is
+        a trace-time constant — telemetry off compiles the exact same
+        program as before.
 
         ``acc``: gradient tree to consume; defaults to ``state.acc_grads``
         (the GAS-scan buffers). The gas==1 fast path passes the micro-step
@@ -494,9 +542,19 @@ class DeepSpeedEngine:
 
         overflow = has_overflow(grads) if self.fp16_enabled() else jnp.asarray(False)
 
+        aux: Dict[str, Any] = {}
+        raw_grads = grads
         clip = float(self.gradient_clipping() or 0.0)
+        # pre-clip global norm computed ONCE and shared: the clip consumes
+        # it via its norm= parameter and telemetry records it (even with
+        # clipping disabled, the satellite contract)
+        norm = None
+        if clip > 0.0 or self._telemetry is not None:
+            norm = global_norm(grads)
         if clip > 0.0:
-            grads, _ = clip_grad_norm_(grads, clip)
+            grads, _ = clip_grad_norm_(grads, clip, norm=norm)
+        if self._telemetry is not None:
+            aux["grad_norm"] = norm
 
         lr = self._lr_fn(state.global_steps)
         opt_target = state.master if state.master is not None else state.params
@@ -510,15 +568,27 @@ class DeepSpeedEngine:
                 # engine-built chains end before lr scaling so the schedule
                 # stays inside jit: direction u is descent, applied as p - lr*u
                 new_target = jax.tree.map(lambda p, u: p - lr * u.astype(p.dtype), opt_target, updates)
-            return new_target, new_opt
+            # sentinel update norm from the update VECTOR, not new - old:
+            # a (new - old) subtraction would keep the whole pre-update
+            # tree live past the update and defeat donation aliasing (one
+            # extra fp32 master copy of peak HBM). ||delta|| = ||u|| for a
+            # client chain, lr*||u|| for engine-built chains.
+            if self._sentinels_on:
+                up_norm = global_norm(updates)
+                if not self._client_tx_full:
+                    up_norm = lr * up_norm
+            else:
+                up_norm = jnp.float32(0.0)
+            return new_target, new_opt, up_norm
 
         def skip_update(_):
-            return opt_target, state.opt_state
+            return opt_target, state.opt_state, jnp.float32(0.0)
 
         if self.fp16_enabled():
-            new_target, new_opt = jax.lax.cond(overflow, skip_update, do_update, operand=None)
+            new_target, new_opt, up_norm = jax.lax.cond(
+                overflow, skip_update, do_update, operand=None)
         else:
-            new_target, new_opt = do_update(None)
+            new_target, new_opt, up_norm = do_update(None)
 
         if state.master is not None:
             new_master = new_target
@@ -528,6 +598,16 @@ class DeepSpeedEngine:
             new_master = None
             new_params = jax.lax.with_sharding_constraint(new_target, self._param_shardings)
 
+        if self._sentinels_on:
+            # numerics sentinels ride THIS program (no extra compiles or
+            # host round-trips): non-finite counts over the raw unscaled
+            # grads + post-update params, param/update norms, per-group
+            # norm buckets — all cheap reductions XLA fuses into the step
+            from deepspeed_tpu.monitor.health import compute_sentinels
+            assignment, names = self._sentinel_buckets(raw_grads)
+            aux["sentinels"] = compute_sentinels(
+                raw_grads, new_target, up_norm, norm, assignment, names)
+
         new_scaler = scaler_update(state.scaler, overflow)
         # donation aliases the untouched buffers through at zero cost
         zero_acc = (jax.tree.map(jnp.zeros_like, state.acc_grads) if from_buffers
@@ -535,7 +615,19 @@ class DeepSpeedEngine:
         return state._replace(
             params=new_params, master=new_master, opt_state=new_opt, acc_grads=zero_acc, scaler=new_scaler,
             global_steps=state.global_steps + 1,
-            skipped_steps=state.skipped_steps + overflow.astype(jnp.int32))
+            skipped_steps=state.skipped_steps + overflow.astype(jnp.int32)), aux
+
+    def _sentinel_buckets(self, grads_tree):
+        """Leaf→layer-group bucket layout for the sentinel vector,
+        computed once (at trace time of the first compiled step) and
+        cached — the structure is fixed for the engine's lifetime."""
+        if self._sentinel_layout is None:
+            from deepspeed_tpu.monitor.health import make_bucket_assignment
+            assignment, names = make_bucket_assignment(
+                grads_tree, self._health.cfg.max_norm_buckets)
+            self._sentinel_layout = (assignment, names)
+            self._health.set_bucket_names(names)
+        return self._sentinel_layout
 
     def _build_accum_batch_fn(self, gas: int) -> Callable:
         """GAS-scan only (offload path): grads accumulate on device, the
@@ -572,11 +664,19 @@ class DeepSpeedEngine:
         # one tree-level D2H transfer (JAX batches/overlaps the copies)
         host_grads_tree = jax.device_get(self.state.acc_grads)
         grads_host: Dict[str, np.ndarray] = {}
+        # offload-path health/telemetry ride the SAME host pass the grads
+        # already make (one extra reduction per leaf, no device work)
+        grad_sq = 0.0
+        nonfinite = 0.0
         for path, leaf in jax.tree_util.tree_flatten_with_path(host_grads_tree)[0]:
             arr = np.asarray(leaf).ravel()
             # one conversion, one divide: .astype copies, then /= is in-place
             arr = arr.astype(np.float32)
             arr /= denom
+            if self._telemetry is not None:
+                grad_sq += float(np.dot(arr, arr))
+            if self._health is not None:
+                nonfinite += float(arr.size - np.isfinite(arr).sum())
             grads_host[_leaf_key(path)] = np.ascontiguousarray(arr)
 
         out_dtype = ml_dtypes.bfloat16 if self.compute_dtype == jnp.bfloat16 else np.float32
@@ -606,7 +706,12 @@ class DeepSpeedEngine:
             skipped_steps=self.state.skipped_steps + int(overflow))
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
-        return {"loss": self._losses, "lr": lr, "loss_scale": float(new_scaler.loss_scale)}
+        metrics = {"loss": self._losses, "lr": lr, "loss_scale": float(new_scaler.loss_scale)}
+        if self._telemetry is not None:
+            metrics["grad_norm"] = float(np.sqrt(grad_sq))
+        if self._health is not None:
+            metrics["nonfinite_grads"] = nonfinite
+        return metrics
 
     # ------------------------------------------------------------------ #
     # 1-bit optimizer path (reference runtime/fp16/onebit/*: the optimizer
@@ -763,9 +868,9 @@ class DeepSpeedEngine:
                     jax.tree.map(lambda g: g.astype(self.grad_acc_dtype), grads),
                     self._grad_shardings)
                 state = state._replace(micro_steps=state.micro_steps + 1)
-                state = self._apply_update(state, 1, acc=grads)
+                state, aux = self._apply_update(state, 1, acc=grads)
                 return state, {"loss": loss, "lr": self._lr_fn(state.global_steps - 1),
-                               "loss_scale": state.scaler.loss_scale}
+                               "loss_scale": state.scaler.loss_scale, **aux}
 
             return jax.jit(train_batch_fn, donate_argnums=(0,))
 
@@ -781,10 +886,10 @@ class DeepSpeedEngine:
 
             (acc, _), losses = jax.lax.scan(micro, (state.acc_grads, jnp.asarray(0, jnp.int32)), batch, length=gas)
             state = state._replace(acc_grads=acc, micro_steps=state.micro_steps + gas)
-            state = self._apply_update(state, gas)
+            state, aux = self._apply_update(state, gas)
             mean_loss = jnp.mean(losses)
             return state, {"loss": mean_loss, "lr": self._lr_fn(state.global_steps - 1),
-                           "loss_scale": state.scaler.loss_scale}
+                           "loss_scale": state.scaler.loss_scale, **aux}
 
         return jax.jit(train_batch_fn, donate_argnums=(0,))
 
@@ -911,7 +1016,20 @@ class DeepSpeedEngine:
             # must bracket the device work for step time / MFU to mean
             # anything (off-mode never reaches this branch)
             jax.block_until_ready(metrics["loss"])
-            self._tel_record_step(batch, time.perf_counter() - t0)
+            dt_s = time.perf_counter() - t0
+            # wait = host time since the previous step's end up to this
+            # step's dispatch: data loading + host-side prep — the
+            # input-bound signal the data-stall detector compares against
+            # the bracketed device time
+            wait_s = (t0 - self._t_prev_step_end
+                      if self._t_prev_step_end is not None else 0.0)
+            self._tel_record_step(batch, dt_s, metrics, wait_s)
+            if self._health is not None:
+                # observe BEFORE the flush so a flush-step anomaly is in
+                # the very snapshot it fired on (matches the step() order)
+                self._observe_health(metrics, dt_s, wait_s)
+            self._tel_maybe_flush()
+            self._t_prev_step_end = time.perf_counter()
         if self.quantizer is not None:
             self._quantize_step(batch)
         self._write_monitor_events(metrics)
@@ -1064,7 +1182,18 @@ class DeepSpeedEngine:
             return
         self._skipped_before_step = self.state.skipped_steps + 0
         if self._offload is not None:
+            t0 = time.perf_counter()
             metrics = self._host_step()
+            if self._telemetry is not None:
+                self._host_global_steps += 1
+                self._tel_record_update(metrics)
+                # wait/stall series record under plain telemetry, exactly
+                # like the train_batch path (health only adds detectors)
+                busy, wait = self._trio_wait_busy(
+                    self._trio_busy_s + time.perf_counter() - t0)
+                if self._health is not None:
+                    self._observe_health(metrics, busy, wait)
+                self._tel_maybe_flush()
             self._write_monitor_events(metrics)
             self._report_progress(metrics)
             return
@@ -1074,11 +1203,26 @@ class DeepSpeedEngine:
                 jax.jit(partial(self._apply_update, gas=gas), donate_argnums=(0,)),
                 "engine.step")
         t0 = time.perf_counter()
-        self.state = self._apply_jit(self.state)
+        self.state, aux = self._apply_jit(self.state)
         self._tel_phase("step", t0, self.state.global_steps)
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
-        metrics = {"loss": self._losses, "lr": self.get_lr()[0], "loss_scale": self.state.scaler.loss_scale}
+        metrics = {"loss": self._losses, "lr": self.get_lr()[0],
+                   "loss_scale": self.state.scaler.loss_scale, **aux}
+        if self._telemetry is not None:
+            # keep the host step counter moving on the trio path too, so
+            # the flush cadence (and snapshot step stamps) work without a
+            # device fetch; train_batch and step() are alternative
+            # boundaries, never both for one update
+            self._host_global_steps += 1
+            self._tel_record_update(metrics)
+            # _tel_phase("step") above already folded this apply into the
+            # cycle's busy accumulator; wait/stall series record under
+            # plain telemetry (health only adds the detectors on top)
+            busy, wait = self._trio_wait_busy(self._trio_busy_s)
+            if self._health is not None:
+                self._observe_health(metrics, busy, wait)
+            self._tel_maybe_flush()
         self._write_monitor_events(metrics)
         self._report_progress(metrics)
 
@@ -1466,14 +1610,47 @@ class DeepSpeedEngine:
         if self._telemetry is None:
             return
         jax.block_until_ready(sync_on)
-        self._tel_phase_hist.labels(phase=phase).observe(
-            (time.perf_counter() - t0) * 1e3)
+        dur = time.perf_counter() - t0
+        self._tel_phase_hist.labels(phase=phase).observe(dur * 1e3)
+        # accumulated per update cycle: the trio path's device-busy time
+        # (fwd + bwd + step), consumed by _trio_wait_busy at the boundary
+        self._trio_busy_s += dur
 
-    def _tel_record_step(self, batch, dt_s: float) -> None:
+    def _trio_wait_busy(self, busy_s: float):
+        """Trio/offload boundary wait accounting: ``busy_s`` is the
+        compiled/host work this cycle actually measured (accumulated phase
+        durations); the wait is the REST of the boundary-to-boundary wall
+        time — data loading and host prep between the timed calls — so the
+        data-stall detector sees input-bound trio runs too, not just
+        train_batch ones. Resets the cycle accumulators and feeds the
+        cumulative train/data_stall_fraction gauge."""
+        now = time.perf_counter()
+        wall = (now - self._t_prev_step_end
+                if self._t_prev_step_end is not None else busy_s)
+        self._t_prev_step_end = now
+        self._trio_busy_s = 0.0
+        wait_s = max(wall - busy_s, 0.0)
+        self._tel_account_wait(wait_s, busy_s)
+        return busy_s, wait_s
+
+    def _tel_account_wait(self, wait_s: float, busy_s: float) -> None:
+        """The single home of wait/stall accounting (train_batch and the
+        trio boundary both feed it): the data-wait histogram plus the
+        cumulative wait/(wait+busy) stall gauge."""
+        wait_s = max(wait_s, 0.0)
+        self._tel_wait_hist.observe(wait_s * 1e3)
+        self._tel_wait_total += wait_s
+        self._tel_busy_total += max(busy_s, 0.0)
+        tot = self._tel_wait_total + self._tel_busy_total
+        if tot > 0:
+            self._tel_stall_gauge.set(self._tel_wait_total / tot)
+
+    def _tel_record_step(self, batch, dt_s: float, metrics=None,
+                         wait_s: float = 0.0) -> None:
         """Per-step series: step time, tokens/sec, achieved TFLOPs + MFU
-        (PaLM-style: model flops/token x token rate / peak), plus the
-        periodic JSONL / MonitorMaster flush."""
-        tcfg = self._telemetry
+        (PaLM-style: model flops/token x token rate / peak), data-wait
+        time, loss/grad-norm/fp16 gauges, plus the periodic JSONL /
+        MonitorMaster flush (memory gauges sampled on the same cadence)."""
         self._tel_step_hist.observe(dt_s * 1e3)
         self._tel_steps_counter.inc()
         self._tel_tracer.add_event("train_batch",
@@ -1492,13 +1669,116 @@ class DeepSpeedEngine:
         self._tel_tflops_gauge.set(achieved)
         peak = self._tel_peak_tflops()
         self._tel_mfu_gauge.set(achieved / peak if peak > 0 else 0.0)
+        self._tel_account_wait(wait_s, dt_s)
+        if metrics is not None:
+            self._tel_record_update(metrics)
+
+    def _tel_maybe_flush(self) -> None:
+        """JSONL/MonitorMaster flush on the ``steps_per_snapshot`` cadence,
+        with memory gauges sampled just before (every step under health —
+        host-side dict reads, ~µs). Shared by the train_batch path and the
+        trio/offload step() boundary so a trio run feeds the sink (and the
+        ``dscli health`` screen) too."""
+        tcfg = self._telemetry
         n = tcfg.steps_per_snapshot
-        if n and self._host_global_steps % n == 0:
+        flush = bool(n) and self._host_global_steps % n == 0
+        if flush or self._health is not None:
+            self._tel_sample_memory(self._tel_reg)
+        if flush:
             if tcfg.jsonl_path:
                 self._tel_reg.write_jsonl(tcfg.jsonl_path,
                                           step=self._host_global_steps)
             if tcfg.publish_to_monitor:
                 self._tel_reg.publish(self.monitor, self._host_global_steps)
+
+    def _tel_record_update(self, metrics) -> None:
+        """Optimizer-update series shared by every path that applies an
+        update (fused train_batch, the trio's step(), the offload host
+        step): loss gauge, the pre-clip grad-norm histogram, and — fp16 —
+        the skipped-steps / loss-scale gauges with a rate-limited warning
+        when overflow skips persist (today's `lax.cond` skip is otherwise
+        invisible unless you read the state object)."""
+        import math as _math
+        self._tel_loss_gauge.set(float(metrics["loss"]))
+        gn = metrics.get("grad_norm")
+        if gn is not None:
+            gn = float(gn)
+            if _math.isfinite(gn):
+                self._tel_grad_norm_hist.observe(gn)
+        if self.fp16_enabled():
+            skipped = int(self.state.skipped_steps)
+            # one blocking scalar fetch per step, shared with
+            # _observe_health (which runs right after on every boundary)
+            self._tel_skipped_cached = skipped
+            self._tel_skipped_gauge.set(skipped)
+            self._tel_scale_gauge.set(float(metrics["loss_scale"]))
+            if self._health is None:
+                # the HealthMonitor's sustained-overflow detector owns
+                # this when enabled; health-off still surfaces it
+                self._warn_sustained_skips(skipped)
+
+    def _warn_sustained_skips(self, skipped_total: int) -> None:
+        window = self._telemetry.health.overflow_window
+        delta = skipped_total - self._tel_skip_seen
+        self._tel_skip_seen = skipped_total
+        self._tel_skip_consec = self._tel_skip_consec + 1 if delta > 0 else 0
+        if window and self._tel_skip_consec and \
+                self._tel_skip_consec % window == 0:
+            logger.warning(
+                f"fp16 overflow skipped the last {self._tel_skip_consec} "
+                f"consecutive optimizer updates (total skipped "
+                f"{skipped_total}, loss scale {self.loss_scale:.4g}). The "
+                "run is making no progress — check for numerics issues or "
+                "lower the initial loss scale.")
+
+    def _observe_health(self, metrics, dt_s: float, wait_s: float) -> None:
+        """Feed one step's record through the health detectors (host side;
+        sentinel values were computed inside the compiled step and arrive
+        as one small vector — fetching them costs no extra device sync
+        beyond the one telemetry-on already performs)."""
+        from deepspeed_tpu.monitor.health import StepHealth, sentinel_to_dict
+        # global_steps, not _host_global_steps: the trio/offload step()
+        # paths never bump the latter, and a constant step number would
+        # permanently mute the per-detector warn/dump rate limiting
+        rec = StepHealth(step=int(self.state.global_steps),
+                         loss=float(metrics["loss"]),
+                         loss_scale=float(metrics.get("loss_scale", 1.0)),
+                         step_time_s=dt_s, wait_time_s=wait_s)
+        gn = metrics.get("grad_norm")
+        if gn is not None:
+            rec.grad_norm = float(gn)
+        sen = metrics.get("sentinels")
+        if sen is not None:
+            d = sentinel_to_dict(sen, self._health.bucket_names)
+            rec.grad_norm = d["grad_norm"]
+            rec.nonfinite_grads = d["nonfinite_grads"]
+            rec.nonfinite_params = d["nonfinite_params"]
+            rec.update_ratio = d["update_ratio"]
+            rec.bucket_norms = tuple(d["bucket_norms"].values())
+        elif "nonfinite_grads" in metrics:  # offload host path
+            rec.nonfinite_grads = float(metrics["nonfinite_grads"])
+        if self.fp16_enabled():
+            # reuse _tel_record_update's single skipped_steps fetch;
+            # detect this boundary's skip against the previous total
+            after = getattr(self, "_tel_skipped_cached", None)
+            if after is None:
+                after = int(self.state.skipped_steps)
+            prev = self._tel_skipped_prev
+            if prev is None:
+                before = getattr(self, "_skipped_before_step", None)
+                prev = int(before) if before is not None else after
+            rec.skipped = after > prev
+            self._tel_skipped_prev = after
+        self._health.observe_step(rec)
+
+    def health_report(self) -> Dict:
+        """The health observatory's one-call summary: anomaly counts,
+        loss/grad-norm EWMAs, consecutive-skip and data-stall state, the
+        last step record, and a fresh memory sample. ``{"enabled": False}``
+        when ``telemetry.health`` is off."""
+        if self._health is None:
+            return {"enabled": False}
+        return self._health.report()
 
     def _tel_flops_per_token(self, batch) -> float:
         """Training flops per token, computed once per engine: the flops
@@ -1551,6 +1831,10 @@ class DeepSpeedEngine:
         summary. Empty dict when telemetry is off."""
         if self._telemetry is None:
             return {}
+        if self._health is not None:
+            # refresh the memory gauges so on-demand snapshots (and the
+            # debug bundles that embed them) carry current HBM numbers
+            self._tel_sample_memory(self._tel_reg)
         snap = self._tel_reg.snapshot()
         snap["compile"] = self._tel_watchdog.summary()
         return snap

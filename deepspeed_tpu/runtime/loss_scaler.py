@@ -70,6 +70,18 @@ def has_overflow(grads) -> jnp.ndarray:
     return ~jnp.isfinite(total)
 
 
+def count_nonfinite(tree) -> jnp.ndarray:
+    """Total non-finite elements across the pytree (fp32 scalar) — the
+    counting twin of :func:`has_overflow`, feeding the health sentinels:
+    where ``has_overflow`` answers "skip this step?", this answers "how
+    bad is it?" for the anomaly report."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    return sum(jnp.sum(~jnp.isfinite(l.astype(jnp.float32))).astype(jnp.float32)
+               for l in leaves)
+
+
 def update(state: LossScaleState, overflow) -> LossScaleState:
     """Next scaler state after a step that did/didn't overflow."""
     if not state.dynamic:

@@ -574,9 +574,9 @@ class PipelineEngine(DeepSpeedEngine):
                     jax.tree.map(lambda g: g.astype(self.grad_acc_dtype), grads),
                     self._grad_shardings)
                 state = state._replace(micro_steps=state.micro_steps + gas)
-                state = self._apply_update(state, gas, acc=grads)
+                state, aux = self._apply_update(state, gas, acc=grads)
                 return state, {"loss": loss, "lr": self._lr_fn(state.global_steps - 1),
-                               "loss_scale": state.scaler.loss_scale}
+                               "loss_scale": state.scaler.loss_scale, **aux}
 
             return jax.jit(train_batch_fn, donate_argnums=(0,))
 
@@ -599,12 +599,12 @@ class PipelineEngine(DeepSpeedEngine):
                     jax.tree.map(lambda g: g.astype(self.grad_acc_dtype), grads),
                     self._grad_shardings)
                 state = state._replace(micro_steps=state.micro_steps + gas)
-                state = self._apply_update(state, gas, acc=grads)
+                state, aux = self._apply_update(state, gas, acc=grads)
             else:
                 acc = self._accumulate(state.acc_grads, grads)
                 state = state._replace(acc_grads=acc, micro_steps=state.micro_steps + gas)
-                state = self._apply_update(state, gas)
+                state, aux = self._apply_update(state, gas)
             return state, {"loss": loss, "lr": self._lr_fn(state.global_steps - 1),
-                           "loss_scale": state.scaler.loss_scale}
+                           "loss_scale": state.scaler.loss_scale, **aux}
 
         return jax.jit(train_batch_fn, donate_argnums=(0,))

@@ -1,0 +1,575 @@
+"""Training health observatory: on-device numerics sentinels (math pinned
+on CPU, no extra compiles), host-side anomaly detectors on synthetic step
+streams, debug-bundle dumps, memory gauges, serving KV gauges, and the
+``dscli health`` renderer."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.models.transformer import TransformerConfig
+from deepspeed_tpu.monitor.config import HealthConfig, get_telemetry_config
+from deepspeed_tpu.monitor.health import (HealthMonitor, StepHealth,
+                                          compute_sentinels, health_cli,
+                                          make_bucket_assignment,
+                                          read_last_snapshots,
+                                          render_health_table,
+                                          sample_memory_gauges,
+                                          sentinel_to_dict)
+from deepspeed_tpu.monitor.metrics import (MetricsRegistry, get_registry,
+                                           validate_snapshot)
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    """Fresh mesh + fresh GLOBAL registry/watchdog per test (engines
+    create their metric families at init, so the reset must come first)."""
+    from deepspeed_tpu.monitor.trace import get_compile_watchdog
+    dist.set_mesh(None)
+    get_registry().reset()
+    get_registry().set_enabled(True)
+    get_compile_watchdog().reset()
+    yield
+    dist.set_mesh(None)
+    get_registry().reset()
+    get_registry().set_enabled(True)
+    get_compile_watchdog().reset()
+
+
+def tiny_model(**over):
+    base = dict(vocab_size=64, n_layer=2, n_head=2, d_model=32, d_ff=64,
+                max_seq=32, remat=False, attention_backend="xla")
+    base.update(over)
+    return CausalLM(TransformerConfig(**base))
+
+
+def make_engine(health=None, fp16=False, **cfg_over):
+    model = tiny_model()
+    params = model.init_params(jax.random.key(0))
+    tel = {"enabled": True}
+    if health is not None:
+        tel["health"] = health
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "mesh": {"dp": -1},
+        "steps_per_print": 0,
+        "telemetry": tel,
+    }
+    if fp16:
+        config["fp16"] = {"enabled": True}
+    config.update(cfg_over)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                               model_parameters=params,
+                                               config=config)
+    return engine
+
+
+def train_batch(engine):
+    dp = dist.get_world_size(dist.data_parallel_axes(engine.mesh))
+    rows = engine.train_micro_batch_size_per_gpu() * \
+        engine.gradient_accumulation_steps() * dp
+    rng = np.random.default_rng(0)
+    return {"input_ids": rng.integers(0, 64, size=(rows, 32)).astype(np.int32)}
+
+
+def force_nonfinite_grads(engine):
+    """Make the compiled step produce non-finite loss/grads (multiplying
+    the loss by inf propagates inf/nan into every gradient). Must run
+    BEFORE the first train_batch so the lazy jit closes over it."""
+    orig = engine.loss_fn
+    engine.loss_fn = lambda p, b, rng: orig(p, b, rng) * jnp.float32(np.inf)
+
+
+# --------------------------------------------------------------------- #
+# sentinel math (pure, pinned on CPU)
+
+
+class TestSentinels:
+
+    def _trees(self):
+        grads = {"embed": jnp.asarray([1.0, -2.0, 3.0]),
+                 "layers": {"w": jnp.asarray([[0.5, -0.5], [1.5, 2.5]])},
+                 "head": jnp.asarray([4.0])}
+        new = jax.tree.map(lambda g: g * 10.0, grads)
+        return grads, new
+
+    def test_clean_values_match_reference(self):
+        grads, new = self._trees()
+        assignment, names = make_bucket_assignment(grads, 8)
+        vec = compute_sentinels(grads, new, jnp.asarray(0.5), None,
+                                assignment, names)
+        d = sentinel_to_dict(vec, names)
+        flat = np.concatenate([np.asarray(l).ravel()
+                               for l in jax.tree.leaves(grads)])
+        assert d["nonfinite_grads"] == 0 and d["nonfinite_params"] == 0
+        assert d["grad_norm"] == pytest.approx(np.linalg.norm(flat), rel=1e-6)
+        pflat = np.concatenate([np.asarray(l).ravel()
+                                for l in jax.tree.leaves(new)])
+        assert d["param_norm"] == pytest.approx(np.linalg.norm(pflat), rel=1e-6)
+        assert d["update_norm"] == pytest.approx(0.5)
+        assert d["update_ratio"] == pytest.approx(
+            0.5 / d["param_norm"], rel=1e-5)
+        # per-group buckets match per-group norms
+        assert set(names) == {"embed", "layers", "head"}
+        assert d["bucket_norms"]["embed"] == pytest.approx(
+            np.linalg.norm([1, -2, 3]), rel=1e-6)
+        assert d["bucket_norms"]["layers"] == pytest.approx(
+            np.linalg.norm([0.5, -0.5, 1.5, 2.5]), rel=1e-6)
+        assert d["bucket_norms"]["head"] == pytest.approx(4.0, rel=1e-6)
+
+    def test_nonfinite_counts(self):
+        grads, new = self._trees()
+        grads["embed"] = jnp.asarray([np.nan, np.inf, 3.0])
+        new["head"] = jnp.asarray([np.nan])
+        assignment, names = make_bucket_assignment(grads, 8)
+        d = sentinel_to_dict(
+            compute_sentinels(grads, new, 0.0, None, assignment, names), names)
+        assert d["nonfinite_grads"] == 2
+        assert d["nonfinite_params"] == 1
+
+    def test_grad_norm_passthrough_not_recomputed(self):
+        grads, new = self._trees()
+        assignment, names = make_bucket_assignment(grads, 8)
+        vec = compute_sentinels(grads, new, 0.0, jnp.asarray(123.0),
+                                assignment, names)
+        assert sentinel_to_dict(vec, names)["grad_norm"] == 123.0
+
+    def test_bucket_cap_merges_into_other(self):
+        tree = {f"g{i}": jnp.ones((2,)) for i in range(6)}
+        assignment, names = make_bucket_assignment(tree, 4)
+        assert len(names) == 4 and names[-1] == "other"
+        assert max(assignment) == 3
+        assert assignment[:3] == (0, 1, 2)  # first groups keep their bucket
+        assert assignment[3:] == (3, 3, 3)  # tail collapses
+
+
+# --------------------------------------------------------------------- #
+# anomaly detectors on synthetic step streams
+
+
+def hcfg(**over):
+    base = dict(enabled=True, action="record", window=50, warmup_steps=5,
+                loss_ewma_alpha=0.1)
+    base.update(over)
+    return HealthConfig(**base)
+
+
+def rec(step, loss=1.0, gn=1.0, **kw):
+    return StepHealth(step=step, loss=loss, grad_norm=gn, step_time_s=0.1,
+                      wait_time_s=0.001, **kw)
+
+
+class TestDetectors:
+
+    def test_loss_spike_fires_and_steady_noise_does_not(self):
+        mon = HealthMonitor(hcfg(), registry=MetricsRegistry())
+        rng = np.random.default_rng(0)
+        for i in range(30):
+            fired = mon.observe_step(rec(i, loss=1.0 + 0.01 * rng.standard_normal()))
+            assert fired == []
+        assert "loss_spike" in mon.observe_step(rec(30, loss=10.0))
+        assert mon.report()["anomalies"]["loss_spike"] == 1
+
+    def test_grad_explosion(self):
+        mon = HealthMonitor(hcfg(grad_norm_factor=10.0),
+                            registry=MetricsRegistry())
+        for i in range(20):
+            assert mon.observe_step(rec(i, gn=1.0)) == []
+        assert "grad_explosion" in mon.observe_step(rec(20, gn=150.0))
+
+    def test_plateau_fires_only_without_improvement(self):
+        mon = HealthMonitor(hcfg(plateau_steps=5), registry=MetricsRegistry())
+        for i in range(16):
+            mon.observe_step(rec(i, loss=2.0))
+        assert mon.report()["anomalies"]["plateau"] >= 2
+        mon2 = HealthMonitor(hcfg(plateau_steps=5), registry=MetricsRegistry())
+        for i in range(16):
+            mon2.observe_step(rec(i, loss=2.0 - 0.1 * i))
+        assert mon2.report()["anomalies"]["plateau"] == 0
+
+    def test_sustained_overflow_vs_sporadic(self):
+        mon = HealthMonitor(hcfg(overflow_window=3), registry=MetricsRegistry())
+        for i in range(6):
+            mon.observe_step(rec(i, loss=float("nan"), gn=float("nan"),
+                                 skipped=True))
+        assert mon.report()["anomalies"]["overflow"] == 2   # at 3 and 6
+        # fp16 skips are NOT double-counted as nonfinite anomalies
+        assert mon.report()["anomalies"]["nonfinite"] == 0
+        mon2 = HealthMonitor(hcfg(overflow_window=3), registry=MetricsRegistry())
+        for i in range(12):
+            mon2.observe_step(rec(i, skipped=(i % 2 == 0)))
+        assert mon2.report()["anomalies"]["overflow"] == 0
+
+    def test_data_stall(self):
+        mon = HealthMonitor(hcfg(data_stall_steps=4, data_stall_fraction=0.5),
+                            registry=MetricsRegistry())
+        for i in range(4):
+            fired = mon.observe_step(StepHealth(step=i, loss=1.0, grad_norm=1.0,
+                                                step_time_s=0.1, wait_time_s=0.9))
+        assert "data_stall" in fired
+        assert mon.report()["data_stall_fraction"] == pytest.approx(0.9)
+        mon2 = HealthMonitor(hcfg(data_stall_steps=4), registry=MetricsRegistry())
+        for i in range(12):
+            assert mon2.observe_step(rec(i)) == []
+
+    def test_unknown_grad_norm_is_not_an_anomaly(self):
+        # grad_norm=None means "not measured" (e.g. the 1-bit optimizer
+        # path) — it must not read as a non-finite norm
+        mon = HealthMonitor(hcfg(), registry=MetricsRegistry())
+        for i in range(10):
+            assert mon.observe_step(StepHealth(step=i, loss=2.0)) == []
+        assert mon.report()["anomalies"]["nonfinite"] == 0
+        # a MEASURED non-finite norm still fires
+        assert "nonfinite" in mon.observe_step(
+            StepHealth(step=10, loss=2.0, grad_norm=float("inf")))
+
+    def test_nonfinite_immediate_and_counter(self):
+        reg = MetricsRegistry()
+        mon = HealthMonitor(hcfg(), registry=reg)
+        assert "nonfinite" in mon.observe_step(rec(0, nonfinite_grads=7))
+        assert reg.snapshot()["counters"][
+            'health/anomalies{type="nonfinite"}'] == 1
+        # pre-created zero children for every other detector
+        assert reg.snapshot()["counters"][
+            'health/anomalies{type="loss_spike"}'] == 0
+
+    def test_warn_action_is_rate_limited_and_record_is_silent(self, monkeypatch):
+        from deepspeed_tpu.monitor import health as health_mod
+        warnings = []
+        monkeypatch.setattr(health_mod.logger, "warning",
+                            lambda msg, *a, **k: warnings.append(str(msg)))
+        mon = HealthMonitor(hcfg(action="warn", window=10, overflow_window=1),
+                            registry=MetricsRegistry())
+        for i in range(25):
+            mon.observe_step(rec(i, skipped=True, loss=float("nan"),
+                                 gn=float("nan")))
+        assert mon.report()["anomalies"]["overflow"] == 25
+        assert 1 <= len(warnings) <= 4          # ~one per 10-step window
+        warnings.clear()
+        mon2 = HealthMonitor(hcfg(action="record", overflow_window=1),
+                             registry=MetricsRegistry())
+        for i in range(25):
+            mon2.observe_step(rec(i, skipped=True))
+        assert warnings == []
+
+    def test_invalid_action_raises(self):
+        with pytest.raises(ValueError, match="action"):
+            HealthMonitor(hcfg(action="explode"), registry=MetricsRegistry())
+
+
+# --------------------------------------------------------------------- #
+# debug bundles
+
+
+class TestDebugBundle:
+
+    def test_dump_contents_and_limit(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("train/steps").inc(3)
+        mon = HealthMonitor(
+            hcfg(action="dump", window=1, dump_dir=str(tmp_path),
+                 dump_limit=2, keep_last_steps=5, overflow_window=1),
+            registry=reg, bucket_names=("embed", "layers"),
+            snapshot_fn=reg.snapshot)
+        for i in range(8):
+            mon.observe_step(rec(i, skipped=True))
+        bundles = sorted(p for p in tmp_path.iterdir() if p.is_dir())
+        assert len(bundles) == 2                       # dump_limit respected
+        b = bundles[0]
+        report = json.load(open(b / "report.json"))
+        assert report["fired"] == ["overflow"]
+        assert report["record"]["skipped"] is True
+        assert report["bucket_names"] == ["embed", "layers"]
+        assert report["config"]["dump_limit"] == 2
+        steps = [json.loads(l) for l in open(b / "steps.jsonl")]
+        assert 1 <= len(steps) <= 5
+        assert all("loss" in s and "grad_norm" in s for s in steps)
+        tel = json.load(open(b / "telemetry.json"))
+        assert tel["counters"]["train/steps"] == 3
+
+
+# --------------------------------------------------------------------- #
+# memory telemetry
+
+
+class TestMemoryTelemetry:
+
+    def test_sample_memory_gauges_host_rss_and_report(self):
+        reg = MetricsRegistry()
+        report = sample_memory_gauges(reg)
+        assert report["host_rss_bytes"] > 0
+        snap = reg.snapshot()
+        assert snap["gauges"]["mem/host_rss_bytes"] > 0
+        # device gauges appear exactly for devices exposing stats
+        assert isinstance(report["devices"], dict)
+        for name, st in report["devices"].items():
+            key = f'mem/hbm_bytes_in_use{{device="{name}"}}'
+            assert (key in snap["gauges"]) == bool(st)
+
+    def test_accelerator_memory_report_shape(self):
+        from deepspeed_tpu.accelerator import get_accelerator
+        acc = get_accelerator()
+        rep = acc.memory_report()
+        assert len(rep) == acc.local_device_count()
+        for st in rep.values():
+            assert st == {} or {"bytes_in_use", "peak_bytes_in_use",
+                                "bytes_limit", "headroom_bytes"} <= set(st)
+
+
+# --------------------------------------------------------------------- #
+# config parsing
+
+
+class TestHealthConfig:
+
+    def test_defaults_off_and_bool_shorthand(self):
+        assert get_telemetry_config({}).health.enabled is False
+        cfg = get_telemetry_config({"telemetry": {"health": True}})
+        assert cfg.health.enabled is True
+        assert cfg.enabled is True            # health implies telemetry
+        # null = defaults, like the parent telemetry section
+        assert get_telemetry_config(
+            {"telemetry": {"health": None}}).health.enabled is False
+        # "on"/"off" shorthand, like the parent section
+        assert get_telemetry_config(
+            {"telemetry": {"health": "on"}}).health.enabled is True
+        assert get_telemetry_config(
+            {"telemetry": {"health": "off"}}).health.enabled is False
+        with pytest.raises(ValueError, match="health"):
+            get_telemetry_config({"telemetry": {"health": "sometimes"}})
+
+    def test_explicit_telemetry_off_wins(self):
+        cfg = get_telemetry_config(
+            {"telemetry": {"enabled": False, "health": {"enabled": True}}})
+        assert cfg.enabled is False
+
+    def test_threshold_passthrough(self):
+        cfg = get_telemetry_config(
+            {"telemetry": {"health": {"enabled": True, "window": 7,
+                                      "action": "dump", "sentinels": False}}})
+        assert cfg.health.window == 7
+        assert cfg.health.action == "dump"
+        assert cfg.health.sentinels is False
+
+
+# --------------------------------------------------------------------- #
+# serving KV pool gauges
+
+
+class TestServingKvGauges:
+
+    def test_free_and_fragmentation_gauges(self):
+        from deepspeed_tpu.inference.block_allocator import BlockAllocator
+        from deepspeed_tpu.inference.scheduler import (
+            ContinuousBatchingScheduler, ServingTelemetry)
+        reg = MetricsRegistry()
+        sched = ContinuousBatchingScheduler(
+            BlockAllocator(9, 8), 2, 8, telemetry=ServingTelemetry(reg))
+        sched.add_request(np.arange(5, dtype=np.int32), max_new=3)
+        fr = []
+        tok = 0
+        while True:
+            action = sched.next_action()
+            g = reg.snapshot()["gauges"]
+            assert g["serving/kv_blocks_free"] + g["serving/kv_blocks_used"] == 8
+            assert 0.0 <= g["serving/kv_fragmentation"] <= 1.0
+            fr.append(g["serving/kv_fragmentation"])
+            if action is None:
+                break
+            kind, payload = action
+            if kind == "prefill":
+                sched.record_prefill(payload, tok)
+            else:
+                for r in list(payload):
+                    sched.record_decode(r, tok)
+            tok += 1
+        g = reg.snapshot()["gauges"]
+        assert g["serving/kv_blocks_free"] == 8      # all returned
+        assert g["serving/kv_fragmentation"] == 0.0
+        # mid-run: one block held 5-7 cached tokens of 8 slots
+        assert max(fr) > 0.0
+
+
+# --------------------------------------------------------------------- #
+# `dscli health` renderer + CLI
+
+
+def write_fixture_jsonl(reg, path, steps=(9, 10)):
+    reg.counter("train/steps").inc(10)
+    reg.gauge("train/loss").set(3.21)
+    reg.gauge("train/mfu").set(0.42)
+    reg.gauge("train/tokens_per_sec").set(12345)
+    reg.histogram("train/step_time_ms").observe(100.0)
+    reg.histogram("train/grad_norm").observe(1.5)
+    reg.gauge("train/loss_scale").set(32768)
+    reg.gauge("train/skipped_steps").set(1)
+    reg.counter("health/anomalies",
+                labelnames=("type",)).labels(type="loss_spike").inc(2)
+    reg.gauge("train/data_stall_fraction").set(0.25)
+    reg.gauge("mem/hbm_bytes_in_use",
+              labelnames=("device",)).labels(device="tpu:0").set(12e9)
+    reg.gauge("mem/hbm_bytes_limit",
+              labelnames=("device",)).labels(device="tpu:0").set(16e9)
+    reg.gauge("mem/host_rss_bytes").set(8e9)
+    reg.histogram("serving/ttft_ms").observe(12.0)
+    reg.gauge("serving/queue_depth").set(3)
+    reg.gauge("serving/kv_block_utilization").set(0.8)
+    reg.gauge("serving/kv_blocks_free").set(12)
+    for s in steps:
+        reg.write_jsonl(path, step=s)
+
+
+class TestHealthCLI:
+
+    def test_render_from_fixture_jsonl(self, tmp_path):
+        path = str(tmp_path / "tel.jsonl")
+        write_fixture_jsonl(MetricsRegistry(), path)
+        recs = read_last_snapshots(path, 2)
+        assert len(recs) == 2 and recs[-1]["step"] == 10
+        table = render_health_table(recs[-1], recs[-2])
+        for needle in ("step 10", "MFU 0.420", "loss 3.21", "grad_norm",
+                       "loss_scale 32768", "skipped 1/10",
+                       "loss_spike:2", "data-stall 25.0%",
+                       "HBM 11.2GB/14.9GB", "host RSS 7.5GB",
+                       "TTFT p50 12.0ms", "queue 3", "KV util 0.80 free 12"):
+            assert needle in table, (needle, table)
+
+    def test_cli_once_and_missing_file(self, tmp_path, capsys):
+        path = str(tmp_path / "tel.jsonl")
+        write_fixture_jsonl(MetricsRegistry(), path)
+        assert health_cli([path, "--once"]) == 0
+        assert "MFU" in capsys.readouterr().out
+        assert health_cli([str(tmp_path / "nope.jsonl"), "--once"]) == 1
+
+    def test_tail_skips_corrupt_lines(self, tmp_path):
+        path = tmp_path / "tel.jsonl"
+        path.write_text('not json\n{"step": 1, "counters": {}}\n{"broken\n'
+                        '{"step": 2, "counters": {}}\n')
+        recs = read_last_snapshots(str(path), 2)
+        assert [r["step"] for r in recs] == [1, 2]
+
+    def test_render_empty_snapshot(self):
+        out = render_health_table({"step": 0})
+        assert "no recognized series" in out
+
+
+# --------------------------------------------------------------------- #
+# engine wiring (the acceptance pins)
+
+
+class TestEngineHealth:
+
+    def test_clean_run_zero_anomalies_and_no_extra_compiles(self):
+        engine = make_engine(health={"enabled": True})
+        for _ in range(3):
+            engine.train_batch(train_batch(engine))
+        snap = engine.telemetry_snapshot()
+        validate_snapshot(snap)
+        # sentinel collection rode the SAME compiled step: exactly one
+        # watched entry point, compiled exactly once
+        assert snap["compile"]["by_fn"] == {"engine.train_batch[gas=1]": 1}
+        # clean run: every detector at an explicit zero
+        for t in HealthMonitor.DETECTORS:
+            assert snap["counters"][f'health/anomalies{{type="{t}"}}'] == 0
+        # satellite: pre-clip grad norm recorded every step, clipping off
+        assert snap["histograms"]["train/grad_norm"]["count"] == 3
+        assert snap["histograms"]["train/grad_norm"]["min"] > 0
+        assert snap["gauges"]["train/loss"] > 0
+        assert snap["gauges"]["health/grad_norm"] > 0
+        assert 0.0 <= snap["gauges"]["train/data_stall_fraction"] <= 1.0
+        assert snap["gauges"]["mem/host_rss_bytes"] > 0
+        rep = engine.health_report()
+        assert rep["enabled"] and rep["steps"] == 3
+        assert rep["anomalies"] == {t: 0 for t in HealthMonitor.DETECTORS}
+        assert rep["bucket_names"]                      # layer groups named
+        assert rep["last"]["update_ratio"] > 0
+        assert len(rep["last"]["bucket_norms"]) == len(rep["bucket_names"])
+
+    def test_forced_nonfinite_fires_warns_and_dumps(self, tmp_path,
+                                                    monkeypatch):
+        from deepspeed_tpu.monitor import health as health_mod
+        warnings = []
+        monkeypatch.setattr(health_mod.logger, "warning",
+                            lambda msg, *a, **k: warnings.append(str(msg)))
+        engine = make_engine(health={"enabled": True, "action": "dump",
+                                     "window": 2, "warmup_steps": 0,
+                                     "dump_dir": str(tmp_path)})
+        force_nonfinite_grads(engine)
+        for _ in range(3):
+            engine.train_batch(train_batch(engine))
+        snap = engine.telemetry_snapshot()
+        assert snap["counters"]['health/anomalies{type="nonfinite"}'] == 3
+        # rate-limited: window 2 suppresses the middle step's warning
+        fired_warns = [w for w in warnings
+                       if w.startswith("health: nonfinite")]
+        assert 1 <= len(fired_warns) < 3
+        bundles = sorted(p for p in tmp_path.iterdir() if p.is_dir())
+        assert bundles, "no debug bundle on disk"
+        names = {p.name for p in bundles[0].iterdir()}
+        assert {"report.json", "steps.jsonl", "telemetry.json"} <= names
+        report = json.load(open(bundles[0] / "report.json"))
+        assert "nonfinite" in report["fired"]
+        assert report["record"]["nonfinite_grads"] > 0
+        # still no extra compiles
+        assert snap["compile"]["by_fn"] == {"engine.train_batch[gas=1]": 1}
+
+    @pytest.mark.slow  # engine-level duplicates of detector/gauge pins
+    def test_fp16_skip_gauges_and_health_off_warning(self, monkeypatch):
+        from deepspeed_tpu.runtime import engine as engine_mod
+        warnings = []
+        monkeypatch.setattr(engine_mod.logger, "warning",
+                            lambda msg, *a, **k: warnings.append(str(msg)))
+        # health OFF: the engine's own rate-limited warning surfaces skips
+        engine = make_engine(health={"overflow_window": 2}, fp16=True)
+        assert engine._health is None
+        force_nonfinite_grads(engine)
+        for _ in range(4):
+            engine.train_batch(train_batch(engine))
+        snap = engine.telemetry_snapshot()
+        assert snap["gauges"]["train/skipped_steps"] == 4
+        assert snap["gauges"]["train/loss_scale"] > 0
+        assert sum("overflow skipped" in w for w in warnings) == 2  # at 2, 4
+        assert engine.skipped_steps == 4
+
+    @pytest.mark.slow  # sentinel flow through the trio path
+    def test_trio_step_records_grad_norm_and_health(self, tmp_path):
+        jsonl = str(tmp_path / "tel.jsonl")
+        engine = make_engine(health={"enabled": True},
+                             **{"telemetry": {"enabled": True,
+                                              "jsonl_path": jsonl,
+                                              "steps_per_snapshot": 1,
+                                              "health": {"enabled": True}}})
+        engine.forward(train_batch(engine))
+        engine.backward()
+        engine.step()
+        # the trio boundary flushes the sink too (not just train_batch)
+        recs = read_last_snapshots(jsonl)
+        assert recs and recs[-1]["step"] == 1
+        snap = engine.telemetry_snapshot()
+        assert snap["histograms"]["train/grad_norm"]["count"] == 1
+        rep = engine.health_report()
+        assert rep["steps"] == 1
+        # wait/busy measured on the trio path too (not hard-coded zero):
+        # one boundary -> one data-wait sample, fraction in range
+        assert snap["histograms"]["train/data_wait_ms"]["count"] == 1
+        assert 0.0 <= snap["gauges"]["train/data_stall_fraction"] <= 1.0
+        assert rep["last"]["step_time_s"] > 0
+
+    @pytest.mark.slow  # health-off engine stays inert beyond base telemetry
+    def test_health_off_no_health_series(self):
+        engine = make_engine()
+        engine.train_batch(train_batch(engine))
+        snap = engine.telemetry_snapshot()
+        assert not any(k.startswith("health/") for k in snap["counters"])
+        assert engine.health_report() == {"enabled": False}
+        # base telemetry still records the reused pre-clip norm
+        assert snap["histograms"]["train/grad_norm"]["count"] == 1
