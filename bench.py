@@ -46,6 +46,14 @@ Env knobs (defaults are the chip-measured fast path):
                            (the paged record's vs_baseline = speedup over
                            dense); BENCH_DECODE_REQS=16 BENCH_DECODE_NEW=128
                            BENCH_DECODE_BLOCK=128 BENCH_DECODE_RUNNING=8
+  BENCH_SERVE_PREFIX=1     shared-system-prompt TTFT probe: prefix caching
+                           off vs on (vs_baseline = off/on TTFT ratio);
+                           BENCH_SERVE_REQS=8 BENCH_SERVE_PREFIX_LEN=768
+                           BENCH_SERVE_NEW=16
+  BENCH_SERVE_CHUNKED=1    decode-interference probe: p99 TPOT with long
+                           prompts prefilling whole vs chunked
+                           (vs_baseline = whole/chunked p99 ratio);
+                           BENCH_SERVE_LONG_LEN=896 BENCH_SERVE_CHUNK=256
   BENCH_SKIP_PROBE=0       skip the subprocess backend probe
   BENCH_PROBE_RETRIES=1    probe retries before giving up on the backend
   BENCH_ALLOW_CPU=0        on probe failure, run a tiny CPU smoke metric
@@ -373,6 +381,8 @@ BENCH_METRICS = [
     ("BENCH_BERT", "1", "bert_large_mlm_train_tokens_per_sec_per_chip"),
     ("BENCH_DECODE_DENSE", "1", "gpt2_decode_dense_tokens_per_sec_per_chip"),
     ("BENCH_DECODE_PAGED", "1", "gpt2_decode_paged_tokens_per_sec_per_chip"),
+    ("BENCH_SERVE_PREFIX", "1", "gpt2_serving_prefix_cache_ttft_ms"),
+    ("BENCH_SERVE_CHUNKED", "1", "gpt2_serving_chunked_prefill_tpot_p99_ms"),
 ]
 
 
@@ -414,7 +424,12 @@ def run_decode_bench():
     _reset_telemetry()
     engine = deepspeed_tpu.init_inference(
         model, dtype="bf16", telemetry=True,
-        serving={"block_size": BLOCK, "max_running": RUNNING})
+        serving={"block_size": BLOCK, "max_running": RUNNING,
+                 # cache off: this metric tracks the PR-2 paged-decode
+                 # trajectory — a warm-call cache hit skipping timed prefill
+                 # would silently change what it measures (the prefix-cache
+                 # win has its own BENCH_SERVE_PREFIX probe)
+                 "prefix_caching": "off"})
     rng = np.random.default_rng(0)
     # mixed prompt lengths: the tail-convoy shape continuous batching wins on
     prompts = [rng.integers(0, 50257, size=int(n)).astype(np.int32)
@@ -467,6 +482,118 @@ def run_decode_bench():
         if tel:
             rec["telemetry"] = tel
         print(json.dumps(rec), flush=True)
+
+
+def _serve_hist(engine, name, key):
+    """One serving-histogram stat from the engine's telemetry snapshot."""
+    h = engine.telemetry_snapshot().get("histograms", {}).get(name, {})
+    return float(h.get(key, 0.0))
+
+
+def run_prefix_cache_bench():
+    """Shared-system-prompt serving probe: NREQ requests whose prompts all
+    start with the same long prefix, prefix caching OFF vs ON. The ON
+    record's value is its p50 TTFT and vs_baseline the OFF/ON TTFT ratio
+    (>1 = caching cut time-to-first-token): request 1 prefills the shared
+    blocks, every later admission hits them with zero prefill compute."""
+    import numpy as np
+
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models import gpt2
+
+    dist.set_mesh(None)
+    NREQ = int(os.environ.get("BENCH_SERVE_REQS", 8))
+    SYS = int(os.environ.get("BENCH_SERVE_PREFIX_LEN", 768))
+    TAIL, MAX_NEW = 32, int(os.environ.get("BENCH_SERVE_NEW", 16))
+    model = gpt2("125m", remat=False,
+                 attention_backend=os.environ.get("BENCH_ATTN", "auto"))
+    rng = np.random.default_rng(0)
+    system = rng.integers(0, 50257, size=SYS).astype(np.int32)
+    prompts = [np.concatenate([system, rng.integers(0, 50257, size=TAIL)
+                               .astype(np.int32)]) for _ in range(NREQ)]
+
+    results = {}
+    for mode in ("off", "auto"):
+        _reset_telemetry()
+        engine = deepspeed_tpu.init_inference(
+            model, dtype="bf16", telemetry=True,
+            serving={"block_size": 128, "max_running": 8,
+                     "prefix_caching": mode})
+        engine.generate_batch(prompts, max_new_tokens=MAX_NEW)   # warm:
+        # compiles, and (ON mode) the steady-state populated cache
+        _reset_telemetry()
+        engine.generate_batch(prompts, max_new_tokens=MAX_NEW)
+        results[mode] = _serve_hist(engine, "serving/ttft_ms", "p50")
+        if mode == "auto":
+            rec = {
+                "metric": _metric_name("BENCH_SERVE_PREFIX"),
+                "value": round(results["auto"], 2),
+                "unit": f"p50 TTFT ms (bf16, {NREQ} reqs sharing a {SYS}-tok "
+                        f"prefix +{TAIL} tail, prefix cache on; off = "
+                        f"{results['off']:.1f} ms)",
+                # >1 = prefix caching sped TTFT up by this factor
+                "vs_baseline": (round(results["off"] / results["auto"], 3)
+                                if results["auto"] else 0.0),
+            }
+            tel = _telemetry_blob(engine)
+            if tel:
+                rec["telemetry"] = tel
+            print(json.dumps(rec), flush=True)
+
+
+def run_chunked_prefill_bench():
+    """Decode-throughput interference probe: short requests decode while
+    long prompts keep arriving and prefilling. Whole-prompt prefill stalls
+    every running decode for the full prompt (TPOT tail spike); chunked
+    prefill interleaves one chunk per decode step. Value = p99 TPOT with
+    chunking ON, vs_baseline = OFF/ON p99 ratio (>1 = chunking cut the
+    decode stall)."""
+    import numpy as np
+
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models import gpt2
+
+    dist.set_mesh(None)
+    LONG = int(os.environ.get("BENCH_SERVE_LONG_LEN", 896))
+    CHUNK = int(os.environ.get("BENCH_SERVE_CHUNK", 256))
+    MAX_NEW = int(os.environ.get("BENCH_SERVE_NEW", 16))
+    model = gpt2("125m", remat=False,
+                 attention_backend=os.environ.get("BENCH_ATTN", "auto"))
+    rng = np.random.default_rng(0)
+    # FIFO admission: the short prompts admit first and decode while each
+    # long prompt prefills into a freed slot mid-run
+    prompts = [rng.integers(0, 50257, size=64).astype(np.int32)
+               for _ in range(3)]
+    prompts += [rng.integers(0, 50257, size=LONG).astype(np.int32)
+                for _ in range(4)]
+
+    results = {}
+    for chunk in (0, CHUNK):
+        _reset_telemetry()
+        engine = deepspeed_tpu.init_inference(
+            model, dtype="bf16", telemetry=True,
+            serving={"block_size": 128, "max_running": 4,
+                     "prefix_caching": "off", "prefill_chunk_tokens": chunk})
+        engine.generate_batch(prompts, max_new_tokens=MAX_NEW)   # warm
+        _reset_telemetry()
+        engine.generate_batch(prompts, max_new_tokens=MAX_NEW)
+        results[chunk] = _serve_hist(engine, "serving/tpot_ms", "p99")
+        if chunk:
+            rec = {
+                "metric": _metric_name("BENCH_SERVE_CHUNKED"),
+                "value": round(results[chunk], 2),
+                "unit": f"p99 TPOT ms (bf16, 3 short decodes vs 4x{LONG}-tok "
+                        f"prefills, chunk={chunk}; whole-prompt = "
+                        f"{results[0]:.1f} ms)",
+                "vs_baseline": (round(results[0] / results[chunk], 3)
+                                if results[chunk] else 0.0),
+            }
+            tel = _telemetry_blob(engine)
+            if tel:
+                rec["telemetry"] = tel
+            print(json.dumps(rec), flush=True)
 
 
 def _emit_skip_records(err: str):
@@ -599,12 +726,23 @@ def main():
                     engine, model, batch, knobs["BATCH"], knobs["SEQ"],
                     STEPS, "MLM, ZeRO-2")
 
-    if _metric_enabled("BENCH_DECODE_DENSE") or _metric_enabled("BENCH_DECODE_PAGED"):
+    if any(_metric_enabled(g) for g in
+           ("BENCH_DECODE_DENSE", "BENCH_DECODE_PAGED",
+            "BENCH_SERVE_PREFIX", "BENCH_SERVE_CHUNKED")):
+        # free the last training engine's device state before serving
         if engine is not None:
             del engine, model, batch
         import gc
         gc.collect()
-        run_decode_bench()
+        if _metric_enabled("BENCH_DECODE_DENSE") \
+                or _metric_enabled("BENCH_DECODE_PAGED"):
+            run_decode_bench()
+            gc.collect()
+        if _metric_enabled("BENCH_SERVE_PREFIX"):
+            run_prefix_cache_bench()
+            gc.collect()
+        if _metric_enabled("BENCH_SERVE_CHUNKED"):
+            run_chunked_prefill_bench()
 
 
 if __name__ == "__main__":

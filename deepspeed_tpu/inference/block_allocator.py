@@ -4,13 +4,36 @@ The host-side twin of the device pools built by
 ``models/transformer.py::init_paged_kv_cache``: the pools are
 ``[n_layer, num_blocks, block_size, KV, Hd]`` arrays, and this allocator
 hands out pool block ids to requests and reclaims them when requests retire
-or are preempted. The analogue of vLLM's ``BlockAllocator`` — no
-reference-counted copy-on-write here (no beam search / prefix sharing yet),
-so a block belongs to exactly one request.
+or are preempted. The analogue of vLLM's ``BlockAllocator``, including its
+automatic prefix caching: blocks are REFERENCE-COUNTED, and with
+``prefix_cache=True`` every FULL block is content-addressed by a rolling
+hash chain ``key_j = H(key_{j-1}, tokens_j)`` so a new request whose prompt
+starts with an already-cached token prefix reuses those blocks with a
+ref-count bump — zero prefill compute for the shared part.
+
+Lifecycle of a block (prefix_cache on)::
+
+    free list --allocate--> ref>=1 --free to ref 0--+--> registered? cold LRU
+        ^                      ^                    |        |        |
+        |                      +----- acquire ------+--------+   reclaimed
+        +------------------------- (unregistered) ----- under pressure
+
+- ``allocate`` pops the FIFO free list first; when it runs dry it reclaims
+  from the COLD list oldest-first (LRU), un-registering the reclaimed
+  block's hash entry. All-or-nothing, deterministic.
+- ``free`` drops one reference; at zero the block parks on the cold list
+  (content intact, future prefix hits resurrect it via ``acquire``) if it
+  was registered, else returns to the free list.
+- Partial trailing blocks are never registered, so they are never shared;
+  a request that would start writing inside a shared block must
+  copy-on-write it first (the scheduler's COW split — see
+  ``scheduler.py``).
 
 Determinism: the free list is FIFO (freed blocks go to the back, allocation
-pops from the front, initial order ascending), so identical request streams
-produce identical block placements — the scheduler tests pin this.
+pops from the front, initial order ascending), the cold list is reclaimed
+strictly LRU, and hash-table registration is first-writer-wins — identical
+request streams produce identical block placements (the scheduler tests
+pin this).
 
 Block 0 is RESERVED as the dummy block: prompt-bucket padding slots and
 inactive decode rows scatter their junk k/v there, and nothing ever reads
@@ -21,17 +44,27 @@ block.
 
 from __future__ import annotations
 
-from collections import deque
-from typing import List, Optional
+import hashlib
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
 
 DUMMY_BLOCK = 0
 
+# root of every hash chain (the "parent" of a sequence's first block)
+ROOT_KEY = b""
+
 
 class BlockAllocator:
-    """FIFO free-list allocator over ``num_blocks`` pool blocks of
-    ``block_size`` tokens; block 0 (``DUMMY_BLOCK``) is never handed out."""
+    """Reference-counted FIFO allocator over ``num_blocks`` pool blocks of
+    ``block_size`` tokens; block 0 (``DUMMY_BLOCK``) is never handed out.
+    With ``prefix_cache=True``, full blocks are content-addressed and
+    freed-but-cached blocks are kept COLD for reuse until allocation
+    pressure reclaims them LRU-first."""
 
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int,
+                 prefix_cache: bool = False):
         if num_blocks < 2:
             raise ValueError(f"num_blocks={num_blocks}: need at least one "
                              "allocatable block besides the reserved dummy")
@@ -39,36 +72,156 @@ class BlockAllocator:
             raise ValueError(f"block_size={block_size} must be positive")
         self.num_blocks = num_blocks
         self.block_size = block_size
+        self.prefix_cache = prefix_cache
         self._free = deque(range(1, num_blocks))
-        # companion set: O(1) double-free detection (the deque alone would
-        # make every retirement O(blocks_freed × num_free))
+        # companion set: O(1) membership for the free-list invariant checks
         self._free_set = set(self._free)
+        self._ref: Dict[int, int] = {}          # block -> live references
+        self._num_used = 0                       # blocks with ref > 0
+        # content-addressed cache state (only populated when prefix_cache)
+        self._cold: "OrderedDict[int, bytes]" = OrderedDict()  # LRU: old first
+        self._table: Dict[bytes, int] = {}       # chain key -> block id
+        self._key_of: Dict[int, bytes] = {}      # registered block -> its key
+
+    # ------------------------------------------------------------------ #
+    # capacity accounting
 
     @property
     def num_free(self) -> int:
-        return len(self._free)
+        """Blocks allocatable right now (free list + reclaimable cold)."""
+        return self.num_blocks - 1 - self._num_used
+
+    @property
+    def num_used(self) -> int:
+        """Blocks referenced by at least one live request."""
+        return self._num_used
+
+    @property
+    def num_cold(self) -> int:
+        """Freed-but-cached blocks (content retained for prefix hits)."""
+        return len(self._cold)
+
+    @property
+    def capacity(self) -> int:
+        """Total allocatable blocks (everything but the reserved dummy)."""
+        return self.num_blocks - 1
 
     def blocks_for_tokens(self, num_tokens: int) -> int:
         """Blocks needed to hold ``num_tokens`` cached tokens."""
         return -(-max(num_tokens, 0) // self.block_size)
 
+    def ref_count(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
+    def leak_report(self) -> Dict[int, int]:
+        """Blocks still referenced — empty once every request retired
+        (the test-suite teardown assertion; cold blocks are NOT leaks)."""
+        return {b: r for b, r in self._ref.items() if r > 0}
+
+    # ------------------------------------------------------------------ #
+    # allocate / free / acquire
+
     def allocate(self, n: int) -> Optional[List[int]]:
-        """Pop ``n`` blocks from the free list, or None (all-or-nothing)
-        when fewer than ``n`` are free."""
-        if n > len(self._free):
+        """Pop ``n`` blocks (ref-count 1 each), or None (all-or-nothing)
+        when fewer than ``n`` are available. The FIFO free list is drained
+        first; under pressure the cold list is reclaimed LRU-first, each
+        reclaimed block losing its cache registration."""
+        if n > len(self._free) + len(self._cold):
             return None
-        got = [self._free.popleft() for _ in range(n)]
-        self._free_set.difference_update(got)
+        got: List[int] = []
+        for _ in range(n):
+            if self._free:
+                b = self._free.popleft()
+                self._free_set.discard(b)
+            else:
+                b, key = self._cold.popitem(last=False)   # LRU eviction
+                del self._table[key]
+                del self._key_of[b]
+            self._ref[b] = 1
+            self._num_used += 1
+            got.append(b)
         return got
 
     def free(self, blocks: List[int]) -> None:
-        """Return blocks to the back of the free list."""
+        """Drop one reference per block; zero-ref registered blocks park on
+        the cold list (MRU end), unregistered ones rejoin the free list."""
         for b in blocks:
             if b == DUMMY_BLOCK:
                 raise ValueError("attempted to free the reserved dummy block")
             if not (0 < b < self.num_blocks):
                 raise ValueError(f"block id {b} out of range")
-            if b in self._free_set:
+            r = self._ref.get(b, 0)
+            if r <= 0:
                 raise ValueError(f"double free of block {b}")
-            self._free.append(b)
-            self._free_set.add(b)
+            r -= 1
+            self._ref[b] = r
+            if r == 0:
+                self._num_used -= 1
+                key = self._key_of.get(b)
+                if key is not None:
+                    self._cold[b] = key           # most-recently-used end
+                else:
+                    self._free.append(b)
+                    self._free_set.add(b)
+
+    def acquire(self, blocks: List[int]) -> None:
+        """Bump the reference count of already-placed blocks (a prefix-cache
+        hit). Cold blocks are resurrected (removed from the LRU list)."""
+        for b in blocks:
+            r = self._ref.get(b, 0)
+            if r == 0:
+                if b not in self._cold:
+                    raise ValueError(
+                        f"acquire of block {b} which is neither live nor "
+                        "cold (stale prefix-cache hit?)")
+                del self._cold[b]
+                self._num_used += 1
+            self._ref[b] = r + 1
+
+    # ------------------------------------------------------------------ #
+    # content-addressed prefix cache
+
+    @staticmethod
+    def chain_key(parent: bytes, tokens) -> bytes:
+        """Rolling hash of (parent-block key, this block's tokens): the
+        content address of a full block. blake2b-128 over exact bytes —
+        deterministic across processes, collision odds negligible."""
+        h = hashlib.blake2b(digest_size=16)
+        h.update(parent)
+        h.update(np.ascontiguousarray(tokens, dtype=np.int32).tobytes())
+        return h.digest()
+
+    def match_prefix(self, tokens) -> Tuple[List[int], List[bytes]]:
+        """Longest chain of cached FULL blocks matching the front of
+        ``tokens``. Read-only (no ref-count changes — callers ``acquire``
+        the hit only once the rest of the admission succeeds). Returns
+        ([block ids], [chain keys])."""
+        if not self.prefix_cache:
+            return [], []
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        blocks: List[int] = []
+        keys: List[bytes] = []
+        parent = ROOT_KEY
+        for j in range(tokens.size // bs):
+            key = self.chain_key(parent, tokens[j * bs:(j + 1) * bs])
+            b = self._table.get(key)
+            if b is None:
+                break
+            blocks.append(b)
+            keys.append(key)
+            parent = key
+        return blocks, keys
+
+    def register(self, block: int, key: bytes) -> bool:
+        """Publish a FULL block under its chain key so future admissions can
+        hit it. First-writer-wins: a key already registered (two requests
+        racing the same prefix) keeps the existing mapping and this block
+        stays private. Returns True when the registration took."""
+        if not self.prefix_cache or block == DUMMY_BLOCK:
+            return False
+        if key in self._table or block in self._key_of:
+            return False
+        self._table[key] = block
+        self._key_of[block] = key
+        return True

@@ -119,6 +119,19 @@ class ServingConfig(ConfigModel):
     (zoo causal LMs with a paged forward; weight-streaming and MoE engines
     fall back), ``"on"`` requires it (loud error otherwise), ``"off"``
     serves each request through the static ``generate`` path sequentially.
+
+    ``prefix_caching`` enables vLLM-style automatic prefix caching: full
+    KV blocks are content-addressed by a rolling hash chain and shared
+    across requests (and across ``generate_batch`` calls) with ref-count
+    bumps — a request whose prompt starts with a cached prefix skips that
+    prefill compute entirely. ``auto`` = on wherever the paged path is
+    active; ``off`` restores the one-owner-per-block behavior.
+
+    ``prefill_chunk_tokens`` > 0 splits prefill into chunks of at most
+    that many tokens (compile buckets are 128-aligned, so keep it a
+    multiple of 128) and interleaves one chunk with each fused decode
+    step — running decodes keep making progress instead of stalling for a
+    whole long prompt. 0 = whole-prompt prefill (the default).
     """
     block_size: int = 128          # tokens per KV block (128 = kernel path;
     # smaller blocks pack tighter but decode through the gather fallback)
@@ -126,6 +139,8 @@ class ServingConfig(ConfigModel):
     # max_running requests can reach the model's max_seq (no eviction)
     max_running: int = 8           # fused-decode width / running request cap
     paged: str = "auto"            # auto | on | off
+    prefix_caching: str = "auto"   # auto | on | off (auto = on when paged)
+    prefill_chunk_tokens: int = 0  # 0 = whole-prompt; else chunk size
 
 
 class InferenceCheckpointConfig(ConfigModel):

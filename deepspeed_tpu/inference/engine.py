@@ -317,6 +317,7 @@ class InferenceEngine:
         self._decode_jit = None
         self._stream_jits = None
         self._paged_jits = None
+        self._paged_alloc = None   # persistent prefix-cache allocator
 
         # ---- telemetry (serving stats + compile watchdog) ----
         tcfg = getattr(self._config, "telemetry", None)
@@ -816,14 +817,17 @@ class InferenceEngine:
     def _paged_pools(self, num_blocks: int, block_size: int):
         """Persistent paged-pool workspace: same lifecycle contract as
         :meth:`_kv_workspace` (reuse is safe — every slot a request reads
-        was written by that request in the current call)."""
+        was written by that request, or by the request that REGISTERED the
+        block in the prefix cache). Returns ``(pools, reused)`` — a fresh
+        workspace has no valid cached content, so the caller must drop any
+        persisted prefix-cache state alongside it."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         pw = getattr(self, "_paged_workspace", None)
         if pw is not None and pw[0] == num_blocks and pw[1] == block_size:
             leaves = jax.tree.leaves(pw[2])
             if not any(getattr(a, "is_deleted", lambda: False)() for a in leaves):
-                return pw[2]
+                return pw[2], True
         pools = self.module.init_paged_cache(num_blocks, block_size,
                                              dtype=self.dtype)
         kv_spec = (P(None, None, None, "tp", None)
@@ -831,11 +835,45 @@ class InferenceEngine:
         pools = jax.tree.map(
             lambda a: jax.device_put(a, NamedSharding(self.mesh, kv_spec)), pools)
         self._paged_workspace = (num_blocks, block_size, pools)
-        return pools
+        return pools, False
+
+    def _paged_allocator(self, num_blocks: int, block_size: int,
+                         caching: bool, pools_reused: bool):
+        """Block allocator for one serve call. With prefix caching the
+        allocator PERSISTS across ``generate_batch`` calls — its
+        content-addressed table describes the persistent pool workspace, so
+        later calls hit earlier calls' prefixes — as long as the workspace
+        itself was reused, geometry matches, and every request of the
+        previous call retired cleanly (no leaked references). A cache-off
+        call writes blocks the persisted table still describes, so it also
+        invalidates the persisted allocator."""
+        from deepspeed_tpu.inference.block_allocator import BlockAllocator
+
+        if not caching:
+            self._paged_alloc = None
+            return BlockAllocator(num_blocks, block_size)
+        pa = self._paged_alloc
+        if (pools_reused and pa is not None
+                and pa.num_blocks == num_blocks
+                and pa.block_size == block_size
+                and not pa.leak_report()):
+            return pa
+        alloc = BlockAllocator(num_blocks, block_size, prefix_cache=True)
+        self._paged_alloc = alloc
+        return alloc
 
     def _ensure_paged_jits(self):
         if self._paged_jits is None:
+            from deepspeed_tpu.models.transformer import copy_paged_block
             mod = self.module
+            chunk = None
+            if hasattr(mod, "forward_paged_prefill_chunk"):
+                chunk = self._watched(
+                    jax.jit(lambda p, t, pools, bt, slots, sp, li:
+                            mod.forward_paged_prefill_chunk(
+                                p, t, pools, bt, slots, sp, li),
+                            donate_argnums=(2,)),
+                    "inference.paged_prefill_chunk")
             self._paged_jits = (
                 self._watched(
                     jax.jit(lambda p, t, pools, slots, li:
@@ -847,8 +885,24 @@ class InferenceEngine:
                             mod.forward_paged_decode(p, t, pools, bt, pos),
                             donate_argnums=(2,)),
                     "inference.paged_decode"),
+                chunk,
+                self._watched(jax.jit(copy_paged_block, donate_argnums=(0,)),
+                              "inference.paged_cow"),
             )
         return self._paged_jits
+
+    @staticmethod
+    def _flat_slots(table, start, n_valid, width, bs):
+        """Flat pool slot per position ``start + t`` for t in [0, width):
+        the first ``n_valid`` positions write through the request's block
+        table, compile-bucket pads route their junk k/v to the dummy
+        block. The ONE place the slot layout lives — whole-prompt prefill
+        and chunked prefill must scatter identically."""
+        from deepspeed_tpu.inference.block_allocator import DUMMY_BLOCK
+        t = np.arange(width)
+        p_t = start + t                              # global positions
+        slot = table[np.minimum(p_t // bs, table.size - 1)] * bs + p_t % bs
+        return np.where(t < n_valid, slot, DUMMY_BLOCK * bs + p_t % bs)
 
     def generate_batch(self, prompts, max_new_tokens: Optional[int] = None,
                        temperature: float = 0.0, top_k: int = 0, seed: int = 0,
@@ -861,8 +915,12 @@ class InferenceEngine:
         ``config.serving`` governs the path: ``paged="auto"`` (default)
         pages whenever the model supports it, ``"on"`` requires it,
         ``"off"`` — and unsupported models under auto — falls back to the
-        static ``generate`` path per request. Greedy decoding
-        (``temperature=0``) reproduces the static path's tokens exactly.
+        static ``generate`` path per request. ``prefix_caching`` (default
+        auto = on) shares already-computed KV blocks across requests AND
+        across calls (the pool workspace persists); ``prefill_chunk_tokens``
+        interleaves prefill chunks with decode steps. Greedy decoding
+        (``temperature=0``) reproduces the static path's tokens exactly in
+        every mode.
         """
         prompts = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
         if not prompts:
@@ -892,8 +950,6 @@ class InferenceEngine:
         if max_new <= 0:
             return [jnp.asarray(p) for p in prompts]
 
-        from deepspeed_tpu.inference.block_allocator import (BlockAllocator,
-                                                             DUMMY_BLOCK)
         from deepspeed_tpu.inference.scheduler import \
             ContinuousBatchingScheduler
 
@@ -908,13 +964,36 @@ class InferenceEngine:
                     f"prompt ({p.size}) + max_new_tokens ({max_new}) exceeds "
                     f"model max_seq {cfg.max_seq}")
 
-        alloc = BlockAllocator(num_blocks, bs)
+        # prefix caching + chunked prefill both ride the chunk forward
+        pc_mode = str(srv.prefix_caching)
+        if pc_mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"serving.prefix_caching={pc_mode!r} (expected auto|on|off)")
+        chunk_tokens = int(srv.prefill_chunk_tokens)
+        if chunk_tokens < 0:
+            raise ValueError("serving.prefill_chunk_tokens must be >= 0")
+        chunk_ok = hasattr(self.module, "forward_paged_prefill_chunk")
+        if not chunk_ok:
+            if pc_mode == "on":
+                raise ValueError(
+                    "serving.prefix_caching='on' but the model has no "
+                    "forward_paged_prefill_chunk (needed to prefill the "
+                    "uncached tail against cached blocks)")
+            if chunk_tokens:
+                raise ValueError(
+                    "serving.prefill_chunk_tokens set but the model has no "
+                    "forward_paged_prefill_chunk")
+        caching = chunk_ok and pc_mode != "off"
+
+        pools, pools_reused = self._paged_pools(num_blocks, bs)
+        alloc = self._paged_allocator(num_blocks, bs, caching, pools_reused)
         sched = ContinuousBatchingScheduler(alloc, W, n_max,
-                                            telemetry=self._serving_tel)
+                                            telemetry=self._serving_tel,
+                                            prefix_caching=caching,
+                                            chunk_tokens=chunk_tokens)
         for p in prompts:
             sched.add_request(p, max_new, eos_token_id)
-        pools = self._paged_pools(num_blocks, bs)
-        prefill_jit, decode_jit = self._ensure_paged_jits()
+        prefill_jit, decode_jit, chunk_jit, cow_jit = self._ensure_paged_jits()
         rng = jax.random.key(seed)
 
         while True:
@@ -929,12 +1008,8 @@ class InferenceEngine:
                 Tb = self._bucket(L, cfg.max_seq)
                 toks = np.zeros((1, Tb), np.int32)
                 toks[0, :L] = prefix
-                # flat pool slot per prompt position; bucket pads write
-                # their junk k/v into the dummy block
-                t = np.arange(Tb)
                 table = np.asarray(req.blocks, np.int32)
-                slot = table[np.minimum(t // bs, table.size - 1)] * bs + t % bs
-                slots = np.where(t < L, slot, DUMMY_BLOCK * bs + t % bs)
+                slots = self._flat_slots(table, 0, L, Tb, bs)
                 logits, pools = prefill_jit(self.params, jnp.asarray(toks),
                                             pools,
                                             jnp.asarray(slots, jnp.int32),
@@ -943,6 +1018,46 @@ class InferenceEngine:
                 tok = self._sample_host(logits.astype(jnp.float32),
                                         temperature, top_k, sub)
                 sched.record_prefill(req, int(np.asarray(tok)[0]))
+            elif kind == "prefill_chunk":
+                req = payload
+                if req.cow_pending is not None:
+                    # copy-on-write split: the request restarts mid-block
+                    # inside a SHARED cached block — give it a private
+                    # device copy before any of its writes land
+                    src, dst = req.cow_pending
+                    pools = cow_jit(pools, jnp.int32(src), jnp.int32(dst))
+                    req.cow_pending = None
+                start = req.pos
+                remaining = req.prefill_target - start
+                step = min(chunk_tokens, remaining) if chunk_tokens \
+                    else remaining
+                Tb = self._bucket(step, cfg.max_seq)
+                prefix = req.prefix()
+                toks = np.zeros((1, Tb), np.int32)
+                toks[0, :step] = prefix[start:start + step]
+                table = np.asarray(req.blocks, np.int32)
+                slots = self._flat_slots(table, start, step, Tb, bs)
+                # the chunk attends over the gathered table, so its cost is
+                # O(table width × block_size) per layer — bucket the width
+                # to the next power of two of the request's OWN block count
+                # (≤ log2(n_max) compiles) instead of paying n_max (=
+                # max_seq worth of KV) for every short cache-hit tail
+                nb = min(n_max, 1 << max(int(table.size) - 1, 0).bit_length())
+                bt = np.zeros((1, nb), np.int32)
+                bt[0, :table.size] = table
+                logits, pools = chunk_jit(self.params, jnp.asarray(toks),
+                                          pools, jnp.asarray(bt),
+                                          jnp.asarray(slots, jnp.int32),
+                                          jnp.int32(start),
+                                          jnp.int32(step - 1))
+                if start + step == req.prefill_target:
+                    rng, sub = jax.random.split(rng)
+                    tok = self._sample_host(logits.astype(jnp.float32),
+                                            temperature, top_k, sub)
+                    sched.record_prefill_chunk(req, step,
+                                               int(np.asarray(tok)[0]))
+                else:
+                    sched.record_prefill_chunk(req, step)
             else:
                 reqs = payload
                 bt = np.zeros((W, n_max), np.int32)       # zeros → dummy
@@ -967,6 +1082,17 @@ class InferenceEngine:
             from deepspeed_tpu.monitor.health import sample_memory_gauges
             sample_memory_gauges(self._tel_reg)
         self._paged_workspace = (num_blocks, bs, pools)
+        failed = [r for r in sched.finished if r.error is not None]
+        if failed:
+            # a silently truncated generation is worse than a loud failure:
+            # this only happens when preemption grew a request's prefix past
+            # what the pool can EVER hold — the same misconfiguration
+            # add_request rejects up front, arising dynamically
+            raise RuntimeError(
+                f"{len(failed)} request(s) retired without completing "
+                "(KV pool too small for the workload — raise "
+                "serving.max_num_blocks): "
+                + "; ".join(f"request {r.rid}: {r.error}" for r in failed))
         done = sorted(sched.finished, key=lambda r: r.rid)
         return [jnp.asarray(r.output) for r in done]
 

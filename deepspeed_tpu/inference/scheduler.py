@@ -1,34 +1,62 @@
 """Continuous-batching scheduler (Orca iteration-level scheduling + vLLM
-eviction, host side).
+eviction/prefix-caching + Sarathi-style chunked prefill, host side).
 
-The engine drives one *step* at a time: :meth:`next_action` returns either
-``("prefill", request)`` — admit the FIFO queue head into freshly allocated
-blocks and run its prompt — or ``("decode", running)`` — one fused decode
-step over every running request. Finished requests retire between steps
-(their blocks return to the pool) and queued requests take their slots, so
-a convoying long request never stalls the batch the way the static
-``generate`` loop does.
+The engine drives one *step* at a time: :meth:`next_action` returns one of
+
+- ``("prefill", request)`` — admit the FIFO queue head into freshly
+  allocated blocks and run its whole prompt (the legacy path: no prefix
+  hit, chunking off);
+- ``("prefill_chunk", request)`` — run the next ``chunk_tokens`` tokens of
+  a mid-prefill request against its already-cached blocks (used for the
+  tail after a prefix-cache hit and for chunked prefill, which interleaves
+  with decode steps instead of stalling every running decode for a whole
+  long prompt);
+- ``("decode", running)`` — one fused decode step over every running
+  request that finished prefilling.
+
+Finished requests retire between steps (their blocks return to the pool)
+and queued requests take their slots, so a convoying long request never
+stalls the batch the way the static ``generate`` loop does.
 
 Request lifecycle::
 
-    QUEUED --admit(alloc prompt blocks)--> RUNNING --eos/max_new--> FINISHED
-       ^                                      |
-       +------- preempt (free ALL blocks) ----+
+    QUEUED --admit(probe cache, alloc tail)--> RUNNING[prefilling]
+       ^                                           |  chunks until pos==target
+       |                                       RUNNING --eos/max_new--> FINISHED
+       +--------- preempt (free ALL blocks) -------+
+
+**Automatic prefix caching** (``prefix_caching=True``): admission probes
+the allocator's content-addressed cache with the request's token prefix.
+Matching FULL blocks are reused with a ref-count bump (zero prefill
+compute) and only the tail is allocated + prefilled, with the request's
+``pos`` starting past the cached tokens. When the ENTIRE prefix is cached
+the hit is capped at ``target - 1`` tokens — logits for the last token
+must still be computed to sample the continuation — which lands the
+restart mid-block inside a shared block: that block is copied-on-write
+(``cow_pending``: the engine device-copies it into a private block before
+the tail chunk runs) because partial blocks are never shared. As a
+request's blocks fill — during prefill chunks AND as decode crosses block
+boundaries — they are registered back into the cache, so repeated system
+prompts, multi-turn continuations, and even a preempted request's own
+re-admission hit.
 
 Preemption is recompute-style (vLLM's default): when a running request
-needs one more KV block and the pool is dry, the LATEST-admitted running
-request is evicted — its blocks are freed and it re-queues at the FRONT
-with its prompt extended by the tokens it already generated, so its next
-admission prefills the whole prefix again (compute traded for memory;
-generated tokens are never lost, and greedy decoding reproduces the exact
-same continuation). Both the victim choice and the FIFO free list are
-deterministic — identical request streams schedule identically.
+needs one more KV block and the pool (free + reclaimable cold blocks) is
+dry, the LATEST-admitted running request is evicted — its blocks are
+dereferenced and it re-queues at the FRONT with its prompt extended by the
+tokens it already generated. With prefix caching on, its own still-cold
+blocks usually satisfy the re-admission probe, so "recompute" preemption
+costs a cache hit instead of a full re-prefill. Victim choice, the FIFO
+free list, the LRU cold list, and the prefill/decode interleave toggle are
+all deterministic — identical request streams schedule identically.
 
 Bookkeeping invariant: ``req.pos`` is the number of tokens whose k/v sit in
 the pools; the newest generated token (``req.last_token``) is NOT yet
 cached — it is the next decode step's input, written at slot ``pos`` by
 that step. Hence cached = prompt + generated[:-1], pos = len(prompt) +
-len(generated) - 1 whenever the request is running.
+len(generated) - 1 whenever the request is running (and past prefill).
+While prefilling, ``pos < prefill_target == len(prefix())`` counts the
+chunked/cache-hit progress.
 """
 
 from __future__ import annotations
@@ -40,7 +68,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from deepspeed_tpu.inference.block_allocator import BlockAllocator
+from deepspeed_tpu.inference.block_allocator import ROOT_KEY, BlockAllocator
 from deepspeed_tpu.utils.logging import logger
 
 QUEUED, RUNNING, FINISHED = "queued", "running", "finished"
@@ -56,11 +84,16 @@ class ServingTelemetry:
     the first token after the ORIGINAL arrival, even when a preemption
     forces a re-prefill later — and ``serving/preemptions`` equals the
     number of eviction events (``serving/recompute_tokens`` the prefix
-    tokens those evictions will prefill again)."""
+    tokens those evictions will prefill again). With prefix caching,
+    ``serving/prefix_cache_hit_tokens`` counts prompt tokens whose prefill
+    was SKIPPED via cache hits (hits / lookups is the admission hit rate),
+    and ``serving/cold_blocks`` gauges the freed-but-cached pool blocks."""
 
     _SERIES = ("ttft", "tpot", "queue_depth", "running", "kv_blocks_used",
                "kv_blocks_free", "kv_block_utilization", "kv_fragmentation",
-               "prefill_steps", "decode_steps",
+               "cold_blocks", "prefill_steps", "prefill_chunks",
+               "decode_steps", "prefix_cache_lookups", "prefix_cache_hits",
+               "prefix_cache_hit_tokens",
                "preemptions", "recompute_tokens", "requests", "finished",
                "generated_tokens")
 
@@ -105,12 +138,14 @@ class ServingTelemetry:
     @property
     def kv_blocks_used(self):
         return self.registry.gauge(
-            "serving/kv_blocks_used", "allocated pool blocks (excl. dummy)")
+            "serving/kv_blocks_used",
+            "pool blocks referenced by live requests (excl. dummy)")
 
     @property
     def kv_blocks_free(self):
         return self.registry.gauge(
-            "serving/kv_blocks_free", "free-list pool blocks (excl. dummy)")
+            "serving/kv_blocks_free",
+            "allocatable pool blocks: free list + reclaimable cold")
 
     @property
     def kv_block_utilization(self):
@@ -121,17 +156,50 @@ class ServingTelemetry:
     def kv_fragmentation(self):
         return self.registry.gauge(
             "serving/kv_fragmentation",
-            "internal fragmentation: unfilled slot fraction of allocated "
-            "blocks (allocated capacity minus cached tokens)")
+            "internal fragmentation: unfilled slot fraction of referenced "
+            "blocks (capacity minus cached tokens; shared blocks counted "
+            "once)")
+
+    @property
+    def cold_blocks(self):
+        return self.registry.gauge(
+            "serving/cold_blocks",
+            "freed-but-cached blocks held for prefix reuse (LRU-reclaimed "
+            "under allocation pressure)")
 
     @property
     def prefill_steps(self):
-        return self.registry.counter("serving/prefill_steps")
+        return self.registry.counter(
+            "serving/prefill_steps", "request admissions that scheduled "
+            "prefill work (one per admission, however many chunks)")
+
+    @property
+    def prefill_chunks(self):
+        return self.registry.counter(
+            "serving/prefill_chunks",
+            "chunked-prefill compute steps (incl. cache-hit tail chunks)")
 
     @property
     def decode_steps(self):
         return self.registry.counter(
             "serving/decode_steps", "fused decode steps (all rows at once)")
+
+    @property
+    def prefix_cache_lookups(self):
+        return self.registry.counter(
+            "serving/prefix_cache_lookups", "admission-time cache probes")
+
+    @property
+    def prefix_cache_hits(self):
+        return self.registry.counter(
+            "serving/prefix_cache_hits",
+            "admission probes that matched at least one full block")
+
+    @property
+    def prefix_cache_hit_tokens(self):
+        return self.registry.counter(
+            "serving/prefix_cache_hit_tokens",
+            "prompt tokens whose prefill was skipped via cache hits")
 
     @property
     def preemptions(self):
@@ -172,13 +240,21 @@ class Request:
     t_arrival: float = 0.0          # perf_counter at add_request
     t_first_token: Optional[float] = None   # TTFT stamp (set once, ever)
     t_last_token: float = 0.0       # previous token's stamp (TPOT base)
+    # ---- prefix caching / chunked prefill state ----
+    prefilling: bool = False        # admitted but pos < prefill_target
+    prefill_target: int = 0         # len(prefix()) captured at admission
+    keys: List[bytes] = dataclasses.field(default_factory=list)
+    # chain keys of this request's REGISTERED-or-matched full blocks
+    cow_pending: Optional[Tuple[int, int]] = None  # (src, dst) device copy
+    error: Optional[str] = None     # set when retired without completing
 
     def prefix(self) -> np.ndarray:
-        """The token prefix a (re)admission must prefill: the prompt plus
-        every already-generated token. Prefill caches k/v for ALL of them
-        and samples the next (new) token from the last position — so a
-        recomputed request continues exactly where it left off (greedy
-        decoding reproduces the unpreempted continuation)."""
+        """The token prefix a (re)admission must have cached before decode
+        resumes: the prompt plus every already-generated token. Prefill
+        caches k/v for ALL of them (minus any prefix-cache hit) and samples
+        the next (new) token from the last position — so a recomputed
+        request continues exactly where it left off (greedy decoding
+        reproduces the unpreempted continuation)."""
         if not self.generated:
             return self.prompt
         return np.concatenate([self.prompt,
@@ -195,17 +271,23 @@ class Request:
 
 
 class ContinuousBatchingScheduler:
-    """FIFO admission, fused decode over all running requests, retire on
+    """FIFO admission (with optional prefix-cache probe), chunked prefill
+    interleaved with fused decode over all running requests, retire on
     eos/max_new, recompute-preempt the latest-admitted request on OOM."""
 
     def __init__(self, allocator: BlockAllocator, max_running: int,
                  max_blocks_per_seq: int,
-                 telemetry: Optional[ServingTelemetry] = None):
+                 telemetry: Optional[ServingTelemetry] = None,
+                 prefix_caching: bool = False, chunk_tokens: int = 0):
         if max_running < 1:
             raise ValueError("max_running must be >= 1")
+        if chunk_tokens < 0:
+            raise ValueError("chunk_tokens must be >= 0 (0 = whole-prompt)")
         self.allocator = allocator
         self.max_running = max_running
         self.max_blocks_per_seq = max_blocks_per_seq
+        self.prefix_caching = prefix_caching and allocator.prefix_cache
+        self.chunk_tokens = chunk_tokens
         self.telemetry = telemetry
         if telemetry is not None:
             telemetry.ensure()
@@ -214,6 +296,9 @@ class ContinuousBatchingScheduler:
         self.finished: List[Request] = []
         self._admit_counter = 0
         self._next_rid = 0
+        # prefill/decode interleave: after a chunk, give decode a turn (when
+        # decodable rows exist) so one long prompt never monopolizes steps
+        self._decode_turn = False
 
     def _tel_gauges(self) -> None:
         """Refresh the occupancy gauges (queue depth, running rows, KV
@@ -221,19 +306,29 @@ class ContinuousBatchingScheduler:
         t = self.telemetry
         if t is None:
             return
+        a = self.allocator
         t.queue_depth.set(len(self.waiting))
         t.running.set(len(self.running))
-        used = self.allocator.num_blocks - 1 - self.allocator.num_free
+        used = a.num_used
         t.kv_blocks_used.set(used)
-        t.kv_blocks_free.set(self.allocator.num_free)
-        t.kv_block_utilization.set(used / max(1, self.allocator.num_blocks - 1))
+        t.kv_blocks_free.set(a.num_free)
+        t.cold_blocks.set(a.num_cold)
+        t.kv_block_utilization.set(used / max(1, a.capacity))
         # internal fragmentation: slots allocated to requests but not yet
         # holding cached k/v (last-block waste + blocks grown ahead of
-        # pos). A just-admitted request (pos still 0, prefill scheduled)
-        # counts its prefix as cached — its blocks are spoken for, not
-        # wasted, and the gauge would otherwise spike to 1.0 at admission
-        cached = sum(r.pos or len(r.prefix()) for r in self.running)
-        cap = used * self.allocator.block_size
+        # pos). Shared blocks count ONCE (dedup by block id); a mid-prefill
+        # request counts its whole target as cached — its blocks are spoken
+        # for, not wasted, and the gauge would otherwise spike at admission
+        fills = {}
+        bs = a.block_size
+        for r in self.running:
+            c = r.prefill_target if r.prefilling else r.pos
+            for j, b in enumerate(r.blocks):
+                f = min(bs, max(0, c - j * bs))
+                if f > fills.get(b, 0):
+                    fills[b] = f
+        cap = used * bs
+        cached = sum(fills.values())
         t.kv_fragmentation.set(1.0 - cached / cap if cap > 0 else 0.0)
 
     # ------------------------------------------------------------------ #
@@ -250,6 +345,16 @@ class ContinuousBatchingScheduler:
                 f"request needs {total} KV slots but the block table holds "
                 f"{cap} ({self.max_blocks_per_seq} blocks of "
                 f"{self.allocator.block_size})")
+        # admission livelock guard: a prompt that needs more blocks than the
+        # pool can EVER supply would sit at the FIFO head forever, starving
+        # everything queued behind it — reject it up front instead
+        need = self.allocator.blocks_for_tokens(prompt.size)
+        if need > self.allocator.capacity:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens needs {need} KV blocks but "
+                f"the pool only has {self.allocator.capacity} allocatable "
+                f"blocks in total — it can never be admitted; raise "
+                "serving.max_num_blocks or shorten the prompt")
         req = Request(rid=self._next_rid, prompt=prompt, max_new=max_new,
                       eos=eos, t_arrival=time.perf_counter())
         self._next_rid += 1
@@ -263,39 +368,139 @@ class ContinuousBatchingScheduler:
         return not self.waiting and not self.running
 
     # ------------------------------------------------------------------ #
+    # admission
 
-    def next_action(self) -> Optional[Tuple[str, object]]:
-        """Pick the next engine step: admit+prefill the queue head when a
-        slot and its prompt blocks are available (admission has priority —
-        back-fill freed slots immediately), else one fused decode step over
-        the running set. None when everything is finished."""
-        if self.waiting and len(self.running) < self.max_running:
-            req = self.waiting[0]
-            need = self.allocator.blocks_for_tokens(len(req.prefix()))
-            blocks = self.allocator.allocate(need)
-            if blocks is not None:
-                self.waiting.popleft()
-                req.blocks = blocks
-                req.state = RUNNING
-                req.admit_seq = self._admit_counter
-                self._admit_counter += 1
-                self.running.append(req)
-                if self.telemetry is not None:
-                    self.telemetry.prefill_steps.inc()
-                    self._tel_gauges()
-                return ("prefill", req)
+    def _try_admit(self) -> Optional[Tuple[str, Request]]:
+        """Admit the FIFO queue head when a slot and its (tail) blocks are
+        available: probe the prefix cache, acquire the hit, allocate only
+        the rest, and start the request's ``pos`` past the cached tokens.
+        Returns the prefill action, or None when nothing was admitted."""
+        if not self.waiting or len(self.running) >= self.max_running:
+            return None
+        req = self.waiting[0]
+        prefix = req.prefix()
+        target = int(prefix.size)
+        bs = self.allocator.block_size
+        need_total = self.allocator.blocks_for_tokens(target)
+        if need_total > self.allocator.capacity:
+            # prompt fit at add_request but preemption-appended generated
+            # tokens grew the prefix past the whole pool: retire with an
+            # error instead of wedging the FIFO head forever
+            self.waiting.popleft()
+            req.state = FINISHED
+            req.error = (
+                f"prefix of {target} tokens (prompt + {len(req.generated)} "
+                f"generated) needs {need_total} KV blocks but the pool has "
+                f"{self.allocator.capacity}; raise serving.max_num_blocks")
+            logger.warning(f"request {req.rid} retired: {req.error}")
+            self.finished.append(req)
+            if self.telemetry is not None:
+                self.telemetry.finished.inc()
+                self._tel_gauges()
+            return self._try_admit()
+
+        shared: List[int] = []
+        keys: List[bytes] = []
+        cow_src: Optional[int] = None
+        cached = 0
+        if self.prefix_caching:
+            hit_blocks, hit_keys = self.allocator.match_prefix(prefix)
+            if self.telemetry is not None:
+                self.telemetry.prefix_cache_lookups.inc()
+                if hit_blocks:
+                    self.telemetry.prefix_cache_hits.inc()
+            cached = len(hit_blocks) * bs
+            if cached >= target:
+                # full prefix cached: cap the hit at target-1 (the last
+                # token's logits must still be computed to sample the
+                # continuation), which restarts mid-block inside the last
+                # shared block — copy-on-write it (partial blocks are
+                # never shared)
+                cached = target - 1
+                cow_src = hit_blocks[-1]
+                shared, keys = hit_blocks[:-1], hit_keys[:-1]
+            else:
+                shared, keys = hit_blocks, hit_keys
+
+        tail_needed = need_total - len(shared)
+        # acquire the hit FIRST so the tail allocation's cold-list reclaim
+        # can't cannibalize the very blocks we are about to share. The COW
+        # source is NOT acquired: the only allocation between here and the
+        # engine's copy is the COW destination itself, and if LRU reclaim
+        # hands back the source as that destination the copy degenerates to
+        # the identity (content still intact — nothing writes between
+        # admission and the engine processing the returned action).
+        self.allocator.acquire(shared)
+        tail = self.allocator.allocate(tail_needed)
+        if tail is None:
+            # roll the probe back — in REVERSE like _free_blocks, so LRU
+            # reclaim takes chain tails before parents (a reclaimed parent
+            # orphans its still-cached children for every future probe)
+            self.allocator.free(list(reversed(shared)))
             if not self.running:
                 raise RuntimeError(
-                    f"prompt of request {req.rid} needs {need} KV blocks but "
-                    f"the pool only has {self.allocator.num_free} free and "
-                    "nothing is running to evict; raise "
-                    "serving.max_num_blocks or shrink the prompt")
-        if self.running:
+                    f"prefix of request {req.rid} needs {tail_needed} more "
+                    f"KV blocks but the pool only has "
+                    f"{self.allocator.num_free} available and nothing is "
+                    "running to evict; raise serving.max_num_blocks or "
+                    "shrink the prompt")
+            return None
+
+        self.waiting.popleft()
+        req.blocks = shared + tail
+        req.keys = list(keys)
+        req.pos = cached
+        req.prefill_target = target
+        req.prefilling = True
+        req.cow_pending = None if cow_src is None \
+            else (cow_src, tail[0])
+        req.state = RUNNING
+        req.admit_seq = self._admit_counter
+        self._admit_counter += 1
+        self.running.append(req)
+        if self.telemetry is not None:
+            self.telemetry.prefill_steps.inc()
+            if cached:
+                self.telemetry.prefix_cache_hit_tokens.inc(cached)
+            self._tel_gauges()
+        if req.pos > 0 or self.chunk_tokens > 0:
+            if self.telemetry is not None:
+                self.telemetry.prefill_chunks.inc()
+            self._decode_turn = True
+            return ("prefill_chunk", req)
+        return ("prefill", req)
+
+    # ------------------------------------------------------------------ #
+
+    def next_action(self) -> Optional[Tuple[str, object]]:
+        """Pick the next engine step: admit+start the queue head when a
+        slot and its tail blocks are available (admission has priority —
+        back-fill freed slots immediately), else alternate one prefill
+        chunk of the oldest mid-prefill request with one fused decode step
+        over the prefill-complete running set. None when everything is
+        finished."""
+        action = self._try_admit()
+        if action is not None:
+            return action
+        prefilling = [r for r in self.running if r.prefilling]
+        decodable = [r for r in self.running if not r.prefilling]
+        if prefilling and (not decodable or not self._decode_turn):
+            if self.telemetry is not None:
+                self.telemetry.prefill_chunks.inc()
+            self._decode_turn = True
+            return ("prefill_chunk", prefilling[0])
+        if decodable:
+            self._decode_turn = False
             self._ensure_decode_capacity()
+            decodable = [r for r in self.running if not r.prefilling]
+            if not decodable:
+                # capacity growth evicted every decodable row (they went
+                # back to the queue); pick again from the new state
+                return self.next_action()
             if self.telemetry is not None:
                 self.telemetry.decode_steps.inc()
                 self._tel_gauges()   # capacity growth/evictions moved blocks
-            return ("decode", list(self.running))
+            return ("decode", decodable)
         if self.waiting:
             # slots full but pool dry would have been handled above; here
             # the running set is empty yet requests wait — impossible unless
@@ -305,12 +510,13 @@ class ContinuousBatchingScheduler:
         return None
 
     def _ensure_decode_capacity(self) -> None:
-        """Every running request writes its next token at slot ``pos``;
-        grow its block list when that slot crosses a block boundary,
-        evicting from the back (latest admitted) when the pool is dry."""
+        """Every decode-ready request writes its next token at slot
+        ``pos``; grow its block list when that slot crosses a block
+        boundary, evicting from the back (latest admitted) when the pool —
+        free list AND reclaimable cold blocks — is dry."""
         for req in list(self.running):
-            if req.state != RUNNING:
-                continue  # evicted by an earlier iteration of this loop
+            if req.state != RUNNING or req.prefilling:
+                continue  # evicted by an earlier iteration, or mid-prefill
             while req.pos >= len(req.blocks) * self.allocator.block_size:
                 got = self.allocator.allocate(1)
                 if got is not None:
@@ -329,28 +535,89 @@ class ContinuousBatchingScheduler:
     def _preempt(self, victim: Request) -> None:
         logger.warning(
             f"KV pool exhausted: preempting request {victim.rid} "
-            f"({len(victim.blocks)} blocks freed; will recompute "
-            f"{len(victim.prefix())} tokens on re-admission)")
+            f"({len(victim.blocks)} blocks dereferenced; will recompute "
+            f"{len(victim.prefix())} tokens on re-admission"
+            + (" minus any prefix-cache hit" if self.prefix_caching else "")
+            + ")")
         if self.telemetry is not None:
             self.telemetry.preemptions.inc()
             self.telemetry.recompute_tokens.inc(len(victim.prefix()))
         self.running.remove(victim)
-        self.allocator.free(victim.blocks)
-        victim.blocks = []
+        self._free_blocks(victim)
         victim.pos = 0
+        victim.prefilling = False
+        victim.prefill_target = 0
         victim.state = QUEUED
         victim.preemptions += 1
         # FRONT of the queue: the victim was admitted before anything still
         # waiting, so FIFO fairness re-admits it first
         self.waiting.appendleft(victim)
 
+    def _free_blocks(self, req: Request) -> None:
+        """Dereference a retiring/preempted request's blocks. Freed in
+        REVERSE order when caching so the LRU cold list reclaims chain
+        TAILS before their parents — a reclaimed parent orphans its still-
+        cached children (match walks front-to-back)."""
+        blocks = req.blocks
+        if self.prefix_caching:
+            blocks = list(reversed(blocks))
+        self.allocator.free(blocks)
+        req.blocks = []
+        req.keys = []
+        req.cow_pending = None
+
+    def _register_full_blocks(self, req: Request) -> None:
+        """Publish every newly-FILLED block (all ``pos`` tokens' k/v are in
+        the pools) into the content-addressed cache, extending the
+        request's hash chain. First-writer-wins on conflicts (a concurrent
+        identical prompt): the chain keys still advance so later blocks
+        stay addressable."""
+        if not self.prefix_caching:
+            return
+        bs = self.allocator.block_size
+        full = req.pos // bs
+        if full <= len(req.keys):
+            return
+        seq = req.prefix()
+        parent = req.keys[-1] if req.keys else ROOT_KEY
+        for j in range(len(req.keys), full):
+            key = self.allocator.chain_key(parent, seq[j * bs:(j + 1) * bs])
+            self.allocator.register(req.blocks[j], key)
+            req.keys.append(key)
+            parent = key
+
     # ------------------------------------------------------------------ #
     # engine callbacks after each compute step
 
     def record_prefill(self, req: Request, token: int) -> None:
-        """The engine prefilled ``req.prefix()`` and sampled ``token`` from
-        the last position."""
+        """The engine prefilled ``req.prefix()`` whole and sampled
+        ``token`` from the last position."""
         req.pos = len(req.prefix())
+        req.prefilling = False
+        self._register_full_blocks(req)
+        req.generated.append(int(token))
+        self._record_token_time(req)
+        self._maybe_finish(req)
+
+    def record_prefill_chunk(self, req: Request, n_tokens: int,
+                             token: Optional[int] = None) -> None:
+        """One prefill chunk of ``n_tokens`` is cached. On the FINAL chunk
+        the engine passes the ``token`` it sampled from the prefix's last
+        position, completing the prefill exactly like
+        :meth:`record_prefill`."""
+        req.pos += int(n_tokens)
+        if req.pos > req.prefill_target:
+            raise ValueError(
+                f"prefill chunk overran request {req.rid}: pos {req.pos} > "
+                f"target {req.prefill_target}")
+        self._register_full_blocks(req)
+        if token is None:
+            return
+        if req.pos != req.prefill_target:
+            raise ValueError(
+                f"request {req.rid} sampled a token at pos {req.pos} before "
+                f"reaching its prefill target {req.prefill_target}")
+        req.prefilling = False
         req.generated.append(int(token))
         self._record_token_time(req)
         self._maybe_finish(req)
@@ -359,6 +626,7 @@ class ContinuousBatchingScheduler:
         """One decode step: the previous ``last_token``'s k/v was written at
         slot ``pos`` and ``token`` sampled from the resulting logits."""
         req.pos += 1
+        self._register_full_blocks(req)
         req.generated.append(int(token))
         self._record_token_time(req)
         self._maybe_finish(req)
@@ -386,8 +654,7 @@ class ContinuousBatchingScheduler:
         if done:
             req.state = FINISHED
             self.running.remove(req)
-            self.allocator.free(req.blocks)
-            req.blocks = []
+            self._free_blocks(req)
             self.finished.append(req)
             if self.telemetry is not None:
                 self.telemetry.finished.inc()

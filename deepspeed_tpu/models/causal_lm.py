@@ -68,6 +68,12 @@ class CausalLM:
         return T.forward_paged_prefill(self.config, params, tokens, pools,
                                        slots, last_idx)
 
+    def forward_paged_prefill_chunk(self, params, tokens, pools,
+                                    block_tables, slots, start_pos, last_idx):
+        return T.forward_paged_prefill_chunk(self.config, params, tokens,
+                                             pools, block_tables, slots,
+                                             start_pos, last_idx)
+
     def forward_paged_decode(self, params, tokens, pools, block_tables, pos,
                              pad_bias=None):
         return T.forward_paged_decode(self.config, params, tokens, pools,

@@ -1136,6 +1136,36 @@ def _paged_prefill_attention(cfg: TransformerConfig, x, lp, positions,
     return out, kp, vp
 
 
+def _paged_chunk_attention(cfg: TransformerConfig, x, lp, positions,
+                           kp, vp, block_tables, slots):
+    """Prefill-chunk attention of ONE request that already has cached
+    context: the chunk's k/v are scattered into the request's pool blocks
+    at ``slots``, then its queries attend causally over EVERYTHING the
+    request has cached — the prefix-cache hit / earlier chunks PLUS this
+    chunk — via the paged gather path and the shared masked-softmax core
+    (``_grouped_cache_einsum`` with per-row query positions; the same
+    machinery the off-kernel paged decode uses, so numerics match it).
+    x [1, T, D] (T the chunk bucket, pads routed to the dummy block);
+    positions [1, T] global positions ``start + arange(T)``."""
+    B, T, D = x.shape
+    H, KV, Hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
+
+    q, k, v = _qkv_project(cfg, x, lp, positions)
+
+    kp = _pool_scatter(kp, k.reshape(T, KV, Hd), slots)
+    vp = _pool_scatter(vp, v.reshape(T, KV, Hd), slots)
+
+    # gather the request's whole block table (static width) and let the
+    # causal mask (kpos <= qpos) hide everything beyond the chunk's last
+    # real token — unwritten tail blocks and dummy-mapped table slots all
+    # sit at higher logical positions than any live query
+    out = _grouped_cache_einsum(cfg, q, _paged_gather(kp, block_tables),
+                                _paged_gather(vp, block_tables),
+                                positions, None)
+    out = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
+    return out, kp, vp
+
+
 def _check_paged_config(cfg: TransformerConfig):
     if cfg.norm_position == "post" or not cfg.causal:
         raise ValueError("the paged KV path serves pre-LN causal LMs only")
@@ -1173,6 +1203,48 @@ def forward_paged_prefill(cfg: TransformerConfig, params, tokens, pools,
     # the whole bucket's [T, vocab]
     xl = jax.lax.dynamic_slice_in_dim(x, last_idx, 1, axis=1)
     return cached_head(cfg, params, xl)[:, 0, :], {"k": nk, "v": nv}
+
+
+def forward_paged_prefill_chunk(cfg: TransformerConfig, params, tokens,
+                                pools, block_tables, slots, start_pos,
+                                last_idx, mlp_fn=None):
+    """Prefill ONE CHUNK of a request that already has ``start_pos`` tokens
+    cached in its blocks (a prefix-cache hit, or earlier chunks of a
+    Sarathi-style chunked prefill).
+
+    tokens [1, T] the chunk, right-padded to the compile bucket;
+    block_tables [1, max_blocks] the request's table (unused entries 0 =
+    dummy); slots [T] flat pool slots per chunk position
+    (block_table[(start+t) // bs] * bs + (start+t) % bs, pads routed to the
+    dummy block); start_pos [] int32 tokens already cached; last_idx []
+    int32 index WITHIN the chunk of its last real token. Returns
+    (logits [1, vocab] at last_idx, new pools) — intermediate chunks
+    discard the logits, the final chunk samples from them."""
+    _check_paged_config(cfg)
+    x, positions = cached_embed(cfg, params, tokens, start_pos,
+                                pools["k"].dtype)
+
+    def run_block(h, xs):
+        lp, kp, vp = xs
+        h, nkp, nvp = _decode_block(
+            cfg, h, lp,
+            lambda xn: _paged_chunk_attention(cfg, xn, lp["attn"], positions,
+                                              kp, vp, block_tables, slots),
+            mlp_fn)
+        return h, (nkp, nvp)
+
+    x, (nk, nv) = jax.lax.scan(run_block, x,
+                               (params["layers"], pools["k"], pools["v"]))
+    xl = jax.lax.dynamic_slice_in_dim(x, last_idx, 1, axis=1)
+    return cached_head(cfg, params, xl)[:, 0, :], {"k": nk, "v": nv}
+
+
+def copy_paged_block(pools, src, dst):
+    """Device copy of one pool block across every layer (the scheduler's
+    copy-on-write split: a request restarting mid-block inside a SHARED
+    block gets a private copy before it writes). src/dst [] int32."""
+    return {"k": pools["k"].at[:, dst].set(pools["k"][:, src]),
+            "v": pools["v"].at[:, dst].set(pools["v"][:, src])}
 
 
 def forward_paged_decode(cfg: TransformerConfig, params, tokens, pools,

@@ -715,6 +715,16 @@ def render_health_table(rec: Dict, prev: Optional[Dict] = None) -> str:
             if "serving/kv_fragmentation" in g:
                 s += f" frag {g['serving/kv_fragmentation']:.2f}"
             parts.append(s)
+        lookups = c.get("serving/prefix_cache_lookups", 0)
+        if lookups:
+            hits = c.get("serving/prefix_cache_hits", 0)
+            s = f"cache {int(hits)}/{int(lookups)} ({hits / lookups:.0%})"
+            toks = c.get("serving/prefix_cache_hit_tokens", 0)
+            if toks:
+                s += f" +{int(toks)}tok"
+            if "serving/cold_blocks" in g:
+                s += f" cold {int(g['serving/cold_blocks'])}"
+            parts.append(s)
         if "serving/preemptions" in c:
             parts.append(f"preempt {int(c['serving/preemptions'])}")
         if parts:

@@ -69,6 +69,39 @@ def pytest_collection_modifyitems(config, items):
                 item.add_marker(skip)
 
 
+@pytest.fixture(autouse=True)
+def _no_kv_block_leaks(request):
+    """Serving suites must not leak KV pool blocks: every scheduler that
+    DRAINED (all requests retired) must leave its allocator with zero live
+    references — a nonzero ref count at teardown is a ref-count/double-free
+    bug in the prefix-cache sharing logic (cold cached blocks are fine).
+    Schedulers a test intentionally abandoned mid-flight are skipped."""
+    if not os.path.basename(str(request.node.fspath)).startswith(
+            "test_serving"):
+        yield
+        return
+    from deepspeed_tpu.inference import scheduler as _sched_mod
+    created = []
+    orig_init = _sched_mod.ContinuousBatchingScheduler.__init__
+
+    def tracking_init(self, *a, **k):
+        orig_init(self, *a, **k)
+        created.append(self)
+
+    _sched_mod.ContinuousBatchingScheduler.__init__ = tracking_init
+    try:
+        yield
+    finally:
+        _sched_mod.ContinuousBatchingScheduler.__init__ = orig_init
+    for sched in created:
+        if not sched.all_done():
+            continue
+        leaked = sched.allocator.leak_report()
+        assert not leaked, (
+            f"KV pool blocks leaked after all requests retired "
+            f"(block -> refcount): {leaked}")
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()
