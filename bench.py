@@ -135,12 +135,15 @@ def _telemetry_blob(engine):
               "serving/kv_fragmentation", "serving/running"):
         if k in g:
             blob[k] = round(g[k], 6)
-    for k in ("train/step_time_ms", "serving/ttft_ms", "serving/tpot_ms"):
+    for k in ("train/step_time_ms", "serving/ttft_ms", "serving/tpot_ms",
+              "checkpoint/save_ms", "checkpoint/snapshot_ms",
+              "checkpoint/bytes"):
         if k in h:
             blob[k] = {kk: round(float(vv), 3) for kk, vv in h[k].items()}
     for k in ("serving/preemptions", "serving/recompute_tokens",
               "serving/prefill_steps", "serving/decode_steps",
-              "serving/generated_tokens"):
+              "serving/generated_tokens", "checkpoint/saves",
+              "checkpoint/failures"):
         if k in c:
             blob[k] = c[k]
     # health summary: detector firings (zero-valued on a clean run)
@@ -383,6 +386,7 @@ BENCH_METRICS = [
     ("BENCH_DECODE_PAGED", "1", "gpt2_decode_paged_tokens_per_sec_per_chip"),
     ("BENCH_SERVE_PREFIX", "1", "gpt2_serving_prefix_cache_ttft_ms"),
     ("BENCH_SERVE_CHUNKED", "1", "gpt2_serving_chunked_prefill_tpot_p99_ms"),
+    ("BENCH_CKPT", "1", "gpt2_ckpt_async_stall_ms_per_step"),
 ]
 
 
@@ -596,6 +600,64 @@ def run_chunked_prefill_bench():
             print(json.dumps(rec), flush=True)
 
 
+def run_checkpoint_bench():
+    """Async-checkpoint stall probe: the same training loop with and
+    without a two-phase async save in flight. Phase 1 (device->host
+    snapshot) runs on the training thread; phase 2 (serialize+fsync+commit)
+    on the background writer — the metric is the per-step stall the whole
+    mechanism adds, with checkpoint/save_ms + /bytes from the same run
+    embedded in the record's telemetry blob. BENCH_CKPT_STEPS overrides the
+    window; BENCH_CKPT_EVERY the save cadence (steps per async save)."""
+    import shutil
+    import tempfile
+    import time as _t
+
+    steps = max(4, int(os.environ.get("BENCH_CKPT_STEPS",
+                                      os.environ.get("BENCH_STEPS", 10))))
+    every = max(1, int(os.environ.get("BENCH_CKPT_EVERY", 2)))
+    engine, model, batch, knobs = build_bench_engine()
+    # bound the probe's disk footprint: retention keeps the 2 newest tags
+    engine._config.checkpoint_config.keep_last = 2
+    save_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        float(engine.train_batch(batch()))  # warmup/compile
+
+        def _window(save: bool):
+            times = []
+            for i in range(steps):
+                t0 = _t.perf_counter()
+                loss = engine.train_batch(batch())
+                if save and i % every == 0:
+                    engine.save_checkpoint(save_dir, asynchronous=True)
+                float(loss)  # host fetch = the only reliable sync point
+                times.append((_t.perf_counter() - t0) * 1e3)
+            return sum(times) / len(times)
+
+        base_ms = _window(save=False)
+        with_ms = _window(save=True)
+        engine.flush_checkpoints()
+        stall = with_ms - base_ms
+        rec = {
+            "metric": _metric_name("BENCH_CKPT"),
+            "value": round(stall, 3),
+            "unit": f"ms/step added by async save every {every} steps "
+                    f"(base {base_ms:.1f} -> {with_ms:.1f} ms/step, "
+                    f"{steps}-step windows)",
+            # <=1.0 means the async save is (near-)stall-free
+            "vs_baseline": round(with_ms / base_ms, 4),
+        }
+        tel = _telemetry_blob(engine)
+        if tel:
+            rec["telemetry"] = tel
+        print(json.dumps(rec), flush=True)
+    finally:
+        try:
+            engine.destroy()   # stop the writer thread so the engine can GC
+        except Exception:
+            pass
+        shutil.rmtree(save_dir, ignore_errors=True)
+
+
 def _emit_skip_records(err: str):
     """One parseable JSON record per enabled metric so the bench trajectory
     is never empty: a dead TPU relay is a data point ("skipped"), not a
@@ -725,6 +787,14 @@ def main():
         _run_metric(_metric_name("BENCH_BERT"),
                     engine, model, batch, knobs["BATCH"], knobs["SEQ"],
                     STEPS, "MLM, ZeRO-2")
+
+    if _metric_enabled("BENCH_CKPT"):
+        if engine is not None:
+            del engine, model, batch
+        import gc
+        gc.collect()
+        run_checkpoint_bench()
+        engine = None
 
     if any(_metric_enabled(g) for g in
            ("BENCH_DECODE_DENSE", "BENCH_DECODE_PAGED",

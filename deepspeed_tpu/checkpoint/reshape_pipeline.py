@@ -69,7 +69,6 @@ def reshape_pipeline_checkpoint(src_dir: str, dst_dir: str, target_pp: int,
 
     src_dir = os.path.abspath(src_dir)
     tag = _resolve_tag(src_dir, tag)
-    src_state = os.path.join(src_dir, tag, "state")
 
     # per-process offload sidecars (host optimizer state) are dp-sharded and
     # topology-bound: refuse BEFORE the (potentially multi-GB) restore
@@ -81,8 +80,8 @@ def reshape_pipeline_checkpoint(src_dir: str, dst_dir: str, target_pp: int,
                          "reshaped offline — resume at the original topology "
                          "or convert via ds_to_universal")
 
-    with ocp.StandardCheckpointer() as ckptr:
-        tree = ckptr.restore(src_state)
+    from deepspeed_tpu.runtime.checkpoint_engine.safe_engine import read_state_tree
+    tree = read_state_tree(os.path.join(src_dir, tag))
 
     if "stages" not in tree.get("params", {}):
         raise ValueError(f"checkpoint {src_dir}/{tag} has no pipeline 'stages' "

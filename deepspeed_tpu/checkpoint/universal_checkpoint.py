@@ -29,13 +29,11 @@ from deepspeed_tpu.checkpoint.zero_to_fp32 import (_leaf_paths, _resolve_tag,
 
 def ds_to_universal(checkpoint_dir: str, out_dir: str, tag: Optional[str] = None) -> None:
     """Convert an engine checkpoint tag into the universal layout."""
-    import orbax.checkpoint as ocp
+    from deepspeed_tpu.runtime.checkpoint_engine.safe_engine import read_state_tree
 
     checkpoint_dir = os.path.abspath(checkpoint_dir)
     tag = _resolve_tag(checkpoint_dir, tag)
-    state_path = os.path.join(checkpoint_dir, tag, "state")
-    with ocp.StandardCheckpointer() as ckptr:
-        tree = ckptr.restore(state_path)
+    tree = read_state_tree(os.path.join(checkpoint_dir, tag))
 
     fp32 = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag, _tree=tree)
 

@@ -42,15 +42,12 @@ def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
     an already-restored state tree to avoid a second disk read."""
     checkpoint_dir = os.path.abspath(checkpoint_dir)
     tag = _resolve_tag(checkpoint_dir, tag)
-    state_path = os.path.join(checkpoint_dir, tag, "state")
-    if not os.path.isdir(state_path):
-        raise FileNotFoundError(f"checkpoint state not found at {state_path}")
 
     tree = _tree
     if tree is None:
-        import orbax.checkpoint as ocp
-        with ocp.StandardCheckpointer() as ckptr:
-            tree = ckptr.restore(state_path)
+        # either checkpoint format: safe-engine state.npz or legacy orbax
+        from deepspeed_tpu.runtime.checkpoint_engine.safe_engine import read_state_tree
+        tree = read_state_tree(os.path.join(checkpoint_dir, tag))
 
     params = _leaf_paths(tree["params"])
     masters = _leaf_paths(tree["master"]) if tree.get("master") is not None else {}

@@ -6,6 +6,7 @@ Subcommands mirror the reference's script family:
 - ``dscli report [--telemetry f]``  — ``ds_report`` environment/op/memory report
 - ``dscli health <jsonl> [--once]`` — live health screen over a telemetry sink
 - ``dscli bench``                   — ``ds_bench`` collective micro-benchmarks
+- ``dscli ckpt verify <dir>``       — checkpoint integrity audit (per-tag manifest check)
 - ``dscli elastic <config>``        — ``ds_elastic`` elastic-config inspector
 - ``dscli autotune <config>``       — ``deepspeed --autotuning`` config search
 - ``dscli ssh [-f hostfile] cmd``   — ``ds_ssh`` run a command on every host
@@ -44,6 +45,53 @@ def _health(argv):
 def _bench(argv):
     from deepspeed_tpu.benchmarks.comm_bench import main as bench_main
     bench_main(argv)
+
+
+def _ckpt(argv):
+    """Checkpoint maintenance. ``verify <dir>`` full-checks every tag's
+    blake2b manifest and prints INTACT/CORRUPT per tag; exit code 1 when
+    any tag is corrupt (CI-friendly)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="dscli ckpt", description="checkpoint maintenance tools")
+    sub = parser.add_subparsers(dest="action", required=True)
+    vp = sub.add_parser("verify", help="verify every tag's manifest")
+    vp.add_argument("dir", type=str, help="checkpoint save_dir (tag parent)")
+    vp.add_argument("--tag", type=str, default=None,
+                    help="verify only this tag")
+    args = parser.parse_args(argv)
+
+    import os
+
+    from deepspeed_tpu.runtime.checkpoint_engine import safe_engine
+
+    save_dir = os.path.abspath(args.dir)
+    reports = ([safe_engine.verify_tag(os.path.join(save_dir, args.tag))]
+               if args.tag else
+               [safe_engine.verify_tag(r.path)
+                for r in safe_engine.list_tags(save_dir)])
+    if not reports:
+        print(f"no checkpoint tags under {save_dir}")
+        return 1
+    latest = safe_engine._latest_target(save_dir)
+    corrupt = 0
+    for rep in reports:
+        if rep.legacy:
+            status = "LEGACY  (orbax tag: loadable, no manifest to verify)"
+        elif rep.intact:
+            status = "INTACT"
+        else:
+            corrupt += 1
+            status = "CORRUPT (" + "; ".join(rep.errors) + ")"
+        steps = "-" if rep.global_steps is None else str(rep.global_steps)
+        mark = " <- latest" if rep.tag == latest else ""
+        print(f"{rep.tag:<28} step {steps:<10} {status}{mark}")
+    if latest and all(r.tag != latest for r in reports) and not args.tag:
+        corrupt += 1
+        print(f"latest -> {latest!r}: tag missing (CORRUPT pointer)")
+    print(f"{len(reports)} tag(s), {corrupt} corrupt")
+    return 1 if corrupt else 0
 
 
 def _elastic(argv):
@@ -127,13 +175,14 @@ def _dlts_hostfile():
 
 
 _COMMANDS = {"run": _run, "report": _report, "health": _health, "bench": _bench,
-             "elastic": _elastic, "autotune": _autotune, "ssh": _ssh}
+             "ckpt": _ckpt, "elastic": _elastic, "autotune": _autotune,
+             "ssh": _ssh}
 
 
 def main():
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
         print(__doc__)
-        print("usage: dscli {run|report|health|bench|elastic|autotune|ssh} [args...]")
+        print("usage: dscli {run|report|health|bench|ckpt|elastic|autotune|ssh} [args...]")
         return 0
     cmd = sys.argv[1]
     if cmd not in _COMMANDS:

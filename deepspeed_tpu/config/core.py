@@ -18,7 +18,8 @@ import os
 from typing import Any, Dict, Optional, Union
 
 from deepspeed_tpu.config import constants as C
-from deepspeed_tpu.config.config_utils import dict_raise_error_on_duplicate_keys, get_scalar_param
+from deepspeed_tpu.config.config_utils import (ConfigModel, dict_raise_error_on_duplicate_keys,
+                                               get_scalar_param)
 from deepspeed_tpu.config.precision import AMPConfig, BF16Config, FP16Config
 from deepspeed_tpu.monitor.config import DeepSpeedMonitorConfig, get_monitor_config
 from deepspeed_tpu.runtime.zero.config import ZeroConfig, get_zero_config
@@ -27,6 +28,36 @@ from deepspeed_tpu.utils.logging import logger
 
 class DeepSpeedConfigError(Exception):
     pass
+
+
+class CheckpointConfig(ConfigModel):
+    """Typed view of the ``"checkpoint"`` section's fault-tolerance knobs
+    (the reference keys ``tag_validation``/``load_universal``/
+    ``use_node_local_storage`` ride through as extra fields and are parsed
+    where they always were)."""
+
+    # storage engine: "safe" = crash-safe two-phase npz+manifest format
+    # (single-process); "orbax" = multi-host sharded writes. Multi-process
+    # jobs fall back to orbax automatically.
+    engine: str = "safe"
+    # two-phase async save: snapshot on the training thread, persist on the
+    # background writer. Off by default so save_checkpoint() returning
+    # means "durably on disk" unless opted in.
+    async_save: bool = False
+    # bounded writer queue: snapshots held in host memory at once
+    max_pending: int = 2
+    # retention: keep this many newest tags (0 = keep all). The newest
+    # VERIFIED tag and the `latest` target are never GC'd.
+    keep_last: int = 0
+    # transient I/O error retry budget (exponential backoff)
+    retries: int = 3
+    retry_backoff_s: float = 0.5
+    # verify the blake2b manifest before any load touches engine state
+    verify_on_load: bool = True
+    # SIGTERM/SIGINT grace handling: drain the writer, emergency-save to
+    # save_dir, exit 128+signum. Requires save_dir.
+    preemption_save: bool = False
+    save_dir: Optional[str] = None
 
 
 ADAGRAD_OPTIMIZER = "adagrad"
@@ -220,6 +251,14 @@ class DeepSpeedConfig:
                                                                C.LOAD_UNIVERSAL_CHECKPOINT_DEFAULT)
         self.use_node_local_storage = checkpoint_params.get(C.USE_NODE_LOCAL_STORAGE_CHECKPOINT,
                                                             C.USE_NODE_LOCAL_STORAGE_CHECKPOINT_DEFAULT)
+        self.checkpoint_config = CheckpointConfig(**checkpoint_params)
+        if self.checkpoint_config.engine not in ("safe", "orbax"):
+            raise DeepSpeedConfigError(
+                f"checkpoint.engine={self.checkpoint_config.engine!r} "
+                "(expected 'safe' or 'orbax')")
+        if self.checkpoint_config.preemption_save and not self.checkpoint_config.save_dir:
+            raise DeepSpeedConfigError(
+                "checkpoint.preemption_save requires checkpoint.save_dir")
         self.dataloader_drop_last = get_scalar_param(param_dict, C.DATALOADER_DROP_LAST,
                                                      C.DATALOADER_DROP_LAST_DEFAULT)
 

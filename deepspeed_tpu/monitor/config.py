@@ -46,6 +46,9 @@ class HealthConfig(ConfigModel):
     # sustained fp16 overflow: consecutive skipped steps before the alarm
     # (also the rate limit of the engine's health-off skip warning)
     overflow_window: int = 25
+    # repeated checkpoint failure: consecutive failed saves (sync or async)
+    # before the ckpt_failure detector fires (0 = off)
+    ckpt_failure_consecutive: int = 2
     # data stall: wait/(wait+step) above the fraction for this many
     # consecutive steps means the input pipeline is the bottleneck
     data_stall_fraction: float = 0.5

@@ -44,6 +44,7 @@ import json
 import math
 import os
 import sys
+import threading
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -249,6 +250,10 @@ class HealthMonitor:
     - ``data_stall`` — wait/(wait+step) above ``data_stall_fraction`` for
       ``data_stall_steps`` consecutive steps: the input pipeline, not the
       device, is the bottleneck.
+    - ``ckpt_failure`` — ``ckpt_failure_consecutive`` checkpoint saves in a
+      row failed after exhausting their retry budget (flaky/full storage):
+      the run is training fine but silently losing its recovery points.
+      Fed by :meth:`observe_checkpoint`, not :meth:`observe_step`.
 
     Every firing increments ``health/anomalies{type=}``; ``action``
     escalates: ``record`` (counters only) → ``warn`` (+ rate-limited log,
@@ -256,7 +261,7 @@ class HealthMonitor:
     bundle via :meth:`dump_bundle`, at most ``dump_limit`` per run)."""
 
     DETECTORS = ("nonfinite", "loss_spike", "grad_explosion", "plateau",
-                 "overflow", "data_stall")
+                 "overflow", "data_stall", "ckpt_failure")
     ACTIONS = ("record", "warn", "dump")
 
     def __init__(self, config, registry=None, bucket_names: Sequence[str] = (),
@@ -283,6 +288,8 @@ class HealthMonitor:
         self._since_best = 0
         self._consec_skips = 0
         self._consec_stall = 0
+        self._consec_ckpt_failures = 0
+        self._ckpt_lock = threading.Lock()
         self._wait_total = 0.0
         self._busy_total = 0.0
         self._fired_counts: Dict[str, int] = {}
@@ -415,6 +422,39 @@ class HealthMonitor:
         if fired:
             self._act(fired, rec)
         return fired
+
+    def observe_checkpoint(self, success: bool, step: Optional[int] = None
+                           ) -> List[str]:
+        """Checkpoint-writer result feed (sync saves and the async writer's
+        completion callback both land here). Fires ``ckpt_failure`` after
+        ``ckpt_failure_consecutive`` failures in a row, then resets so a
+        persistently-broken store re-fires once per further run of K.
+
+        Serialized under a lock: sync saves land here on the training thread
+        while async results arrive on the writer thread, and the consecutive
+        counter must not lose an increment or a reset between them."""
+        with self._ckpt_lock:
+            if success:
+                self._consec_ckpt_failures = 0
+                return []
+            self._consec_ckpt_failures += 1
+            k = self.cfg.ckpt_failure_consecutive
+            if not k or self._consec_ckpt_failures < k:
+                return []
+            self._consec_ckpt_failures = 0
+            self._fired_counts["ckpt_failure"] = \
+                self._fired_counts.get("ckpt_failure", 0) + 1
+        self.anomalies.labels(type="ckpt_failure").inc()
+        if self.cfg.action != "record":
+            at = self._n if step is None else int(step)
+            if at - self._last_warn.get("ckpt_failure", -10**12) >= self.cfg.window:
+                self._last_warn["ckpt_failure"] = at
+                logger.warning(
+                    f"health: ckpt_failure — {k} consecutive checkpoint "
+                    f"saves failed (storage flaky or full); the run keeps "
+                    f"training but is NOT gaining recovery points. Next "
+                    f"warning in {self.cfg.window} steps.")
+        return ["ckpt_failure"]
 
     # ---- actions ---- #
 

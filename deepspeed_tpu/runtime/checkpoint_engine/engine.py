@@ -3,21 +3,41 @@
 Reference parity: ``deepspeed/runtime/engine.py:2512-3259`` —
 ``save_checkpoint``/``load_checkpoint`` with tag directories, the ``latest``
 tag file, tag validation, module+optimizer+scheduler+rng+config state, and
-ZeRO partitioned state. Because orbax writes each process's shards, the
-reference's separate per-dp-rank ZeRO files and mp-rank files collapse into
-one sharded tree per tag.
+ZeRO partitioned state.
+
+Two storage engines:
+
+- ``safe`` (default, single-process) — the crash-safe two-phase format of
+  :mod:`.safe_engine`: one ``state.npz`` of flat dotted-key host arrays plus
+  ``meta.json`` and optional offload npz files, committed atomically under a
+  per-file blake2b ``manifest.json``. Loads are **all-or-nothing**: every
+  byte is read, verified, and staged in host memory before ``engine.state``
+  is touched, and an auto-resolved tag that fails verification walks back to
+  the newest intact one.
+- ``orbax`` — the multi-host path (each process writes its addressable
+  shards). Selected via ``checkpoint.engine: "orbax"`` or automatically when
+  ``jax.process_count() > 1``. No manifest; loads are unverified but still
+  staged-before-apply.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Optional
+import time
+from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.runtime.checkpoint_engine import safe_engine
+from deepspeed_tpu.runtime.checkpoint_engine.safe_engine import (
+    MANIFEST, META_FILE, STATE_FILE, CheckpointCorruptError,
+    CheckpointPayload, CheckpointWriteError)
 from deepspeed_tpu.utils.logging import log_dist, logger
+
+RNG_KEY = "__rng_key_data__"
 
 
 def _tag_dir(save_dir: str, tag: str) -> str:
@@ -49,8 +69,128 @@ def _opt_state_labels(opt_state):
     return labels
 
 
-def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None, client_state=None,
-                           save_latest: bool = True) -> bool:
+# --------------------------------------------------------------------- #
+# the state tree <-> flat keys (shared by save and load so they never
+# disagree about structure)
+
+def _state_tree(engine) -> Dict[str, Any]:
+    state = engine.state
+    tree: Dict[str, Any] = {
+        "params": state.params,
+        "acc_grads": state.acc_grads,
+        "scaler": {
+            "loss_scale": state.scaler.loss_scale,
+            "good_steps": state.scaler.good_steps,
+            "hysteresis": state.scaler.hysteresis,
+        },
+        "counters": {
+            "micro_steps": state.micro_steps,
+            "global_steps": state.global_steps,
+            "skipped_steps": state.skipped_steps,
+        },
+    }
+    if state.master is not None:
+        tree["master"] = state.master
+    flat, _ = jax.tree.flatten(state.opt_state)
+    tree["opt_state_flat"] = {f"leaf_{i}": leaf for i, leaf in enumerate(flat)}
+    return tree
+
+
+def _flatten_tree(tree, prefix: str = "") -> Dict[str, Any]:
+    """dict/list/tuple tree -> {'a.b.0.c': leaf}: the shared dotted-key
+    scheme (utils.pytree.leaf_paths) with sequence descent, so saved keys
+    and the offline tools' lookups can never drift apart. Empty containers
+    vanish (they carry no data; the load template re-supplies them)."""
+    from deepspeed_tpu.utils.pytree import leaf_paths
+    return leaf_paths(tree, prefix, descend_sequences=True)
+
+
+def _rebuild_from_flat(template, flat: Dict[str, Any], prefix: str = ""):
+    """Walk the TEMPLATE structure, pulling each leaf from ``flat`` by its
+    dotted key — missing keys are a structure mismatch (KeyError)."""
+    if isinstance(template, dict):
+        return {k: _rebuild_from_flat(v, flat, prefix + str(k) + ".")
+                for k, v in template.items()}
+    if isinstance(template, (list, tuple)):
+        seq = [_rebuild_from_flat(v, flat, prefix + str(i) + ".")
+               for i, v in enumerate(template)]
+        return type(template)(seq) if isinstance(template, tuple) else seq
+    key = prefix[:-1]
+    if key not in flat:
+        raise KeyError(f"checkpoint is missing state leaf {key!r}")
+    return flat[key]
+
+
+def _checkpoint_cfg(engine):
+    """The training ``CheckpointConfig``, defaulted when the engine's config
+    carries none (or an unrelated one, e.g. the inference config's) — so the
+    knob defaults live in exactly one place."""
+    from deepspeed_tpu.config.core import CheckpointConfig
+    ccfg = getattr(engine._config, "checkpoint_config", None)
+    return ccfg if isinstance(ccfg, CheckpointConfig) else CheckpointConfig()
+
+
+def _storage_kind(engine) -> str:
+    kind = _checkpoint_cfg(engine).engine
+    if kind == "safe" and jax.process_count() > 1:
+        # the safe engine serializes full logical arrays host-side; in a
+        # multi-controller job only orbax writes per-process shards
+        return "orbax"
+    return kind
+
+
+def _notify_ckpt_result(engine, ok: bool, steps: Optional[int]) -> None:
+    health = getattr(engine, "_health", None)
+    if health is not None and hasattr(health, "observe_checkpoint"):
+        try:
+            health.observe_checkpoint(ok, step=steps)
+        except Exception as e:
+            logger.warning(f"health checkpoint observation failed: {e}")
+
+
+# --------------------------------------------------------------------- #
+# save
+
+def _build_meta(engine, tag: str, client_state) -> Dict[str, Any]:
+    """The checkpoint meta dict, shared by the safe and orbax save paths so
+    a field added to one can never silently miss the other."""
+    meta = {
+        "tag": tag,
+        "global_steps": int(engine.global_steps),
+        "micro_steps": int(engine.micro_steps),
+        "skipped_steps": int(engine.skipped_steps),
+        "ds_config": engine._config._param_dict,
+        "lr_scheduler": engine.lr_scheduler.state_dict() if engine.lr_scheduler is not None else None,
+        "client_state": client_state or {},
+        "framework_version": 1,
+        "data_progress": dict(getattr(engine, "_data_progress", {}) or {}),
+    }
+    if getattr(engine, "quantizer", None) is not None:
+        # MoQ host schedule: a resumed run must continue mid-schedule
+        meta["moq_state"] = engine.quantizer.state_dict()
+    if engine.state.opt_state is not None:
+        # structured identity of every opt_state_flat leaf, so tools
+        # (ds_to_universal) never have to guess moments by shape matching
+        meta["opt_state_labels"] = _opt_state_labels(engine.state.opt_state)
+    return meta
+
+
+def _offload_arrays(sd: Dict[str, Any], copy: bool = False) -> Dict[str, Any]:
+    """Flatten an offload optimizer state_dict to '|'-keyed npz arrays.
+    ``copy=True`` for async saves: the non-swapper state_dict returns the
+    LIVE master buffers, which cpu_adam keeps mutating in place while the
+    background writer serializes."""
+    out: Dict[str, Any] = {"step": np.asarray(sd.get("step", 0)),
+                           "lr": np.asarray(sd.get("lr", 0.0))}
+    for group in ("masters", "exp_avg", "exp_avg_sq"):
+        for k, v in sd.get(group, {}).items():
+            out[f"{group}|{k}"] = np.array(v, copy=True) if copy else v
+    return out
+
+
+def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                           client_state=None, save_latest: bool = True,
+                           asynchronous: Optional[bool] = None) -> bool:
     if tag is None:
         tag = f"global_step{engine.global_steps}"
     tag = str(tag)
@@ -69,7 +209,86 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None, cli
                 raise ValueError(msg)
             logger.warning(msg)
 
-    os.makedirs(os.path.abspath(save_dir), exist_ok=True)
+    save_dir = os.path.abspath(save_dir)
+    os.makedirs(save_dir, exist_ok=True)
+
+    if _storage_kind(engine) == "orbax":
+        if asynchronous:
+            logger.warning(
+                f"checkpoint {tag}: async save is not supported on the "
+                "orbax path; saving synchronously")
+        return _save_orbax(engine, save_dir, tag, client_state, save_latest)
+
+    ccfg = _checkpoint_cfg(engine)
+    if asynchronous is None:
+        asynchronous = bool(ccfg.async_save)
+
+    # ---- phase 1: device -> host snapshot on the caller's thread ----
+    t0 = time.perf_counter()
+    steps = int(engine.global_steps)
+    host_tree = jax.device_get(_state_tree(engine))
+    arrays = {k: np.asarray(v) for k, v in _flatten_tree(host_tree).items()}
+    arrays[RNG_KEY] = np.asarray(jax.random.key_data(engine._rng)) \
+        if getattr(engine, "_rng", None) is not None else np.zeros((2,), np.uint32)
+
+    extra_npz: Dict[str, Dict[str, Any]] = {}
+    offload = getattr(engine, "_offload", None)
+    if offload is not None:
+        # ZeRO-Offload: host optimizer state (fp32 masters + moments) rides
+        # as its own npz next to the device state (reference saves per-dp-
+        # rank zero files, engine.py:3136)
+        extra_npz[f"offload_state_p{jax.process_index()}.npz"] = \
+            _offload_arrays(offload.state_dict(), copy=asynchronous)
+
+    meta = _build_meta(engine, tag, client_state)
+    meta["format"] = "safe-v1"
+    if asynchronous:
+        # the writer thread serializes this later; live references
+        # (ds_config, schedules) must not tear under concurrent mutation
+        import copy as _copy
+        meta = _copy.deepcopy(meta)
+
+    payload = CheckpointPayload(tag=tag, arrays=arrays, meta=meta,
+                                extra_npz=extra_npz, global_steps=steps,
+                                update_latest=save_latest)
+    mets = safe_engine._ckpt_metrics()
+    mets["snapshot_ms"].observe((time.perf_counter() - t0) * 1e3)
+
+    if asynchronous:
+        writer = engine._checkpoint_writer()
+        # runtime config changes (e.g. retention) apply to future jobs
+        writer.keep_last = ccfg.keep_last
+        writer.retries = ccfg.retries
+        writer.retry_backoff_s = ccfg.retry_backoff_s
+        writer.submit(save_dir, payload)
+        log_dist(f"Queued async checkpoint {tag} for {save_dir} "
+                 f"(depth {writer.queue_depth})", ranks=[0])
+        return True
+
+    # ---- phase 2 inline (synchronous save) ----
+    t1 = time.perf_counter()
+    try:
+        total = safe_engine.write_tag(
+            save_dir, payload, retries=ccfg.retries,
+            retry_backoff_s=ccfg.retry_backoff_s, keep_last=ccfg.keep_last)
+    except CheckpointWriteError:
+        mets["failures"].inc()
+        _notify_ckpt_result(engine, False, steps)
+        raise
+    mets["save_ms"].observe((time.perf_counter() - t1) * 1e3)
+    mets["bytes"].observe(total)
+    mets["saves"].inc()
+    _notify_ckpt_result(engine, True, steps)
+    log_dist(f"Saved checkpoint {tag} to {_tag_dir(save_dir, tag)} "
+             f"({total / 1e6:.2f} MB)", ranks=[0])
+    return True
+
+
+def _save_orbax(engine, save_dir: str, tag: str, client_state,
+                save_latest: bool) -> bool:
+    """The multi-host orbax path. The historical ordering bug — ``latest``
+    plain-written BEFORE ``ckpt_engine.commit`` — is fixed: the pointer
+    moves atomically (tmp+fsync+rename) strictly after commit."""
     path = _tag_dir(save_dir, tag)
 
     ckpt_engine = engine.checkpoint_engine if hasattr(engine, "checkpoint_engine") else None
@@ -79,120 +298,89 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None, cli
         engine.checkpoint_engine = ckpt_engine
 
     ckpt_engine.create(tag)
-
-    state = engine.state
-    tree = {
-        "params": state.params,
-        "acc_grads": state.acc_grads,
-        "scaler": {
-            "loss_scale": state.scaler.loss_scale,
-            "good_steps": state.scaler.good_steps,
-            "hysteresis": state.scaler.hysteresis,
-        },
-        "counters": {
-            "micro_steps": state.micro_steps,
-            "global_steps": state.global_steps,
-            "skipped_steps": state.skipped_steps,
-        },
-    }
-    if state.master is not None:
-        tree["master"] = state.master
-    opt_labels = None
-    if state.opt_state is not None:
-        # flatten the optax state to a dict orbax can store without the types
-        flat, treedef = jax.tree.flatten(state.opt_state)
-        tree["opt_state_flat"] = {f"leaf_{i}": leaf for i, leaf in enumerate(flat)}
-        opt_labels = _opt_state_labels(state.opt_state)
-
+    tree = _state_tree(engine)
     ckpt_engine.save(tree, os.path.join(path, "state"))
 
-    # ZeRO-Offload: host optimizer state (fp32 masters + moments) is saved
-    # per-process as an npz next to the sharded device state (reference saves
-    # per-dp-rank zero files, engine.py:3136)
     offload = getattr(engine, "_offload", None)
     if offload is not None:
-        sd = offload.state_dict()
-        arrays = {}
-        for group in ("masters", "exp_avg", "exp_avg_sq"):
-            for k, v in sd.get(group, {}).items():
-                arrays[f"{group}|{k}"] = v
         np.savez(os.path.join(path, f"offload_state_p{jax.process_index()}.npz"),
-                 step=sd.get("step", 0), lr=sd.get("lr", 0.0), **arrays)
+                 **_offload_arrays(offload.state_dict()))
 
-    meta = {
-        "tag": tag,
-        "global_steps": int(state.global_steps),
-        "micro_steps": int(state.micro_steps),
-        "skipped_steps": int(state.skipped_steps),
-        "ds_config": engine._config._param_dict,
-        "lr_scheduler": engine.lr_scheduler.state_dict() if engine.lr_scheduler is not None else None,
-        "client_state": client_state or {},
-        "framework_version": 1,
-    }
-    if getattr(engine, "quantizer", None) is not None:
-        # MoQ host schedule: a resumed run must continue mid-schedule
-        meta["moq_state"] = engine.quantizer.state_dict()
-    if opt_labels is not None:
-        # structured identity of every opt_state_flat leaf, so tools
-        # (ds_to_universal) never have to guess moments by shape matching
-        meta["opt_state_labels"] = opt_labels
+    meta = _build_meta(engine, tag, client_state)
     if jax.process_index() == 0:
         with open(os.path.join(path, "meta.json"), "w") as f:
             json.dump(meta, f, indent=2, default=str)
-        if save_latest:
-            with open(os.path.join(os.path.abspath(save_dir), "latest"), "w") as f:
-                f.write(tag)
     ckpt_engine.commit(tag)
+    # `latest` moves ONLY after the tag is fully committed (regression:
+    # a crash between the old early write and commit left `latest`
+    # pointing at an uncommitted tag)
+    if jax.process_index() == 0 and save_latest:
+        safe_engine.atomic_write_text(os.path.join(save_dir, "latest"), tag)
     log_dist(f"Saved checkpoint {tag} to {path}", ranks=[0])
     return True
 
 
-def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None, load_optimizer_states: bool = True,
-                           load_module_only: bool = False):
-    load_dir = os.path.abspath(load_dir)
-    if tag is None:
-        latest_path = os.path.join(load_dir, "latest")
-        if not os.path.exists(latest_path):
-            logger.warning(f"No 'latest' file at {load_dir}; cannot auto-resolve tag")
-            return None, {}
-        with open(latest_path) as f:
-            tag = f.read().strip()
-    path = _tag_dir(load_dir, tag)
+# --------------------------------------------------------------------- #
+# load
+
+def _prepare_tag_load(engine, path: str, verify: bool):
+    """Stage EVERYTHING a load needs in host memory — verified manifest,
+    decoded state arrays rebuilt against the engine's template, parsed
+    meta, offload state — without touching the engine. Raises on any
+    missing/corrupt piece; the caller decides walk-back vs abort."""
     if not os.path.isdir(path):
-        logger.warning(f"Checkpoint {path} does not exist")
-        return None, {}
+        raise FileNotFoundError(f"checkpoint {path} does not exist")
+    legacy = (not os.path.isfile(os.path.join(path, MANIFEST))
+              and os.path.isdir(os.path.join(path, "state")))
+    template = _state_tree(engine)
+    if legacy:
+        from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import OrbaxCheckpointEngine
+        ckpt_engine = getattr(engine, "checkpoint_engine", None) or OrbaxCheckpointEngine()
+        restored = ckpt_engine.load(os.path.join(path, "state"), template=template)
+        rng_data = None
+        logger.info(f"checkpoint {path}: legacy orbax tag (no manifest; "
+                    f"loading unverified)")
+    else:
+        if verify:
+            rep = safe_engine.verify_tag(path)
+            if not rep.intact:
+                raise CheckpointCorruptError(
+                    f"checkpoint {path} failed verification: "
+                    + "; ".join(rep.errors))
+        flat = safe_engine.read_npz(os.path.join(path, STATE_FILE))
+        rng_data = flat.pop(RNG_KEY, None)
+        restored = _rebuild_from_flat(template, flat)
 
-    from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import OrbaxCheckpointEngine
-    ckpt_engine = getattr(engine, "checkpoint_engine", None) or OrbaxCheckpointEngine()
+    with open(os.path.join(path, META_FILE)) as f:
+        meta = json.load(f)
 
+    offload_sd = None
+    offload_path = os.path.join(
+        path, f"offload_state_p{jax.process_index()}.npz")
+    if os.path.exists(offload_path):
+        with np.load(offload_path) as z:
+            offload_sd = {"step": int(z["step"]), "lr": float(z["lr"]),
+                          "masters": {}, "exp_avg": {}, "exp_avg_sq": {}}
+            for name in z.files:
+                if "|" in name:
+                    group, key = name.split("|", 1)
+                    offload_sd[group][key] = z[name]
+
+    return {"path": path, "template": template, "restored": restored,
+            "meta": meta, "offload_sd": offload_sd, "rng_data": rng_data}
+
+
+def _apply_prepared(engine, prepared, load_optimizer_states: bool,
+                    load_module_only: bool, load_data_progress: bool) -> None:
+    """The only function that mutates the engine — runs strictly after
+    every read and check succeeded (all-or-nothing)."""
     state = engine.state
-    template = {
-        "params": state.params,
-        "acc_grads": state.acc_grads,
-        "scaler": {
-            "loss_scale": state.scaler.loss_scale,
-            "good_steps": state.scaler.good_steps,
-            "hysteresis": state.scaler.hysteresis,
-        },
-        "counters": {
-            "micro_steps": state.micro_steps,
-            "global_steps": state.global_steps,
-            "skipped_steps": state.skipped_steps,
-        },
-    }
-    if state.master is not None:
-        template["master"] = state.master
-    # the saved tree always contains opt_state_flat; restore with the full
-    # template and drop what wasn't requested afterwards (orbax rejects
-    # structure mismatches between saved tree and template)
-    flat, treedef = jax.tree.flatten(state.opt_state)
-    template["opt_state_flat"] = {f"leaf_{i}": leaf for i, leaf in enumerate(flat)}
-
-    restored = ckpt_engine.load(os.path.join(path, "state"), template=template)
-    # re-commit every restored leaf to its template sharding (orbax may
-    # return host/default-device arrays for replicated scalars)
+    template, restored = prepared["template"], prepared["restored"]
+    # re-commit every restored leaf to its template sharding (host arrays /
+    # replicated scalars land back on the mesh)
     restored = jax.tree.map(
-        lambda r, t: jax.device_put(r, t.sharding) if hasattr(t, "sharding") else r, restored, template)
+        lambda r, t: jax.device_put(r, t.sharding) if hasattr(t, "sharding") else r,
+        restored, template)
 
     new_scaler = state.scaler._replace(
         loss_scale=restored["scaler"]["loss_scale"],
@@ -210,30 +398,107 @@ def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None, loa
     if load_module_only:
         kwargs = dict(params=restored["params"])
     if load_optimizer_states and not load_module_only and "opt_state_flat" in restored:
+        flat, treedef = jax.tree.flatten(state.opt_state)
         leaves = [restored["opt_state_flat"][f"leaf_{i}"] for i in range(len(flat))]
         kwargs["opt_state"] = jax.tree.unflatten(treedef, leaves)
     engine.state = state._replace(**kwargs)
 
     offload = getattr(engine, "_offload", None)
-    offload_path = os.path.join(path, f"offload_state_p{jax.process_index()}.npz")
-    if offload is not None and load_optimizer_states and not load_module_only and os.path.exists(offload_path):
-        with np.load(offload_path) as z:
-            sd = {"step": int(z["step"]), "lr": float(z["lr"]),
-                  "masters": {}, "exp_avg": {}, "exp_avg_sq": {}}
-            for name in z.files:
-                if "|" in name:
-                    group, key = name.split("|", 1)
-                    sd[group][key] = z[name]
-        offload.load_state_dict(sd)
+    if offload is not None and load_optimizer_states and not load_module_only \
+            and prepared["offload_sd"] is not None:
+        offload.load_state_dict(prepared["offload_sd"])
 
-    meta = {}
-    meta_path = os.path.join(path, "meta.json")
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
-        if engine.lr_scheduler is not None and meta.get("lr_scheduler"):
-            engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
-        if getattr(engine, "quantizer", None) is not None and meta.get("moq_state"):
-            engine.quantizer.load_state_dict(meta["moq_state"])
-    log_dist(f"Loaded checkpoint {tag} from {path} (step {engine.global_steps})", ranks=[0])
-    return path, meta.get("client_state", {})
+    meta = prepared["meta"]
+    if engine.lr_scheduler is not None and meta.get("lr_scheduler"):
+        engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+    if getattr(engine, "quantizer", None) is not None and meta.get("moq_state"):
+        engine.quantizer.load_state_dict(meta["moq_state"])
+
+    if prepared["rng_data"] is not None and hasattr(engine, "_rng"):
+        engine._rng = jax.random.wrap_key_data(
+            jnp.asarray(prepared["rng_data"]))
+
+    progress = meta.get("data_progress") or {}
+    if hasattr(engine, "_data_progress"):
+        engine._data_progress = {
+            "consumed_samples": int(progress.get("consumed_samples", 0)),
+            "iterations": int(progress.get("iterations", 0)),
+        }
+    if load_data_progress and progress.get("iterations"):
+        ff = getattr(engine, "_fast_forward_data", None)
+        if ff is not None:
+            ff(int(progress["iterations"]))
+
+
+def load_engine_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                           load_optimizer_states: bool = True,
+                           load_module_only: bool = False,
+                           strict: bool = False,
+                           load_data_progress: bool = False):
+    """Resolve, verify, stage, then apply. Auto-resolved tags (``tag=None``)
+    walk back newest-first past corrupt/partial tags to the newest intact
+    one. ``strict=True`` turns the silent ``(None, {})`` for a missing
+    ``latest``/directory into ``FileNotFoundError``. A checkpoint that
+    EXISTS but is corrupt (with no intact fallback) always raises
+    :class:`CheckpointCorruptError` — data loss is never silent."""
+    load_dir = os.path.abspath(load_dir)
+    try:
+        # a crash mid tag-overwrite leaves the tag only as .tmp/.old
+        # survivors; promote them before resolving candidates
+        safe_engine.recover_interrupted(load_dir)
+    except OSError:
+        pass
+    verify = _checkpoint_cfg(engine).verify_on_load
+
+    explicit = tag is not None
+    candidates = []
+    if explicit:
+        candidates = [str(tag)]
+    else:
+        latest = safe_engine._latest_target(load_dir)
+        if latest:
+            candidates.append(latest)
+        for rep in safe_engine.list_tags(load_dir):
+            if rep.tag not in candidates:
+                candidates.append(rep.tag)
+        if not candidates:
+            if strict:
+                raise FileNotFoundError(
+                    f"no 'latest' file or checkpoint tags in {load_dir}")
+            logger.warning(f"No checkpoint found at {load_dir}; "
+                           f"cannot auto-resolve tag")
+            return None, {}
+
+    errors = []
+    for cand in candidates:
+        path = _tag_dir(load_dir, cand)
+        try:
+            prepared = _prepare_tag_load(engine, path, verify=verify)
+        except FileNotFoundError as e:
+            errors.append(f"{cand}: {e}")
+            if explicit:
+                if strict:
+                    raise
+                logger.warning(str(e))
+                return None, {}
+            continue
+        except Exception as e:
+            errors.append(f"{cand}: {e}")
+            if explicit:
+                raise CheckpointCorruptError(
+                    f"checkpoint tag {cand} is unusable: {e}") from e
+            logger.warning(f"checkpoint {cand} unusable ({e}); "
+                           f"walking back to an older tag")
+            continue
+        _apply_prepared(engine, prepared, load_optimizer_states,
+                        load_module_only, load_data_progress)
+        if cand != candidates[0]:
+            logger.warning(
+                f"resumed from {cand} after skipping "
+                f"{candidates.index(cand)} corrupt/partial newer tag(s)")
+        log_dist(f"Loaded checkpoint {cand} from {path} "
+                 f"(step {engine.global_steps})", ranks=[0])
+        return path, prepared["meta"].get("client_state", {})
+
+    raise CheckpointCorruptError(
+        f"no intact checkpoint in {load_dir}: " + "; ".join(errors))

@@ -34,6 +34,44 @@ class RepeatingLoader:
         return batch
 
 
+def resume_loader_iterator(loader, consumed_batches: int):
+    """Auto-resume support: a standing iterator over ``loader`` positioned
+    ``consumed_batches`` batches in, continuing across epoch boundaries
+    forever (so a resumed run sees exactly the batches an uninterrupted run
+    would have seen next). For :class:`DeepSpeedDataLoader` the consumed
+    epochs are replayed by COUNTER, not by iteration: ``loader.epoch`` is
+    set so the shuffle seed of the current epoch matches, then only the
+    in-epoch remainder is skipped."""
+    per_epoch = None
+    try:
+        per_epoch = len(loader)
+    except TypeError:
+        pass
+    skip = consumed_batches
+    if per_epoch and hasattr(loader, "epoch"):
+        loader.epoch = consumed_batches // per_epoch
+        skip = consumed_batches % per_epoch
+
+    def _stream():
+        skipped = 0
+        while True:
+            empty = True
+            for item in iter(loader):
+                empty = False
+                if skipped < skip:
+                    skipped += 1
+                    continue
+                yield item
+            if empty:
+                # an empty pass would otherwise spin forever (empty dataset,
+                # or a one-shot generator that iter() cannot restart)
+                raise RuntimeError(
+                    f"resume_loader_iterator: loader yielded no batches; "
+                    f"cannot position the stream {skip} batch(es) in")
+
+    return _stream()
+
+
 def _default_collate(samples):
     first = samples[0]
     if isinstance(first, dict):

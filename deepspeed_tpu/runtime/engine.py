@@ -318,6 +318,18 @@ class DeepSpeedEngine:
         # train_batch just to read state.global_steps)
         self._host_global_steps = 0
 
+        # ---- fault tolerance: data-pipeline progress + async checkpoint
+        # writer + preemption grace handler (runtime/checkpoint_engine) ----
+        # consumed_samples/iterations are recorded in every checkpoint's
+        # meta.json so auto_resume can fast-forward the data pipeline
+        self._data_progress = {"consumed_samples": 0, "iterations": 0}
+        # True only for a user-provided set_dataiterator stream: resume
+        # fast-forwards it in place; loader-derived iterators are instead
+        # re-created by the epoch-aware resume_loader_iterator path
+        self._data_iter_external = False
+        self._ckpt_writer = None
+        self._preemption = None
+
         # ---- dataloader ----
         self.training_dataloader = None
         if training_data is not None:
@@ -404,6 +416,10 @@ class DeepSpeedEngine:
                     gas_boundary_resolution=ev.get("gas_boundary_resolution", 1))
                 self._ev_layer_name = ev.get("layer_name", "layers")
                 self._ev_layer_num = ev.get("layer_num", 0)
+
+        ccfg = getattr(self._config, "checkpoint_config", None)
+        if ccfg is not None and ccfg.preemption_save and ccfg.save_dir:
+            self.enable_preemption_handler(ccfg.save_dir)
 
         log_dist(f"DeepSpeedEngine ready: optimizer={self._optimizer_name}, "
                  f"dtype={self.compute_dtype.__name__}, mesh={dict(mesh.shape)}, "
@@ -926,7 +942,14 @@ class DeepSpeedEngine:
             if data_iter is None:
                 if self.training_dataloader is None:
                     raise ValueError("train_batch needs a batch, a data_iter, or engine training_data")
-                data_iter = iter(self.training_dataloader)
+                # standing sequential stream rolling over epochs — the same
+                # stream auto_resume's fast-forward reconstructs, so resume
+                # stays step-identical on the engine-owned dataloader (a
+                # fresh iter() per call would replay the epoch head forever)
+                from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+                self._data_iterator = iter(RepeatingLoader(self.training_dataloader))
+                self._data_iter_external = False
+                data_iter = self._data_iterator
             micros = [next(data_iter) for _ in range(gas)]
             if getattr(self, "_batch_fn", None) is not None:
                 micros = [self._batch_fn(m) for m in micros]
@@ -1011,6 +1034,8 @@ class DeepSpeedEngine:
                 self._train_batch_jit[gas] = fn
             self.state, metrics = fn(self.state, batch, step_rng)
         self.tput_timer.stop(global_step=True)
+        self._data_progress["iterations"] += 1
+        self._data_progress["consumed_samples"] += self.train_batch_size()
         if self._telemetry is not None:
             # telemetry-on accepts one host sync per step: the wall clock
             # must bracket the device work for step time / MFU to mean
@@ -1417,11 +1442,17 @@ class DeepSpeedEngine:
         gas items forever)."""
         self.training_dataloader = loader
         self._data_iterator = iter(loader) if loader is not None else None
+        self._data_iter_external = False
+        # progress describes the data pipeline; a new pipeline starts at 0
+        self._data_progress = {"consumed_samples": 0, "iterations": 0}
 
     def set_dataiterator(self, iterator) -> None:
         """Reference pipe-engine surface: a standing iterator yielding
         micro-batches for batchless train_batch calls."""
         self._data_iterator = iterator
+        self._data_iter_external = iterator is not None
+        # progress describes the data pipeline; a new pipeline starts at 0
+        self._data_progress = {"consumed_samples": 0, "iterations": 0}
 
     def set_batch_fn(self, fn) -> None:
         """Post-process every batch (or micro-batch from an iterator)
@@ -1503,6 +1534,10 @@ class DeepSpeedEngine:
     def destroy(self) -> None:
         """Drop compiled executables and large state references (reference
         engine.destroy): the engine is unusable afterwards."""
+        self.disable_preemption_handler()
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.stop()
+            self._ckpt_writer = None
         self._train_batch_jit = {}
         self._grad_jit = None
         self._apply_jit = None
@@ -1854,16 +1889,127 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------ #
     # checkpointing
 
-    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
+                        asynchronous=None):
+        """Two-phase crash-safe save. ``asynchronous`` overrides the config's
+        ``checkpoint.async_save``: True snapshots device state to host and
+        returns while the background writer persists/commits; False blocks
+        until the tag is durably on disk."""
         from deepspeed_tpu.runtime.checkpoint_engine.engine import save_engine_checkpoint
-        return save_engine_checkpoint(self, save_dir, tag=tag, client_state=client_state, save_latest=save_latest)
+        return save_engine_checkpoint(self, save_dir, tag=tag, client_state=client_state,
+                                      save_latest=save_latest, asynchronous=asynchronous)
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True, load_lr_scheduler_states=True,
-                        load_module_only=False):
+                        load_module_only=False, strict=False, load_data_progress=False):
         from deepspeed_tpu.runtime.checkpoint_engine.engine import load_engine_checkpoint
         result = load_engine_checkpoint(self, load_dir, tag=tag,
                                         load_optimizer_states=load_optimizer_states,
-                                        load_module_only=load_module_only)
+                                        load_module_only=load_module_only,
+                                        strict=strict,
+                                        load_data_progress=load_data_progress)
         # resync the host-side curriculum counter with the restored step
         self._host_global_steps = int(self.global_steps)
         return result
+
+    def auto_resume(self, save_dir, tag=None, strict=False):
+        """Verified auto-resume: restore params/optimizer/loss-scaler/RNG/
+        counters from the newest INTACT checkpoint under ``save_dir``
+        (walking back past corrupt/partial tags) and fast-forward the data
+        pipeline to the recorded progress, so the resumed loss curve is
+        step-identical to an uninterrupted run. Returns ``(path,
+        client_state)``; ``(None, {})`` when nothing is there to resume
+        (fresh start) unless ``strict``."""
+        return self.load_checkpoint(save_dir, tag=tag, strict=strict,
+                                    load_data_progress=True)
+
+    def flush_checkpoints(self, timeout=None):
+        """Block until every queued async checkpoint is durably committed.
+        Raises the writer's error if a queued save failed."""
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.drain(timeout=timeout, raise_on_error=True)
+
+    def emergency_save(self, save_dir):
+        """Preemption-grace save: drain in-flight async saves (best effort,
+        bounded — preemption grace windows are short and the synchronous
+        save below captures newer state anyway), then take one synchronous
+        verified save of the current state."""
+        if self._ckpt_writer is not None:
+            try:
+                self._ckpt_writer.drain(timeout=30)
+            except Exception as e:
+                logger.warning(f"emergency save: drain failed ({e}); "
+                               f"taking the synchronous save anyway")
+        return self.save_checkpoint(save_dir, asynchronous=False)
+
+    def enable_preemption_handler(self, save_dir, signals=None,
+                                  exit_on_signal=True):
+        """Install the SIGTERM/SIGINT grace handler: on signal, drain the
+        checkpoint writer, emergency-save to ``save_dir``, exit
+        ``128+signum`` (TPU preemption / maintenance SIGTERMs become clean
+        resumable exits)."""
+        from deepspeed_tpu.runtime.checkpoint_engine.safe_engine import PreemptionHandler
+        if self._preemption is not None:
+            self._preemption.uninstall()
+        kwargs = {} if signals is None else {"signals": tuple(signals)}
+        self._preemption = PreemptionHandler(
+            self, save_dir, exit_on_signal=exit_on_signal, **kwargs).install()
+        return self._preemption
+
+    def disable_preemption_handler(self):
+        if self._preemption is not None:
+            self._preemption.uninstall()
+            self._preemption = None
+
+    def _checkpoint_writer(self):
+        """Lazy per-engine async writer; failures feed checkpoint metrics
+        and the health observatory's ckpt_failure detector."""
+        if self._ckpt_writer is None:
+            from deepspeed_tpu.runtime.checkpoint_engine.engine import (
+                _checkpoint_cfg, _notify_ckpt_result)
+            from deepspeed_tpu.runtime.checkpoint_engine.safe_engine import AsyncCheckpointWriter
+            ccfg = _checkpoint_cfg(self)
+            self._ckpt_writer = AsyncCheckpointWriter(
+                max_pending=ccfg.max_pending,
+                retries=ccfg.retries,
+                retry_backoff_s=ccfg.retry_backoff_s,
+                keep_last=ccfg.keep_last,
+                on_result=lambda ok, steps: _notify_ckpt_result(self, ok, steps))
+        return self._ckpt_writer
+
+    def _fast_forward_data(self, iterations):
+        """Advance the data pipeline past ``iterations`` already-consumed
+        train_batch calls (``iterations * gas`` micro-batches) so resume
+        neither replays nor skips batches. Works on the engine's standing
+        ``set_dataiterator`` iterator (advanced in place — re-create it
+        fresh before auto_resume) or on ``training_dataloader`` (epoch
+        seed + in-epoch position recomputed, then a standing iterator that
+        rolls over epochs is installed)."""
+        micro = int(iterations) * self.gradient_accumulation_steps()
+        if micro <= 0:
+            return
+        it = getattr(self, "_data_iterator", None)
+        # a loader-derived standing iterator (set_dataloader / train_batch's
+        # auto-install) is NOT advanced in place: it is a plain single-epoch
+        # iter that StopIterations past the first epoch and knows nothing of
+        # shuffle-seed replay — the loader path below re-creates it at the
+        # right position instead
+        if it is not None and (getattr(self, "_data_iter_external", False)
+                               or self.training_dataloader is None):
+            for _ in range(micro):
+                next(it)
+            log_dist(f"auto_resume: fast-forwarded data iterator by "
+                     f"{micro} micro-batches", ranks=[0])
+            return
+        if self.training_dataloader is None:
+            logger.warning(
+                f"auto_resume: {micro} micro-batches of recorded progress "
+                f"but no engine-owned data pipeline to fast-forward; pass a "
+                f"freshly-created iterator via set_dataiterator BEFORE "
+                f"auto_resume, or expect replayed batches")
+            return
+        from deepspeed_tpu.runtime.dataloader import resume_loader_iterator
+        self._data_iterator = resume_loader_iterator(
+            self.training_dataloader, micro)
+        self._data_iter_external = False
+        log_dist(f"auto_resume: dataloader fast-forwarded by {micro} "
+                 f"micro-batches", ranks=[0])

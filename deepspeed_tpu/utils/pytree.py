@@ -13,13 +13,23 @@ def leaf_key(path) -> str:
     return ".".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
 
 
-def leaf_paths(tree: Any, prefix: str = "") -> Dict[str, Any]:
+def leaf_paths(tree: Any, prefix: str = "", *,
+               descend_sequences: bool = False) -> Dict[str, Any]:
     """Flatten a nested dict tree into {'a.b.c': leaf} (same naming as
-    :func:`leaf_key` for dict-only trees)."""
+    :func:`leaf_key` for dict-only trees). With ``descend_sequences``,
+    list/tuple nodes flatten too, their indices as key segments
+    ({'a.0.c': leaf}) — the checkpoint on-disk key scheme; the default
+    keeps sequences as leaves (an array-valued state_dict entry is one
+    leaf, not a container)."""
     out: Dict[str, Any] = {}
     if isinstance(tree, dict):
-        for k, v in tree.items():
-            out.update(leaf_paths(v, prefix + str(k) + "."))
+        items = list(tree.items())
+    elif descend_sequences and isinstance(tree, (list, tuple)):
+        items = list(enumerate(tree))
     else:
         out[prefix[:-1]] = tree
+        return out
+    for k, v in items:
+        out.update(leaf_paths(v, prefix + str(k) + ".",
+                              descend_sequences=descend_sequences))
     return out

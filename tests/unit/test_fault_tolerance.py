@@ -1,0 +1,675 @@
+"""Fault-tolerant training: crash-safe two-phase checkpointing, verified
+auto-resume, preemption handling, and the deterministic fault-injection
+harness (utils/fault_injection.py) that drives this suite.
+
+Every test here carries the ``chaos`` marker; the cases below are the fast
+tier-1 set (heavier sweeps ride the slow tier)."""
+
+import errno
+import json
+import os
+import signal
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.models.transformer import TransformerConfig
+from deepspeed_tpu.runtime.checkpoint_engine import safe_engine
+from deepspeed_tpu.runtime.checkpoint_engine.engine import CheckpointCorruptError
+from deepspeed_tpu.runtime.checkpoint_engine.safe_engine import (
+    CheckpointWriteError, MANIFEST, STATE_FILE)
+from deepspeed_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.chaos
+
+VOCAB, SEQ = 64, 16
+
+
+def _batch(i):
+    rng = np.random.default_rng(1000 + i)
+    return {"input_ids": rng.integers(0, VOCAB, (8, SEQ)).astype(np.int32)}
+
+
+def _make_engine(extra_config=None):
+    cfg = TransformerConfig(vocab_size=VOCAB, n_layer=2, n_head=2, d_model=32,
+                            d_ff=64, max_seq=SEQ, remat=False)
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.key(0))
+    dist.set_mesh(None)
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"dp": -1},
+        "steps_per_print": 0,
+        "checkpoint": {"retries": 2, "retry_backoff_s": 0.0},
+    }
+    config.update(extra_config or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=config)
+    return engine
+
+
+@pytest.fixture(scope="module")
+def engine(devices):
+    e = _make_engine()
+    e.train_batch(_batch(0))     # one compile up front, shared by the module
+    yield e
+    e.destroy()
+
+
+@pytest.fixture(scope="module")
+def engine_b(devices):
+    """A second engine for resume tests (its own jit cache — resume must be
+    exact across a fresh process-equivalent, not via a shared executable)."""
+    e = _make_engine()
+    yield e
+    e.destroy()
+
+
+def _tag_total_bytes(tag_dir):
+    return sum(os.path.getsize(os.path.join(tag_dir, f))
+               for f in os.listdir(tag_dir))
+
+
+# --------------------------------------------------------------------- #
+# atomic commit + the `latest` ordering regression
+
+
+class TestAtomicCommit:
+
+    def test_crash_mid_write_leaves_latest_and_previous_intact(self, engine, tmp_path):
+        """Regression for the pre-refactor bug: `latest` was plain-written
+        BEFORE commit, so a crash mid-save left it pointing at an
+        uncommitted tag. Now: crash mid-write => no tag dir at all, latest
+        untouched, previous tag verifies intact."""
+        d = str(tmp_path)
+        engine.save_checkpoint(d, tag="t1")
+        assert (tmp_path / "latest").read_text() == "t1"
+
+        with pytest.raises(fi.SimulatedCrash):
+            with fi.inject(fi.FaultInjector(kill_at_byte=200)):
+                engine.save_checkpoint(d, tag="t2")
+
+        assert not (tmp_path / "t2").exists()          # nothing half-published
+        assert (tmp_path / "latest").read_text() == "t1"
+        assert safe_engine.verify_tag(str(tmp_path / "t1")).intact
+
+    @pytest.mark.parametrize("frac", [0.01, 0.5, 0.99])
+    def test_kill_at_byte_offset_then_auto_resume(self, engine, tmp_path, frac):
+        """Kill the write stream at several byte offsets (early/mid state,
+        inside the manifest near the end): auto_resume always lands on the
+        previous intact tag."""
+        d = str(tmp_path)
+        engine.save_checkpoint(d, tag="good")
+        saved_step = engine.global_steps
+        total = _tag_total_bytes(str(tmp_path / "good"))
+
+        with pytest.raises(fi.SimulatedCrash):
+            with fi.inject(fi.FaultInjector(kill_at_byte=int(total * frac))):
+                engine.save_checkpoint(d, tag="partial")
+
+        assert not (tmp_path / "partial").exists()
+        path, _ = engine.auto_resume(d)
+        assert path is not None and path.endswith("good")
+        assert engine.global_steps == saved_step
+
+    def test_latest_pointer_never_moves_backward(self, tmp_path):
+        """A straggling async job committing AFTER a later save (e.g. a sync
+        emergency save that gave up draining the writer) must not move
+        `latest` back to the older tag: the straggler's tag is kept on disk
+        but the pointer only ever advances."""
+        d = str(tmp_path)
+        arr = {"w": np.arange(3.0)}
+        safe_engine.write_tag(d, safe_engine.CheckpointPayload(
+            tag="sync12", arrays=arr, meta={"global_steps": 12}, global_steps=12))
+        assert (tmp_path / "latest").read_text() == "sync12"
+
+        # the straggler: an older-step tag commits afterwards
+        safe_engine.write_tag(d, safe_engine.CheckpointPayload(
+            tag="async10", arrays=arr, meta={"global_steps": 10}, global_steps=10))
+        assert (tmp_path / "latest").read_text() == "sync12"
+        assert safe_engine.verify_tag(str(tmp_path / "async10")).intact
+
+        # a genuinely newer save still advances the pointer
+        safe_engine.write_tag(d, safe_engine.CheckpointPayload(
+            tag="sync14", arrays=arr, meta={"global_steps": 14}, global_steps=14))
+        assert (tmp_path / "latest").read_text() == "sync14"
+
+    def test_tmp_debris_swept_by_retention_gc(self, engine, tmp_path):
+        d = str(tmp_path)
+        engine.save_checkpoint(d, tag="a")
+        with pytest.raises(fi.SimulatedCrash):
+            with fi.inject(fi.FaultInjector(kill_at_byte=100)):
+                engine.save_checkpoint(d, tag="b")
+        assert (tmp_path / ".tmp.b").exists()
+        engine._config.checkpoint_config.keep_last = 4
+        try:
+            engine.save_checkpoint(d, tag="c")
+        finally:
+            engine._config.checkpoint_config.keep_last = 0
+        assert not (tmp_path / ".tmp.b").exists()
+
+    def test_interrupted_overwrite_recovered_not_swept(self, tmp_path):
+        """Overwriting an existing tag parks the old copy at <tag>.old
+        before renaming the new one into place; a crash between those two
+        renames leaves the tag missing with BOTH survivors on disk. They
+        must be promoted back (newest complete copy wins), never deleted
+        as debris."""
+        d = str(tmp_path)
+        mk = lambda v, step: safe_engine.CheckpointPayload(
+            tag="t", arrays={"w": np.full(4, float(v))},
+            meta={"v": v}, global_steps=step)
+        safe_engine.write_tag(d, mk(1, 1))
+        # rebuild the exact crash-window state: old copy parked aside, new
+        # fully-written copy still under its temp name, tag dir missing
+        os.replace(str(tmp_path / "t"), str(tmp_path / "t.old"))
+        safe_engine.write_tag(d, mk(2, 2))
+        os.replace(str(tmp_path / "t"), str(tmp_path / ".tmp.t"))
+
+        recovered = safe_engine.recover_interrupted(d)
+        assert recovered == ["t"]
+        rep = safe_engine.verify_tag(str(tmp_path / "t"))
+        assert rep.intact
+        flat = safe_engine.read_npz(str(tmp_path / "t" / STATE_FILE))
+        assert flat["w"][0] == 2.0            # the newer complete copy won
+        # retention GC sweeps the leftover .old without touching the tag
+        safe_engine.gc_tags(d, keep_last=4)
+        assert not (tmp_path / "t.old").exists()
+        assert safe_engine.verify_tag(str(tmp_path / "t")).intact
+
+    def test_parked_old_copy_restored_when_tmp_unusable(self, tmp_path):
+        """Defensive half of the recovery: only the parked .old copy
+        survives (or the temp copy is incomplete) — restore it rather than
+        sweeping it."""
+        d = str(tmp_path)
+        payload = safe_engine.CheckpointPayload(
+            tag="t", arrays={"w": np.ones(4)}, meta={}, global_steps=1)
+        safe_engine.write_tag(d, payload)
+        os.replace(str(tmp_path / "t"), str(tmp_path / "t.old"))
+        (tmp_path / ".tmp.t").mkdir()          # incomplete: no manifest
+        assert safe_engine.recover_interrupted(d) == ["t"]
+        assert safe_engine.verify_tag(str(tmp_path / "t")).intact
+
+
+# --------------------------------------------------------------------- #
+# manifest verification + walk-back
+
+
+class TestVerifyAndWalkBack:
+
+    def _three_tags(self, engine, tmp_path):
+        d = str(tmp_path)
+        engine.save_checkpoint(d, tag="t1")
+        engine.train_batch(_batch(1))
+        engine.save_checkpoint(d, tag="t2")
+        engine.train_batch(_batch(2))
+        engine.save_checkpoint(d, tag="t3")
+        return d
+
+    def test_bit_flip_every_manifest_entry_is_caught(self, engine, tmp_path):
+        d = self._three_tags(engine, tmp_path)
+        tag_dir = os.path.join(d, "t3")
+        with open(os.path.join(tag_dir, MANIFEST)) as f:
+            listed = list(json.load(f)["files"])
+        assert STATE_FILE in listed and "meta.json" in listed
+        for name in listed:
+            path = os.path.join(tag_dir, name)
+            idx = fi.bit_flip(path)
+            rep = safe_engine.verify_tag(tag_dir)
+            assert not rep.intact
+            assert any(name in e for e in rep.errors), (name, rep.errors)
+            fi.bit_flip(path, byte_index=idx)        # flip back
+        # the manifest itself is also covered: corrupting it kills the tag
+        idx = fi.bit_flip(os.path.join(tag_dir, MANIFEST))
+        assert not safe_engine.verify_tag(tag_dir).intact
+        fi.bit_flip(os.path.join(tag_dir, MANIFEST), byte_index=idx)
+        assert safe_engine.verify_tag(tag_dir).intact
+
+    def test_walk_back_to_newest_intact(self, engine, tmp_path):
+        d = self._three_tags(engine, tmp_path)
+        t2_step_meta = json.load(open(os.path.join(d, "t2", "meta.json")))
+        fi.bit_flip(os.path.join(d, "t3", STATE_FILE))
+        path, _ = engine.auto_resume(d)
+        assert path.endswith("t2")
+        assert engine.global_steps == t2_step_meta["global_steps"]
+
+    def test_all_corrupt_raises_never_silent(self, engine, tmp_path):
+        d = self._three_tags(engine, tmp_path)
+        for t in ("t1", "t2", "t3"):
+            fi.bit_flip(os.path.join(d, t, STATE_FILE))
+        with pytest.raises(CheckpointCorruptError):
+            engine.auto_resume(d)
+
+    def test_corrupt_explicit_tag_is_all_or_nothing(self, engine, tmp_path):
+        """A corrupt tail must never leave a half-restored engine: state,
+        counters, and rng are bit-identical to before the failed load."""
+        d = str(tmp_path)
+        engine.save_checkpoint(d, tag="bad")
+        fi.bit_flip(os.path.join(d, "bad", "meta.json"))
+
+        w_before = np.asarray(engine.state.params["embed"]["tokens"]).copy()
+        steps_before = engine.global_steps
+        rng_before = np.asarray(jax.random.key_data(engine._rng)).copy()
+        with pytest.raises(CheckpointCorruptError):
+            engine.load_checkpoint(d, tag="bad")
+        np.testing.assert_array_equal(
+            np.asarray(engine.state.params["embed"]["tokens"]), w_before)
+        assert engine.global_steps == steps_before
+        np.testing.assert_array_equal(
+            np.asarray(jax.random.key_data(engine._rng)), rng_before)
+
+    def test_strict_flag(self, engine, tmp_path):
+        empty = str(tmp_path / "empty")
+        os.makedirs(empty)
+        # default: the historical silent (None, {})
+        assert engine.load_checkpoint(empty) == (None, {})
+        with pytest.raises(FileNotFoundError):
+            engine.load_checkpoint(empty, strict=True)
+        with pytest.raises(FileNotFoundError):
+            engine.load_checkpoint(empty, tag="nope", strict=True)
+
+
+# --------------------------------------------------------------------- #
+# injected I/O errors: retry-with-backoff, clean failure
+
+
+class TestIOFaults:
+
+    def test_transient_enospc_retries_to_success(self, engine, tmp_path):
+        d = str(tmp_path)
+        inj = fi.FaultInjector().fail_writes(errno.ENOSPC, count=1)
+        with fi.inject(inj):
+            engine.save_checkpoint(d, tag="t")      # retry budget = 2
+        assert inj.writes_seen > 0
+        assert safe_engine.verify_tag(str(tmp_path / "t")).intact
+        assert (tmp_path / "latest").read_text() == "t"
+
+    def test_persistent_eio_fails_cleanly(self, engine, tmp_path):
+        from deepspeed_tpu.monitor.metrics import get_registry
+        d = str(tmp_path)
+        engine.save_checkpoint(d, tag="ok")
+        reg = get_registry()
+        was_enabled = reg.enabled
+        reg.set_enabled(True)
+        try:
+            fails0 = reg.counter("checkpoint/failures").value
+            with fi.inject(fi.FaultInjector().fail_writes(errno.EIO, count=-1)):
+                with pytest.raises(CheckpointWriteError):
+                    engine.save_checkpoint(d, tag="doomed")
+            assert reg.counter("checkpoint/failures").value == fails0 + 1
+        finally:
+            reg.set_enabled(was_enabled)
+        # flaky storage must not cost the previous recovery point
+        assert (tmp_path / "latest").read_text() == "ok"
+        assert safe_engine.verify_tag(str(tmp_path / "ok")).intact
+        path, _ = engine.auto_resume(d)
+        assert path.endswith("ok")
+
+
+# --------------------------------------------------------------------- #
+# the async two-phase writer
+
+
+class TestAsyncWriter:
+
+    def test_async_commit_matches_sync(self, engine, tmp_path):
+        da, ds_ = str(tmp_path / "a"), str(tmp_path / "s")
+        engine.save_checkpoint(da, tag="t", asynchronous=True)
+        engine.flush_checkpoints()
+        engine.save_checkpoint(ds_, tag="t", asynchronous=False)
+        assert safe_engine.verify_tag(os.path.join(da, "t")).intact
+        fa = safe_engine.read_npz(os.path.join(da, "t", STATE_FILE))
+        fs = safe_engine.read_npz(os.path.join(ds_, "t", STATE_FILE))
+        assert set(fa) == set(fs)
+        for k in fa:
+            np.testing.assert_array_equal(fa[k], fs[k])
+
+    def test_async_failure_surfaces_on_flush(self, engine, tmp_path):
+        d = str(tmp_path)
+        engine.save_checkpoint(d, tag="ok")
+        with fi.inject(fi.FaultInjector().fail_writes(errno.ENOSPC, count=-1)):
+            engine.save_checkpoint(d, tag="doomed", asynchronous=True)
+            with pytest.raises(CheckpointWriteError):
+                engine.flush_checkpoints()
+        assert (tmp_path / "latest").read_text() == "ok"
+        assert not (tmp_path / "doomed").exists()
+
+    def test_async_crash_mid_write(self, engine, tmp_path):
+        d = str(tmp_path)
+        engine.save_checkpoint(d, tag="ok")
+        with fi.inject(fi.FaultInjector(kill_at_byte=500)):
+            engine.save_checkpoint(d, tag="dead", asynchronous=True)
+            with pytest.raises(fi.SimulatedCrash):
+                engine.flush_checkpoints()
+        assert not (tmp_path / "dead").exists()
+        assert engine.auto_resume(d)[0].endswith("ok")
+
+    def test_bounded_queue_and_delayed_writes(self, engine, tmp_path):
+        d = str(tmp_path)
+        with fi.inject(fi.FaultInjector(delay_per_write_s=0.02)):
+            for i in range(3):
+                engine.save_checkpoint(d, tag=f"q{i}", asynchronous=True)
+            depth = engine._ckpt_writer.queue_depth
+            engine.flush_checkpoints()
+        assert depth >= 1                    # writer genuinely lagged
+        assert engine._ckpt_writer.queue_depth == 0
+        for i in range(3):
+            assert safe_engine.verify_tag(str(tmp_path / f"q{i}")).intact
+        assert (tmp_path / "latest").read_text() == "q2"
+
+
+# --------------------------------------------------------------------- #
+# retention
+
+
+class TestRetention:
+
+    def test_keep_last_never_gcs_latest(self, engine, tmp_path):
+        d = str(tmp_path)
+        engine._config.checkpoint_config.keep_last = 2
+        try:
+            for i in range(4):
+                engine.train_batch(_batch(10 + i))
+                engine.save_checkpoint(d, tag=f"global_step{engine.global_steps}")
+        finally:
+            engine._config.checkpoint_config.keep_last = 0
+        tags = sorted(t for t in os.listdir(d)
+                      if os.path.isdir(os.path.join(d, t)))
+        assert len(tags) == 2
+        latest = (tmp_path / "latest").read_text()
+        assert latest in tags
+        for t in tags:
+            assert safe_engine.verify_tag(os.path.join(d, t)).intact
+
+    def test_gc_protects_newest_verified_tag(self, engine, tmp_path):
+        """Corruption ages in: when every tag inside the retention window
+        is corrupt, the GC must keep the newest tag that actually verifies,
+        however old — the run's last real recovery point."""
+        d = str(tmp_path)
+        for tag in ("t1", "t2", "t3"):
+            engine.train_batch(_batch(20))
+            engine.save_checkpoint(d, tag=tag)
+        fi.bit_flip(os.path.join(d, "t2", STATE_FILE))
+        fi.bit_flip(os.path.join(d, "t3", STATE_FILE))
+        deleted = safe_engine.gc_tags(d, keep_last=1)
+        assert "t1" not in deleted                      # newest VERIFIED tag
+        assert os.path.isdir(os.path.join(d, "t1"))
+        assert safe_engine.verify_tag(os.path.join(d, "t1")).intact
+        assert "t2" in deleted                          # corrupt, not latest
+        assert os.path.isdir(os.path.join(d, "t3"))     # latest target kept
+
+
+# --------------------------------------------------------------------- #
+# preemption (SIGTERM/SIGINT grace)
+
+
+class TestPreemption:
+
+    def test_sigterm_takes_emergency_save_and_exits(self, engine, tmp_path):
+        d = str(tmp_path)
+        engine.enable_preemption_handler(d)
+        try:
+            with pytest.raises(SystemExit) as ei:
+                os.kill(os.getpid(), signal.SIGTERM)
+                # the handler runs at the next bytecode boundary
+                for _ in range(100):
+                    time.sleep(0.01)
+            assert ei.value.code == 128 + signal.SIGTERM
+        finally:
+            engine.disable_preemption_handler()
+        # handler restored the previous disposition before exiting
+        assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+        rep = safe_engine.newest_intact_tag(d)
+        assert rep is not None and rep.global_steps == engine.global_steps
+        path, _ = engine.auto_resume(d)
+        assert path is not None
+
+    def test_sigint_covered_and_uninstall(self, engine, tmp_path):
+        d = str(tmp_path)
+        h = engine.enable_preemption_handler(d, exit_on_signal=False)
+        try:
+            os.kill(os.getpid(), signal.SIGINT)
+            for _ in range(100):
+                time.sleep(0.01)
+                if safe_engine.newest_intact_tag(d) is not None:
+                    break
+        finally:
+            engine.disable_preemption_handler()
+        assert safe_engine.newest_intact_tag(d) is not None
+        # uninstalled: a later SIGINT raises KeyboardInterrupt as usual
+        assert engine._preemption is None
+
+
+# --------------------------------------------------------------------- #
+# THE acceptance pin: crash/resume loss-curve identity
+
+
+class TestResumeIdentity:
+
+    def test_loss_curve_identity_after_resume(self, engine, engine_b, tmp_path):
+        """Save mid-run (async), 'crash', auto-resume into a FRESH engine:
+        the resumed loss sequence is bit-identical to the uninterrupted
+        run — params, optimizer, loss-scaler, RNG stream, and counters all
+        restored exactly."""
+        d = str(tmp_path)
+        for i in range(2):
+            engine.train_batch(_batch(50 + i))
+        engine.save_checkpoint(d, asynchronous=True)
+        engine.flush_checkpoints()
+        uninterrupted = [float(engine.train_batch(_batch(60 + i)))
+                         for i in range(3)]
+
+        path, _ = engine_b.auto_resume(d)
+        assert path is not None
+        assert engine_b.global_steps == engine.global_steps - 3
+        resumed = [float(engine_b.train_batch(_batch(60 + i)))
+                   for i in range(3)]
+        assert resumed == uninterrupted, (resumed, uninterrupted)
+
+    def test_dataloader_fast_forward_identity(self, engine, engine_b, tmp_path):
+        """The data-pipeline satellite: meta.json records consumed
+        samples/iterations and auto_resume fast-forwards the standing
+        iterator, so resume neither replays nor skips batches."""
+        d = str(tmp_path)
+
+        def stream():
+            i = 0
+            while True:
+                yield _batch(100 + i)
+                i += 1
+
+        engine.set_dataiterator(stream())
+        for _ in range(3):
+            engine.train_batch()
+        engine.save_checkpoint(d)
+        assert engine._data_progress["iterations"] == 3
+        uninterrupted = [float(engine.train_batch()) for _ in range(2)]
+        engine.set_dataiterator(None)
+
+        engine_b.set_dataiterator(stream())           # fresh stream, batch 0
+        path, _ = engine_b.auto_resume(d)
+        assert path is not None
+        assert engine_b._data_progress["iterations"] == 3
+        resumed = [float(engine_b.train_batch()) for _ in range(2)]
+        engine_b.set_dataiterator(None)
+        assert resumed == uninterrupted, (resumed, uninterrupted)
+
+    def test_engine_owned_dataloader_resume_identity(self, devices, tmp_path):
+        """The engine-owned ``training_data`` pipeline is a standing stream
+        rolling over epochs; auto_resume reconstructs it at the recorded
+        position, so this path is loss-identical too (regression: the old
+        fresh-iter-per-call fallback replayed the epoch head forever and
+        could never resume exactly)."""
+        d = str(tmp_path)
+        rng = np.random.default_rng(77)
+        data = [{"input_ids": rng.integers(0, VOCAB, (SEQ,)).astype(np.int32)}
+                for _ in range(24)]
+        cfg = TransformerConfig(vocab_size=VOCAB, n_layer=2, n_head=2,
+                                d_model=32, d_ff=64, max_seq=SEQ, remat=False)
+        config = {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 1}, "mesh": {"dp": -1},
+            "steps_per_print": 0,
+        }
+
+        def make():
+            model = CausalLM(cfg)
+            params = model.init_params(jax.random.key(0))
+            dist.set_mesh(None)
+            e, _, _, _ = deepspeed_tpu.initialize(
+                model=model, model_parameters=params, config=config,
+                training_data=data)
+            return e
+
+        a = make()
+        try:
+            for _ in range(2):
+                a.train_batch()
+            a.save_checkpoint(d)
+            uninterrupted = [float(a.train_batch()) for _ in range(3)]
+        finally:
+            a.destroy()
+
+        b = make()
+        try:
+            path, _ = b.auto_resume(d)
+            assert path is not None
+            resumed = [float(b.train_batch()) for _ in range(3)]
+        finally:
+            b.destroy()
+        assert resumed == uninterrupted, (resumed, uninterrupted)
+
+    def test_set_dataloader_resume_rolls_past_epoch(self, devices, tmp_path):
+        """Regression: auto_resume on a set_dataloader pipeline used to
+        advance the loader's plain single-epoch iterator in place, so
+        recorded progress past one epoch crashed with StopIteration (after
+        engine.state was already restored). The loader-derived iterator now
+        takes the epoch-aware resume_loader_iterator path instead."""
+        d = str(tmp_path)
+        rng = np.random.default_rng(55)
+        data = [{"input_ids": rng.integers(0, VOCAB, (SEQ,)).astype(np.int32)}
+                for _ in range(24)]          # 3 micro-batches/epoch at bs 8
+        cfg = TransformerConfig(vocab_size=VOCAB, n_layer=2, n_head=2,
+                                d_model=32, d_ff=64, max_seq=SEQ, remat=False)
+        config = {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 1}, "mesh": {"dp": -1},
+            "steps_per_print": 0,
+        }
+
+        def make(training_data=None):
+            model = CausalLM(cfg)
+            params = model.init_params(jax.random.key(0))
+            dist.set_mesh(None)
+            e, _, _, _ = deepspeed_tpu.initialize(
+                model=model, model_parameters=params, config=config,
+                training_data=training_data)
+            return e
+
+        a = make(training_data=data)
+        try:
+            for _ in range(4):               # 4 micros: one epoch + 1
+                a.train_batch()
+            a.save_checkpoint(d)
+            uninterrupted = [float(a.train_batch()) for _ in range(2)]
+        finally:
+            a.destroy()
+
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+        b = make()
+        try:
+            b.set_dataloader(DeepSpeedDataLoader(data, batch_size=8))
+            path, _ = b.auto_resume(d)       # must not StopIteration
+            assert path is not None
+            resumed = [float(b.train_batch()) for _ in range(2)]
+        finally:
+            b.destroy()
+        assert resumed == uninterrupted, (resumed, uninterrupted)
+
+    def test_meta_records_data_progress(self, engine, tmp_path):
+        d = str(tmp_path)
+        before = dict(engine._data_progress)
+        engine.save_checkpoint(d, tag="p")
+        meta = json.load(open(os.path.join(d, "p", "meta.json")))
+        assert meta["data_progress"]["iterations"] == before["iterations"]
+        assert meta["data_progress"]["consumed_samples"] == before["consumed_samples"]
+
+
+class TestDataloaderResume:
+
+    def test_resume_loader_iterator_positions_exactly(self):
+        from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                                      RepeatingLoader,
+                                                      resume_loader_iterator)
+        data = [np.array([i]) for i in range(12)]
+
+        ref_loader = DeepSpeedDataLoader(data, batch_size=4, shuffle=True, seed=7)
+        ref = RepeatingLoader(ref_loader)
+        stream = [next(ref) for _ in range(9)]        # 3 epochs of 3 batches
+
+        res_loader = DeepSpeedDataLoader(data, batch_size=4, shuffle=True, seed=7)
+        it = resume_loader_iterator(res_loader, consumed_batches=5)
+        got = [next(it) for _ in range(4)]
+        for a, b in zip(got, stream[5:9]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_resume_empty_loader_raises_not_spins(self):
+        """A loader that yields nothing (empty dataset, or an exhausted
+        one-shot generator that iter() cannot restart) must raise instead
+        of busy-looping forever in the fast-forward."""
+        from deepspeed_tpu.runtime.dataloader import resume_loader_iterator
+        it = resume_loader_iterator([], consumed_batches=3)
+        with pytest.raises(RuntimeError, match="no batches"):
+            next(it)
+        one_shot = iter([np.array([0]), np.array([1])])
+        it = resume_loader_iterator(one_shot, consumed_batches=5)
+        with pytest.raises(RuntimeError, match="no batches"):
+            next(it)
+
+
+# --------------------------------------------------------------------- #
+# surfaces: CLI + health detector
+
+
+class TestSurfaces:
+
+    def test_dscli_ckpt_verify(self, engine, tmp_path, capsys):
+        from deepspeed_tpu.cli import _ckpt
+        d = str(tmp_path)
+        engine.save_checkpoint(d, tag="good")
+        engine.save_checkpoint(d, tag="rotten", save_latest=False)
+        assert _ckpt(["verify", d]) == 0
+        fi.bit_flip(os.path.join(d, "rotten", STATE_FILE))
+        rc = _ckpt(["verify", d])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "INTACT" in out and "CORRUPT" in out
+        assert "rotten" in out and "blake2b mismatch" in out
+        assert "<- latest" in out
+
+    def test_health_ckpt_failure_detector(self):
+        from deepspeed_tpu.monitor.config import HealthConfig
+        from deepspeed_tpu.monitor.health import HealthMonitor
+        from deepspeed_tpu.monitor.metrics import MetricsRegistry
+
+        reg = MetricsRegistry(enabled=True)
+        hm = HealthMonitor(HealthConfig(enabled=True, action="record",
+                                        ckpt_failure_consecutive=2),
+                           registry=reg)
+        assert hm.observe_checkpoint(False) == []
+        assert hm.observe_checkpoint(False) == ["ckpt_failure"]
+        assert hm.report()["anomalies"]["ckpt_failure"] == 1
+        # success resets the run; a single later failure does not fire
+        assert hm.observe_checkpoint(True) == []
+        assert hm.observe_checkpoint(False) == []
+        # and the anomaly counter series exists with an explicit value
+        snap = reg.snapshot()
+        assert snap["counters"]['health/anomalies{type="ckpt_failure"}'] == 1
