@@ -76,17 +76,26 @@ def _probe_backend(timeout_s: int = 240):
     """Probe device init in a SUBPROCESS: a dead TPU relay hangs backend
     setup indefinitely inside C++ (uninterruptible in-process), which would
     hang the whole bench run. A bounded probe fails fast instead. Returns
-    None on success, else a diagnostic string."""
+    None on success, else a failure dict: ``{"stage", "summary", "error"}``
+    — the init stage that failed and the actual exception text, so the
+    skip records emitted from it are diagnosable from the JSON alone
+    (ROADMAP r03-r05: relay failures surfaced only as ``parsed: null``)."""
     try:
         r = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
             timeout=timeout_s, capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        return (f"device backend did not initialize within {timeout_s}s "
-                "(hung init — TPU relay down?)")
+    except subprocess.TimeoutExpired as e:
+        return {"stage": "backend_init_timeout",
+                "summary": f"device backend did not initialize within "
+                           f"{timeout_s}s (hung init — TPU relay down?)",
+                "error": str(e)}
     if r.returncode != 0:
         tail = (r.stderr or "").strip().splitlines()[-15:]
-        return "device backend init failed:\n" + "\n".join(tail)
+        return {"stage": "backend_init_error",
+                "summary": f"device backend init failed (rc={r.returncode}): "
+                           + (tail[-1] if tail else "no stderr"),
+                "error": "\n".join(tail),
+                "returncode": r.returncode}
     return None
 
 
@@ -658,18 +667,26 @@ def run_checkpoint_bench():
         shutil.rmtree(save_dir, ignore_errors=True)
 
 
-def _emit_skip_records(err: str):
+def _emit_skip_records(err):
     """One parseable JSON record per enabled metric so the bench trajectory
     is never empty: a dead TPU relay is a data point ("skipped"), not a
-    silent rc=1 hole the driver records as ``parsed: null``."""
-    reason = err.strip().splitlines()[0] if err else "backend probe failed"
+    silent rc=1 hole the driver records as ``parsed: null``. ``err`` is
+    the probe's failure dict (or a bare string from older callers); each
+    record carries the init stage and the ACTUAL exception text so the
+    failure is diagnosable from the JSON alone."""
+    if isinstance(err, str) or err is None:
+        first = (err or "").strip().splitlines() or ["backend probe failed"]
+        err = {"stage": "backend_probe", "summary": first[0],
+               "error": err or ""}
     for name in _enabled_metrics():
         print(json.dumps({
             "metric": name,
             "value": 0.0,
-            "unit": f"tokens/s (skipped: {reason})",
+            "unit": f"tokens/s (skipped: {err['summary']})",
             "vs_baseline": 0.0,
             "skipped": True,
+            "skip_stage": err["stage"],
+            "skip_error": err.get("error", ""),
         }), flush=True)
 
 
@@ -729,7 +746,7 @@ def main():
         retries = int(os.environ.get("BENCH_PROBE_RETRIES", 1))
         err = _probe_backend()
         while err is not None and retries > 0:
-            print(f"bench: probe failed ({err}); retrying in 60s",
+            print(f"bench: probe failed ({err['summary']}); retrying in 60s",
                   file=sys.stderr)
             time.sleep(60)
             retries -= 1
@@ -737,7 +754,8 @@ def main():
         if err is not None:
             # degrade gracefully: parseable skip records (and optionally a
             # CPU smoke metric), rc=0 — never an empty bench round
-            print(f"bench: {err}", file=sys.stderr)
+            print(f"bench: [{err['stage']}] {err['summary']}\n"
+                  f"{err.get('error', '')}", file=sys.stderr)
             _emit_skip_records(err)
             if os.environ.get("BENCH_ALLOW_CPU") == "1":
                 # best effort only: the skip records above are already the

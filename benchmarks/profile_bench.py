@@ -46,7 +46,8 @@ def main():
     if os.environ.get("BENCH_SKIP_PROBE") != "1":
         err = _probe_backend()
         if err is not None:
-            print(f"profile_bench: {err}", file=sys.stderr)
+            print(f"profile_bench: [{err['stage']}] {err['summary']}\n"
+                  f"{err.get('error', '')}", file=sys.stderr)
             sys.exit(1)
 
     import jax

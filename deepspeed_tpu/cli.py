@@ -4,9 +4,11 @@ Subcommands mirror the reference's script family:
 
 - ``dscli run <script> [args...]``  — the ``deepspeed`` launcher CLI
 - ``dscli report [--telemetry f]``  — ``ds_report`` environment/op/memory report
-- ``dscli health <jsonl> [--once]`` — live health screen over a telemetry sink
+- ``dscli health <jsonl> [--once|--json]`` — live health screen over a telemetry sink
 - ``dscli bench``                   — ``ds_bench`` collective micro-benchmarks
 - ``dscli ckpt verify <dir>``       — checkpoint integrity audit (per-tag manifest check)
+- ``dscli trace --validate <path>`` — chrome-trace / events.jsonl schema check
+- ``dscli profile <logdir|trace>``  — summarize a jax.profiler capture / chrome trace
 - ``dscli elastic <config>``        — ``ds_elastic`` elastic-config inspector
 - ``dscli autotune <config>``       — ``deepspeed --autotuning`` config search
 - ``dscli ssh [-f hostfile] cmd``   — ``ds_ssh`` run a command on every host
@@ -94,6 +96,119 @@ def _ckpt(argv):
     return 1 if corrupt else 0
 
 
+def _load_validator():
+    """Load ``tools/validate_trace.py`` (repo-level tool, not a package
+    module — the same file CI runs standalone) by path."""
+    import importlib.util
+    import os
+
+    import deepspeed_tpu
+    path = os.path.abspath(os.path.join(
+        os.path.dirname(deepspeed_tpu.__file__), "..", "tools",
+        "validate_trace.py"))
+    if not os.path.isfile(path):
+        raise RuntimeError(
+            f"tools/validate_trace.py not found at {path} (run from a "
+            "source checkout, or invoke the script directly)")
+    spec = importlib.util.spec_from_file_location("validate_trace", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _trace(argv):
+    """Trace tooling. ``--validate <path>...`` schema-checks chrome-trace
+    JSON / flight-recorder events.jsonl exports (rc=1 on violations)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="dscli trace",
+        description="chrome-trace / events.jsonl schema validation")
+    parser.add_argument("--validate", nargs="+", metavar="PATH",
+                        required=True, help="file(s) to validate")
+    parser.add_argument("--kind", choices=("auto", "chrome", "events"),
+                        default="auto")
+    args = parser.parse_args(argv)
+    return _load_validator().main(["--kind", args.kind] + args.validate)
+
+
+def _profile(argv):
+    """Summarize a profiling artifact: a ``jax.profiler`` capture dir
+    (``telemetry.profile`` / ``engine.profile(steps=N)``) — run inventory
+    plus how to open it — or a chrome-trace JSON (``export_trace`` /
+    ``export_serving_trace``) — per-span statistics."""
+    import argparse
+    import json as _json
+    import os
+
+    parser = argparse.ArgumentParser(
+        prog="dscli profile",
+        description="summarize a jax.profiler logdir or chrome-trace JSON")
+    parser.add_argument("path", help="profiler logdir or trace .json")
+    parser.add_argument("--top", type=int, default=20,
+                        help="spans to show for a chrome trace (default 20)")
+    args = parser.parse_args(argv)
+    path = os.path.abspath(args.path)
+
+    if os.path.isfile(path):
+        # chrome-trace JSON: per-name span statistics
+        try:
+            with open(path) as f:
+                doc = _json.load(f)
+            events = doc.get("traceEvents", [])
+        except ValueError:
+            print(f"{path}: not JSON (for xplane.pb captures pass the "
+                  "logdir, then open it in TensorBoard/xprof)")
+            return 1
+        spans = {}
+        for ev in events:
+            if ev.get("ph") == "X" and isinstance(ev.get("dur"), (int, float)):
+                s = spans.setdefault(ev.get("name", "?"),
+                                     {"n": 0, "total_us": 0.0, "max_us": 0.0})
+                s["n"] += 1
+                s["total_us"] += ev["dur"]
+                s["max_us"] = max(s["max_us"], ev["dur"])
+        if not spans:
+            print(f"{path}: no complete (ph=X) spans")
+            return 1
+        print(f"{path}: {sum(s['n'] for s in spans.values())} spans, "
+              f"{len(spans)} names")
+        print(f"{'name':<32} {'count':>7} {'total ms':>10} {'mean ms':>9} "
+              f"{'max ms':>9}")
+        ranked = sorted(spans.items(), key=lambda kv: -kv[1]["total_us"])
+        for name, s in ranked[:args.top]:
+            print(f"{name[:32]:<32} {s['n']:>7} {s['total_us'] / 1e3:>10.2f} "
+                  f"{s['total_us'] / s['n'] / 1e3:>9.3f} "
+                  f"{s['max_us'] / 1e3:>9.3f}")
+        if len(ranked) > args.top:
+            print(f"... {len(ranked) - args.top} more (raise --top)")
+        return 0
+
+    if not os.path.isdir(path):
+        print(f"{path}: no such file or directory")
+        return 1
+    # jax.profiler logdir: TensorBoard layout <dir>/plugins/profile/<run>/
+    runs_root = os.path.join(path, "plugins", "profile")
+    runs = sorted(os.listdir(runs_root)) if os.path.isdir(runs_root) else []
+    if not runs:
+        print(f"{path}: no profiler runs under plugins/profile/ — capture "
+              "one with engine.profile(steps=N) or telemetry.profile")
+        return 1
+    print(f"{path}: {len(runs)} profiler run(s)")
+    for run in runs:
+        rdir = os.path.join(runs_root, run)
+        files = sorted(os.listdir(rdir))
+        total = sum(os.path.getsize(os.path.join(rdir, f)) for f in files)
+        hosts = sorted({f.split(".")[0] for f in files if ".xplane.pb" in f})
+        print(f"  {run}: {len(files)} file(s), {total / 1e6:.1f} MB"
+              + (f", hosts: {', '.join(hosts)}" if hosts else ""))
+        for f in files:
+            print(f"    {f}")
+    print("open with: tensorboard --logdir", path,
+          " (Profile tab), or xprof")
+    return 0
+
+
 def _elastic(argv):
     import argparse
     import json
@@ -175,14 +290,15 @@ def _dlts_hostfile():
 
 
 _COMMANDS = {"run": _run, "report": _report, "health": _health, "bench": _bench,
-             "ckpt": _ckpt, "elastic": _elastic, "autotune": _autotune,
-             "ssh": _ssh}
+             "ckpt": _ckpt, "trace": _trace, "profile": _profile,
+             "elastic": _elastic, "autotune": _autotune, "ssh": _ssh}
 
 
 def main():
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
         print(__doc__)
-        print("usage: dscli {run|report|health|bench|ckpt|elastic|autotune|ssh} [args...]")
+        print("usage: dscli {run|report|health|bench|ckpt|trace|profile|"
+              "elastic|autotune|ssh} [args...]")
         return 0
     cmd = sys.argv[1]
     if cmd not in _COMMANDS:

@@ -196,7 +196,9 @@ class DeepSpeedInferenceConfig(ConfigModel):
         if "dtype" in data and data["dtype"] is not None:
             data["dtype"] = DtypeEnum.from_any(data["dtype"])
         if "telemetry" in data and not isinstance(data["telemetry"],
-                                                  (dict, TelemetryConfig)):
+                                                  TelemetryConfig):
+            # dicts too: the sub-blocks (health/events) accept bool and
+            # "on"/"off" shorthands only get_telemetry_config understands
             from deepspeed_tpu.monitor.config import get_telemetry_config
             data["telemetry"] = get_telemetry_config(
                 {"telemetry": data["telemetry"]})

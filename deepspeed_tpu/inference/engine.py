@@ -21,6 +21,7 @@ the decode step is one compiled program with a donated KV cache.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -323,6 +324,11 @@ class InferenceEngine:
         tcfg = getattr(self._config, "telemetry", None)
         self._telemetry = tcfg if tcfg is not None and tcfg.enabled else None
         self._serving_tel = None
+        # flight recorder: None when off, so every hot-path emit site in
+        # generate_batch (and the scheduler it constructs) gates at one
+        # None check and allocates nothing
+        self._events = None
+        self._serve_rid_base = 0   # rids unique across generate_batch calls
         if self._telemetry is not None:
             from deepspeed_tpu.inference.scheduler import ServingTelemetry
             from deepspeed_tpu.monitor.metrics import get_registry
@@ -333,6 +339,10 @@ class InferenceEngine:
             self._tel_watchdog = get_compile_watchdog()
             self._tel_watchdog.storm_threshold = tcfg.compile_storm_threshold
             self._serving_tel = ServingTelemetry(reg)
+            if tcfg.events.enabled:
+                from deepspeed_tpu.monitor.events import get_flight_recorder
+                self._events = get_flight_recorder().enable(
+                    capacity=tcfg.events.capacity)
 
         log_dist(f"InferenceEngine ready: dtype={self.dtype.__name__}, tp={tp_size}, "
                  f"mesh={dict(self.mesh.shape)}"
@@ -357,6 +367,23 @@ class InferenceEngine:
         snap = self._tel_reg.snapshot()
         snap["compile"] = self._tel_watchdog.summary()
         return snap
+
+    def export_serving_trace(self, path: str) -> str:
+        """Render the flight recorder's serving events as chrome-trace
+        JSON (open in Perfetto / chrome://tracing): one track per request
+        — its admission→retire span with prefill-chunk / decode-tick /
+        COW child slices and preemption instants — plus queue-depth and
+        KV-block counter tracks, so a whole ``generate_batch`` (or
+        several: rids are unique across calls) is replayable. Requires
+        ``telemetry.events`` on; validate the output with
+        ``dscli trace --validate <path>``."""
+        if self._events is None:
+            raise ValueError(
+                "serving trace export needs the flight recorder: set "
+                "telemetry.events (e.g. telemetry={'events': True}) on "
+                "init_inference")
+        from deepspeed_tpu.monitor.events import export_serving_trace
+        return export_serving_trace(self._events.snapshot(), path)
 
     # ------------------------------------------------------------------ #
 
@@ -987,95 +1014,141 @@ class InferenceEngine:
 
         pools, pools_reused = self._paged_pools(num_blocks, bs)
         alloc = self._paged_allocator(num_blocks, bs, caching, pools_reused)
+        ev = self._events
+        t_serve0 = time.monotonic_ns() if ev is not None else 0
+        if ev is not None:
+            ev.emit("serve.begin", t_ns=t_serve0, requests=len(prompts))
         sched = ContinuousBatchingScheduler(alloc, W, n_max,
                                             telemetry=self._serving_tel,
                                             prefix_caching=caching,
-                                            chunk_tokens=chunk_tokens)
-        for p in prompts:
-            sched.add_request(p, max_new, eos_token_id)
+                                            chunk_tokens=chunk_tokens,
+                                            events=ev,
+                                            rid_base=self._serve_rid_base)
         prefill_jit, decode_jit, chunk_jit, cow_jit = self._ensure_paged_jits()
         rng = jax.random.key(seed)
 
-        while True:
-            action = sched.next_action()
-            if action is None:
-                break
-            kind, payload = action
-            if kind == "prefill":
-                req = payload
-                prefix = req.prefix()
-                L = prefix.size
-                Tb = self._bucket(L, cfg.max_seq)
-                toks = np.zeros((1, Tb), np.int32)
-                toks[0, :L] = prefix
-                table = np.asarray(req.blocks, np.int32)
-                slots = self._flat_slots(table, 0, L, Tb, bs)
-                logits, pools = prefill_jit(self.params, jnp.asarray(toks),
-                                            pools,
-                                            jnp.asarray(slots, jnp.int32),
-                                            jnp.int32(L - 1))
-                rng, sub = jax.random.split(rng)
-                tok = self._sample_host(logits.astype(jnp.float32),
-                                        temperature, top_k, sub)
-                sched.record_prefill(req, int(np.asarray(tok)[0]))
-            elif kind == "prefill_chunk":
-                req = payload
-                if req.cow_pending is not None:
-                    # copy-on-write split: the request restarts mid-block
-                    # inside a SHARED cached block — give it a private
-                    # device copy before any of its writes land
-                    src, dst = req.cow_pending
-                    pools = cow_jit(pools, jnp.int32(src), jnp.int32(dst))
-                    req.cow_pending = None
-                start = req.pos
-                remaining = req.prefill_target - start
-                step = min(chunk_tokens, remaining) if chunk_tokens \
-                    else remaining
-                Tb = self._bucket(step, cfg.max_seq)
-                prefix = req.prefix()
-                toks = np.zeros((1, Tb), np.int32)
-                toks[0, :step] = prefix[start:start + step]
-                table = np.asarray(req.blocks, np.int32)
-                slots = self._flat_slots(table, start, step, Tb, bs)
-                # the chunk attends over the gathered table, so its cost is
-                # O(table width × block_size) per layer — bucket the width
-                # to the next power of two of the request's OWN block count
-                # (≤ log2(n_max) compiles) instead of paying n_max (=
-                # max_seq worth of KV) for every short cache-hit tail
-                nb = min(n_max, 1 << max(int(table.size) - 1, 0).bit_length())
-                bt = np.zeros((1, nb), np.int32)
-                bt[0, :table.size] = table
-                logits, pools = chunk_jit(self.params, jnp.asarray(toks),
-                                          pools, jnp.asarray(bt),
-                                          jnp.asarray(slots, jnp.int32),
-                                          jnp.int32(start),
-                                          jnp.int32(step - 1))
-                if start + step == req.prefill_target:
+        # the try/finally guards rid uniqueness: even when a serve aborts
+        # (oversized prompt, pool exhaustion) the next serve's rids must
+        # not collide with this one's in the shared flight-recorder ring
+        try:
+            for p in prompts:
+                sched.add_request(p, max_new, eos_token_id)
+
+            while True:
+                action = sched.next_action()
+                if action is None:
+                    break
+                kind, payload = action
+                if kind == "prefill":
+                    req = payload
+                    prefix = req.prefix()
+                    L = prefix.size
+                    Tb = self._bucket(L, cfg.max_seq)
+                    toks = np.zeros((1, Tb), np.int32)
+                    toks[0, :L] = prefix
+                    table = np.asarray(req.blocks, np.int32)
+                    slots = self._flat_slots(table, 0, L, Tb, bs)
+                    t0 = time.monotonic_ns() if ev is not None else 0
+                    logits, pools = prefill_jit(self.params, jnp.asarray(toks),
+                                                pools,
+                                                jnp.asarray(slots, jnp.int32),
+                                                jnp.int32(L - 1))
                     rng, sub = jax.random.split(rng)
                     tok = self._sample_host(logits.astype(jnp.float32),
                                             temperature, top_k, sub)
-                    sched.record_prefill_chunk(req, step,
-                                               int(np.asarray(tok)[0]))
+                    if ev is not None:
+                        # the sample's host fetch synced the dispatch: the
+                        # span brackets device work + the sampling round-trip
+                        ev.emit("req.prefill", rid=req.rid, t_ns=t0,
+                                dur_ns=time.monotonic_ns() - t0, tokens=L)
+                    sched.record_prefill(req, int(np.asarray(tok)[0]))
+                elif kind == "prefill_chunk":
+                    req = payload
+                    if req.cow_pending is not None:
+                        # copy-on-write split: the request restarts mid-block
+                        # inside a SHARED cached block — give it a private
+                        # device copy before any of its writes land
+                        src, dst = req.cow_pending
+                        t0 = time.monotonic_ns() if ev is not None else 0
+                        pools = cow_jit(pools, jnp.int32(src), jnp.int32(dst))
+                        if ev is not None:
+                            # dispatch is async: wait for the copy so the
+                            # span covers device work, not µs of dispatch
+                            jax.block_until_ready(pools)
+                            ev.emit("req.cow_copy", rid=req.rid, t_ns=t0,
+                                    dur_ns=time.monotonic_ns() - t0,
+                                    src=src, dst=dst)
+                        req.cow_pending = None
+                    start = req.pos
+                    remaining = req.prefill_target - start
+                    step = min(chunk_tokens, remaining) if chunk_tokens \
+                        else remaining
+                    Tb = self._bucket(step, cfg.max_seq)
+                    prefix = req.prefix()
+                    toks = np.zeros((1, Tb), np.int32)
+                    toks[0, :step] = prefix[start:start + step]
+                    table = np.asarray(req.blocks, np.int32)
+                    slots = self._flat_slots(table, start, step, Tb, bs)
+                    # the chunk attends over the gathered table, so its cost is
+                    # O(table width × block_size) per layer — bucket the width
+                    # to the next power of two of the request's OWN block count
+                    # (≤ log2(n_max) compiles) instead of paying n_max (=
+                    # max_seq worth of KV) for every short cache-hit tail
+                    nb = min(n_max, 1 << max(int(table.size) - 1, 0).bit_length())
+                    bt = np.zeros((1, nb), np.int32)
+                    bt[0, :table.size] = table
+                    t0 = time.monotonic_ns() if ev is not None else 0
+                    logits, pools = chunk_jit(self.params, jnp.asarray(toks),
+                                              pools, jnp.asarray(bt),
+                                              jnp.asarray(slots, jnp.int32),
+                                              jnp.int32(start),
+                                              jnp.int32(step - 1))
+                    if ev is not None:
+                        # non-final chunks never fetch a result, so the
+                        # dispatch alone would clock near-zero: sync first
+                        # (tracing-only cost) so the slice is device time
+                        jax.block_until_ready(logits)
+                        ev.emit("req.prefill_chunk", rid=req.rid, t_ns=t0,
+                                dur_ns=time.monotonic_ns() - t0,
+                                start=start, tokens=step)
+                    if start + step == req.prefill_target:
+                        rng, sub = jax.random.split(rng)
+                        tok = self._sample_host(logits.astype(jnp.float32),
+                                                temperature, top_k, sub)
+                        sched.record_prefill_chunk(req, step,
+                                                   int(np.asarray(tok)[0]))
+                    else:
+                        sched.record_prefill_chunk(req, step)
                 else:
-                    sched.record_prefill_chunk(req, step)
-            else:
-                reqs = payload
-                bt = np.zeros((W, n_max), np.int32)       # zeros → dummy
-                pos = np.zeros((W,), np.int32)
-                toks = np.zeros((W, 1), np.int32)
-                for i, r in enumerate(reqs):
-                    bt[i, :len(r.blocks)] = r.blocks
-                    pos[i] = r.pos
-                    toks[i, 0] = r.last_token
-                logits, pools = decode_jit(self.params, jnp.asarray(toks),
-                                           pools, jnp.asarray(bt),
-                                           jnp.asarray(pos))
-                rng, sub = jax.random.split(rng)
-                tok = np.asarray(self._sample_host(
-                    logits.astype(jnp.float32), temperature, top_k, sub))
-                for i, r in enumerate(reqs):
-                    sched.record_decode(r, int(tok[i]))
-
+                    reqs = payload
+                    bt = np.zeros((W, n_max), np.int32)       # zeros → dummy
+                    pos = np.zeros((W,), np.int32)
+                    toks = np.zeros((W, 1), np.int32)
+                    for i, r in enumerate(reqs):
+                        bt[i, :len(r.blocks)] = r.blocks
+                        pos[i] = r.pos
+                        toks[i, 0] = r.last_token
+                    t0 = time.monotonic_ns() if ev is not None else 0
+                    logits, pools = decode_jit(self.params, jnp.asarray(toks),
+                                               pools, jnp.asarray(bt),
+                                               jnp.asarray(pos))
+                    rng, sub = jax.random.split(rng)
+                    tok = np.asarray(self._sample_host(
+                        logits.astype(jnp.float32), temperature, top_k, sub))
+                    if ev is not None:
+                        # emitted BEFORE record_decode so a retirement this
+                        # tick triggers lands after its final decode slice
+                        ev.emit("decode.tick", t_ns=t0,
+                                dur_ns=time.monotonic_ns() - t0,
+                                rids=[r.rid for r in reqs], n=len(reqs))
+                    for i, r in enumerate(reqs):
+                        sched.record_decode(r, int(tok[i]))
+        finally:
+            self._serve_rid_base = sched._next_rid
+        if ev is not None:
+            ev.emit("serve.end", t_ns=t_serve0,
+                    dur_ns=time.monotonic_ns() - t_serve0,
+                    requests=len(prompts))
         if self._telemetry is not None:
             # HBM live/peak + host RSS after the serve (the pools and the
             # decode workspace are the serving memory story)

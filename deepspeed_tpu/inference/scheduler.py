@@ -278,7 +278,8 @@ class ContinuousBatchingScheduler:
     def __init__(self, allocator: BlockAllocator, max_running: int,
                  max_blocks_per_seq: int,
                  telemetry: Optional[ServingTelemetry] = None,
-                 prefix_caching: bool = False, chunk_tokens: int = 0):
+                 prefix_caching: bool = False, chunk_tokens: int = 0,
+                 events=None, rid_base: int = 0):
         if max_running < 1:
             raise ValueError("max_running must be >= 1")
         if chunk_tokens < 0:
@@ -289,20 +290,34 @@ class ContinuousBatchingScheduler:
         self.prefix_caching = prefix_caching and allocator.prefix_cache
         self.chunk_tokens = chunk_tokens
         self.telemetry = telemetry
+        # flight recorder (monitor/events.py): None when disabled, so
+        # every emit site below gates at one None check
+        self.events = events
         if telemetry is not None:
             telemetry.ensure()
         self.waiting: deque = deque()
         self.running: List[Request] = []   # admission-ordered
         self.finished: List[Request] = []
         self._admit_counter = 0
-        self._next_rid = 0
+        # rid_base: the engine threads a per-engine offset through so rids
+        # stay unique ACROSS generate_batch calls — the flight recorder's
+        # request identity must not collide between serve calls
+        self._next_rid = int(rid_base)
         # prefill/decode interleave: after a chunk, give decode a turn (when
         # decodable rows exist) so one long prompt never monopolizes steps
         self._decode_turn = False
 
     def _tel_gauges(self) -> None:
         """Refresh the occupancy gauges (queue depth, running rows, KV
-        pool utilization) from current scheduler/allocator state."""
+        pool utilization) from current scheduler/allocator state, and
+        emit the flight-recorder occupancy sample (the serving trace's
+        counter-track source) at the same transitions."""
+        ev = self.events
+        if ev is not None:
+            a = self.allocator
+            ev.emit("sched.gauge", queued=len(self.waiting),
+                    running=len(self.running), kv_used=a.num_used,
+                    kv_free=a.num_free)
         t = self.telemetry
         if t is None:
             return
@@ -359,9 +374,12 @@ class ContinuousBatchingScheduler:
                       eos=eos, t_arrival=time.perf_counter())
         self._next_rid += 1
         self.waiting.append(req)
+        if self.events is not None:
+            self.events.emit("req.enqueue", rid=req.rid,
+                             prompt_tokens=int(prompt.size), max_new=max_new)
         if self.telemetry is not None:
             self.telemetry.requests.inc()
-            self._tel_gauges()
+        self._tel_gauges()
         return req
 
     def all_done(self) -> bool:
@@ -394,17 +412,23 @@ class ContinuousBatchingScheduler:
                 f"{self.allocator.capacity}; raise serving.max_num_blocks")
             logger.warning(f"request {req.rid} retired: {req.error}")
             self.finished.append(req)
+            if self.events is not None:
+                self.events.emit("req.retire", rid=req.rid,
+                                 generated=len(req.generated),
+                                 error=req.error)
             if self.telemetry is not None:
                 self.telemetry.finished.inc()
-                self._tel_gauges()
+            self._tel_gauges()
             return self._try_admit()
 
         shared: List[int] = []
         keys: List[bytes] = []
         cow_src: Optional[int] = None
         cached = 0
+        had_hit = False
         if self.prefix_caching:
             hit_blocks, hit_keys = self.allocator.match_prefix(prefix)
+            had_hit = bool(hit_blocks)
             if self.telemetry is not None:
                 self.telemetry.prefix_cache_lookups.inc()
                 if hit_blocks:
@@ -458,11 +482,24 @@ class ContinuousBatchingScheduler:
         req.admit_seq = self._admit_counter
         self._admit_counter += 1
         self.running.append(req)
+        if self.events is not None:
+            # probe outcome emitted only on the admission that sticks: a
+            # block-short pool retries admission every engine step, and
+            # per-attempt instants would flood the bounded ring
+            if self.prefix_caching:
+                if had_hit:
+                    self.events.emit("req.cache_hit", rid=req.rid,
+                                     tokens=cached)
+                else:
+                    self.events.emit("req.cache_miss", rid=req.rid)
+            self.events.emit("req.admit", rid=req.rid,
+                             cached_tokens=cached, blocks=len(req.blocks),
+                             prefill_target=target)
         if self.telemetry is not None:
             self.telemetry.prefill_steps.inc()
             if cached:
                 self.telemetry.prefix_cache_hit_tokens.inc(cached)
-            self._tel_gauges()
+        self._tel_gauges()
         if req.pos > 0 or self.chunk_tokens > 0:
             if self.telemetry is not None:
                 self.telemetry.prefill_chunks.inc()
@@ -499,7 +536,7 @@ class ContinuousBatchingScheduler:
                 return self.next_action()
             if self.telemetry is not None:
                 self.telemetry.decode_steps.inc()
-                self._tel_gauges()   # capacity growth/evictions moved blocks
+            self._tel_gauges()       # capacity growth/evictions moved blocks
             return ("decode", decodable)
         if self.waiting:
             # slots full but pool dry would have been handled above; here
@@ -539,6 +576,10 @@ class ContinuousBatchingScheduler:
             f"{len(victim.prefix())} tokens on re-admission"
             + (" minus any prefix-cache hit" if self.prefix_caching else "")
             + ")")
+        if self.events is not None:
+            self.events.emit("req.preempt", rid=victim.rid,
+                             blocks=len(victim.blocks),
+                             recompute_tokens=len(victim.prefix()))
         if self.telemetry is not None:
             self.telemetry.preemptions.inc()
             self.telemetry.recompute_tokens.inc(len(victim.prefix()))
@@ -656,6 +697,10 @@ class ContinuousBatchingScheduler:
             self.running.remove(req)
             self._free_blocks(req)
             self.finished.append(req)
+            if self.events is not None:
+                self.events.emit("req.retire", rid=req.rid,
+                                 generated=len(req.generated),
+                                 preemptions=req.preemptions)
             if self.telemetry is not None:
                 self.telemetry.finished.inc()
-                self._tel_gauges()
+            self._tel_gauges()

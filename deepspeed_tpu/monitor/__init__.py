@@ -1,7 +1,12 @@
 from deepspeed_tpu.monitor.config import (DeepSpeedMonitorConfig,
-                                          HealthConfig, TelemetryConfig,
+                                          EventsConfig, HealthConfig,
+                                          ProfileConfig, TelemetryConfig,
                                           get_monitor_config,
                                           get_telemetry_config)
+from deepspeed_tpu.monitor.events import (EVENT_KINDS, Event, FlightRecorder,
+                                          export_serving_trace,
+                                          get_flight_recorder,
+                                          render_serving_trace)
 from deepspeed_tpu.monitor.health import (HealthMonitor, StepHealth,
                                           compute_sentinels,
                                           make_bucket_assignment,
@@ -11,15 +16,19 @@ from deepspeed_tpu.monitor.health import (HealthMonitor, StepHealth,
 from deepspeed_tpu.monitor.metrics import (MetricsRegistry, get_registry,
                                            validate_snapshot)
 from deepspeed_tpu.monitor.monitor import MonitorMaster
-from deepspeed_tpu.monitor.trace import (CompileWatchdog, StepTracer,
-                                         get_compile_watchdog, get_tracer,
-                                         watched_jit)
+from deepspeed_tpu.monitor.trace import (CompileWatchdog, ProfileWindow,
+                                         StepTracer, get_compile_watchdog,
+                                         get_tracer, watched_jit)
 
 __all__ = [
-    "DeepSpeedMonitorConfig", "HealthConfig", "TelemetryConfig",
+    "DeepSpeedMonitorConfig", "EventsConfig", "HealthConfig",
+    "ProfileConfig", "TelemetryConfig",
+    "EVENT_KINDS", "Event", "FlightRecorder", "get_flight_recorder",
+    "export_serving_trace", "render_serving_trace",
     "get_monitor_config", "get_telemetry_config", "MetricsRegistry",
     "get_registry", "validate_snapshot", "MonitorMaster", "CompileWatchdog",
-    "StepTracer", "get_compile_watchdog", "get_tracer", "watched_jit",
+    "ProfileWindow", "StepTracer", "get_compile_watchdog", "get_tracer",
+    "watched_jit",
     "HealthMonitor", "StepHealth", "compute_sentinels",
     "make_bucket_assignment", "render_health_table", "sample_memory_gauges",
     "sentinel_to_dict",

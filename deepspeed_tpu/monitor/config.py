@@ -61,6 +61,33 @@ class HealthConfig(ConfigModel):
     max_norm_buckets: int = 8
 
 
+class EventsConfig(ConfigModel):
+    """"telemetry.events" sub-block: the flight recorder
+    (``monitor/events.py``) — a bounded ring of structured lifecycle
+    events (train step/phase/skip, checkpoint phases, serving request
+    lifecycle) with monotonic-ns timestamps. Off by default; when off
+    every emit site costs one flag/None check and allocates nothing."""
+    enabled: bool = False
+    # ring size (events). The recorder keeps the NEWEST `capacity` events
+    # and counts evictions — post-mortems want the tail, not the head.
+    capacity: int = 16384
+
+
+class ProfileConfig(ConfigModel):
+    """"telemetry.profile" sub-block: an on-demand ``jax.profiler``
+    capture window. ``num_steps > 0`` arms it: the capture starts at the
+    ``start_step``-th train_batch call of this process and stops
+    ``num_steps`` later, writing a TensorBoard/xprof profile under
+    ``dir`` (summarize with ``dscli profile <dir>``). The host-side
+    ``TraceAnnotation`` names pushed while capturing match the
+    StepTracer span names, so host spans line up with the device
+    timeline. ``engine.profile(steps=N)`` arms the same window
+    programmatically."""
+    start_step: int = 0
+    num_steps: int = 0      # 0 = no config-armed capture window
+    dir: str = "ds_profile"
+
+
 class TelemetryConfig(ConfigModel):
     """"telemetry" section: the cross-layer metrics registry + tracing.
 
@@ -91,6 +118,11 @@ class TelemetryConfig(ConfigModel):
     # health observatory sub-block (sentinels + anomaly detectors +
     # memory gauges + the `dscli health` screen); accepts a dict or a bool
     health: HealthConfig = Field(default_factory=HealthConfig)
+    # flight recorder sub-block (event ring + serving trace export);
+    # accepts a dict or a bool like `health`
+    events: EventsConfig = Field(default_factory=EventsConfig)
+    # on-demand jax.profiler capture window
+    profile: ProfileConfig = Field(default_factory=ProfileConfig)
 
 
 def get_telemetry_config(param_dict: dict) -> TelemetryConfig:
@@ -112,21 +144,33 @@ def get_telemetry_config(param_dict: dict) -> TelemetryConfig:
         raise ValueError(f"telemetry section must be a dict, bool, or "
                          f"'on'/'off'; got {type(t).__name__}")
     t = dict(t)
-    health = t.get("health", {})
-    if health is None:
-        health = {}          # null = defaults, like the parent section
-    elif isinstance(health, str):
-        # the same shorthand the parent section accepts
-        if health not in ("on", "off"):
-            raise ValueError(f"telemetry.health={health!r} (expected 'on', "
-                             "'off', a bool, or a config dict)")
-        health = {"enabled": health == "on"}
-    elif isinstance(health, (bool, int)):
-        health = {"enabled": bool(health)}
-    t["health"] = health
-    if isinstance(health, dict) and health.get("enabled") \
-            and "enabled" not in t:
-        t["enabled"] = True
+
+    def _sub_shorthand(key):
+        """bool / "on"/"off" / null shorthand for a sub-block (shared by
+        ``health`` and ``events``)."""
+        sub = t.get(key, {})
+        if sub is None:
+            sub = {}         # null = defaults, like the parent section
+        elif isinstance(sub, str):
+            if sub not in ("on", "off"):
+                raise ValueError(f"telemetry.{key}={sub!r} (expected 'on', "
+                                 "'off', a bool, or a config dict)")
+            sub = {"enabled": sub == "on"}
+        elif isinstance(sub, (bool, int)):
+            sub = {"enabled": bool(sub)}
+        t[key] = sub
+        return sub
+
+    health = _sub_shorthand("health")
+    events = _sub_shorthand("events")
+    if t.get("profile") is None and "profile" in t:
+        t["profile"] = {}    # null = defaults
+    # enabling a sub-block implies the telemetry substrate it rides on,
+    # unless the user explicitly disabled telemetry itself
+    for sub in (health, events):
+        if isinstance(sub, dict) and sub.get("enabled") \
+                and "enabled" not in t:
+            t["enabled"] = True
     return TelemetryConfig(**t)
 
 

@@ -1,0 +1,354 @@
+"""Flight recorder: a typed, bounded, thread-safe ring of lifecycle events.
+
+PR 3/4 built *aggregate* observability (metrics registry, health
+detectors); this module records *what happened when*: a ring buffer of
+structured events with monotonic-ns timestamps and request/step identity,
+emitted by the training engine (step / phase / checkpoint phases / fp16
+skip), the continuous-batching scheduler (enqueue / admit / cache hit /
+preempt / retire), the inference engine (prefill, prefill chunk, COW
+copy, fused decode tick), and the crash-safe checkpoint writer
+(snapshot / serialize / commit / retry). The buffer keeps the newest
+``capacity`` events (a flight recorder preserves the TAIL — the moments
+before the incident), counting evictions in ``dropped``.
+
+Cost discipline: when disabled, every emit site gates at ONE flag/None
+check and allocates nothing (the engines hold ``None`` instead of the
+recorder on their hot paths; :meth:`FlightRecorder.emit` itself returns
+after one flag check for the module-level sites like the checkpoint
+writer). Enabled, an emit is one :class:`Event` allocation and a locked
+deque append — host-side work on paths that already do host-side
+bookkeeping, never inside compiled code.
+
+Two export shapes:
+
+- :meth:`FlightRecorder.write_jsonl` — the raw timeline, one event per
+  line. Anomaly debug bundles and the SIGTERM/emergency-save path ship
+  this as ``events.jsonl`` so every post-mortem carries its timeline.
+- :func:`export_serving_trace` — the serving events rendered as
+  chrome-trace JSON (Perfetto / chrome://tracing): one track per request
+  holding its admission→retire span with prefill/decode/preempt child
+  events, plus queue-depth and KV-block counter tracks.
+
+Both are schema-checked by ``tools/validate_trace.py``
+(``dscli trace --validate``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional
+
+#: the typed event catalogue — ``emit`` rejects anything else, so the
+#: exporters and the schema validator can rely on the vocabulary.
+EVENT_KINDS = frozenset({
+    # training engine
+    "train.step",           # one train_batch (step=, dur_ns=)
+    "train.phase",          # trio phase (step=, dur_ns=, phase=fwd|bwd|step)
+    "train.fp16_skip",      # overflow skipped the update (step=)
+    # checkpoint (crash-safe two-phase path)
+    "ckpt.snapshot",        # device->host snapshot (step=, dur_ns=, tag=)
+    "ckpt.serialize",       # npz+manifest write+fsync (dur_ns=, tag=)
+    "ckpt.commit",          # atomic rename + dir fsync (dur_ns=, tag=, bytes=)
+    "ckpt.retry",           # transient I/O fault retried (what=, attempt=, error=)
+    # serving: scheduler state machine (rid= identity)
+    "req.enqueue",          # add_request (prompt_tokens=, max_new=)
+    "req.admit",            # admission (cached_tokens=, blocks=)
+    "req.cache_hit",        # admission prefix-cache probe hit (tokens=)
+    "req.cache_miss",       # admission prefix-cache probe miss
+    "req.preempt",          # recompute-preemption (blocks=, recompute_tokens=)
+    "req.retire",           # finished (generated=, error=)
+    # serving: engine compute steps (dur_ns= brackets the jit dispatch)
+    "req.prefill",          # whole-prompt prefill (tokens=)
+    "req.prefill_chunk",    # one prefill chunk (start=, tokens=)
+    "req.cow_copy",         # copy-on-write block split (src=, dst=)
+    "decode.tick",          # one fused decode step (rids=, n=)
+    "serve.begin",          # generate_batch entry (requests=)
+    "serve.end",            # generate_batch span (dur_ns=, requests=)
+    # scheduler occupancy sample (the counter-track source)
+    "sched.gauge",          # queued=, running=, kv_used=, kv_free=
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One flight-recorder entry. ``ts_ns`` is ``time.monotonic_ns()`` at
+    the event's START (timed events pass their start explicitly so the
+    slice covers [ts_ns, ts_ns + dur_ns]); ``rid``/``step`` carry request
+    or training-step identity; ``data`` the kind-specific payload."""
+    ts_ns: int
+    kind: str
+    rid: Optional[int] = None
+    step: Optional[int] = None
+    dur_ns: Optional[int] = None
+    data: Optional[Dict[str, Any]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"ts_ns": self.ts_ns, "kind": self.kind}
+        if self.rid is not None:
+            d["rid"] = self.rid
+        if self.step is not None:
+            d["step"] = self.step
+        if self.dur_ns is not None:
+            d["dur_ns"] = self.dur_ns
+        if self.data:
+            d.update(self.data)
+        return d
+
+
+class FlightRecorder:
+    """Bounded ring of :class:`Event`. Oldest events are evicted when the
+    ring is full (``dropped`` counts them); ``snapshot()`` returns the
+    retained tail oldest-first. Thread-safe: scheduler/engine emits land
+    from the caller thread, checkpoint emits from the writer thread."""
+
+    DEFAULT_CAPACITY = 16384
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 enabled: bool = False):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=capacity)
+        self._dropped = 0
+        self.enabled = enabled
+
+    @property
+    def capacity(self) -> int:
+        return self._buf.maxlen
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def enable(self, capacity: Optional[int] = None) -> "FlightRecorder":
+        """Turn recording on, optionally resizing the ring (a resize keeps
+        the newest events that still fit)."""
+        with self._lock:
+            if capacity is not None and capacity != self._buf.maxlen:
+                if capacity < 1:
+                    raise ValueError("capacity must be >= 1")
+                self._buf = deque(self._buf, maxlen=capacity)
+            self.enabled = True
+        return self
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def emit(self, kind: str, rid: Optional[int] = None,
+             step: Optional[int] = None, dur_ns: Optional[int] = None,
+             t_ns: Optional[int] = None, **data) -> None:
+        """Record one event. Disabled-mode cost is this method's first
+        flag check (hot paths gate even earlier by holding ``None``).
+        ``t_ns`` overrides the start timestamp for timed events whose
+        duration was measured before emitting."""
+        if not self.enabled:
+            return
+        if kind not in EVENT_KINDS:
+            raise ValueError(f"unknown event kind {kind!r} "
+                             "(see monitor.events.EVENT_KINDS)")
+        ev = Event(ts_ns=t_ns if t_ns is not None else time.monotonic_ns(),
+                   kind=kind, rid=rid, step=step, dur_ns=dur_ns,
+                   data=data or None)
+        with self._lock:
+            if len(self._buf) == self._buf.maxlen:
+                self._dropped += 1
+            self._buf.append(ev)
+
+    def snapshot(self) -> List[Event]:
+        """The retained events, oldest first."""
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._dropped = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def write_jsonl(self, path: str) -> str:
+        """Dump the retained tail as JSONL (one event dict per line,
+        oldest first); returns the path. The schema is what
+        ``tools/validate_trace.py --kind events`` checks."""
+        events = self.snapshot()
+        dropped = self.dropped
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            if dropped:
+                f.write(json.dumps({"ts_ns": events[0].ts_ns if events else 0,
+                                    "kind": "recorder.dropped",
+                                    "count": dropped}) + "\n")
+            for ev in events:
+                f.write(json.dumps(ev.to_dict()) + "\n")
+        return path
+
+
+# ------------------------------------------------------------------ #
+# process-global recorder (the engines all share one timeline, so a merged
+# post-mortem interleaves training, checkpoint, and serving events)
+
+_recorder: Optional[FlightRecorder] = None
+_lock = threading.Lock()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    global _recorder
+    if _recorder is None:
+        with _lock:
+            if _recorder is None:
+                _recorder = FlightRecorder()
+    return _recorder
+
+
+# ------------------------------------------------------------------ #
+# serving trace rendering: chrome-trace JSON, one track per request
+
+_SERVING_PID = 1      # per-request tracks
+_ENGINE_PID = 2       # engine spans + counter tracks
+_ENGINE_TID = 0
+
+#: request-track child slices: recorder kind -> slice name
+_CHILD_SLICES = {"req.prefill": "prefill", "req.prefill_chunk": "prefill_chunk",
+                 "req.cow_copy": "cow_copy"}
+#: request-track instants
+_INSTANTS = {"req.enqueue": "enqueue", "req.cache_hit": "cache_hit",
+             "req.cache_miss": "cache_miss", "req.preempt": "preempt"}
+
+
+def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
+    """Render serving events as a chrome-trace document: per-request
+    tracks (pid 1, tid = rid) each holding exactly ONE admission→retire
+    span (first admission to final retirement — a preempted-and-resumed
+    request stays one span, with its preemption as an instant inside)
+    with prefill / prefill-chunk / decode-tick / COW child slices, plus
+    ``queue_depth`` and ``kv_blocks`` counter tracks and the
+    ``generate_batch`` engine spans (pid 2)."""
+    events = [e for e in events
+              if e.kind.startswith(("req.", "serve.", "decode.", "sched."))]
+    out: List[Dict[str, Any]] = []
+    if not events:
+        return {"traceEvents": out, "displayTimeUnit": "ms"}
+    t0 = min(e.ts_ns for e in events)
+
+    def us(ts_ns: int) -> float:
+        return (ts_ns - t0) / 1e3
+
+    # ---- per-request lifecycle ---- #
+    admits: Dict[int, int] = {}            # rid -> first admission ts
+    last_seen: Dict[int, int] = {}         # rid -> newest event end ts
+    retires: Dict[int, Event] = {}
+    info: Dict[int, Dict[str, Any]] = {}
+    for e in events:
+        rid = e.rid
+        if rid is None and e.kind == "decode.tick":
+            end = e.ts_ns + (e.dur_ns or 0)
+            for r in (e.data or {}).get("rids", ()):  # fused over many reqs
+                last_seen[r] = max(last_seen.get(r, 0), end)
+            continue
+        if rid is None:
+            continue
+        last_seen[rid] = max(last_seen.get(rid, 0),
+                             e.ts_ns + (e.dur_ns or 0))
+        meta = info.setdefault(rid, {"preemptions": 0, "cached_tokens": 0})
+        if e.kind == "req.admit":
+            admits.setdefault(rid, e.ts_ns)
+            meta["cached_tokens"] += (e.data or {}).get("cached_tokens", 0)
+        elif e.kind == "req.enqueue":
+            meta["prompt_tokens"] = (e.data or {}).get("prompt_tokens")
+        elif e.kind == "req.preempt":
+            meta["preemptions"] += 1
+        elif e.kind == "req.retire":
+            retires[rid] = e
+
+    for rid in sorted(admits):
+        out.append({"ph": "M", "name": "thread_name", "pid": _SERVING_PID,
+                    "tid": rid, "args": {"name": f"req {rid}"}})
+        start = admits[rid]
+        ret = retires.get(rid)
+        end = ret.ts_ns if ret is not None else last_seen[rid]
+        args = {k: v for k, v in info[rid].items() if v is not None}
+        if ret is not None:
+            args.update({k: v for k, v in (ret.data or {}).items()
+                         if v is not None})
+        else:
+            args["incomplete"] = True      # truncated ring / still running
+        out.append({"name": f"request {rid}", "cat": "request", "ph": "X",
+                    "pid": _SERVING_PID, "tid": rid, "ts": us(start),
+                    "dur": max((end - start) / 1e3, 0.001), "args": args})
+
+    # ---- child slices, instants, counters, engine spans ---- #
+    for e in events:
+        if e.kind in _CHILD_SLICES:
+            out.append({"name": _CHILD_SLICES[e.kind], "cat": "serving",
+                        "ph": "X", "pid": _SERVING_PID, "tid": e.rid,
+                        "ts": us(e.ts_ns), "dur": (e.dur_ns or 0) / 1e3,
+                        "args": dict(e.data or {})})
+        elif e.kind in _INSTANTS:
+            out.append({"name": _INSTANTS[e.kind], "cat": "serving",
+                        "ph": "i", "s": "t", "pid": _SERVING_PID,
+                        "tid": e.rid, "ts": us(e.ts_ns),
+                        "args": dict(e.data or {})})
+        elif e.kind == "decode.tick":
+            d = dict(e.data or {})
+            for rid in d.get("rids", ()):
+                out.append({"name": "decode", "cat": "serving", "ph": "X",
+                            "pid": _SERVING_PID, "tid": rid,
+                            "ts": us(e.ts_ns), "dur": (e.dur_ns or 0) / 1e3,
+                            "args": {"n": d.get("n")}})
+        elif e.kind == "sched.gauge":
+            d = dict(e.data or {})
+            out.append({"name": "queue_depth", "ph": "C", "pid": _ENGINE_PID,
+                        "tid": _ENGINE_TID, "ts": us(e.ts_ns),
+                        "args": {"queued": d.get("queued", 0),
+                                 "running": d.get("running", 0)}})
+            out.append({"name": "kv_blocks", "ph": "C", "pid": _ENGINE_PID,
+                        "tid": _ENGINE_TID, "ts": us(e.ts_ns),
+                        "args": {"used": d.get("kv_used", 0),
+                                 "free": d.get("kv_free", 0)}})
+        elif e.kind == "serve.end":
+            out.append({"name": "generate_batch", "cat": "serving",
+                        "ph": "X", "pid": _ENGINE_PID, "tid": _ENGINE_TID,
+                        "ts": us(e.ts_ns), "dur": (e.dur_ns or 0) / 1e3,
+                        "args": dict(e.data or {})})
+
+    out.append({"ph": "M", "name": "process_name", "pid": _SERVING_PID,
+                "args": {"name": "serving requests"}})
+    out.append({"ph": "M", "name": "process_name", "pid": _ENGINE_PID,
+                "args": {"name": "serving engine"}})
+    out.append({"ph": "M", "name": "thread_name", "pid": _ENGINE_PID,
+                "tid": _ENGINE_TID, "args": {"name": "engine steps"}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def export_serving_trace(events: Iterable[Event], path: str) -> str:
+    """Write :func:`render_serving_trace` of ``events`` to ``path``."""
+    doc = render_serving_trace(events)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def dump_events_jsonl(dirpath: str,
+                      filename: str = "events.jsonl") -> Optional[str]:
+    """Post-mortem helper: write the global recorder's tail into
+    ``dirpath/filename`` when recording is on and anything was captured.
+    Never raises (debug artifacts must not break the failing path);
+    returns the path or None."""
+    try:
+        rec = get_flight_recorder()
+        if not rec.enabled or not len(rec):
+            return None
+        return rec.write_jsonl(os.path.join(dirpath, filename))
+    except Exception:
+        return None

@@ -517,6 +517,11 @@ class HealthMonitor:
                 self._trace_export_fn(os.path.join(path, "trace.json"))
             except Exception as e:
                 logger.warning(f"health: trace export in bundle failed: {e}")
+        # the flight-recorder tail (events.jsonl): the causal timeline —
+        # checkpoint phases, fp16 skips, serving lifecycle — leading into
+        # the anomaly (present when telemetry.events is on)
+        from deepspeed_tpu.monitor.events import dump_events_jsonl
+        dump_events_jsonl(path)
         self._dumps += 1
         self._last_dump_step = rec.step
         logger.warning(f"health: debug bundle written to {path} "
@@ -775,9 +780,106 @@ def render_health_table(rec: Dict, prev: Optional[Dict] = None) -> str:
     return "\n".join(lines)
 
 
+def health_summary(rec: Dict, prev: Optional[Dict] = None) -> Dict[str, Any]:
+    """The machine-readable form of :func:`render_health_table`: the same
+    snapshot-derived values the table shows, as a nested dict (consumed by
+    ``dscli health --json`` so CI and scripts never screen-scrape the
+    table). Sections with no data are omitted; the raw snapshot rides
+    along under ``"snapshot"``."""
+    g = rec.get("gauges", {}) or {}
+    c = rec.get("counters", {}) or {}
+    h = rec.get("histograms", {}) or {}
+    out: Dict[str, Any] = {"step": rec.get("step"), "ts": rec.get("ts")}
+
+    train: Dict[str, Any] = {}
+    st = h.get("train/step_time_ms")
+    if st or "train/steps" in c:
+        train["steps"] = int(c.get("train/steps", 0))
+        if st:
+            train["step_time_ms"] = st
+        ts = rec.get("ts")
+        if prev and ts and prev.get("ts") and "train/steps" in c \
+                and "train/steps" in (prev.get("counters") or {}):
+            dt = ts - prev["ts"]
+            dsteps = c["train/steps"] - prev["counters"]["train/steps"]
+            if dt > 0 and dsteps > 0:
+                train["steps_per_sec"] = dsteps / dt
+        for key, name in (("train/tokens_per_sec", "tokens_per_sec"),
+                          ("train/mfu", "mfu")):
+            if key in g:
+                train[name] = g[key]
+    if train:
+        out["train"] = train
+
+    loss: Dict[str, Any] = {}
+    for key, name in (("train/loss", "loss"), ("health/loss_ewma", "ewma"),
+                      ("health/grad_norm", "grad_norm")):
+        if key in g:
+            loss[name] = g[key]
+    if h.get("train/grad_norm", {}).get("count"):
+        loss["grad_norm_hist"] = h["train/grad_norm"]
+    if loss:
+        out["loss"] = loss
+
+    fp16: Dict[str, Any] = {}
+    for key, name in (("train/loss_scale", "loss_scale"),
+                      ("train/skipped_steps", "skipped_steps"),
+                      ("health/consecutive_skips", "consecutive_skips")):
+        if key in g:
+            fp16[name] = g[key]
+    if fp16:
+        out["fp16"] = fp16
+
+    anoms = labeled_series(c, "health/anomalies")
+    if anoms:
+        out["anomalies"] = {k: int(v) for k, v in sorted(anoms.items())}
+    if "train/data_stall_fraction" in g:
+        out["data_stall_fraction"] = g["train/data_stall_fraction"]
+
+    mem: Dict[str, Any] = {}
+    for key, name in (("mem/hbm_bytes_in_use", "hbm_bytes_in_use"),
+                      ("mem/hbm_peak_bytes", "hbm_peak_bytes"),
+                      ("mem/hbm_bytes_limit", "hbm_bytes_limit"),
+                      ("mem/hbm_headroom_bytes", "hbm_headroom_bytes")):
+        series = labeled_series(g, key)
+        if series:
+            mem[name] = series
+    if "mem/host_rss_bytes" in g:
+        mem["host_rss_bytes"] = g["mem/host_rss_bytes"]
+    if mem:
+        out["memory"] = mem
+
+    serving: Dict[str, Any] = {}
+    for key, name in (("serving/ttft_ms", "ttft_ms"),
+                      ("serving/tpot_ms", "tpot_ms")):
+        if h.get(key, {}).get("count"):
+            serving[name] = h[key]
+    for key, name in (("serving/queue_depth", "queue_depth"),
+                      ("serving/running", "running"),
+                      ("serving/kv_block_utilization", "kv_block_utilization"),
+                      ("serving/kv_blocks_free", "kv_blocks_free"),
+                      ("serving/kv_fragmentation", "kv_fragmentation"),
+                      ("serving/cold_blocks", "cold_blocks")):
+        if key in g:
+            serving[name] = g[key]
+    for key, name in (("serving/prefix_cache_lookups", "prefix_cache_lookups"),
+                      ("serving/prefix_cache_hits", "prefix_cache_hits"),
+                      ("serving/prefix_cache_hit_tokens",
+                       "prefix_cache_hit_tokens"),
+                      ("serving/preemptions", "preemptions")):
+        if key in c:
+            serving[name] = c[key]
+    if serving:
+        out["serving"] = serving
+
+    out["snapshot"] = rec
+    return out
+
+
 def health_cli(argv: Optional[List[str]] = None) -> int:
     """``dscli health <telemetry.jsonl>`` — live one-screen status table
     tailing the JSONL telemetry sink (``--once`` renders a single table
+    and exits; ``--json`` prints the latest snapshot's summary as JSON
     and exits; default follows at ``--interval`` seconds)."""
     import argparse
     parser = argparse.ArgumentParser(
@@ -787,16 +889,27 @@ def health_cli(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("path", help="JSONL telemetry sink to tail")
     parser.add_argument("--once", action="store_true",
                         help="render one table and exit (no follow loop)")
+    parser.add_argument("--json", action="store_true",
+                        help="print the latest snapshot summary as JSON "
+                             "and exit (machine-readable --once)")
     parser.add_argument("--interval", type=float, default=2.0,
                         help="refresh period in seconds (default 2)")
     args = parser.parse_args(argv)
 
-    if args.once:
+    if args.once or args.json:
         recs = read_last_snapshots(args.path, 2)
         if not recs:
-            print(f"health: no telemetry records in {args.path}")
+            if args.json:
+                print(json.dumps({"error": "no telemetry records",
+                                  "path": args.path}))
+            else:
+                print(f"health: no telemetry records in {args.path}")
             return 1
-        print(render_health_table(recs[-1], recs[-2] if len(recs) > 1 else None))
+        prev = recs[-2] if len(recs) > 1 else None
+        if args.json:
+            print(json.dumps(health_summary(recs[-1], prev)))
+        else:
+            print(render_health_table(recs[-1], prev))
         return 0
     try:
         while True:

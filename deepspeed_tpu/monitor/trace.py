@@ -36,10 +36,15 @@ from deepspeed_tpu.utils.logging import logger
 class StepTracer:
     """Span recorder: chrome-trace "complete" (ph=X) events, bounded."""
 
+    #: chrome-trace pid of the host-span track group (the serving trace
+    #: renderer uses 1/2, so merged host+serving documents never collide)
+    PID = 0
+
     def __init__(self, max_events: int = 100_000, use_accelerator: bool = True):
         self.max_events = max_events
         self.use_accelerator = use_accelerator
         self._events: List[Dict[str, Any]] = []
+        self._thread_names: Dict[int, str] = {}   # tid -> thread name
         self._lock = threading.Lock()
         self._dropped = 0
         self._t0 = time.perf_counter()
@@ -71,12 +76,16 @@ class StepTracer:
 
     def add_event(self, name: str, start_s: float, dur_s: float,
                   args: Optional[Dict] = None) -> None:
-        ev = {"name": name, "ph": "X", "pid": 0,
-              "tid": threading.get_ident() % 2**31,
+        tid = threading.get_ident() % 2**31
+        ev = {"name": name, "ph": "X", "pid": self.PID, "tid": tid,
               "ts": (start_s - self._t0) * 1e6, "dur": dur_s * 1e6}
         if args:
             ev["args"] = {k: str(v) for k, v in args.items()}
         with self._lock:
+            if tid not in self._thread_names:
+                # captured at record time: export may run from another
+                # thread, by which point this one may be gone
+                self._thread_names[tid] = threading.current_thread().name
             if len(self._events) >= self.max_events:
                 self._dropped += 1
                 return
@@ -90,16 +99,27 @@ class StepTracer:
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+            self._thread_names.clear()
             self._dropped = 0
 
     def export_chrome_trace(self, path: str) -> str:
-        """Write the recorded spans as chrome-trace JSON; returns path."""
+        """Write the recorded spans as chrome-trace JSON; returns path.
+        Process/thread metadata events name the tracks (Perfetto shows
+        "deepspeed_tpu host / MainThread" instead of bare integers — and
+        a merged host+serving document keeps its groups tellable)."""
         import json
         import os
         with self._lock:
             events = list(self._events)
             dropped = self._dropped
-        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+            names = dict(self._thread_names)
+        meta: List[Dict[str, Any]] = [
+            {"ph": "M", "name": "process_name", "pid": self.PID,
+             "args": {"name": "deepspeed_tpu host"}}]
+        for tid in sorted(names):
+            meta.append({"ph": "M", "name": "thread_name", "pid": self.PID,
+                         "tid": tid, "args": {"name": names[tid]}})
+        doc = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
         if dropped:
             doc["otherData"] = {"dropped_events": dropped}
         d = os.path.dirname(os.path.abspath(path))
@@ -107,6 +127,117 @@ class StepTracer:
         with open(path, "w") as f:
             json.dump(doc, f)
         return path
+
+
+# ------------------------------------------------------------------ #
+# on-demand device profiling: a jax.profiler capture window
+
+
+class ProfileWindow:
+    """Bounded ``jax.profiler`` capture armed by config
+    (``telemetry.profile: {start_step, num_steps, dir}``) or
+    programmatically (``engine.profile(steps=N)``): the engine calls
+    :meth:`tick` once per ``train_batch`` dispatch (one None/flag check
+    when nothing is armed) and the window starts/stops the device trace
+    around the requested steps. Steps are counted as tick calls in THIS
+    process (no device sync to read a global step). While capturing,
+    :meth:`annotate` pushes the accelerator ``TraceAnnotation`` under the
+    same names the :class:`StepTracer` spans use, so host spans line up
+    with the device timeline in xprof/TensorBoard."""
+
+    def __init__(self, log_dir: str = "ds_profile", start_step: int = 0,
+                 num_steps: int = 0):
+        self.log_dir = log_dir
+        self._lock = threading.Lock()
+        self._step = 0             # tick calls seen
+        self._stop_at: Optional[int] = None
+        self.active = False
+        self.captures = 0
+        self._armed: Optional[Dict[str, Any]] = None
+        if num_steps > 0:
+            self._armed = {"start": max(int(start_step), 0),
+                           "steps": int(num_steps), "dir": log_dir}
+
+    def arm(self, steps: int, log_dir: Optional[str] = None,
+            start_step: Optional[int] = None) -> None:
+        """Request a capture of ``steps`` train steps, starting at the
+        next tick (or at absolute tick ``start_step``)."""
+        if steps < 1:
+            raise ValueError("profile steps must be >= 1")
+        with self._lock:
+            if self.active:
+                raise RuntimeError("a profile capture is already running")
+            self._armed = {"start": (self._step if start_step is None
+                                     else int(start_step)),
+                           "steps": int(steps),
+                           "dir": log_dir or self.log_dir}
+
+    def tick(self) -> None:
+        """One train-step boundary: start the trace when the armed window
+        begins, stop it when the window has covered its steps."""
+        with self._lock:
+            step = self._step
+            self._step += 1
+            if self.active:
+                if step >= self._stop_at:
+                    self._stop()
+                return
+            armed = self._armed
+            if armed is None or step < armed["start"]:
+                return
+            self._armed = None
+            self._stop_at = step + armed["steps"]
+            try:
+                import jax
+                jax.profiler.start_trace(armed["dir"])
+            except Exception as e:
+                logger.warning(f"profile: start_trace failed ({e}); "
+                               "capture window dropped")
+                self._stop_at = None
+                return
+            self.active = True
+            logger.info(f"profile: capturing {armed['steps']} step(s) to "
+                        f"{armed['dir']} (summarize with "
+                        f"`dscli profile {armed['dir']}`)")
+
+    def _stop(self) -> None:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+            self.captures += 1
+            logger.info("profile: capture complete")
+        except Exception as e:
+            logger.warning(f"profile: stop_trace failed ({e})")
+        self.active = False
+        self._stop_at = None
+
+    def stop(self) -> None:
+        """Force-stop an active capture (engine teardown safety: a trace
+        left open keeps the profiler session dangling)."""
+        with self._lock:
+            if self.active:
+                self._stop()
+
+    @contextmanager
+    def annotate(self, name: str):
+        """Accelerator ``TraceAnnotation`` around the with-block while a
+        capture is active (no-op otherwise) — the host-side span marker
+        on the device timeline."""
+        if not self.active:
+            yield
+            return
+        try:
+            from deepspeed_tpu.accelerator import get_accelerator
+            acc = get_accelerator()
+        except Exception:
+            acc = None
+        if acc is not None:
+            acc.range_push(name)
+        try:
+            yield
+        finally:
+            if acc is not None:
+                acc.range_pop()
 
 
 # ------------------------------------------------------------------ #

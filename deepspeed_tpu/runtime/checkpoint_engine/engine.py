@@ -253,6 +253,11 @@ def save_engine_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                                 update_latest=save_latest)
     mets = safe_engine._ckpt_metrics()
     mets["snapshot_ms"].observe((time.perf_counter() - t0) * 1e3)
+    now = time.monotonic_ns()
+    dur = int((time.perf_counter() - t0) * 1e9)
+    safe_engine._emit_ckpt_event("ckpt.snapshot", t_ns=now - dur,
+                                 dur_ns=dur, step=steps, tag=tag,
+                                 asynchronous=bool(asynchronous))
 
     if asynchronous:
         writer = engine._checkpoint_writer()

@@ -60,6 +60,17 @@ _DTYPE_TAG = "::dt="
 _HASH_CHUNK = 1 << 20
 
 
+def _emit_ckpt_event(kind: str, **data) -> None:
+    """Flight-recorder hook for the checkpoint phases (snapshot is emitted
+    by the engine-side save path; serialize/commit/retry here). Disabled
+    recorder = one flag check; diagnostics never fail a save."""
+    try:
+        from deepspeed_tpu.monitor.events import get_flight_recorder
+        get_flight_recorder().emit(kind, **data)
+    except Exception:
+        pass
+
+
 class CheckpointWriteError(RuntimeError):
     """A checkpoint save failed after exhausting its retry budget. The
     previous committed checkpoints are untouched."""
@@ -275,6 +286,7 @@ def _write_tag_once(save_dir: str, payload: CheckpointPayload) -> int:
         shutil.rmtree(tmp, ignore_errors=True)
     os.makedirs(tmp)
 
+    t_ser = time.monotonic_ns()
     write_npz(os.path.join(tmp, STATE_FILE), payload.arrays)
     for name, flat in payload.extra_npz.items():
         write_npz(os.path.join(tmp, name), flat)
@@ -294,6 +306,9 @@ def _write_tag_once(save_dir: str, payload: CheckpointPayload) -> int:
     _write_bytes_durable(os.path.join(tmp, MANIFEST),
                          json.dumps(manifest, indent=2).encode())
     _fsync_dir(tmp)
+    t_commit = time.monotonic_ns()
+    _emit_ckpt_event("ckpt.serialize", t_ns=t_ser, dur_ns=t_commit - t_ser,
+                     step=payload.global_steps, tag=payload.tag, bytes=total)
 
     if os.path.isdir(tag_dir):
         # overwriting an existing tag: park it aside so there is never a
@@ -307,6 +322,9 @@ def _write_tag_once(save_dir: str, payload: CheckpointPayload) -> int:
     else:
         os.replace(tmp, tag_dir)
     _fsync_dir(save_dir)
+    _emit_ckpt_event("ckpt.commit", t_ns=t_commit,
+                     dur_ns=time.monotonic_ns() - t_commit,
+                     step=payload.global_steps, tag=payload.tag, bytes=total)
     return total
 
 
@@ -323,6 +341,8 @@ def _retry_os(fn, what: str, retries: int, retry_backoff_s: float):
             if attempt > max(retries, 0):
                 raise CheckpointWriteError(
                     f"{what} failed after {attempt} attempt(s): {e}") from e
+            _emit_ckpt_event("ckpt.retry", what=what, attempt=attempt,
+                             error=str(e))
             delay = retry_backoff_s * (2 ** (attempt - 1))
             logger.warning(
                 f"{what}: transient error ({e}); "

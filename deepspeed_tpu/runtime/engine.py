@@ -35,6 +35,7 @@ parameter pytree.
 
 from __future__ import annotations
 
+import contextlib
 import inspect
 import os
 import time
@@ -237,6 +238,19 @@ class DeepSpeedEngine:
         self._tel_skip_seen = 0
         self._tel_skipped_prev = None    # health skip detection base
         self._tel_skipped_cached = None  # per-step skipped_steps fetch
+        # flight recorder: None when off — every hot-path emit site is one
+        # None check and allocates nothing
+        self._tel_events = None
+        self._ev_skip_prev = None        # fp16-skip event detection base
+        # on-demand jax.profiler capture window; armed by config
+        # (telemetry.profile) or engine.profile(steps=N). One None check
+        # per train_batch when absent.
+        self._profiler = None
+        pcfg = tcfg.profile
+        if pcfg.num_steps > 0:
+            from deepspeed_tpu.monitor.trace import ProfileWindow
+            self._profiler = ProfileWindow(pcfg.dir, pcfg.start_step,
+                                           pcfg.num_steps)
         if self._telemetry is not None:
             from deepspeed_tpu.monitor.health import sample_memory_gauges
             from deepspeed_tpu.monitor.metrics import get_registry
@@ -283,6 +297,10 @@ class DeepSpeedEngine:
                     "fp16 overflow skip-update steps so far")
                 self._tel_scale_gauge = reg.gauge(
                     "train/loss_scale", "current dynamic loss scale")
+            if tcfg.events.enabled:
+                from deepspeed_tpu.monitor.events import get_flight_recorder
+                self._tel_events = get_flight_recorder().enable(
+                    capacity=tcfg.events.capacity)
             hcfg = tcfg.health
             if hcfg.enabled:
                 from deepspeed_tpu.monitor.health import HealthMonitor
@@ -1015,24 +1033,31 @@ class DeepSpeedEngine:
             batch = jax.tree.map(shard_leaf, batch)
 
         self.tput_timer.start()
+        prof = self._profiler
+        if prof is not None:
+            # profile-window boundary: starts/stops the jax.profiler
+            # capture when an armed window begins/ends at this step
+            prof.tick()
         t0 = time.perf_counter() if self._telemetry is not None else 0.0
         self._rng, step_rng = jax.random.split(self._rng)
-        if self._offload is not None:
-            fn = self._accum_batch_jit.get(gas)
-            if fn is None:
-                fn = self._watched(self._build_accum_batch_fn(gas),
-                                   f"engine.accum_batch[gas={gas}]")
-                self._accum_batch_jit[gas] = fn
-            self.state, mean_loss = fn(self.state, batch, step_rng)
-            self._losses = mean_loss
-            metrics = self._host_step()
-        else:
-            fn = self._train_batch_jit.get(gas)
-            if fn is None:
-                fn = self._watched(self._build_train_batch_fn(gas),
-                                   f"engine.train_batch[gas={gas}]")
-                self._train_batch_jit[gas] = fn
-            self.state, metrics = fn(self.state, batch, step_rng)
+        with (prof.annotate("train_batch") if prof is not None
+              and prof.active else contextlib.nullcontext()):
+            if self._offload is not None:
+                fn = self._accum_batch_jit.get(gas)
+                if fn is None:
+                    fn = self._watched(self._build_accum_batch_fn(gas),
+                                       f"engine.accum_batch[gas={gas}]")
+                    self._accum_batch_jit[gas] = fn
+                self.state, mean_loss = fn(self.state, batch, step_rng)
+                self._losses = mean_loss
+                metrics = self._host_step()
+            else:
+                fn = self._train_batch_jit.get(gas)
+                if fn is None:
+                    fn = self._watched(self._build_train_batch_fn(gas),
+                                       f"engine.train_batch[gas={gas}]")
+                    self._train_batch_jit[gas] = fn
+                self.state, metrics = fn(self.state, batch, step_rng)
         self.tput_timer.stop(global_step=True)
         self._data_progress["iterations"] += 1
         self._data_progress["consumed_samples"] += self.train_batch_size()
@@ -1535,6 +1560,8 @@ class DeepSpeedEngine:
         """Drop compiled executables and large state references (reference
         engine.destroy): the engine is unusable afterwards."""
         self.disable_preemption_handler()
+        if self._profiler is not None:
+            self._profiler.stop()   # a dangling capture wedges the profiler
         if self._ckpt_writer is not None:
             self._ckpt_writer.stop()
             self._ckpt_writer = None
@@ -1647,6 +1674,13 @@ class DeepSpeedEngine:
         jax.block_until_ready(sync_on)
         dur = time.perf_counter() - t0
         self._tel_phase_hist.labels(phase=phase).observe(dur * 1e3)
+        if self._tel_events is not None:
+            now = time.monotonic_ns()
+            dur_ns = int(dur * 1e9)
+            self._tel_events.emit("train.phase",
+                                  step=self._host_global_steps,
+                                  t_ns=now - dur_ns, dur_ns=dur_ns,
+                                  phase=phase)
         # accumulated per update cycle: the trio path's device-busy time
         # (fwd + bwd + step), consumed by _trio_wait_busy at the boundary
         self._trio_busy_s += dur
@@ -1690,6 +1724,11 @@ class DeepSpeedEngine:
         self._tel_steps_counter.inc()
         self._tel_tracer.add_event("train_batch",
                                    time.perf_counter() - dt_s, dt_s)
+        if self._tel_events is not None:
+            now = time.monotonic_ns()
+            dur = int(dt_s * 1e9)
+            self._tel_events.emit("train.step", step=self._host_global_steps,
+                                  t_ns=now - dur, dur_ns=dur)
         lead = jax.tree.leaves(batch)[0]
         dims = lead.shape[:3] if lead.ndim >= 3 else lead.shape[:2]
         tokens = 1
@@ -1747,6 +1786,14 @@ class DeepSpeedEngine:
             self._tel_skipped_cached = skipped
             self._tel_skipped_gauge.set(skipped)
             self._tel_scale_gauge.set(float(metrics["loss_scale"]))
+            if self._tel_events is not None:
+                if self._ev_skip_prev is not None \
+                        and skipped > self._ev_skip_prev:
+                    self._tel_events.emit(
+                        "train.fp16_skip", step=self._host_global_steps,
+                        skipped_total=skipped,
+                        loss_scale=float(metrics["loss_scale"]))
+                self._ev_skip_prev = skipped
             if self._health is None:
                 # the HealthMonitor's sustained-overflow detector owns
                 # this when enabled; health-off still surfaces it
@@ -1886,6 +1933,20 @@ class DeepSpeedEngine:
                              "telemetry.chrome_trace_path")
         return self._tel_tracer.export_chrome_trace(path)
 
+    def profile(self, steps: int, log_dir: Optional[str] = None):
+        """Arm an on-demand device-profile capture: the next ``steps``
+        ``train_batch`` calls run under ``jax.profiler`` and the trace
+        lands in ``log_dir`` (default ``telemetry.profile.dir``) —
+        summarize with ``dscli profile <log_dir>``. Works with telemetry
+        off (it is a profiler window, not a metrics feature); raises if a
+        capture is already running. Returns the armed window."""
+        if self._profiler is None:
+            from deepspeed_tpu.monitor.trace import ProfileWindow
+            pcfg = self._config.telemetry_config.profile
+            self._profiler = ProfileWindow(log_dir or pcfg.dir)
+        self._profiler.arm(steps, log_dir=log_dir)
+        return self._profiler
+
     # ------------------------------------------------------------------ #
     # checkpointing
 
@@ -1939,7 +2000,13 @@ class DeepSpeedEngine:
             except Exception as e:
                 logger.warning(f"emergency save: drain failed ({e}); "
                                f"taking the synchronous save anyway")
-        return self.save_checkpoint(save_dir, asynchronous=False)
+        result = self.save_checkpoint(save_dir, asynchronous=False)
+        # ship the flight-recorder tail next to the emergency tag: the
+        # post-mortem gets the event timeline leading into the signal
+        # (no-op when the recorder is off; never fails the save)
+        from deepspeed_tpu.monitor.events import dump_events_jsonl
+        dump_events_jsonl(save_dir)
+        return result
 
     def enable_preemption_handler(self, save_dir, signals=None,
                                   exit_on_signal=True):

@@ -269,9 +269,11 @@ class TestCompileWatchdog:
         tr.add_event("bwd", 0.0, 0.002)
         path = tr.export_chrome_trace(str(tmp_path / "trace.json"))
         doc = json.load(open(path))
-        names = [e["name"] for e in doc["traceEvents"]]
-        assert names == ["fwd", "bwd"]
-        assert all(e["ph"] == "X" and e["dur"] >= 0 for e in doc["traceEvents"])
+        # metadata (process/thread names) precedes the spans; the span
+        # payload itself is unchanged
+        spans = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+        assert [e["name"] for e in spans] == ["fwd", "bwd"]
+        assert all(e["ph"] == "X" and e["dur"] >= 0 for e in spans)
 
 
 # --------------------------------------------------------------------- #

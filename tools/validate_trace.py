@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Schema validator for the observability exports — chrome-trace JSON
+(``StepTracer.export_chrome_trace``, ``InferenceEngine.
+export_serving_trace``) and flight-recorder ``events.jsonl``
+(``FlightRecorder.write_jsonl``).
+
+Used by the test suite so the export formats cannot silently drift, and
+exposed as ``dscli trace --validate <path>`` for CI / ad-hoc checks.
+Exit code 0 = valid, 1 = violations (printed one per line).
+
+Chrome-trace checks (structural, renderer-agnostic):
+
+- top level is an object with a ``traceEvents`` list;
+- every event has a known ``ph`` and the fields that phase requires
+  (``X``: numeric ts/dur + pid/tid, dur >= 0; ``C``: numeric args;
+  ``M``: process_name/thread_name metadata with ``args.name``; instants
+  need ts);
+- serving traces (events with ``cat == "request"``): exactly ONE
+  admission→retire request span per track, and every other slice on that
+  track lies inside its span — the acceptance shape of
+  ``export_serving_trace``.
+
+Events-JSONL checks: every line is an object with an integer ``ts_ns``
+and a ``kind`` from the recorder's typed catalogue
+(``deepspeed_tpu.monitor.events.EVENT_KINDS``, plus the
+``recorder.dropped`` header line). Timestamps are NOT required to be
+monotone: timed events carry their START stamp, and a concurrent
+checkpoint-writer event can legitimately start after a still-open train
+step that lands later in the ring.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List
+
+_PHASES = {"X", "B", "E", "i", "I", "C", "M", "b", "e", "n", "s", "t", "f"}
+_META_NAMES = {"process_name", "thread_name", "process_labels",
+               "process_sort_index", "thread_sort_index"}
+#: slack for float-us rounding when checking child-inside-span containment
+_CONTAIN_SLACK_US = 1.0
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _event_kinds():
+    """The recorder's typed catalogue; empty set (= skip the membership
+    check) when deepspeed_tpu is not importable — the validator stays
+    usable as a standalone script."""
+    try:
+        from deepspeed_tpu.monitor.events import EVENT_KINDS
+        return set(EVENT_KINDS)
+    except Exception:
+        return set()
+
+
+def validate_chrome_trace(doc: Any) -> List[str]:
+    """Return the list of schema violations in a chrome-trace document
+    (empty = valid)."""
+    errors: List[str] = []
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        return ["top level must be an object with a 'traceEvents' list"]
+    tracks: Dict[tuple, Dict[str, Any]] = {}
+    for i, ev in enumerate(doc["traceEvents"]):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _PHASES:
+            errors.append(f"{where}: unknown ph {ph!r}")
+            continue
+        if ph == "M":
+            if ev.get("name") not in _META_NAMES:
+                errors.append(f"{where}: metadata name {ev.get('name')!r} "
+                              f"not one of {sorted(_META_NAMES)}")
+            elif ev.get("name") in ("process_name", "thread_name") and \
+                    not isinstance(ev.get("args", {}).get("name"), str):
+                errors.append(f"{where}: metadata needs args.name string")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            errors.append(f"{where}: missing event name")
+        if not _is_num(ev.get("ts")):
+            errors.append(f"{where}: ts must be numeric")
+            continue
+        if ph == "X":
+            if not _is_num(ev.get("dur")) or ev["dur"] < 0:
+                errors.append(f"{where}: X event needs dur >= 0")
+                continue
+            if "pid" not in ev or "tid" not in ev:
+                errors.append(f"{where}: X event needs pid and tid")
+                continue
+            track = tracks.setdefault((ev["pid"], ev["tid"]),
+                                      {"requests": [], "slices": []})
+            rec = {"i": i, "ts": ev["ts"], "end": ev["ts"] + ev["dur"],
+                   "name": ev["name"]}
+            if ev.get("cat") == "request":
+                track["requests"].append(rec)
+            else:
+                track["slices"].append(rec)
+        elif ph == "C":
+            args = ev.get("args")
+            if not isinstance(args, dict) or not args or \
+                    not all(_is_num(v) for v in args.values()):
+                errors.append(f"{where}: counter args must be a non-empty "
+                              "dict of numbers")
+
+    # serving shape: one request span per track, children inside it
+    for (pid, tid), track in tracks.items():
+        reqs = track["requests"]
+        if not reqs:
+            continue
+        if len(reqs) > 1:
+            errors.append(f"track pid={pid} tid={tid}: {len(reqs)} request "
+                          "spans (admission->retire must be exactly one)")
+            continue
+        span = reqs[0]
+        lo = span["ts"] - _CONTAIN_SLACK_US
+        hi = span["end"] + _CONTAIN_SLACK_US
+        for s in track["slices"]:
+            if s["ts"] < lo or s["end"] > hi:
+                errors.append(
+                    f"track pid={pid} tid={tid}: slice {s['name']!r} "
+                    f"[{s['ts']:.1f}, {s['end']:.1f}]us outside its request "
+                    f"span [{span['ts']:.1f}, {span['end']:.1f}]us")
+    return errors
+
+
+def validate_events_jsonl(lines) -> List[str]:
+    """Validate flight-recorder JSONL content (an iterable of lines)."""
+    errors: List[str] = []
+    kinds = _event_kinds()
+    n = 0
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        n += 1
+        try:
+            rec = json.loads(line)
+        except ValueError as e:
+            errors.append(f"line {lineno}: not JSON ({e})")
+            continue
+        if not isinstance(rec, dict):
+            errors.append(f"line {lineno}: not an object")
+            continue
+        kind = rec.get("kind")
+        if not isinstance(kind, str) or not kind:
+            errors.append(f"line {lineno}: missing kind")
+            continue
+        if kind == "recorder.dropped":
+            if not isinstance(rec.get("count"), int) or rec["count"] < 1:
+                errors.append(f"line {lineno}: recorder.dropped needs a "
+                              "positive integer count")
+            continue
+        if kinds and kind not in kinds:
+            errors.append(f"line {lineno}: unknown kind {kind!r}")
+        ts = rec.get("ts_ns")
+        if not isinstance(ts, int):
+            errors.append(f"line {lineno}: ts_ns must be an integer")
+            continue
+        dur = rec.get("dur_ns")
+        if dur is not None and (not isinstance(dur, int) or dur < 0):
+            errors.append(f"line {lineno}: dur_ns must be a non-negative "
+                          "integer")
+        for key in ("rid", "step"):
+            if key in rec and not isinstance(rec[key], int):
+                errors.append(f"line {lineno}: {key} must be an integer")
+    if n == 0:
+        errors.append("no events (empty file)")
+    return errors
+
+
+def validate_path(path: str, kind: str = "auto") -> List[str]:
+    """Validate a file: ``kind`` = chrome | events | auto (by sniffing —
+    a JSON object with traceEvents is a chrome trace, otherwise JSONL)."""
+    with open(path) as f:
+        content = f.read()
+    if kind == "auto":
+        # both formats start with "{": a chrome trace is ONE json object
+        # (with traceEvents), events.jsonl is one object per line
+        try:
+            doc = json.loads(content)
+            kind = "chrome" if isinstance(doc, dict) \
+                and "traceEvents" in doc else "events"
+        except ValueError:
+            kind = "events"
+    if kind == "chrome":
+        try:
+            doc = json.loads(content)
+        except ValueError as e:
+            return [f"not valid JSON: {e}"]
+        return validate_chrome_trace(doc)
+    if kind == "events":
+        return validate_events_jsonl(content.splitlines())
+    raise ValueError(f"kind must be chrome|events|auto, got {kind!r}")
+
+
+def main(argv=None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        description="validate chrome-trace JSON / flight-recorder "
+                    "events.jsonl exports")
+    parser.add_argument("paths", nargs="+", help="file(s) to validate")
+    parser.add_argument("--kind", choices=("auto", "chrome", "events"),
+                        default="auto",
+                        help="schema to check (default: sniff per file)")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="suppress per-file OK lines")
+    args = parser.parse_args(argv)
+    rc = 0
+    for path in args.paths:
+        try:
+            errors = validate_path(path, kind=args.kind)
+        except OSError as e:
+            errors = [f"unreadable: {e}"]
+        if errors:
+            rc = 1
+            for e in errors:
+                print(f"{path}: {e}")
+        elif not args.quiet:
+            print(f"{path}: OK")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
