@@ -7,6 +7,7 @@ Subcommands mirror the reference's script family:
 - ``dscli health <jsonl> [--once|--json]`` — live health screen over a telemetry sink
 - ``dscli bench``                   — ``ds_bench`` collective micro-benchmarks
 - ``dscli ckpt verify <dir>``       — checkpoint integrity audit (per-tag manifest check)
+- ``dscli lint``                    — dslint trace-safety static analysis (rc=1 on new findings)
 - ``dscli trace --validate <path>`` — chrome-trace / events.jsonl schema check
 - ``dscli profile <logdir|trace>``  — summarize a jax.profiler capture / chrome trace
 - ``dscli elastic <config>``        — ``ds_elastic`` elastic-config inspector
@@ -94,6 +95,32 @@ def _ckpt(argv):
         print(f"latest -> {latest!r}: tag missing (CORRUPT pointer)")
     print(f"{len(reports)} tag(s), {corrupt} corrupt")
     return 1 if corrupt else 0
+
+
+def _load_dslint():
+    """Import ``tools/dslint`` (repo-level tool package, not a package
+    module — the same analyzer CI runs standalone) off the checkout's
+    tools/ directory."""
+    import importlib
+    import os
+
+    import deepspeed_tpu
+    tools_dir = os.path.abspath(os.path.join(
+        os.path.dirname(deepspeed_tpu.__file__), "..", "tools"))
+    if not os.path.isdir(os.path.join(tools_dir, "dslint")):
+        raise RuntimeError(
+            f"tools/dslint not found under {tools_dir} (run from a source "
+            "checkout, or `python tools/dslint` directly)")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    return importlib.import_module("dslint")
+
+
+def _lint(argv):
+    """``dscli lint`` — trace-safety static analysis over the package.
+    rc=0 clean / rc=1 on findings not in tools/dslint_baseline.json,
+    matching ``dscli trace --validate`` semantics."""
+    return _load_dslint().main(argv)
 
 
 def _load_validator():
@@ -290,15 +317,16 @@ def _dlts_hostfile():
 
 
 _COMMANDS = {"run": _run, "report": _report, "health": _health, "bench": _bench,
-             "ckpt": _ckpt, "trace": _trace, "profile": _profile,
-             "elastic": _elastic, "autotune": _autotune, "ssh": _ssh}
+             "ckpt": _ckpt, "lint": _lint, "trace": _trace,
+             "profile": _profile, "elastic": _elastic, "autotune": _autotune,
+             "ssh": _ssh}
 
 
 def main():
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
         print(__doc__)
-        print("usage: dscli {run|report|health|bench|ckpt|trace|profile|"
-              "elastic|autotune|ssh} [args...]")
+        print("usage: dscli {run|report|health|bench|ckpt|lint|trace|"
+              "profile|elastic|autotune|ssh} [args...]")
         return 0
     cmd = sys.argv[1]
     if cmd not in _COMMANDS:

@@ -677,8 +677,12 @@ class InferenceEngine:
         tokens = input_ids
         for _ in range(max_new):
             logits = self.forward(tokens)[:, -1, :].astype(jnp.float32)
-            nxt = self._sample_host(logits, temperature, top_k, rng)
-            rng, _ = jax.random.split(rng)
+            # split first, consume the child: sampling with `rng` and then
+            # splitting the SAME consumed key correlates the next step's
+            # stream with the draw already made (DS002; every other
+            # generate path uses this split-then-sample order)
+            rng, sub = jax.random.split(rng)
+            nxt = self._sample_host(logits, temperature, top_k, sub)
             tokens = jnp.concatenate([tokens, nxt[:, None].astype(jnp.int32)], axis=1)
             if eos_token_id is not None and bool((nxt == eos_token_id).all()):
                 break
@@ -1054,14 +1058,16 @@ class InferenceEngine:
                                                 jnp.asarray(slots, jnp.int32),
                                                 jnp.int32(L - 1))
                     rng, sub = jax.random.split(rng)
-                    tok = self._sample_host(logits.astype(jnp.float32),
-                                            temperature, top_k, sub)
+                    # fetch the sampled token BEFORE emitting: _sample_host
+                    # is device-only (argmax/categorical), so the np.asarray
+                    # here is the sync — emitting first would clock async
+                    # dispatch while the device work lands later (DS005)
+                    tok = np.asarray(self._sample_host(
+                        logits.astype(jnp.float32), temperature, top_k, sub))
                     if ev is not None:
-                        # the sample's host fetch synced the dispatch: the
-                        # span brackets device work + the sampling round-trip
                         ev.emit("req.prefill", rid=req.rid, t_ns=t0,
                                 dur_ns=time.monotonic_ns() - t0, tokens=L)
-                    sched.record_prefill(req, int(np.asarray(tok)[0]))
+                    sched.record_prefill(req, int(tok[0]))
                 elif kind == "prefill_chunk":
                     req = payload
                     if req.cow_pending is not None:

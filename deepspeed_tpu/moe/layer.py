@@ -70,9 +70,12 @@ class MoE:
             D = self.hidden_size
             F = self.expert.d_ff
             s_in, s_out = 1.0 / math.sqrt(D), 1.0 / math.sqrt(F)
+            # one fresh key per draw: fold_in on the key w_up already
+            # consumed would derive w_down from a spent key (DS002)
+            k_up, k_down = jax.random.split(kr)
             params["residual_mlp"] = {
-                "w_up": jax.random.normal(kr, (D, F)) * s_in, "b_up": jnp.zeros((F,)),
-                "w_down": jax.random.normal(jax.random.fold_in(kr, 1), (F, D)) * s_out,
+                "w_up": jax.random.normal(k_up, (D, F)) * s_in, "b_up": jnp.zeros((F,)),
+                "w_down": jax.random.normal(k_down, (F, D)) * s_out,
                 "b_down": jnp.zeros((D,))}
             params["coefficient"] = {"w": jax.random.normal(kc, (D, 2)) * 0.02, "b": jnp.zeros((2,))}
         return params

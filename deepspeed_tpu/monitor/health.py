@@ -630,58 +630,57 @@ def _fmt(v: Optional[float], spec: str = ".3g", missing: str = "-") -> str:
 def render_health_table(rec: Dict, prev: Optional[Dict] = None) -> str:
     """One-screen status table from a telemetry JSONL record (a registry
     snapshot line). ``prev`` (the previous record) sharpens the step-rate
-    and loss-trend readouts. Sections with no data are omitted."""
-    g = rec.get("gauges", {}) or {}
-    c = rec.get("counters", {}) or {}
-    h = rec.get("histograms", {}) or {}
-    lines: List[str] = []
+    and loss-trend readouts. Thin wrapper: the metric-key extraction lives
+    ONCE in :func:`health_summary`; this renders its dict (so the table
+    and ``dscli health --json`` can never drift apart)."""
+    return render_summary_table(health_summary(rec, prev))
 
-    step = rec.get("step")
-    ts = rec.get("ts")
+
+def render_summary_table(s: Dict[str, Any]) -> str:
+    """Render a :func:`health_summary` dict as the one-screen table.
+    Sections absent from the summary are omitted."""
+    lines: List[str] = []
+    step = s.get("step")
+    ts = s.get("ts")
     when = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts)) if ts else ""
     lines.append(f"deepspeed_tpu health — step {step if step is not None else '?'}"
                  f"  {when}".rstrip())
     lines.append("-" * 64)
 
     # ---- train throughput ---- #
-    st = h.get("train/step_time_ms")
-    if st or "train/steps" in c:
-        rate = None
-        if prev and ts and prev.get("ts") and "train/steps" in c \
-                and "train/steps" in (prev.get("counters") or {}):
-            dt = ts - prev["ts"]
-            dsteps = c["train/steps"] - prev["counters"]["train/steps"]
-            if dt > 0 and dsteps > 0:
-                rate = dsteps / dt
+    train = s.get("train")
+    if train is not None:
+        st = train.get("step_time_ms")
+        rate = train.get("steps_per_sec")
         if rate is None and st and st.get("mean"):
             rate = 1000.0 / st["mean"]
-        parts = [f"steps {int(c.get('train/steps', 0))}"]
+        parts = [f"steps {train['steps']}"]
         if st:
             parts.append(f"step {st['mean']:.1f}ms (p50 {st['p50']:.1f}, "
                          f"p99 {st['p99']:.1f})")
         if rate:
             parts.append(f"rate {rate:.2f}/s")
-        if "train/tokens_per_sec" in g:
-            parts.append(f"tok/s {g['train/tokens_per_sec']:,.0f}")
-        if "train/mfu" in g:
-            parts.append(f"MFU {g['train/mfu']:.3f}")
+        if "tokens_per_sec" in train:
+            parts.append(f"tok/s {train['tokens_per_sec']:,.0f}")
+        if "mfu" in train:
+            parts.append(f"MFU {train['mfu']:.3f}")
         lines.append("train    " + "   ".join(parts))
 
     # ---- loss / grad ---- #
-    if "train/loss" in g or "train/grad_norm" in h:
+    loss = s.get("loss")
+    if loss is not None:
         parts = []
-        if "train/loss" in g:
+        if "loss" in loss:
             trend = ""
-            pg = (prev or {}).get("gauges") or {}
-            if "train/loss" in pg:
-                d = g["train/loss"] - pg["train/loss"]
+            if "delta" in loss:
+                d = loss["delta"]
                 trend = " ↓" if d < 0 else (" ↑" if d > 0 else " →")
-            parts.append(f"loss {_fmt(g['train/loss'], '.4g')}{trend}")
-        if "health/loss_ewma" in g:
-            parts.append(f"ewma {_fmt(g['health/loss_ewma'], '.4g')}")
-        gn = h.get("train/grad_norm")
-        if gn and gn.get("count"):
-            cur = g.get("health/grad_norm")
+            parts.append(f"loss {_fmt(loss['loss'], '.4g')}{trend}")
+        if "ewma" in loss:
+            parts.append(f"ewma {_fmt(loss['ewma'], '.4g')}")
+        gn = loss.get("grad_norm_hist")
+        if gn:
+            cur = loss.get("grad_norm")
             cur_s = f"{_fmt(cur)} " if cur is not None else ""
             parts.append(f"grad_norm {cur_s}(p50 {_fmt(gn['p50'])}, "
                          f"p99 {_fmt(gn['p99'])})")
@@ -689,26 +688,27 @@ def render_health_table(rec: Dict, prev: Optional[Dict] = None) -> str:
             lines.append("loss     " + "   ".join(parts))
 
     # ---- fp16 / skips ---- #
-    if "train/loss_scale" in g or "train/skipped_steps" in g:
+    fp16 = s.get("fp16")
+    if fp16 is not None:
         parts = []
-        if "train/loss_scale" in g:
-            parts.append(f"loss_scale {_fmt(g['train/loss_scale'], '.6g')}")
-        if "train/skipped_steps" in g:
+        if "loss_scale" in fp16:
+            parts.append(f"loss_scale {_fmt(fp16['loss_scale'], '.6g')}")
+        if "skipped_steps" in fp16:
             # denominator: the snapshot's step stamp (advances on both the
             # train_batch and trio paths; the train/steps counter is
             # train_batch-only and would render "N/0" for trio runs)
-            total = rec.get("step") or int(c.get("train/steps", 0))
-            parts.append(f"skipped {int(g['train/skipped_steps'])}"
+            total = s.get("step") or (s.get("train") or {}).get("steps", 0)
+            parts.append(f"skipped {int(fp16['skipped_steps'])}"
                          f"/{int(total)} steps")
-        if "health/consecutive_skips" in g:
-            parts.append(f"consecutive {int(g['health/consecutive_skips'])}")
+        if "consecutive_skips" in fp16:
+            parts.append(f"consecutive {int(fp16['consecutive_skips'])}")
         lines.append("fp16     " + "   ".join(parts))
 
     # ---- anomalies / stall ---- #
-    anoms = labeled_series(c, "health/anomalies")
-    stall = g.get("train/data_stall_fraction")
-    if anoms or stall is not None:
-        nonzero = {k: int(v) for k, v in sorted(anoms.items()) if v}
+    anoms = s.get("anomalies")
+    stall = s.get("data_stall_fraction")
+    if anoms is not None or stall is not None:
+        nonzero = {k: v for k, v in sorted((anoms or {}).items()) if v}
         a_s = ", ".join(f"{k}:{v}" for k, v in nonzero.items()) \
             if nonzero else ("none" if anoms else "-")
         parts = [f"anomalies {a_s}"]
@@ -717,61 +717,65 @@ def render_health_table(rec: Dict, prev: Optional[Dict] = None) -> str:
         lines.append("health   " + "   ".join(parts))
 
     # ---- memory ---- #
-    used = labeled_series(g, "mem/hbm_bytes_in_use")
-    lim = labeled_series(g, "mem/hbm_bytes_limit")
-    peak = labeled_series(g, "mem/hbm_peak_bytes")
-    head = labeled_series(g, "mem/hbm_headroom_bytes")
-    rss = g.get("mem/host_rss_bytes")
-    if used or rss:
+    mem = s.get("memory")
+    if mem is not None:
+        used = mem.get("hbm_bytes_in_use") or {}
+        lim = mem.get("hbm_bytes_limit") or {}
+        peak = mem.get("hbm_peak_bytes") or {}
+        head = mem.get("hbm_headroom_bytes") or {}
+        rss = mem.get("host_rss_bytes")
         parts = []
         if used:
             mx = max(used, key=used.get)
             u, l2, p = used[mx], lim.get(mx, 0), peak.get(mx, 0)
-            s = f"HBM {_fmt_bytes(u)}"
+            line = f"HBM {_fmt_bytes(u)}"
             if l2:
-                s += f"/{_fmt_bytes(l2)}"
+                line += f"/{_fmt_bytes(l2)}"
             if p:
-                s += f" (peak {_fmt_bytes(p)}"
+                line += f" (peak {_fmt_bytes(p)}"
                 if head.get(mx) is not None:
-                    s += f", headroom {_fmt_bytes(head[mx])}"
-                s += ")"
-            parts.append(s + f" [{mx}]")
+                    line += f", headroom {_fmt_bytes(head[mx])}"
+                line += ")"
+            parts.append(line + f" [{mx}]")
         if rss:
             parts.append(f"host RSS {_fmt_bytes(rss)}")
-        lines.append("memory   " + "   ".join(parts))
+        if parts:
+            lines.append("memory   " + "   ".join(parts))
 
     # ---- serving ---- #
-    ttft = h.get("serving/ttft_ms")
-    if ttft and ttft.get("count") or "serving/queue_depth" in g:
+    serving = s.get("serving")
+    if serving is not None and ("ttft_ms" in serving
+                                or "queue_depth" in serving):
         parts = []
-        if ttft and ttft.get("count"):
+        ttft = serving.get("ttft_ms")
+        if ttft:
             parts.append(f"TTFT p50 {ttft['p50']:.1f}ms p99 {ttft['p99']:.1f}ms")
-        tpot = h.get("serving/tpot_ms")
-        if tpot and tpot.get("count"):
+        tpot = serving.get("tpot_ms")
+        if tpot:
             parts.append(f"TPOT p50 {tpot['p50']:.2f}ms")
-        if "serving/queue_depth" in g:
-            parts.append(f"queue {int(g['serving/queue_depth'])}")
-        if "serving/running" in g:
-            parts.append(f"running {int(g['serving/running'])}")
-        if "serving/kv_block_utilization" in g:
-            s = f"KV util {g['serving/kv_block_utilization']:.2f}"
-            if "serving/kv_blocks_free" in g:
-                s += f" free {int(g['serving/kv_blocks_free'])}"
-            if "serving/kv_fragmentation" in g:
-                s += f" frag {g['serving/kv_fragmentation']:.2f}"
-            parts.append(s)
-        lookups = c.get("serving/prefix_cache_lookups", 0)
+        if "queue_depth" in serving:
+            parts.append(f"queue {int(serving['queue_depth'])}")
+        if "running" in serving:
+            parts.append(f"running {int(serving['running'])}")
+        if "kv_block_utilization" in serving:
+            line = f"KV util {serving['kv_block_utilization']:.2f}"
+            if "kv_blocks_free" in serving:
+                line += f" free {int(serving['kv_blocks_free'])}"
+            if "kv_fragmentation" in serving:
+                line += f" frag {serving['kv_fragmentation']:.2f}"
+            parts.append(line)
+        lookups = serving.get("prefix_cache_lookups", 0)
         if lookups:
-            hits = c.get("serving/prefix_cache_hits", 0)
-            s = f"cache {int(hits)}/{int(lookups)} ({hits / lookups:.0%})"
-            toks = c.get("serving/prefix_cache_hit_tokens", 0)
+            hits = serving.get("prefix_cache_hits", 0)
+            line = f"cache {int(hits)}/{int(lookups)} ({hits / lookups:.0%})"
+            toks = serving.get("prefix_cache_hit_tokens", 0)
             if toks:
-                s += f" +{int(toks)}tok"
-            if "serving/cold_blocks" in g:
-                s += f" cold {int(g['serving/cold_blocks'])}"
-            parts.append(s)
-        if "serving/preemptions" in c:
-            parts.append(f"preempt {int(c['serving/preemptions'])}")
+                line += f" +{int(toks)}tok"
+            if "cold_blocks" in serving:
+                line += f" cold {int(serving['cold_blocks'])}"
+            parts.append(line)
+        if "preemptions" in serving:
+            parts.append(f"preempt {int(serving['preemptions'])}")
         if parts:
             lines.append("serving  " + "   ".join(parts))
 
@@ -816,6 +820,9 @@ def health_summary(rec: Dict, prev: Optional[Dict] = None) -> Dict[str, Any]:
                       ("health/grad_norm", "grad_norm")):
         if key in g:
             loss[name] = g[key]
+    pg = (prev or {}).get("gauges") or {}
+    if "train/loss" in g and "train/loss" in pg:
+        loss["delta"] = g["train/loss"] - pg["train/loss"]   # trend
     if h.get("train/grad_norm", {}).get("count"):
         loss["grad_norm_hist"] = h["train/grad_norm"]
     if loss:

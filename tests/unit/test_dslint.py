@@ -1,0 +1,872 @@
+"""dslint analyzer tests: per-rule positive/negative fixtures (pure AST,
+no jax device work), the suppression + baseline workflow, CLI rc
+semantics, the repo-wide run pinned green against
+``tools/dslint_baseline.json``, and the compile-budget contracts
+(unit semantics + tier-1 integration through the PR-3 CompileWatchdog,
+including the deliberately shape-unstable fixture that must fail its
+budget)."""
+
+import json
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+_TOOLS = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                      "..", "..", "tools"))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+import dslint  # noqa: E402
+from dslint.callgraph import PackageIndex  # noqa: E402
+from dslint.contracts import (BUDGETS, CompileBudget,  # noqa: E402
+                              budgets_for, check_compile_budgets)
+from dslint.core import (LintContext, load_baseline, run_lint,  # noqa: E402
+                         write_baseline)
+
+REPO = os.path.dirname(_TOOLS)
+
+
+# --------------------------------------------------------------------- #
+# fixture harness: write a throwaway package, lint it with one rule
+
+
+def lint_pkg(tmp_path, sources, select=None, tests=None, pytest_ini=None,
+             conftest=None, baseline=None):
+    """Lint a fixture tree. ``sources``: relpath->code under ``pkg/``;
+    ``tests``: relpath->code under ``tests/``. Returns the LintResult."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    for rel, src in sources.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    tests_index = None
+    if tests is not None:
+        tdir = tmp_path / "tests"
+        tdir.mkdir(exist_ok=True)
+        for rel, src in tests.items():
+            (tdir / rel).write_text(textwrap.dedent(src))
+        tests_index = PackageIndex(str(tmp_path), ["tests"])
+    ini_path = None
+    if pytest_ini is not None:
+        ini_path = tmp_path / "pytest.ini"
+        ini_path.write_text(textwrap.dedent(pytest_ini))
+    conftest_path = None
+    if conftest is not None:
+        tdir = tmp_path / "tests"
+        tdir.mkdir(exist_ok=True)
+        conftest_path = tdir / "conftest.py"
+        conftest_path.write_text(textwrap.dedent(conftest))
+    ctx = LintContext(
+        repo_root=str(tmp_path),
+        index=PackageIndex(str(tmp_path), ["pkg"]),
+        tests_index=tests_index,
+        pytest_ini=str(ini_path) if ini_path else None,
+        conftest=str(conftest_path) if conftest_path else None)
+    return run_lint(ctx, select=select,
+                    baseline_path=baseline or str(tmp_path / "no_baseline"))
+
+
+def rules_fired(result):
+    return sorted({f.rule for f in result.findings})
+
+
+# --------------------------------------------------------------------- #
+# DS001 host-sync-in-hot-path
+
+
+class TestDS001HostSync:
+
+    def test_positive_item_and_asarray_in_jit(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(x):
+                y = np.asarray(x)
+                return x.item() + y
+            """}, select=["DS001"])
+        msgs = [f.message for f in res.findings]
+        assert len(res.findings) == 2
+        assert any(".item()" in m for m in msgs)
+        assert any("np.asarray" in m for m in msgs)
+
+    def test_positive_float_on_traced_reachable_via_callgraph(self, tmp_path):
+        # the hazard sits in a helper, only reachable THROUGH the jit root
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            def helper(x):
+                return float(x) * 2.0
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+            """}, select=["DS001"])
+        assert len(res.findings) == 1
+        assert "jit-reachable via" in res.findings[0].message
+
+    def test_negative_not_jit_reachable(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import numpy as np
+
+            def host_side(x):
+                return float(np.asarray(x).mean())
+            """}, select=["DS001"])
+        assert res.findings == []
+
+    def test_negative_float_on_static_config(self, tmp_path):
+        # cfg is a conventional static name: float(cfg.lr) is trace-safe
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def step(x, cfg):
+                return x * float(cfg)
+            """}, select=["DS001"])
+        assert res.findings == []
+
+
+# --------------------------------------------------------------------- #
+# DS002 rng-key-reuse
+
+
+class TestDS002KeyReuse:
+
+    def test_positive_same_key_two_draws(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            def init(key):
+                a = jax.random.normal(key, (4,))
+                b = jax.random.normal(key, (4,))
+                return a, b
+            """}, select=["DS002"])
+        assert len(res.findings) == 1
+        assert "already consumed" in res.findings[0].message
+
+    def test_positive_split_after_consume(self, tmp_path):
+        # the PR-8 inference.generate bug shape: sample with rng, THEN
+        # split the spent key
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            def sample(rng, logits):
+                tok = jax.random.categorical(rng, logits)
+                rng, sub = jax.random.split(rng)
+                return tok, rng
+            """}, select=["DS002"])
+        assert len(res.findings) == 1
+        assert "split" in res.findings[0].message
+
+    def test_positive_reuse_through_helper(self, tmp_path):
+        # consumption is tracked through the intra-package call graph
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            def draw(key, shape):
+                return jax.random.normal(key, shape)
+
+            def init(key):
+                a = draw(key, (4,))
+                b = draw(key, (4,))
+                return a, b
+            """}, select=["DS002"])
+        assert len(res.findings) == 1
+        assert "draw" in res.findings[0].message
+
+    def test_negative_split_then_consume_children(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            def init(key):
+                k1, k2 = jax.random.split(key)
+                a = jax.random.normal(k1, (4,))
+                b = jax.random.normal(k2, (4,))
+                return a, b
+            """}, select=["DS002"])
+        assert res.findings == []
+
+    def test_negative_either_or_branches(self, tmp_path):
+        # consumption on only one side of an if/else is legal
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            def sample(rng, logits, greedy):
+                if greedy:
+                    return logits.argmax()
+                else:
+                    return jax.random.categorical(rng, logits)
+            """}, select=["DS002"])
+        assert res.findings == []
+
+    def test_positive_loop_carried_reuse(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            def roll(rng, n):
+                out = []
+                for _ in range(n):
+                    out.append(jax.random.normal(rng, (2,)))
+                return out
+            """}, select=["DS002"])
+        assert len(res.findings) == 1
+
+    def test_negative_refreshed_in_loop(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            def roll(rng, n):
+                out = []
+                for _ in range(n):
+                    rng, sub = jax.random.split(rng)
+                    out.append(jax.random.normal(sub, (2,)))
+                return out
+            """}, select=["DS002"])
+        assert res.findings == []
+
+
+# --------------------------------------------------------------------- #
+# DS003 np-on-traced
+
+
+class TestDS003NpOnTraced:
+
+    def test_positive_np_on_traced_param(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(x):
+                return np.sum(x)
+            """}, select=["DS003"])
+        assert len(res.findings) == 1
+        assert "np.sum" in res.findings[0].message
+
+    def test_positive_through_dataflow(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(x):
+                y = x * 2
+                return np.tanh(y)
+            """}, select=["DS003"])
+        assert len(res.findings) == 1
+
+    def test_negative_np_on_static(self, tmp_path):
+        # np on shapes/constants at trace time is fine (and idiomatic)
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(x):
+                scale = np.sqrt(float(x.shape[-1]))
+                pad = np.zeros((4,))
+                return x / scale
+            """}, select=["DS003"])
+        assert res.findings == []
+
+
+# --------------------------------------------------------------------- #
+# DS004 python-control-flow-on-traced
+
+
+class TestDS004ControlFlow:
+
+    def test_positive_if_on_traced(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def relu(x):
+                if x > 0:
+                    return x
+                return 0.0 * x
+            """}, select=["DS004"])
+        assert len(res.findings) == 1
+        assert "lax.cond" in res.findings[0].message
+
+    def test_positive_while_on_jnp_result(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def loop(x):
+                while jnp.any(x > 0):
+                    x = x - 1
+                return x
+            """}, select=["DS004"])
+        assert len(res.findings) == 1
+
+    def test_negative_branch_on_shape(self, tmp_path):
+        # shape access is static even on tracers
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def maybe_pad(x):
+                if x.shape[0] > 4:
+                    return x
+                return x * 2
+            """}, select=["DS004"])
+        assert res.findings == []
+
+    def test_negative_branch_on_mode_flag(self, tmp_path):
+        # params with bool/str/None defaults are mode flags, not tracers
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def step(x, training=False):
+                if training:
+                    return x * 2
+                return x
+            """}, select=["DS004"])
+        assert res.findings == []
+
+
+# --------------------------------------------------------------------- #
+# DS005 untimed-device-work
+
+
+class TestDS005UntimedDeviceWork:
+
+    def test_positive_perf_bracket_no_sync(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import time
+
+            def bench(step_jit, batch):
+                t0 = time.perf_counter()
+                out = step_jit(batch)
+                dt = time.perf_counter() - t0
+                return out, dt
+            """}, select=["DS005"])
+        assert len(res.findings) == 1
+        assert "async dispatch" in res.findings[0].message
+
+    def test_negative_synced_before_read(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import time
+            import jax
+
+            def bench(step_jit, batch):
+                t0 = time.perf_counter()
+                out = step_jit(batch)
+                jax.block_until_ready(out)
+                dt = time.perf_counter() - t0
+                return out, dt
+            """}, select=["DS005"])
+        assert res.findings == []
+
+    def test_positive_span_no_sync(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            def run(tracer, step_jit, batch):
+                with tracer.span("train_step"):
+                    out = step_jit(batch)
+                return out
+            """}, select=["DS005"])
+        assert len(res.findings) == 1
+        assert "span" in res.findings[0].message
+
+    def test_negative_span_with_host_transfer(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import numpy as np
+
+            def run(tracer, step_jit, batch):
+                with tracer.span("train_step"):
+                    out = step_jit(batch)
+                    loss = np.asarray(out)
+                return loss
+            """}, select=["DS005"])
+        assert res.findings == []
+
+
+# --------------------------------------------------------------------- #
+# DS006 nondeterminism-in-jit
+
+
+class TestDS006Nondeterminism:
+
+    def test_positive_time_and_stdlib_random(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+            import time
+            import random
+
+            @jax.jit
+            def step(x):
+                jitter = random.random()
+                return x * time.time() + jitter
+            """}, select=["DS006"])
+        assert len(res.findings) == 2
+        assert all("trace time" in f.message for f in res.findings)
+
+    def test_positive_set_iteration(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def step(x, names):
+                for n in set(names):
+                    x = x + len(n)
+                return x
+            """}, select=["DS006"])
+        assert len(res.findings) == 1
+        assert "unordered set" in res.findings[0].message
+
+    def test_negative_outside_jit(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import time
+
+            def wall_clock():
+                return time.time()
+            """}, select=["DS006"])
+        assert res.findings == []
+
+    def test_negative_sorted_iteration(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def step(x, names):
+                for n in sorted(set(names)):
+                    x = x + len(n)
+                return x
+            """}, select=["DS006"])
+        # sorted(set(...)) is deterministic: the iter node is the sorted()
+        # call, not the set
+        assert res.findings == []
+
+
+# --------------------------------------------------------------------- #
+# DS007 / DS008 marker audit (tests domain)
+
+_INI_TPU = """
+    [pytest]
+    markers =
+        tpu: needs hardware
+    addopts = -m "not tpu"
+"""
+
+_GATED_CONFTEST = """
+    def pytest_collection_modifyitems(config, items):
+        gates = [("tpu", "DS_TPU_TESTS", "needs a real TPU")]
+        for marker, env, reason in gates:
+            pass
+"""
+
+
+class TestMarkerAudit:
+
+    def test_ds007_positive_unregistered_marker(self, tmp_path):
+        res = lint_pkg(tmp_path, {}, select=["DS007"],
+                       tests={"test_a.py": """
+                           import pytest
+
+                           @pytest.mark.mystery
+                           def test_x():
+                               pass
+                           """},
+                       pytest_ini=_INI_TPU, conftest=_GATED_CONFTEST)
+        assert len(res.findings) == 1
+        assert "mystery" in res.findings[0].message
+
+    def test_ds007_negative_registered_and_builtin(self, tmp_path):
+        res = lint_pkg(tmp_path, {}, select=["DS007"],
+                       tests={"test_a.py": """
+                           import pytest
+
+                           @pytest.mark.tpu
+                           @pytest.mark.parametrize("n", [1, 2])
+                           def test_x(n):
+                               pass
+                           """},
+                       pytest_ini=_INI_TPU, conftest=_GATED_CONFTEST)
+        assert res.findings == []
+
+    def test_ds008_positive_excluded_tier_without_gate(self, tmp_path):
+        # addopts excludes tpu but no conftest env-gated skip: any
+        # command-line -m REPLACES addopts and unleashes the tier
+        res = lint_pkg(tmp_path, {}, select=["DS008"],
+                       tests={"test_a.py": "def test_x():\n    pass\n"},
+                       pytest_ini=_INI_TPU)
+        assert len(res.findings) == 1
+        assert "tpu" in res.findings[0].message
+        assert "replaces addopts" in res.findings[0].message
+
+    def test_ds008_negative_gated(self, tmp_path):
+        res = lint_pkg(tmp_path, {}, select=["DS008"],
+                       tests={"test_a.py": "def test_x():\n    pass\n"},
+                       pytest_ini=_INI_TPU, conftest=_GATED_CONFTEST)
+        assert res.findings == []
+
+
+# --------------------------------------------------------------------- #
+# suppressions + baseline workflow
+
+
+class TestSuppressionsAndBaseline:
+
+    _HOT = {"mod.py": """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.item()
+        """}
+
+    def test_inline_trailing_suppression(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x.item()  # dslint: disable=DS001
+            """}, select=["DS001"])
+        assert res.findings == []
+
+    def test_own_line_suppression_covers_next_line(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def step(x):
+                # dslint: disable=DS001
+                return x.item()
+            """}, select=["DS001"])
+        assert res.findings == []
+
+    def test_file_level_suppression(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            # dslint: disable-file=DS001
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x.item()
+            """}, select=["DS001"])
+        assert res.findings == []
+
+    def test_suppression_is_rule_specific(self, tmp_path):
+        res = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x.item()  # dslint: disable=DS006
+            """}, select=["DS001"])
+        assert len(res.findings) == 1
+
+    def test_baseline_round_trip(self, tmp_path):
+        bl = str(tmp_path / "baseline.json")
+        res = lint_pkg(tmp_path, self._HOT, select=["DS001"], baseline=bl)
+        assert len(res.new) == 1
+        fp = res.new[0].fingerprint
+
+        # regenerate: fresh entries carry the TODO sentinel
+        todo = write_baseline(bl, res.findings, {})
+        assert todo == 1
+        entries = load_baseline(bl)
+        assert entries[fp]["justification"].startswith("TODO")
+
+        # with the baseline in place, the same findings stop being new
+        res2 = lint_pkg(tmp_path, self._HOT, select=["DS001"], baseline=bl)
+        assert res2.new == [] and len(res2.baselined) == 1
+
+        # justifications survive regeneration by fingerprint
+        entries[fp]["justification"] = "accepted: boundary sync by design"
+        with open(bl, "w") as f:
+            json.dump({"version": 1, "entries": list(entries.values())}, f)
+        todo = write_baseline(bl, res2.findings, load_baseline(bl))
+        assert todo == 0
+        assert load_baseline(bl)[fp]["justification"].startswith("accepted")
+
+    def test_stale_baseline_entries_reported(self, tmp_path):
+        bl = str(tmp_path / "baseline.json")
+        res = lint_pkg(tmp_path, self._HOT, select=["DS001"], baseline=bl)
+        write_baseline(bl, res.findings, {})
+        # the hazard gets fixed; its baseline entry must surface as stale
+        res2 = lint_pkg(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x * 2
+            """}, select=["DS001"], baseline=bl)
+        assert res2.findings == []
+        assert len(res2.stale_baseline) == 1
+
+
+# --------------------------------------------------------------------- #
+# CLI rc semantics + rule catalogue
+
+
+class TestCliAndCatalogue:
+
+    def _violating_checkout(self, tmp_path):
+        pkg = tmp_path / "deepspeed_tpu"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(textwrap.dedent("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x.item()
+            """))
+        (tmp_path / "tools").mkdir()
+        return tmp_path
+
+    def test_rc1_on_new_finding_rc0_after_update_baseline(self, tmp_path,
+                                                          capsys):
+        root = self._violating_checkout(tmp_path)
+        assert dslint.main(["--root", str(root)]) == 1
+        out = capsys.readouterr().out
+        assert "DS001" in out and "1 new" in out
+        # triage: regenerate the ledger, then the gate is green
+        assert dslint.main(["--root", str(root), "--update-baseline"]) == 0
+        assert dslint.main(["--root", str(root)]) == 0
+        # --no-baseline ignores the ledger: the finding fails again
+        assert dslint.main(["--root", str(root), "--no-baseline"]) == 1
+
+    def test_unknown_select_is_an_error_not_a_clean_run(self, tmp_path):
+        # a typoed --select must not silently run zero rules and pass
+        root = self._violating_checkout(tmp_path)
+        with pytest.raises(SystemExit) as e:
+            dslint.main(["--root", str(root), "--select", "DS0002"])
+        assert e.value.code == 2
+
+    def test_update_baseline_refuses_partial_select_run(self, tmp_path):
+        # regenerating the ledger from a one-rule run would drop every
+        # other rule's entries and their justifications
+        root = self._violating_checkout(tmp_path)
+        with pytest.raises(SystemExit) as e:
+            dslint.main(["--root", str(root), "--select", "DS001",
+                         "--update-baseline"])
+        assert e.value.code == 2
+
+    def test_select_run_does_not_flag_other_rules_stale(self, tmp_path):
+        # the repo baseline holds DS001/DS004 entries; a DS002-only run
+        # must not report them as no-longer-firing
+        ctx = dslint.build_context(REPO)
+        res = dslint.run_lint(ctx, select=["DS002"])
+        assert res.stale_baseline == []
+
+    def test_list_rules(self, capsys):
+        assert dslint.main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in ("DS001", "DS002", "DS003", "DS004", "DS005", "DS006",
+                    "DS007", "DS008"):
+            assert rid in out
+
+    def test_catalogue_ids_and_rationales(self):
+        assert set(dslint.RULES) == {"DS001", "DS002", "DS003", "DS004",
+                                     "DS005", "DS006", "DS007", "DS008"}
+        names = [r.name for r in dslint.RULES.values()]
+        assert len(set(names)) == len(names)
+        for info in dslint.RULES.values():
+            assert info.rationale, f"{info.id} has no rationale docstring"
+            assert info.domain in ("package", "tests")
+
+
+# --------------------------------------------------------------------- #
+# the repo-wide gate (THE tier-1 CI check)
+
+
+class TestRepoWideLint:
+
+    def test_repo_lint_green_against_baseline(self):
+        """Zero unbaselined findings over the real package + tests, no
+        parse errors, inside the 30 s CPU budget."""
+        t0 = time.perf_counter()
+        ctx = dslint.build_context(REPO)
+        res = dslint.run_lint(ctx)
+        dt = time.perf_counter() - t0
+        assert not res.errors, res.errors
+        assert res.new == [], "unbaselined dslint findings:\n" + \
+            "\n".join(f.render() for f in res.new)
+        assert res.stale_baseline == [], (
+            "baseline entries no longer firing (run "
+            "`dscli lint --update-baseline`): "
+            f"{res.stale_baseline}")
+        assert dt < 30.0, f"dslint took {dt:.1f}s (budget 30s)"
+
+    def test_serving_engine_timing_brackets_stay_synced(self):
+        """Regression pin for the PR-8 DS005 fix: generate_batch emitted
+        the req.prefill event BEFORE the sampled token's host fetch, so
+        the span clocked async dispatch. The serving engine must stay
+        DS005-clean — baselining a new finding there doesn't satisfy this
+        test, fixing it does."""
+        ctx = dslint.build_context(REPO)
+        res = dslint.run_lint(ctx, select=["DS005"],
+                              baseline_path="/nonexistent")
+        offenders = [f for f in res.findings
+                     if f.path == "deepspeed_tpu/inference/engine.py"]
+        assert offenders == [], "\n".join(f.render() for f in offenders)
+
+    def test_baseline_has_no_silent_suppressions(self):
+        """Every accepted finding carries a real one-line justification —
+        the TODO sentinel from --update-baseline must never land."""
+        entries = load_baseline(os.path.join(REPO, "tools",
+                                             "dslint_baseline.json"))
+        assert entries, "baseline missing or empty"
+        for fp, e in entries.items():
+            just = e.get("justification", "")
+            assert just and not just.startswith("TODO"), \
+                f"unjustified baseline entry: {fp}"
+
+
+# --------------------------------------------------------------------- #
+# compile-budget contracts
+
+
+class TestCompileBudgetSemantics:
+    """Pure-python contract checker semantics (no jax)."""
+
+    def test_within_budget_passes(self):
+        assert check_compile_budgets(
+            {"engine.train_batch[gas=1]": 1}, "steady_train") == []
+
+    def test_over_budget_reports_with_rationale(self):
+        out = check_compile_budgets(
+            {"engine.train_batch[gas=1]": 3}, "steady_train")
+        assert len(out) == 1
+        assert "3 compiles exceeds" in out[0]
+        assert "signature is unstable" in out[0]
+
+    def test_untouched_entries_pass(self):
+        # entries the scenario never compiled are simply absent from by_fn
+        assert check_compile_budgets({}, "steady_train") == []
+
+    def test_strict_flags_undeclared_entry_points(self):
+        out = check_compile_budgets({"engine.mystery_step": 1},
+                                    "steady_train", strict=True)
+        assert len(out) == 1 and "declares no compile budget" in out[0]
+        assert check_compile_budgets({"engine.mystery_step": 1},
+                                     "steady_train") == []
+
+    def test_registry_covers_the_acceptance_entries(self):
+        assert "engine.train_batch[gas=1]" in budgets_for("steady_train")
+        assert "inference.paged_decode" in budgets_for("serving_steady")
+        for b in BUDGETS:
+            assert b.max_compiles >= 1 and b.note
+
+
+class TestCompileBudgetContracts:
+    """Tier-1 integration: drive the real engines through the contract
+    scenarios and verify the watchdog counts against the registry."""
+
+    @pytest.fixture(autouse=True)
+    def clean_state(self):
+        import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.monitor.metrics import get_registry
+        from deepspeed_tpu.monitor.trace import get_compile_watchdog
+        dist.set_mesh(None)
+        get_registry().reset()
+        get_registry().set_enabled(True)
+        get_compile_watchdog().reset()
+        yield
+        dist.set_mesh(None)
+        get_registry().reset()
+        get_registry().set_enabled(True)
+        get_compile_watchdog().reset()
+
+    def _tiny_model(self, **over):
+        from deepspeed_tpu.models import CausalLM
+        from deepspeed_tpu.models.transformer import TransformerConfig
+        base = dict(vocab_size=64, n_layer=2, n_head=2, d_model=32,
+                    d_ff=64, max_seq=64, remat=False,
+                    attention_backend="xla")
+        base.update(over)
+        return CausalLM(TransformerConfig(**base))
+
+    def test_steady_train_contract(self):
+        """Pins train_batch[gas=1] at its contracted compile count: three
+        identical steps, ONE compile — a second would be a signature
+        regression (python scalars, weak_type flap, donation mismatch)."""
+        import jax
+        import numpy as np
+
+        import deepspeed_tpu
+        import deepspeed_tpu.comm as dist
+
+        model = self._tiny_model(max_seq=32)
+        params = model.init_params(jax.random.key(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "gradient_accumulation_steps": 1,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "mesh": {"dp": -1}, "steps_per_print": 0,
+                    "telemetry": {"enabled": True}})
+        dp = dist.get_world_size(dist.data_parallel_axes(engine.mesh))
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(
+            0, 64, size=(dp, 32)).astype(np.int32)}
+        for _ in range(3):
+            engine.train_batch(batch)
+        by_fn = engine.telemetry_snapshot()["compile"]["by_fn"]
+        assert by_fn.get("engine.train_batch[gas=1]") == 1
+        violations = check_compile_budgets(by_fn, "steady_train",
+                                           strict=True)
+        assert violations == [], "\n".join(violations)
+
+    def test_serving_steady_contract(self):
+        """Pins the fused decode step at ONE compile for a whole mixed-
+        length generate_batch, and the prefill path within its per-bucket
+        budget."""
+        import numpy as np
+
+        import deepspeed_tpu
+
+        engine = deepspeed_tpu.init_inference(
+            self._tiny_model(), dtype="fp32", telemetry=True,
+            serving={"block_size": 8, "max_running": 2})
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 64, size=n).astype(np.int32)
+                   for n in (5, 11, 3)]
+        outs = engine.generate_batch(prompts, max_new_tokens=4)
+        assert len(outs) == 3
+        by_fn = engine.telemetry_snapshot()["compile"]["by_fn"]
+        assert by_fn.get("inference.paged_decode") == 1
+        violations = check_compile_budgets(by_fn, "serving_steady",
+                                           strict=True)
+        assert violations == [], "\n".join(violations)
+
+    def test_shape_unstable_fixture_fails_its_budget(self):
+        """The deliberate regression: a watched entry point called with a
+        churning input shape recompiles per call and MUST violate a
+        1-compile budget — this is what a real shape-stability regression
+        looks like to the contract test."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from deepspeed_tpu.monitor.metrics import MetricsRegistry
+        from deepspeed_tpu.monitor.trace import CompileWatchdog
+
+        wd = CompileWatchdog(registry=MetricsRegistry())
+        step = wd.jit(lambda x: jnp.sum(x * 2), name="fixture.step")
+        for n in (4, 8, 16):          # shape-unstable: a compile per call
+            step(np.ones((n,), np.float32))
+        assert wd.compile_count("fixture.step") == 3
+        budgets = [CompileBudget("fixture.step", "steady_train", 1,
+                                 "fixture entry: fixed shape expected")]
+        violations = check_compile_budgets(
+            wd.summary()["by_fn"], "steady_train", budgets=budgets)
+        assert len(violations) == 1
+        assert "3 compiles exceeds" in violations[0]
+
+        # and the stable call pattern passes the same budget
+        wd.reset()
+        for _ in range(3):
+            step(np.ones((4,), np.float32))
+        assert check_compile_budgets(wd.summary()["by_fn"], "steady_train",
+                                     budgets=budgets) == []

@@ -38,6 +38,53 @@ def test_generate_greedy_deterministic():
     np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
 
 
+class _NoCacheLM:
+    """CausalLM facade WITHOUT forward_cached/init_cache — forces
+    ``generate`` onto its full-prefix-recompute fallback path."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.config = inner.config
+
+    def init_params(self, rng):
+        return self._inner.init_params(rng)
+
+    def forward(self, params, tokens, attn_mask=None):
+        return self._inner.forward(params, tokens, attn_mask)
+
+    __call__ = forward
+
+
+def test_generate_fallback_rng_single_use(monkeypatch):
+    """Regression for the PR-8 dslint DS002 finding: the fallback generate
+    loop sampled with ``rng`` and then split the SAME consumed key, so the
+    first draw used the raw seed key and every later step's stream was
+    correlated with the draw already made. Pin the split-first order: every
+    key reaching ``_sample_host`` is a fresh split child — distinct from
+    the seed key and from each other."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    seen = []
+    real_sample = InferenceEngine._sample_host
+
+    def recording_sample(logits, temperature, top_k, rng):
+        seen.append(np.asarray(jax.random.key_data(rng)).tobytes())
+        return real_sample(logits, temperature, top_k, rng)
+
+    monkeypatch.setattr(InferenceEngine, "_sample_host",
+                        staticmethod(recording_sample))
+    engine = deepspeed_tpu.init_inference(_NoCacheLM(tiny_model()),
+                                          dtype="fp32")
+    out = engine.generate(jnp.array([[1, 2, 3]], jnp.int32),
+                          max_new_tokens=4, temperature=1.0, seed=0)
+    assert out.shape == (1, 7)
+    assert len(seen) == 4
+    assert len(set(seen)) == 4, "a sampling step reused a key"
+    seed_key = np.asarray(jax.random.key_data(jax.random.key(0))).tobytes()
+    assert seed_key not in seen, \
+        "the raw seed key was consumed by a draw (the DS002 bug)"
+
+
 def test_generate_length_check():
     engine = deepspeed_tpu.init_inference(tiny_model(), dtype="fp32")
     with pytest.raises(ValueError, match="max_seq"):

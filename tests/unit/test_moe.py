@@ -122,6 +122,40 @@ def test_moe_module_residual():
     assert counts.shape == (4,)
 
 
+def test_residual_mlp_rng_keys_single_use(monkeypatch):
+    """Regression for the PR-8 dslint DS002 finding: residual-MLP init drew
+    ``w_up`` with ``kr`` and then derived ``w_down`` via ``fold_in`` on the
+    SAME consumed key, correlating the down-projection's stream with the
+    draw already made. Pin the single-use discipline at runtime: no key
+    passed to a draw is ever also split/folded, and every draw uses a
+    distinct key."""
+    drawn, derived = [], []
+
+    def key_bytes(key):
+        return np.asarray(jax.random.key_data(key)).tobytes()
+
+    real_normal = jax.random.normal
+    real_uniform = jax.random.uniform
+    real_split = jax.random.split
+    real_fold = jax.random.fold_in
+    monkeypatch.setattr(jax.random, "normal", lambda key, *a, **k: (
+        drawn.append(key_bytes(key)), real_normal(key, *a, **k))[1])
+    monkeypatch.setattr(jax.random, "uniform", lambda key, *a, **k: (
+        drawn.append(key_bytes(key)), real_uniform(key, *a, **k))[1])
+    monkeypatch.setattr(jax.random, "split", lambda key, *a, **k: (
+        derived.append(key_bytes(key)), real_split(key, *a, **k))[1])
+    monkeypatch.setattr(jax.random, "fold_in", lambda key, *a, **k: (
+        derived.append(key_bytes(key)), real_fold(key, *a, **k))[1])
+
+    moe = MoE(hidden_size=8, num_experts=4, k=1, capacity_factor=2.0,
+              use_residual=True, d_ff=16)
+    params = moe.init_params(jax.random.key(0))
+    assert "residual_mlp" in params
+    assert len(drawn) == len(set(drawn)), "a key was drawn from twice"
+    assert not set(drawn) & set(derived), \
+        "a consumed key was passed back to split/fold_in (the DS002 bug)"
+
+
 def test_moe_param_classification():
     moe = MoE(hidden_size=8, num_experts=2, d_ff=16)
     params = {"block": {"moe": moe.init_params(jax.random.key(0))}}

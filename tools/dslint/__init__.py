@@ -1,0 +1,120 @@
+"""dslint — JAX/TPU trace-safety static analysis for deepspeed_tpu.
+
+An AST-based, pluggable-rule analyzer (no jax import, no device work)
+enforcing the trace discipline the runtime telemetry otherwise has to
+catch on-device: host syncs in jit-reachable code, RNG-key reuse, ``np``
+on traced values, Python control flow on traced comparisons, timing
+brackets that clock async dispatch, trace-time nondeterminism, and the
+pytest marker/tier wiring. Repo-wide findings triage into
+``tools/dslint_baseline.json``; CI (the tier-1 lint test and ``dscli
+lint``) fails only on NEW findings.
+
+Usage::
+
+    dscli lint                      # rc=1 on any unbaselined finding
+    dscli lint --list-rules         # the DS0xx catalogue
+    dscli lint --select DS002       # one rule, full output
+    dscli lint --all                # include baselined findings
+    dscli lint --update-baseline    # regenerate the triage ledger
+
+``tools/dslint/contracts.py`` carries the compile-budget contracts the
+tier-1 contract test verifies through the PR-3 CompileWatchdog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+from .contracts import BUDGETS, CompileBudget, check_compile_budgets
+from .core import (RULES, Finding, LintResult, build_context,
+                   default_baseline_path, default_repo_root, load_baseline,
+                   run_lint, write_baseline)
+from . import rules as _rules  # noqa: F401  (registers the catalogue)
+
+__all__ = ["BUDGETS", "CompileBudget", "check_compile_budgets", "RULES",
+           "Finding", "LintResult", "build_context", "run_lint",
+           "load_baseline", "write_baseline", "default_baseline_path",
+           "main"]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI. Exit code 0 = clean (no unbaselined findings), 1 = new
+    findings (printed one per line) — same semantics as
+    ``dscli trace --validate``."""
+    parser = argparse.ArgumentParser(
+        prog="dscli lint",
+        description="JAX/TPU trace-safety static analysis (dslint)")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: this checkout)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline path (default tools/"
+                             "dslint_baseline.json)")
+    parser.add_argument("--select", default=None,
+                        help="comma list of rule ids/names to run")
+    parser.add_argument("--all", action="store_true",
+                        help="also print baselined findings")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline: every finding fails")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="regenerate the baseline from this run, "
+                             "carrying justifications by fingerprint")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for info in sorted(RULES.values(), key=lambda r: r.id):
+            first = info.rationale.splitlines()[0] if info.rationale else ""
+            print(f"{info.id}  {info.name:<28} [{info.domain}]  {first}")
+        return 0
+
+    if args.update_baseline and args.select:
+        # a partial run only carries the selected rules' findings;
+        # regenerating from it would drop every other rule's entries
+        # (and their justifications) from the ledger
+        parser.error("--update-baseline requires a full run; "
+                     "drop --select")
+
+    root = args.root or default_repo_root()
+    baseline_path = args.baseline or default_baseline_path(root)
+    select = [s.strip() for s in args.select.split(",")] \
+        if args.select else None
+    t0 = time.perf_counter()
+    ctx = build_context(root)
+    try:
+        result = run_lint(ctx, select=select,
+                          baseline_path="/nonexistent" if args.no_baseline
+                          else baseline_path)
+    except ValueError as e:          # unknown --select rule: never rc=0
+        parser.error(str(e))
+    dt = time.perf_counter() - t0
+
+    for err in result.errors:
+        print(f"error: {err}", file=sys.stderr)
+
+    if args.update_baseline:
+        todo = write_baseline(baseline_path, result.findings,
+                              load_baseline(baseline_path))
+        print(f"baseline: {len(result.findings)} entr"
+              f"{'y' if len(result.findings) == 1 else 'ies'} written to "
+              f"{baseline_path}"
+              + (f" ({todo} need a justification)" if todo else ""))
+        return 0
+
+    shown = result.findings if args.all else result.new
+    for f in shown:
+        mark = "" if f in result.new else "  [baselined]"
+        print(f.render() + mark)
+    for fp in result.stale_baseline:
+        print(f"stale baseline entry (no longer fires): {fp}",
+              file=sys.stderr)
+    n_files = len(ctx.index.modules) + \
+        (len(ctx.tests_index.modules) if ctx.tests_index else 0)
+    print(f"dslint: {n_files} files, {len(RULES) if not select else len(select)}"
+          f" rule(s), {len(result.findings)} finding(s) "
+          f"({len(result.new)} new, {len(result.baselined)} baselined) "
+          f"in {dt:.2f}s")
+    return 1 if result.new or result.errors else 0

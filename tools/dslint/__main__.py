@@ -1,0 +1,13 @@
+"""``python tools/dslint`` / ``python -m dslint`` entry point."""
+import os
+import sys
+
+if __package__ in (None, ""):
+    # invoked as `python tools/dslint` — make the package importable
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from dslint import main
+else:
+    from . import main
+
+sys.exit(main())

@@ -1,0 +1,463 @@
+"""Package index + lightweight intra-package call graph for dslint.
+
+The trace-safety rules need two whole-package facts a single file can't
+provide:
+
+- **jit reachability** — which functions can execute *inside* a traced
+  region. Roots are functions that are ``jax.jit``-ed / ``pallas_call``-ed
+  (decorator or call-site form, including lambdas and ``partial`` wraps);
+  the closure is taken over a conservative name-resolved call graph
+  (same-module simple names, explicit ``from x import f`` edges, and
+  ``module.attr`` calls through intra-package imports — never fuzzy
+  package-wide name matching, which would drown the rules in noise).
+
+- **taint** — which local names (transitively) data-flow from a function's
+  parameters, i.e. are plausibly traced values. Static escapes prune the
+  flow: ``.shape``/``.ndim``/``.dtype`` access, ``len()``, ``isinstance()``
+  produce Python-static values even on tracers, and parameters with
+  bool/str/None defaults (mode flags) or conventional static names
+  (``self``, ``cfg``, ``config``, ``mesh``, ``dtype``, ...) are not seeded.
+
+Pure ``ast`` — importing the analyzed package (and jax) is never required.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+#: names that make a call a "jitting" call when they are the final dotted
+#: segment (jax.jit, watchdog.jit, ...) or the bare callee name
+_JIT_CALLEES = {"jit", "watched_jit", "pallas_call"}
+
+#: parameter names conventionally holding static (non-traced) values
+_STATIC_PARAM_NAMES = {"self", "cls", "cfg", "config", "mesh", "dtype",
+                       "name", "axis_name", "static_argnums"}
+
+#: attribute accesses that yield Python-static values even on tracers
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "aval", "sharding", "weak_type"}
+
+#: builtins whose result is static regardless of argument taint
+_STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type", "range",
+                 "enumerate", "zip", "id", "repr", "str"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str                 # "<relpath>:<Class.>fn[.<locals>.inner]"
+    name: str                     # simple name
+    node: ast.AST                 # FunctionDef / AsyncFunctionDef / Lambda
+    module: "ModuleInfo"
+    lineno: int
+    #: enclosing scope chain, e.g. (("class", "Engine"), ("function", "f"))
+    scope: Tuple[Tuple[str, str], ...] = ()
+    params: List[str] = field(default_factory=list)
+    param_defaults: Dict[str, ast.AST] = field(default_factory=dict)
+    is_staticmethod: bool = False
+    is_jit_root: bool = False
+    jit_reason: str = ""          # how it became a root, for messages
+    calls: List[Tuple] = field(default_factory=list)  # callee descriptors
+    #: set by PackageIndex: a sample jit root this fn is reachable from
+    sample_root: Optional[str] = None
+
+    def seeded_taint(self) -> Set[str]:
+        """Parameter names plausibly holding traced values."""
+        out = set()
+        for p in self.params:
+            if p in _STATIC_PARAM_NAMES:
+                continue
+            d = self.param_defaults.get(p)
+            if isinstance(d, ast.Constant) and (
+                    d.value is None or isinstance(d.value, (bool, str))):
+                continue          # bool/str/None default => mode flag
+            out.add(p)
+        return out
+
+
+@dataclass
+class ModuleInfo:
+    path: str                     # absolute
+    rel: str                      # repo-relative posix path
+    tree: ast.Module
+    source: str
+    lines: List[str]
+    #: import alias -> full module path ("np" -> "numpy",
+    #: "T" -> "deepspeed_tpu.models.transformer")
+    import_map: Dict[str, str] = field(default_factory=dict)
+    #: from-import alias -> "module.name"
+    from_map: Dict[str, str] = field(default_factory=dict)
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)
+
+    def expand(self, dotted: Optional[str]) -> Optional[str]:
+        """Expand the first segment of a dotted name through this module's
+        imports: ``jrandom.split`` -> ``jax.random.split``."""
+        if not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        if head in self.from_map:
+            full = self.from_map[head]
+        elif head in self.import_map:
+            full = self.import_map[head]
+        else:
+            return dotted
+        return full + ("." + rest if rest else "")
+
+
+class _FunctionCollector(ast.NodeVisitor):
+    """Collects every function/method (incl. nested) with call edges."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.stack: List[Tuple[str, str]] = []   # (kind, name) scope chain
+        self._lambda_n = 0
+
+    def _register(self, node, name: str) -> FunctionInfo:
+        qual = self.mod.rel + ":" + ".".join(
+            [n for _, n in self.stack] + [name])
+        params: List[str] = []
+        defaults: Dict[str, ast.AST] = {}
+        args = node.args
+        all_pos = list(getattr(args, "posonlyargs", [])) + list(args.args)
+        params.extend(a.arg for a in all_pos)
+        params.extend(a.arg for a in args.kwonlyargs)
+        for a, d in zip(all_pos[len(all_pos) - len(args.defaults):],
+                        args.defaults):
+            defaults[a.arg] = d
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is not None:
+                defaults[a.arg] = d
+        info = FunctionInfo(qualname=qual, name=name, node=node,
+                            module=self.mod, lineno=node.lineno,
+                            scope=tuple(self.stack),
+                            params=params, param_defaults=defaults)
+        self.mod.functions[qual] = info
+        return info
+
+    def visit_FunctionDef(self, node):
+        info = self._register(node, node.name)
+        for deco in node.decorator_list:
+            reason = _jitting_expr(deco, self.mod)
+            if reason:
+                info.is_jit_root = True
+                info.jit_reason = f"decorated with {reason}"
+            if isinstance(deco, ast.Name) and deco.id == "staticmethod":
+                info.is_staticmethod = True
+        self.stack.append(("function", node.name))
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._lambda_n += 1
+        self._register(node, f"<lambda#{self._lambda_n}@{node.lineno}>")
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node):
+        self.stack.append(("class", node.name))
+        self.generic_visit(node)
+        self.stack.pop()
+
+
+def _jitting_expr(node: ast.AST, mod: ModuleInfo) -> Optional[str]:
+    """Non-None (a display string) when ``node`` is a jitting expression:
+    ``jax.jit`` / ``watched_jit`` / ``pl.pallas_call`` or a
+    ``partial(jax.jit, ...)`` wrap of one."""
+    if isinstance(node, ast.Call):
+        inner = dotted_name(node.func)
+        if inner and inner.rsplit(".", 1)[-1] in ("partial",) and node.args:
+            return _jitting_expr(node.args[0], mod)
+        return None
+    name = dotted_name(node)
+    if name and name.rsplit(".", 1)[-1] in _JIT_CALLEES:
+        return name
+    return None
+
+
+def _unwrap_partial(node: ast.AST) -> ast.AST:
+    """``partial(f, ...)``/``functools.partial(f, ...)`` -> ``f``."""
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name and name.rsplit(".", 1)[-1] == "partial" and node.args:
+            return _unwrap_partial(node.args[0])
+    return node
+
+
+class PackageIndex:
+    """Parsed modules + jit roots + reachability for a set of source roots."""
+
+    def __init__(self, repo_root: str, roots: List[str]):
+        self.repo_root = os.path.abspath(repo_root)
+        self.modules: List[ModuleInfo] = []
+        self.errors: List[str] = []
+        for root in roots:
+            self._collect(os.path.join(self.repo_root, root))
+        for mod in self.modules:
+            self._index_module(mod)
+        self._mark_callsite_roots()
+        self._link_calls()
+        self.jit_reachable: Dict[str, FunctionInfo] = {}
+        self._compute_reachability()
+
+    # ---- construction ---- #
+
+    def _collect(self, path: str) -> None:
+        if os.path.isfile(path):
+            self._parse(path)
+            return
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__", ".git"))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    self._parse(os.path.join(dirpath, fn))
+
+    def _parse(self, path: str) -> None:
+        rel = os.path.relpath(path, self.repo_root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=rel)
+        except (OSError, SyntaxError) as e:
+            self.errors.append(f"{rel}: {e}")
+            return
+        self.modules.append(ModuleInfo(path=path, rel=rel, tree=tree,
+                                       source=source,
+                                       lines=source.splitlines()))
+
+    def _index_module(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        mod.import_map[alias.asname] = alias.name
+                    else:
+                        head = alias.name.split(".")[0]
+                        mod.import_map[head] = head
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    mod.from_map[alias.asname or alias.name] = \
+                        node.module + "." + alias.name
+        _FunctionCollector(mod).visit(mod.tree)
+
+    def _mark_callsite_roots(self) -> None:
+        """``jax.jit(fn)`` / ``pallas_call(kernel)`` call sites mark the
+        referenced function (same-module resolution) as a jit root."""
+        for mod in self.modules:
+            by_simple: Dict[str, List[FunctionInfo]] = {}
+            for fi in mod.functions.values():
+                by_simple.setdefault(fi.name, []).append(fi)
+            lambda_by_line = {fi.node.lineno: fi
+                              for fi in mod.functions.values()
+                              if isinstance(fi.node, ast.Lambda)}
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                reason = _jitting_expr(node.func, mod)
+                if not reason:
+                    continue
+                target = _unwrap_partial(node.args[0])
+                fis: List[FunctionInfo] = []
+                if isinstance(target, ast.Lambda):
+                    fi = lambda_by_line.get(target.lineno)
+                    if fi:
+                        fis = [fi]
+                else:
+                    tname = dotted_name(target)
+                    if tname:
+                        fis = by_simple.get(tname.rsplit(".", 1)[-1], [])
+                for fi in fis:
+                    fi.is_jit_root = True
+                    fi.jit_reason = fi.jit_reason or \
+                        f"passed to {reason} at {mod.rel}:{node.lineno}"
+
+    def _link_calls(self) -> None:
+        """Record resolvable callee FunctionInfos per function."""
+        # module path -> ModuleInfo (for "module.attr" resolution)
+        self._by_modpath: Dict[str, ModuleInfo] = {}
+        for mod in self.modules:
+            modpath = mod.rel[:-3].replace("/", ".")
+            if modpath.endswith(".__init__"):
+                modpath = modpath[:-len(".__init__")]
+            self._by_modpath[modpath] = mod
+        # per module: scope chain -> simple name -> functions in that scope,
+        # and simple name -> class methods (kept for resolve_call)
+        self._scoped: Dict[str, Dict[Tuple, Dict[str, List[FunctionInfo]]]] = {}
+        self._methods: Dict[str, Dict[str, List[FunctionInfo]]] = {}
+        for mod in self.modules:
+            scoped = self._scoped.setdefault(mod.rel, {})
+            methods = self._methods.setdefault(mod.rel, {})
+            for fi in mod.functions.values():
+                scoped.setdefault(fi.scope, {}).setdefault(
+                    fi.name, []).append(fi)
+                if fi.scope and fi.scope[-1][0] == "class":
+                    methods.setdefault(fi.name, []).append(fi)
+        for mod in self.modules:
+            for fi in mod.functions.values():
+                body = fi.node.body if isinstance(fi.node, ast.Lambda) \
+                    else fi.node
+                for node in ast.walk(body):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    fi.calls.extend(self.resolve_call(fi, node.func))
+
+    def resolve_call(self, caller: FunctionInfo,
+                     func: ast.AST) -> List[FunctionInfo]:
+        """Candidate FunctionInfos a call expression's ``func`` may bind
+        to (conservative: empty when unresolvable)."""
+        mod = caller.module
+        scoped = self._scoped[mod.rel]
+        methods = self._methods[mod.rel]
+        by_modpath = self._by_modpath
+        if isinstance(func, ast.Name):
+            # lexical scoping: own nested defs, then enclosing function
+            # scopes outward, then module level — never class bodies
+            # (methods are only reachable via self.X)
+            chain = caller.scope + (("function", caller.name),)
+            for depth in range(len(chain), -1, -1):
+                prefix = chain[:depth]
+                if prefix and prefix[-1][0] == "class":
+                    continue
+                hit = scoped.get(prefix, {}).get(func.id)
+                if hit:
+                    return hit
+            full = mod.from_map.get(func.id)
+            if full:
+                fmod, _, fname = full.rpartition(".")
+                target = by_modpath.get(fmod)
+                if target:
+                    return [fi for fi in target.functions.values()
+                            if fi.name == fname and not fi.scope]
+            return []
+        if isinstance(func, ast.Attribute):
+            # self.method -> same-module method(s), any class (untyped)
+            if isinstance(func.value, ast.Name) and func.value.id == "self":
+                return methods.get(func.attr, [])
+            base = dotted_name(func.value)
+            full = mod.expand(base) if base else None
+            if full:
+                target = by_modpath.get(full)
+                if target:
+                    return [fi for fi in target.functions.values()
+                            if fi.name == func.attr and not fi.scope]
+        return []
+
+    def _compute_reachability(self) -> None:
+        queue: List[FunctionInfo] = []
+        for mod in self.modules:
+            for fi in mod.functions.values():
+                if fi.is_jit_root:
+                    fi.sample_root = fi.qualname
+                    self.jit_reachable[fi.qualname] = fi
+                    queue.append(fi)
+        while queue:
+            fi = queue.pop()
+            for callee in fi.calls:
+                if callee.qualname not in self.jit_reachable:
+                    callee.sample_root = fi.sample_root
+                    self.jit_reachable[callee.qualname] = callee
+                    queue.append(callee)
+
+    # ---- queries ---- #
+
+    def all_functions(self):
+        for mod in self.modules:
+            yield from mod.functions.values()
+
+
+# --------------------------------------------------------------------- #
+# taint
+
+
+def compute_taint(fn: FunctionInfo) -> Set[str]:
+    """Names in ``fn`` that (transitively) data-flow from its parameters.
+    Single forward pass repeated twice so simple loop-carried assignments
+    converge; static escapes (shape access, len, isinstance, literals)
+    prune the flow."""
+    tainted = fn.seeded_taint()
+    body = fn.node.body
+    stmts = body if isinstance(body, list) else []   # Lambda: no statements
+    for _ in range(2):
+        before = set(tainted)
+        _taint_pass(stmts, tainted)
+        if tainted == before:
+            break
+    return tainted
+
+
+def _taint_pass(stmts, tainted: Set[str]) -> None:
+    for stmt in stmts:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is not None and expr_is_tainted(value, tainted):
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                for t in targets:
+                    for name in ast.walk(t):
+                        if isinstance(name, ast.Name):
+                            tainted.add(name.id)
+        elif isinstance(stmt, ast.For):
+            if expr_is_tainted(stmt.iter, tainted):
+                for name in ast.walk(stmt.target):
+                    if isinstance(name, ast.Name):
+                        tainted.add(name.id)
+            _taint_pass(stmt.body, tainted)
+            _taint_pass(stmt.orelse, tainted)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            _taint_pass(stmt.body, tainted)
+            _taint_pass(stmt.orelse, tainted)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                if item.optional_vars is not None and \
+                        expr_is_tainted(item.context_expr, tainted):
+                    for name in ast.walk(item.optional_vars):
+                        if isinstance(name, ast.Name):
+                            tainted.add(name.id)
+            _taint_pass(stmt.body, tainted)
+        elif isinstance(stmt, ast.Try):
+            _taint_pass(stmt.body, tainted)
+            for h in stmt.handlers:
+                _taint_pass(h.body, tainted)
+            _taint_pass(stmt.orelse, tainted)
+            _taint_pass(stmt.finalbody, tainted)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            continue               # nested scopes analyzed on their own
+
+
+def expr_is_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+    """True when ``expr`` references a tainted name outside a static
+    escape (``x.shape``, ``len(x)``, ``isinstance(x, ...)``)."""
+    for node in _walk_pruned(expr):
+        if isinstance(node, ast.Name) and node.id in tainted:
+            return True
+    return False
+
+
+def _walk_pruned(expr: ast.AST):
+    """ast.walk that does not descend into static-escape subtrees."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            continue
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name and name.rsplit(".", 1)[-1] in _STATIC_CALLS:
+                continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

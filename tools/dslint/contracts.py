@@ -1,0 +1,113 @@
+"""Compile-budget contracts for the framework's jitted entry points.
+
+The static rules keep trace hazards out of the code; this registry pins
+the *dynamic* compile behavior the code is supposed to have. Each entry
+declares, for a named scenario, the maximum number of XLA compilations a
+watched jit entry point (the names `CompileWatchdog` records in
+``by_fn``) may perform. A tier-1 test drives the real engines through the
+scenario and feeds ``telemetry_snapshot()["compile"]["by_fn"]`` to
+:func:`check_compile_budgets` — so a shape-stability regression (the
+sustained-recompile class PR-3's watchdog could only flag at runtime,
+on-device) fails review instead of surfacing as a compile storm.
+
+Budget semantics: ``max_compiles`` bounds the compiles a scenario may
+trigger for that entry; entries the scenario never touches are simply
+absent from ``by_fn`` (0 compiles always passes). ``by_fn`` names that
+have NO budget for the scenario are reported too when ``strict`` — a new
+jit entry point must declare its budget before it ships.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional
+
+
+@dataclass(frozen=True)
+class CompileBudget:
+    entry: str          # CompileWatchdog name, e.g. "engine.train_batch[gas=1]"
+    scenario: str       # scenario key the budget applies to
+    max_compiles: int
+    note: str           # why this bound holds (shape-stability argument)
+
+
+#: The registry. Scenarios:
+#:   steady_train    — N identical train_batch steps after warmup
+#:   serving_steady  — one generate_batch over mixed-length prompts with
+#:                     default serving config (prompt lengths within one
+#:                     128-token prefill bucket)
+#:   serving_chunked — generate_batch with chunked prefill + prefix cache
+BUDGETS: List[CompileBudget] = [
+    CompileBudget(
+        "engine.train_batch[gas=1]", "steady_train", 1,
+        "fixed (B, S) batch: one fused step program, ever; a second "
+        "compile means the step fn's input signature is unstable "
+        "(python scalars, weak_type flap, donation mismatch)"),
+    CompileBudget(
+        "engine.accum_batch[gas=1]", "steady_train", 1,
+        "accumulation variant of the fused step; same stability bound"),
+    CompileBudget(
+        "engine.forward", "steady_train", 1,
+        "trio forward: one program per fixed micro-batch shape"),
+    CompileBudget(
+        "engine.backward", "steady_train", 1,
+        "trio backward: one program per fixed micro-batch shape"),
+    CompileBudget(
+        "engine.step", "steady_train", 1,
+        "trio apply-update: parameter shapes never change mid-run"),
+    CompileBudget(
+        "inference.paged_decode", "serving_steady", 1,
+        "THE fused decode step: fixed-width over max_running slots, "
+        "per-request positions are traced vectors — one program no "
+        "matter how many requests/tokens flow through"),
+    CompileBudget(
+        "inference.paged_prefill", "serving_steady", 2,
+        "whole-prompt prefill compiles once per 128-token prompt-length "
+        "bucket; the steady scenario stays within two buckets"),
+    CompileBudget(
+        "inference.paged_cow", "serving_steady", 1,
+        "copy-on-write block copy: fixed block geometry"),
+    CompileBudget(
+        "inference.paged_decode", "serving_chunked", 1,
+        "chunked prefill interleaves with the SAME fused decode program"),
+    CompileBudget(
+        "inference.paged_prefill_chunk", "serving_chunked", 4,
+        "one program per (chunk bucket, table-width power-of-two) pair; "
+        "the acceptance scenario touches at most four"),
+    CompileBudget(
+        "inference.paged_cow", "serving_chunked", 1,
+        "copy-on-write block copy: fixed block geometry"),
+]
+
+
+def budgets_for(scenario: str,
+                budgets: Optional[Iterable[CompileBudget]] = None
+                ) -> Dict[str, CompileBudget]:
+    return {b.entry: b for b in (budgets if budgets is not None else BUDGETS)
+            if b.scenario == scenario}
+
+
+def check_compile_budgets(by_fn: Dict[str, int], scenario: str,
+                          budgets: Optional[Iterable[CompileBudget]] = None,
+                          strict: bool = False) -> List[str]:
+    """Violation strings (empty = contract holds) for a watchdog
+    ``by_fn`` compile-count map under ``scenario``. ``strict`` also
+    reports watched entries that have no declared budget for the
+    scenario (new entry points must declare one)."""
+    table = budgets_for(scenario, budgets)
+    out: List[str] = []
+    for entry, count in sorted(by_fn.items()):
+        budget = table.get(entry)
+        if budget is None:
+            if strict:
+                out.append(
+                    f"{entry}: compiled {count}x in scenario "
+                    f"'{scenario}' but declares no compile budget — add a "
+                    "CompileBudget entry (tools/dslint/contracts.py)")
+            continue
+        if count > budget.max_compiles:
+            out.append(
+                f"{entry}: {count} compiles exceeds the "
+                f"'{scenario}' budget of {budget.max_compiles} — "
+                f"contract rationale: {budget.note}")
+    return out

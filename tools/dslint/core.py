@@ -1,0 +1,256 @@
+"""dslint core: findings, the rule registry, inline suppressions, and the
+baseline workflow.
+
+A *finding* is (rule, file, line, message); its **fingerprint** is
+``rule:file:line``. CI semantics (the ``dscli lint`` gate): a run fails
+(rc=1) only on findings whose fingerprint is NOT in
+``tools/dslint_baseline.json``. The baseline is the triage ledger — every
+entry carries a one-line ``justification`` (why the finding is accepted
+rather than fixed), and ``dscli lint --update-baseline`` regenerates the
+file, carrying justifications over by fingerprint and marking new entries
+``TODO: justify`` (which the repo's own lint test rejects, so a
+suppression can never land silently).
+
+Inline suppression: a trailing ``# dslint: disable=DS001`` (comma list
+allowed) suppresses those rules on that line; a bare
+``# dslint: disable=DS001`` on its own line suppresses the line below it;
+``# dslint: disable-file=DS001`` anywhere in the first 25 lines
+suppresses the rule for the whole file. Suppressions are for confirmed
+false positives next to the code they excuse — baseline entries are for
+accepted debt.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .callgraph import PackageIndex
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str          # "DS001"
+    path: str          # repo-relative posix path
+    line: int
+    message: str
+    col: int = 0
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}:{self.path}:{self.line}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass(frozen=True)
+class RuleInfo:
+    id: str            # stable DS0xx id
+    name: str          # short kebab-case name
+    domain: str        # "package" (deepspeed_tpu/ index) | "tests"
+    fn: Callable       # (ctx) -> List[Finding]
+    rationale: str     # the rule docstring
+
+
+#: id -> RuleInfo; populated by the @rule decorator in rules.py
+RULES: Dict[str, RuleInfo] = {}
+
+
+def rule(id: str, name: str, domain: str = "package"):
+    """Register a rule function. The docstring is the user-facing
+    rationale (shown by ``dscli lint --list-rules`` and the docs)."""
+    def deco(fn):
+        if id in RULES:
+            raise ValueError(f"duplicate rule id {id}")
+        RULES[id] = RuleInfo(id=id, name=name, domain=domain, fn=fn,
+                             rationale=(fn.__doc__ or "").strip())
+        return fn
+    return deco
+
+
+@dataclass
+class LintContext:
+    """Everything a rule may look at."""
+    repo_root: str
+    index: PackageIndex                    # the package (jit-rule) index
+    tests_index: Optional[PackageIndex]    # tests/ (marker rules)
+    pytest_ini: Optional[str] = None       # path, when present
+    conftest: Optional[str] = None         # tests/conftest.py path
+
+
+# --------------------------------------------------------------------- #
+# suppressions
+
+_SUPPRESS_RE = re.compile(r"#\s*dslint:\s*disable=([A-Z0-9, ]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*dslint:\s*disable-file=([A-Z0-9, ]+)")
+
+
+def _parse_ids(group: str) -> List[str]:
+    return [t.strip() for t in group.split(",") if t.strip()]
+
+
+class Suppressions:
+    """Per-file map of suppressed (line, rule) pairs + file-level rules."""
+
+    def __init__(self):
+        self._by_file: Dict[str, Dict[int, set]] = {}
+        self._file_level: Dict[str, set] = {}
+
+    def scan(self, rel: str, lines: Sequence[str]) -> None:
+        per_line: Dict[int, set] = {}
+        file_rules: set = set()
+        for i, text in enumerate(lines, start=1):
+            m = _SUPPRESS_FILE_RE.search(text)
+            if m and i <= 25:
+                file_rules.update(_parse_ids(m.group(1)))
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            ids = set(_parse_ids(m.group(1)))
+            target = i + 1 if text.lstrip().startswith("#") else i
+            per_line.setdefault(target, set()).update(ids)
+        if per_line:
+            self._by_file[rel] = per_line
+        if file_rules:
+            self._file_level[rel] = file_rules
+
+    def is_suppressed(self, f: Finding) -> bool:
+        if f.rule in self._file_level.get(f.path, ()):
+            return True
+        return f.rule in self._by_file.get(f.path, {}).get(f.line, ())
+
+
+# --------------------------------------------------------------------- #
+# baseline
+
+BASELINE_VERSION = 1
+TODO_JUSTIFICATION = "TODO: justify"
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """fingerprint -> entry dict. Missing file = empty baseline."""
+    if not os.path.isfile(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    return {e["fingerprint"]: e for e in doc.get("entries", [])}
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   previous: Dict[str, dict]) -> int:
+    """Regenerate the baseline from ``findings``, carrying each existing
+    entry's justification over by fingerprint. Returns the number of
+    entries still needing a justification."""
+    entries, todo = [], 0
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        prev = previous.get(f.fingerprint)
+        just = (prev or {}).get("justification", TODO_JUSTIFICATION)
+        if just.startswith("TODO"):
+            todo += 1
+        entries.append({"fingerprint": f.fingerprint, "rule": f.rule,
+                        "file": f.path, "line": f.line,
+                        "message": f.message, "justification": just})
+    doc = {"version": BASELINE_VERSION,
+           "comment": "dslint accepted-findings ledger; regenerate with "
+                      "`dscli lint --update-baseline`, then fill in every "
+                      "TODO justification (the lint test rejects TODOs).",
+           "entries": entries}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=False)
+        f.write("\n")
+    return todo
+
+
+# --------------------------------------------------------------------- #
+# runner
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]                # all unsuppressed findings
+    new: List[Finding]                     # not covered by the baseline
+    baselined: List[Finding]
+    stale_baseline: List[str]              # fingerprints no longer firing
+    errors: List[str] = field(default_factory=list)
+
+
+def default_repo_root() -> str:
+    return os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir, os.pardir))
+
+
+def default_baseline_path(repo_root: Optional[str] = None) -> str:
+    return os.path.join(repo_root or default_repo_root(), "tools",
+                        "dslint_baseline.json")
+
+
+def build_context(repo_root: Optional[str] = None,
+                  package: str = "deepspeed_tpu",
+                  tests: str = "tests") -> LintContext:
+    root = os.path.abspath(repo_root or default_repo_root())
+    index = PackageIndex(root, [package])
+    tests_dir = os.path.join(root, tests)
+    tests_index = PackageIndex(root, [tests]) \
+        if os.path.isdir(tests_dir) else None
+    ini = os.path.join(root, "pytest.ini")
+    conftest = os.path.join(tests_dir, "conftest.py")
+    return LintContext(
+        repo_root=root, index=index, tests_index=tests_index,
+        pytest_ini=ini if os.path.isfile(ini) else None,
+        conftest=conftest if os.path.isfile(conftest) else None)
+
+
+def run_lint(ctx: LintContext, select: Optional[Sequence[str]] = None,
+             baseline_path: Optional[str] = None) -> LintResult:
+    """Run every (selected) rule, apply inline suppressions, and split
+    findings against the baseline."""
+    # make sure the rule catalogue is registered
+    from . import rules as _rules  # noqa: F401
+
+    sup = Suppressions()
+    for mod in ctx.index.modules:
+        sup.scan(mod.rel, mod.lines)
+    if ctx.tests_index is not None:
+        for mod in ctx.tests_index.modules:
+            sup.scan(mod.rel, mod.lines)
+
+    if select:
+        known = {r.id for r in RULES.values()} | \
+                {r.name for r in RULES.values()}
+        unknown = [s for s in select if s not in known]
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) in --select: {', '.join(unknown)} "
+                "(see --list-rules)")
+
+    findings: List[Finding] = []
+    errors = list(ctx.index.errors)
+    if ctx.tests_index is not None:
+        errors.extend(ctx.tests_index.errors)
+    ran: set = set()
+    for info in sorted(RULES.values(), key=lambda r: r.id):
+        if select and info.id not in select and info.name not in select:
+            continue
+        if info.domain == "tests" and ctx.tests_index is None:
+            continue
+        ran.add(info.id)
+        findings.extend(info.fn(ctx))
+
+    findings = sorted((f for f in findings if not sup.is_suppressed(f)),
+                      key=lambda f: (f.path, f.line, f.rule))
+    baseline = load_baseline(baseline_path or
+                             default_baseline_path(ctx.repo_root))
+    new = [f for f in findings if f.fingerprint not in baseline]
+    old = [f for f in findings if f.fingerprint in baseline]
+    firing = {f.fingerprint for f in findings}
+    # staleness is only decidable for rules that actually ran: a partial
+    # --select run must not report the other rules' entries as dead
+    stale = [fp for fp in baseline
+             if fp not in firing and fp.partition(":")[0] in ran]
+    return LintResult(findings=findings, new=new, baselined=old,
+                      stale_baseline=sorted(stale), errors=errors)

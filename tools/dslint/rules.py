@@ -1,0 +1,767 @@
+"""The dslint rule catalogue (stable DS0xx ids).
+
+Each rule's docstring is its user-facing rationale; each has positive and
+negative fixtures in ``tests/unit/test_dslint.py``. The rules encode the
+hazard classes behind this repo's shipped bugs:
+
+- DS001/DS003/DS004/DS006 police what happens *inside* traced code
+  (anything jit-reachable per the call graph);
+- DS002 polices RNG-key discipline everywhere (the PR-1 GPipe head/embed
+  collision class);
+- DS005 polices host-side timing brackets around jit dispatch (the PR-7
+  async-dispatch-clocked-as-device-work class);
+- DS007/DS008 police the pytest marker/tier machinery (the PR-2
+  ``-m``-replaces-addopts trap).
+"""
+
+from __future__ import annotations
+
+import ast
+import configparser
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import (FunctionInfo, ModuleInfo, compute_taint, dotted_name,
+                        expr_is_tainted)
+from .core import Finding, LintContext, rule
+
+# --------------------------------------------------------------------- #
+# shared helpers
+
+
+def _own_walk(fn: FunctionInfo):
+    """Walk a function's own body without descending into nested
+    functions/lambdas/classes (those are separate FunctionInfos)."""
+    node = fn.node
+    roots = [node.body] if isinstance(node.body, ast.AST) else node.body
+    stack = list(roots)
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def _full_name(mod: ModuleInfo, func: ast.AST) -> Tuple[Optional[str], bool]:
+    """(expanded dotted name, resolved-through-an-import). The flag
+    distinguishes ``time.sleep`` under ``import time`` from an attribute
+    chain on a local variable that happens to be called ``time``."""
+    name = dotted_name(func)
+    if not name:
+        return None, False
+    head = name.partition(".")[0]
+    resolved = head in mod.import_map or head in mod.from_map
+    return mod.expand(name), resolved
+
+
+def _finding(fn: FunctionInfo, node: ast.AST, rule_id: str,
+             msg: str) -> Finding:
+    return Finding(rule=rule_id, path=fn.module.rel,
+                   line=getattr(node, "lineno", fn.lineno), message=msg,
+                   col=getattr(node, "col_offset", 0))
+
+
+def _reach_note(fn: FunctionInfo) -> str:
+    if fn.sample_root and fn.sample_root != fn.qualname:
+        return f" (jit-reachable via {fn.sample_root})"
+    return " (jitted entry point)" if fn.is_jit_root else ""
+
+
+# --------------------------------------------------------------------- #
+# DS001 host-sync-in-hot-path
+
+
+@rule("DS001", "host-sync-in-hot-path")
+def host_sync_in_hot_path(ctx: LintContext) -> List[Finding]:
+    """Host-synchronizing ops (``.item()``, ``float()/int()`` on traced
+    values, ``np.asarray``, ``jax.device_get``, ``block_until_ready``)
+    inside jit-reachable code either abort tracing outright or, worse,
+    silently serialize the device pipeline every call. Hot paths must stay
+    device-only; sync at the boundary, once."""
+    out: List[Finding] = []
+    for fn in ctx.index.jit_reachable.values():
+        tainted = compute_taint(fn)
+        for node in _own_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr == "item" and not node.args:
+                    out.append(_finding(
+                        fn, node, "DS001",
+                        f"`.item()` in `{fn.name}`{_reach_note(fn)} — "
+                        "host sync inside traced code"))
+                    continue
+                if func.attr == "block_until_ready":
+                    out.append(_finding(
+                        fn, node, "DS001",
+                        f"`block_until_ready` in `{fn.name}`"
+                        f"{_reach_note(fn)} — meaningless under trace, a "
+                        "pipeline stall if the function also runs eagerly"))
+                    continue
+            full, via_import = _full_name(fn.module, func)
+            if full and via_import:
+                tail = full.rsplit(".", 1)[-1]
+                if full.startswith("numpy.") and tail in ("asarray", "array") \
+                        and any(expr_is_tainted(a, tainted)
+                                for a in node.args):
+                    out.append(_finding(
+                        fn, node, "DS001",
+                        f"`np.{tail}` on a traced value in `{fn.name}`"
+                        f"{_reach_note(fn)} — forces device->host transfer"))
+                    continue
+                if full.endswith(".device_get"):
+                    out.append(_finding(
+                        fn, node, "DS001",
+                        f"`device_get` in `{fn.name}`{_reach_note(fn)}"))
+                    continue
+            if isinstance(func, ast.Name) and func.id in ("float", "int",
+                                                          "bool") \
+                    and len(node.args) == 1 \
+                    and expr_is_tainted(node.args[0], tainted):
+                out.append(_finding(
+                    fn, node, "DS001",
+                    f"`{func.id}()` on a traced value in `{fn.name}`"
+                    f"{_reach_note(fn)} — concretization error under jit, "
+                    "silent sync when run eagerly"))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# DS002 rng-key-reuse
+
+_KEY_PARAM_RE = re.compile(r"(^|_)(rng|rngs|key|keys|prng)$")
+_NONCONSUMERS = {"split", "fold_in", "PRNGKey", "key", "key_data",
+                 "wrap_key_data", "clone", "key_impl"}
+
+
+@rule("DS002", "rng-key-reuse")
+def rng_key_reuse(ctx: LintContext) -> List[Finding]:
+    """A PRNG key is single-use: consumed by ONE ``jax.random.*`` draw or
+    split, never both, never twice. Reuse makes two draws identical (the
+    PR-1 GPipe bug class — embed and head sharing one key) and splitting
+    an already-consumed key derives children correlated with the draw.
+    Consumption is tracked through the call graph: a helper whose key
+    parameter feeds ``jax.random.*`` consumes its caller's key too."""
+    consuming = _consuming_key_params(ctx)
+    out: Set[Tuple[str, int, str]] = set()
+    for fn in ctx.index.all_functions():
+        if isinstance(fn.node, ast.Lambda):
+            continue
+        keyvars: Set[str] = {p for p in fn.params if _KEY_PARAM_RE.search(p)}
+        findings: List[Finding] = []
+        _scan_keys(fn, fn.node.body, keyvars, {}, findings,
+                   ctx, consuming)
+        for f in findings:
+            out.add((f.path, f.line, f.message))
+    return [Finding(rule="DS002", path=p, line=l, message=m)
+            for (p, l, m) in sorted(out)]
+
+
+def _is_jax_random(fn: FunctionInfo, func: ast.AST) -> Optional[str]:
+    full, via = _full_name(fn.module, func)
+    if full and via and full.startswith("jax.random."):
+        return full.rsplit(".", 1)[-1]
+    return None
+
+
+def _call_param_args(callee: FunctionInfo,
+                     call: ast.Call, via_self: bool):
+    """Yield ``(param_name, arg_node)`` pairs for a resolved call.
+    ``via_self`` offsets past the bound ``self`` for instance methods."""
+    params = list(callee.params)
+    if via_self and not callee.is_staticmethod and params \
+            and params[0] in ("self", "cls"):
+        params = params[1:]
+    for i, a in enumerate(call.args):
+        if i < len(params):
+            yield params[i], a
+    for kw in call.keywords:
+        if kw.arg:
+            yield kw.arg, kw.value
+
+
+def _consuming_key_params(ctx: LintContext) -> Dict[str, Set[str]]:
+    """Fixpoint: qualname -> param names that end up consumed by a
+    ``jax.random.*`` draw (directly, or via a callee's consuming param).
+    This is what lets DS002 see through ``self._sample_host(..., rng)``."""
+    consuming: Dict[str, Set[str]] = {}
+    fns = [fn for fn in ctx.index.all_functions()
+           if not isinstance(fn.node, ast.Lambda)]
+    changed = True
+    while changed:
+        changed = False
+        for fn in fns:
+            mine = consuming.setdefault(fn.qualname, set())
+            pset = set(fn.params)
+            for node in _own_walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = _is_jax_random(fn, node.func)
+                if tail is not None:
+                    if tail in _NONCONSUMERS:
+                        continue
+                    args = list(node.args) + \
+                        [kw.value for kw in node.keywords
+                         if kw.arg in ("key", "rng")]
+                    for a in args:
+                        if isinstance(a, ast.Name) and a.id in pset \
+                                and a.id not in mine:
+                            mine.add(a.id)
+                            changed = True
+                    continue
+                via_self = isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self"
+                for callee in ctx.index.resolve_call(fn, node.func):
+                    ctab = consuming.get(callee.qualname, set())
+                    if not ctab:
+                        continue
+                    for pname, a in _call_param_args(callee, node, via_self):
+                        if pname in ctab and isinstance(a, ast.Name) \
+                                and a.id in pset and a.id not in mine:
+                            mine.add(a.id)
+                            changed = True
+    return consuming
+
+
+def _scan_keys(fn: FunctionInfo, stmts, keyvars: Set[str],
+               consumed: Dict[str, int], findings: List[Finding],
+               ctx: LintContext, consuming: Dict[str, Set[str]]) -> None:
+    """Branch-aware straight-line scan: ``consumed[name]`` is the line of
+    the live consumption; reassignment clears it. If-branches merge by
+    intersection (either/or consumption is legal); loop bodies are scanned
+    twice so a loop-carried reuse of an un-refreshed key is caught."""
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(stmt, ast.If):
+            pre = dict(consumed)
+            then_state = dict(pre)
+            _scan_keys(fn, stmt.body, keyvars, then_state, findings, ctx,
+                       consuming)
+            else_state = dict(pre)
+            _scan_keys(fn, stmt.orelse, keyvars, else_state, findings, ctx,
+                       consuming)
+            consumed.clear()
+            for name in set(then_state) & set(else_state):
+                consumed[name] = min(then_state[name], else_state[name])
+            consumed.update({k: v for k, v in pre.items()
+                             if k not in consumed})
+            continue
+        if isinstance(stmt, (ast.For, ast.While)):
+            body_state = dict(consumed)
+            _scan_keys(fn, stmt.body, keyvars, body_state, findings, ctx,
+                       consuming)
+            _scan_keys(fn, stmt.body, keyvars, dict(body_state), findings,
+                       ctx, consuming)
+            consumed.update(body_state)
+            _scan_keys(fn, stmt.orelse, keyvars, consumed, findings, ctx,
+                       consuming)
+            continue
+        if isinstance(stmt, ast.Try):
+            _scan_keys(fn, stmt.body, keyvars, consumed, findings, ctx,
+                       consuming)
+            for h in stmt.handlers:
+                _scan_keys(fn, h.body, keyvars, dict(consumed), findings,
+                           ctx, consuming)
+            _scan_keys(fn, stmt.orelse, keyvars, consumed, findings, ctx,
+                       consuming)
+            _scan_keys(fn, stmt.finalbody, keyvars, consumed, findings, ctx,
+                       consuming)
+            continue
+        if isinstance(stmt, ast.With):
+            _consume_in_expr(fn, stmt, keyvars, consumed, findings, ctx,
+                             consuming)
+            _scan_keys(fn, stmt.body, keyvars, consumed, findings, ctx,
+                       consuming)
+            continue
+        # flat statement: consumption first, then assignment effects
+        _consume_in_expr(fn, stmt, keyvars, consumed, findings, ctx,
+                         consuming)
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            from_random = isinstance(stmt.value, ast.Call) and \
+                _is_jax_random(fn, stmt.value.func) is not None
+            for t in targets:
+                for name_node in ast.walk(t):
+                    if isinstance(name_node, ast.Name):
+                        consumed.pop(name_node.id, None)
+                        if from_random:
+                            keyvars.add(name_node.id)
+
+
+def _consume_in_expr(fn: FunctionInfo, stmt: ast.AST, keyvars: Set[str],
+                     consumed: Dict[str, int], findings: List[Finding],
+                     ctx: LintContext,
+                     consuming: Dict[str, Set[str]]) -> None:
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        tail = _is_jax_random(fn, node.func)
+        if tail is not None and tail in ("split", "fold_in"):
+            # deriving from an already-consumed key: the children are
+            # correlated with the draw the consumer already made
+            for a in node.args[:1]:
+                if isinstance(a, ast.Name) and a.id in keyvars \
+                        and consumed.get(a.id) is not None:
+                    findings.append(_finding(
+                        fn, node, "DS002",
+                        f"key `{a.id}` was consumed at line "
+                        f"{consumed[a.id]} and is then passed to "
+                        f"`jax.random.{tail}` (in `{fn.name}`) — split "
+                        "first, consume the child"))
+            continue
+        consumer = None          # display name of the consuming callee
+        hit_args: List[ast.Name] = []
+        if tail is not None and tail not in _NONCONSUMERS:
+            consumer = f"jax.random.{tail}"
+            args = list(node.args) + [kw.value for kw in node.keywords
+                                      if kw.arg in ("key", "rng")]
+            hit_args = [a for a in args
+                        if isinstance(a, ast.Name) and a.id in keyvars]
+        elif tail is None:
+            # a resolved intra-package callee whose key param is consumed
+            # downstream consumes the caller's key just the same
+            via_self = isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "self"
+            for callee in ctx.index.resolve_call(fn, node.func):
+                ctab = consuming.get(callee.qualname, set())
+                if not ctab:
+                    continue
+                for pname, a in _call_param_args(callee, node, via_self):
+                    if pname in ctab and isinstance(a, ast.Name) \
+                            and a.id in keyvars:
+                        consumer = f"`{callee.name}` (consumes its "\
+                                   f"`{pname}` param)"
+                        hit_args.append(a)
+        for a in hit_args:
+            prev = consumed.get(a.id)
+            if prev is not None:
+                findings.append(_finding(
+                    fn, node, "DS002",
+                    f"key `{a.id}` already consumed at line {prev} is "
+                    f"passed to {consumer} again without split/fold_in "
+                    f"(in `{fn.name}`)"))
+            else:
+                consumed[a.id] = node.lineno
+
+
+# --------------------------------------------------------------------- #
+# DS003 np-on-traced
+
+_SAFE_NP = {"dtype", "finfo", "iinfo", "result_type", "promote_types",
+            "issubdtype", "can_cast", "isscalar", "ndim", "shape",
+            "asarray", "array"}   # asarray/array are DS001's (host-sync)
+
+
+@rule("DS003", "np-on-traced")
+def np_on_traced(ctx: LintContext) -> List[Finding]:
+    """``np.*`` applied to a value that data-flows from the parameters of
+    jit-reachable code runs on host at trace time: it either raises a
+    TracerArrayConversionError or constant-folds a value that should be
+    traced (shape-silent wrong results). Use ``jnp.*`` inside traced
+    code."""
+    out: List[Finding] = []
+    for fn in ctx.index.jit_reachable.values():
+        tainted = compute_taint(fn)
+        for node in _own_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            full, via = _full_name(fn.module, node.func)
+            if not (full and via and full.startswith("numpy.")):
+                continue
+            tail = full.rsplit(".", 1)[-1]
+            if tail in _SAFE_NP or full.startswith("numpy.random."):
+                continue
+            if any(expr_is_tainted(a, tainted) for a in node.args):
+                out.append(_finding(
+                    fn, node, "DS003",
+                    f"`np.{tail}` on a traced value in `{fn.name}`"
+                    f"{_reach_note(fn)} — use jnp inside traced code"))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# DS004 python-control-flow-on-traced
+
+_STATIC_JNP = {"ndim", "result_type", "issubdtype", "dtype", "shape",
+               "iscomplexobj", "isdtype"}
+
+
+@rule("DS004", "py-control-flow-on-traced")
+def py_control_flow_on_traced(ctx: LintContext) -> List[Finding]:
+    """Python ``if``/``while`` branching on a traced comparison inside jit
+    raises TracerBoolConversionError at trace time — or, when the value is
+    concrete on the first trace, silently bakes one branch into the
+    compiled program. Use ``lax.cond``/``lax.while_loop`` or ``jnp.where``
+    on device values."""
+    out: List[Finding] = []
+    for fn in ctx.index.jit_reachable.values():
+        tainted = compute_taint(fn)
+        for node in _own_walk(fn):
+            if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                continue
+            hit = _traced_test(fn, node.test, tainted)
+            if hit:
+                kind = {ast.If: "if", ast.While: "while",
+                        ast.IfExp: "conditional expression"}[type(node)]
+                out.append(_finding(
+                    fn, node, "DS004",
+                    f"python `{kind}` on {hit} in `{fn.name}`"
+                    f"{_reach_note(fn)} — use lax.cond/while_loop or "
+                    "jnp.where"))
+    return out
+
+
+def _traced_test(fn: FunctionInfo, test: ast.AST,
+                 tainted: Set[str]) -> Optional[str]:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            full, via = _full_name(fn.module, node.func)
+            if full and via and (full.startswith("jax.numpy.") or
+                                 full.startswith("jax.lax.")):
+                tail = full.rsplit(".", 1)[-1]
+                if tail not in _STATIC_JNP:
+                    return f"a `{full.replace('jax.numpy', 'jnp')}` result"
+        if isinstance(node, ast.Compare):
+            ops_ok = all(not isinstance(op, (ast.Is, ast.IsNot, ast.In,
+                                             ast.NotIn))
+                         for op in node.ops)
+            operands = [node.left] + node.comparators
+            if ops_ok and not any(
+                    isinstance(o, ast.Constant) and
+                    isinstance(o.value, (str, bytes, type(None)))
+                    for o in operands):
+                for o in operands:
+                    if isinstance(o, (ast.Name, ast.Subscript, ast.BinOp)) \
+                            and expr_is_tainted(o, tainted):
+                        return f"a comparison over traced `{_src_name(o)}`"
+    return None
+
+
+def _src_name(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return "value"
+
+
+# --------------------------------------------------------------------- #
+# DS005 untimed-device-work
+
+_PERF_TAILS = {"perf_counter", "monotonic", "perf_counter_ns",
+               "monotonic_ns"}
+_SYNC_ATTRS = {"block_until_ready", "item"}
+_SYNC_NP = {"asarray", "array"}
+_DISPATCH_RE = re.compile(r"(_jit|_jitted)$")
+
+
+@rule("DS005", "untimed-device-work")
+def untimed_device_work(ctx: LintContext) -> List[Finding]:
+    """A ``perf_counter`` bracket (or tracer span) around a jit dispatch
+    with no ``block_until_ready``/host transfer before the closing read
+    measures async dispatch (microseconds) while the device work lands in
+    whichever later operation happens to sync — the PR-7 tracing bug
+    class. Sync before closing a timing bracket around device work."""
+    out: List[Finding] = []
+    for fn in ctx.index.all_functions():
+        if isinstance(fn.node, ast.Lambda):
+            continue
+        events = _timing_events(fn)
+        out.extend(_check_brackets(fn, events))
+        out.extend(_check_spans(fn))
+    return out
+
+
+def _timing_events(fn: FunctionInfo) -> Dict[str, List]:
+    """Line-indexed occurrences of perf starts, elapsed reads, jit
+    dispatches and sync points within one function body."""
+    starts: Dict[str, int] = {}       # var -> line of t = perf_counter()
+    reads: List[Tuple[str, int]] = []  # (var, line) of "... - var"
+    dispatch: List[int] = []
+    syncs: List[int] = []
+    jit_locals: Set[str] = set()
+    named_calls: List[Tuple[str, int]] = []   # resolved after the walk
+    for node in _own_walk(fn):
+        if isinstance(node, ast.Assign):
+            if _contains_perf_call(fn, node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        starts[t.id] = node.lineno
+            if isinstance(node.value, ast.Call):
+                full, _ = _full_name(fn.module, node.value.func)
+                if full and full.rsplit(".", 1)[-1] in ("jit",
+                                                        "watched_jit"):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            jit_locals.add(t.id)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub) \
+                and isinstance(node.right, ast.Name) \
+                and _contains_perf_call(fn, node.left):
+            # whether `node.right` is a perf start is resolved in
+            # _check_brackets — _own_walk visits in stack order, so the
+            # start assignment may not be indexed yet
+            reads.append((node.right.id, node.lineno))
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            tail = name.rsplit(".", 1)[-1] if name else None
+            if tail:
+                if _DISPATCH_RE.search(tail):
+                    dispatch.append(node.lineno)
+                else:
+                    # a call of a jit-valued local — the local's defining
+                    # assignment may not be indexed yet (stack order), so
+                    # membership in jit_locals is resolved after the walk
+                    named_calls.append((tail, node.lineno))
+            if isinstance(node.func, ast.Call):   # jax.jit(f)(...)
+                inner, _ = _full_name(fn.module, node.func.func)
+                if inner and inner.rsplit(".", 1)[-1] == "jit":
+                    dispatch.append(node.lineno)
+            if _is_sync_call(fn, node):
+                syncs.append(node.lineno)
+    dispatch.extend(line for name, line in named_calls
+                    if name in jit_locals)
+    return {"starts": starts, "reads": reads, "dispatch": dispatch,
+            "syncs": syncs}
+
+
+def _contains_perf_call(fn: FunctionInfo, expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            full, _ = _full_name(fn.module, node.func)
+            if full and full.rsplit(".", 1)[-1] in _PERF_TAILS:
+                return True
+    return False
+
+
+def _is_sync_call(fn: FunctionInfo, node: ast.Call) -> bool:
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in _SYNC_ATTRS:
+        return True
+    full, via = _full_name(fn.module, func)
+    if full:
+        tail = full.rsplit(".", 1)[-1]
+        if tail == "block_until_ready" or tail == "device_get":
+            return True
+        if via and full.startswith("numpy.") and tail in _SYNC_NP:
+            return True
+        if via and full.startswith("numpy.testing."):
+            return True
+    if isinstance(func, ast.Name) and func.id in ("float", "int") \
+            and node.args:
+        return True
+    return False
+
+
+def _check_brackets(fn: FunctionInfo, ev: Dict[str, List]) -> List[Finding]:
+    out: List[Finding] = []
+    for var, read_line in ev["reads"]:
+        start_line = ev["starts"].get(var)
+        if start_line is None or read_line <= start_line:
+            continue
+        dispatched = sorted(d for d in ev["dispatch"]
+                            if start_line < d <= read_line)
+        if not dispatched:
+            continue
+        if any(dispatched[0] <= s <= read_line for s in ev["syncs"]):
+            continue
+        out.append(Finding(
+            rule="DS005", path=fn.module.rel, line=read_line,
+            message=f"elapsed read of `{var}` (started line {start_line}) "
+                    f"brackets a jit dispatch (line {dispatched[0]}) with "
+                    f"no block_until_ready/host transfer before the read "
+                    f"(in `{fn.name}`) — measures async dispatch, not "
+                    "device work"))
+    return out
+
+
+def _check_spans(fn: FunctionInfo) -> List[Finding]:
+    out: List[Finding] = []
+    for node in _own_walk(fn):
+        if not isinstance(node, ast.With):
+            continue
+        is_span = any(
+            isinstance(item.context_expr, ast.Call) and
+            isinstance(item.context_expr.func, ast.Attribute) and
+            item.context_expr.func.attr == "span"
+            for item in node.items)
+        if not is_span:
+            continue
+        dispatch_line = None
+        synced = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = dotted_name(sub.func)
+                tail = name.rsplit(".", 1)[-1] if name else None
+                if tail and _DISPATCH_RE.search(tail):
+                    dispatch_line = dispatch_line or sub.lineno
+                if _is_sync_call(fn, sub):
+                    synced = True
+        if dispatch_line and not synced:
+            out.append(Finding(
+                rule="DS005", path=fn.module.rel, line=node.lineno,
+                message=f"tracer span encloses a jit dispatch (line "
+                        f"{dispatch_line}) with no sync before span exit "
+                        f"(in `{fn.name}`) — span clocks async dispatch"))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# DS006 nondeterminism-in-jit
+
+_STDLIB_TIME_RANDOM = ("time.", "random.")
+_NONDET_FULL_PREFIXES = ("numpy.random.", "datetime.datetime.now",
+                         "datetime.datetime.utcnow", "uuid.uuid4",
+                         "os.urandom", "secrets.")
+
+
+@rule("DS006", "nondeterminism-in-jit")
+def nondeterminism_in_jit(ctx: LintContext) -> List[Finding]:
+    """Host nondeterminism inside traced code (``time.*``, stdlib
+    ``random.*``, ``np.random.*``, unordered-set iteration) is evaluated
+    ONCE at trace time and baked into the compiled program as a constant —
+    every subsequent step reuses the first step's value, silently. Traced
+    randomness must come from ``jax.random`` keys; trace-time iteration
+    order must be deterministic (sort the set)."""
+    out: List[Finding] = []
+    for fn in ctx.index.jit_reachable.values():
+        for node in _own_walk(fn):
+            if isinstance(node, ast.Call):
+                full, via = _full_name(fn.module, node.func)
+                if not (full and via):
+                    continue
+                if full.startswith(_STDLIB_TIME_RANDOM) and \
+                        not full.startswith("random.Random"):
+                    out.append(_finding(
+                        fn, node, "DS006",
+                        f"`{full}` in `{fn.name}`{_reach_note(fn)} — "
+                        "evaluated once at trace time, constant-folded "
+                        "into the compiled program"))
+                elif full.startswith(_NONDET_FULL_PREFIXES):
+                    out.append(_finding(
+                        fn, node, "DS006",
+                        f"`{full}` in `{fn.name}`{_reach_note(fn)} — host "
+                        "nondeterminism baked in at trace time"))
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                it = node.iter
+                if isinstance(it, ast.Set) or (
+                        isinstance(it, ast.Call) and
+                        isinstance(it.func, ast.Name) and
+                        it.func.id in ("set", "frozenset")):
+                    out.append(Finding(
+                        rule="DS006", path=fn.module.rel,
+                        line=getattr(node, "lineno", it.lineno),
+                        message=f"iteration over an unordered set in "
+                                f"`{fn.name}`{_reach_note(fn)} — trace "
+                                "order varies across processes (sort it)"))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# DS007 / DS008 — pytest marker audit (tests domain)
+
+_BUILTIN_MARKS = {"parametrize", "skip", "skipif", "xfail", "usefixtures",
+                  "filterwarnings"}
+
+
+def _pytest_ini(ctx: LintContext):
+    """(registered marker names, excluded-by-addopts names, addopts line,
+    ini relpath) from pytest.ini."""
+    cp = configparser.ConfigParser()
+    cp.read(ctx.pytest_ini)
+    markers, excluded, addopts_line = set(), set(), 1
+    if cp.has_option("pytest", "markers"):
+        for line in cp.get("pytest", "markers").splitlines():
+            line = line.strip()
+            if line:
+                markers.add(line.split(":", 1)[0].strip())
+    if cp.has_option("pytest", "addopts"):
+        addopts = cp.get("pytest", "addopts")
+        for m in re.finditer(r"not\s+(\w+)", addopts):
+            excluded.add(m.group(1))
+        with open(ctx.pytest_ini, encoding="utf-8") as f:
+            for i, line in enumerate(f, start=1):
+                if line.strip().startswith("addopts"):
+                    addopts_line = i
+                    break
+    import os
+    rel = os.path.relpath(ctx.pytest_ini, ctx.repo_root).replace(os.sep, "/")
+    return markers, excluded, addopts_line, rel
+
+
+def _conftest_gates(ctx: LintContext) -> Set[str]:
+    """Marker names wired into the conftest runtime tier gates (the
+    ``gates = [("tpu", "DS_TPU_TESTS", ...), ...]`` list)."""
+    if not ctx.conftest:
+        return set()
+    try:
+        with open(ctx.conftest, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return set()
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "gates"
+                for t in node.targets):
+            for elt in ast.walk(node.value):
+                if isinstance(elt, ast.Tuple) and elt.elts and \
+                        isinstance(elt.elts[0], ast.Constant) and \
+                        isinstance(elt.elts[0].value, str):
+                    names.add(elt.elts[0].value)
+    return names
+
+
+@rule("DS007", "unregistered-marker", domain="tests")
+def unregistered_marker(ctx: LintContext) -> List[Finding]:
+    """A ``pytest.mark.<x>`` not registered in pytest.ini is a typo-prone
+    no-op: ``-m x`` selects nothing, tier filters silently miss it, and
+    ``--strict-markers`` CI dies. Register every marker."""
+    if not ctx.pytest_ini:
+        return []
+    registered, _, _, _ = _pytest_ini(ctx)
+    out: List[Finding] = []
+    for mod in ctx.tests_index.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute):
+                base = dotted_name(node.value)
+                if base in ("pytest.mark", "mark"):
+                    name = node.attr
+                    if name in _BUILTIN_MARKS or name in registered:
+                        continue
+                    out.append(Finding(
+                        rule="DS007", path=mod.rel, line=node.lineno,
+                        message=f"marker `pytest.mark.{name}` is not "
+                                "registered in pytest.ini"))
+    return out
+
+
+@rule("DS008", "ungated-tier-marker", domain="tests")
+def ungated_tier_marker(ctx: LintContext) -> List[Finding]:
+    """A marker excluded by pytest.ini ``addopts -m`` but absent from the
+    conftest env-gated skip list is a trap: any command-line ``-m`` (the
+    tier-1 runner's ``-m 'not slow'``) REPLACES addopts exclusions and
+    silently unleashes that tier — the PR-2 bug that let TPU tests loose
+    on the CPU mesh. Every addopts-excluded tier needs a conftest gate."""
+    if not ctx.pytest_ini:
+        return []
+    _, excluded, addopts_line, ini_rel = _pytest_ini(ctx)
+    gates = _conftest_gates(ctx)
+    out: List[Finding] = []
+    for marker in sorted(excluded - gates):
+        out.append(Finding(
+            rule="DS008", path=ini_rel, line=addopts_line,
+            message=f"tier marker `{marker}` is excluded via addopts -m "
+                    "but has no conftest env-gated skip — a command-line "
+                    "-m replaces addopts and would unleash the tier"))
+    return out
