@@ -50,6 +50,12 @@ Env knobs (defaults are the chip-measured fast path):
                            off vs on (vs_baseline = off/on TTFT ratio);
                            BENCH_SERVE_REQS=8 BENCH_SERVE_PREFIX_LEN=768
                            BENCH_SERVE_NEW=16
+  BENCH_SERVE_SPEC=1       speculative-decode probe: p50 TPOT on repetitive
+                           motif prompts, serving.speculative off vs ngram
+                           (vs_baseline = off/on p50 ratio; accepted
+                           tokens/step in the telemetry blob);
+                           BENCH_SERVE_SPEC_REQS=8 BENCH_SERVE_SPEC_K=4
+                           BENCH_SERVE_SPEC_NEW=64 BENCH_SERVE_SPEC_MOTIF=48
   BENCH_SERVE_CHUNKED=1    decode-interference probe: p99 TPOT with long
                            prompts prefilling whole vs chunked
                            (vs_baseline = whole/chunked p99 ratio);
@@ -151,7 +157,9 @@ def _telemetry_blob(engine):
             blob[k] = {kk: round(float(vv), 3) for kk, vv in h[k].items()}
     for k in ("serving/preemptions", "serving/recompute_tokens",
               "serving/prefill_steps", "serving/decode_steps",
-              "serving/generated_tokens", "checkpoint/saves",
+              "serving/generated_tokens", "serving/spec_verify_steps",
+              "serving/spec_proposed_tokens", "serving/spec_accepted_tokens",
+              "serving/spec_rollbacks", "checkpoint/saves",
               "checkpoint/failures"):
         if k in c:
             blob[k] = c[k]
@@ -395,6 +403,7 @@ BENCH_METRICS = [
     ("BENCH_DECODE_PAGED", "1", "gpt2_decode_paged_tokens_per_sec_per_chip"),
     ("BENCH_SERVE_PREFIX", "1", "gpt2_serving_prefix_cache_ttft_ms"),
     ("BENCH_SERVE_CHUNKED", "1", "gpt2_serving_chunked_prefill_tpot_p99_ms"),
+    ("BENCH_SERVE_SPEC", "1", "gpt2_serving_spec_decode_tpot_ms"),
     ("BENCH_CKPT", "1", "gpt2_ckpt_async_stall_ms_per_step"),
 ]
 
@@ -609,6 +618,79 @@ def run_chunked_prefill_bench():
             print(json.dumps(rec), flush=True)
 
 
+def run_spec_decode_bench():
+    """Speculative-decode probe: a repetitive / shared-pattern prompt set
+    (the n-gram self-speculation sweet spot — templated text where the
+    continuation has literally been seen before) decoded with
+    ``serving.speculative`` OFF vs ON at the same greedy settings. Value =
+    p50 TPOT with speculation on; vs_baseline = OFF/ON p50 TPOT ratio
+    (>1 = fewer fused steps per emitted token); the same run's
+    ``accepted_tokens_per_step`` and spec counters ride in the record's
+    telemetry blob, so the acceptance rate that produced the speedup is
+    part of the data point."""
+    import numpy as np
+
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models import gpt2
+
+    dist.set_mesh(None)
+    NREQ = int(os.environ.get("BENCH_SERVE_SPEC_REQS", 8))
+    K = int(os.environ.get("BENCH_SERVE_SPEC_K", 4))
+    MAX_NEW = int(os.environ.get("BENCH_SERVE_SPEC_NEW", 64))
+    MOTIF = int(os.environ.get("BENCH_SERVE_SPEC_MOTIF", 48))
+    model = gpt2("125m", remat=False,
+                 attention_backend=os.environ.get("BENCH_ATTN", "auto"))
+    rng = np.random.default_rng(0)
+    # repetitive prompts: a short unique PREFIX then a motif tiled several
+    # times — the prompt's tail n-gram recurs earlier in the tiling, so
+    # the proposer speculates from the very first decode turn (a unique
+    # suffix would leave the tail unmatchable and measure nothing); greedy
+    # loops then extend the win into generated text
+    prompts = []
+    for _ in range(NREQ):
+        motif = rng.integers(0, 50257, size=MOTIF).astype(np.int32)
+        prompts.append(np.concatenate(
+            [rng.integers(0, 50257, size=8).astype(np.int32),
+             np.tile(motif, 5)]))
+
+    results, stats = {}, {}
+    for mode in ("off", "ngram"):
+        _reset_telemetry()
+        engine = deepspeed_tpu.init_inference(
+            model, dtype="bf16", telemetry=True,
+            serving={"block_size": 128, "max_running": 8,
+                     # cache off: both modes pay identical prefill, so the
+                     # TPOT delta is the multi-token decode win alone
+                     "prefix_caching": "off",
+                     "speculative": {"mode": mode, "k": K}})
+        engine.generate_batch(prompts, max_new_tokens=MAX_NEW)   # warm
+        _reset_telemetry()
+        engine.generate_batch(prompts, max_new_tokens=MAX_NEW)
+        results[mode] = _serve_hist(engine, "serving/tpot_ms", "p50")
+        stats[mode] = dict(getattr(engine, "_last_serve_stats", {}) or {})
+        if mode == "ngram":
+            st = stats[mode]
+            steps = st.get("decode_steps", 0) + st.get("verify_steps", 0)
+            rec = {
+                "metric": _metric_name("BENCH_SERVE_SPEC"),
+                "value": round(results["ngram"], 3),
+                "unit": f"p50 TPOT ms (bf16, {NREQ} reqs x {MAX_NEW} new, "
+                        f"5x{MOTIF}-tok motif prompts, ngram k={K}; off = "
+                        f"{results['off']:.2f} ms)",
+                # >1 = speculation cut per-token latency by this factor
+                "vs_baseline": (round(results["off"] / results["ngram"], 3)
+                                if results["ngram"] else 0.0),
+            }
+            tel = _telemetry_blob(engine) or {}
+            tel["accepted_tokens_per_step"] = (
+                round(st.get("emitted_tokens", 0) / steps, 3) if steps
+                else 0.0)
+            tel["spec_stats"] = st
+            rec["telemetry"] = tel
+            print(json.dumps(rec), flush=True)
+
+
 def run_checkpoint_bench():
     """Async-checkpoint stall probe: the same training loop with and
     without a two-phase async save in flight. Phase 1 (device->host
@@ -816,7 +898,8 @@ def main():
 
     if any(_metric_enabled(g) for g in
            ("BENCH_DECODE_DENSE", "BENCH_DECODE_PAGED",
-            "BENCH_SERVE_PREFIX", "BENCH_SERVE_CHUNKED")):
+            "BENCH_SERVE_PREFIX", "BENCH_SERVE_CHUNKED",
+            "BENCH_SERVE_SPEC")):
         # free the last training engine's device state before serving
         if engine is not None:
             del engine, model, batch
@@ -831,6 +914,9 @@ def main():
             gc.collect()
         if _metric_enabled("BENCH_SERVE_CHUNKED"):
             run_chunked_prefill_bench()
+            gc.collect()
+        if _metric_enabled("BENCH_SERVE_SPEC"):
+            run_spec_decode_bench()
 
 
 if __name__ == "__main__":

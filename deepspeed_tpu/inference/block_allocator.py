@@ -225,3 +225,31 @@ class BlockAllocator:
         self._table[key] = block
         self._key_of[block] = key
         return True
+
+    def unregister_if_owner(self, block: int, key: bytes) -> bool:
+        """Withdraw ``block``'s registration under ``key`` — the rollback
+        half of speculative decoding: a block that filled DURING a verify
+        window was registered with candidate tokens in its hash chain, and
+        when those candidates are rejected its tail slots will be
+        overwritten by the real continuation, so the key would describe
+        content that no longer exists. First-writer-wins is preserved: when
+        ``key`` maps to a DIFFERENT block (another request registered the
+        same content first, so this block's ``register`` never took — that
+        owner's content IS committed) the mapping is left untouched.
+        Returns True when the registration was removed.
+
+        Callers normally roll back while still holding a reference to the
+        block; a zero-ref block parked COLD under this key loses its only
+        address, so it is moved back to the free list (nothing can ever
+        resurrect it)."""
+        if not self.prefix_cache:
+            return False
+        if self._table.get(key) != block or self._key_of.get(block) != key:
+            return False
+        del self._table[key]
+        del self._key_of[block]
+        if block in self._cold:
+            del self._cold[block]
+            self._free.append(block)
+            self._free_set.add(block)
+        return True

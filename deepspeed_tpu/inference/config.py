@@ -110,6 +110,28 @@ class QuantizationConfig(ConfigModel):
     qkv: QKVQuantConfig = Field(default_factory=QKVQuantConfig)
 
 
+class SpeculativeConfig(ConfigModel):
+    """Speculative decoding ("serving.speculative" sub-section).
+
+    ``mode="ngram"`` turns on draft-free self-speculation on the paged
+    path: a host-side n-gram proposer (``inference/spec.py``) matches the
+    tail of each request's prompt + generated tokens against earlier
+    occurrences and proposes up to ``k`` continuation tokens, which one
+    fused verify step (``forward_paged_verify``) checks at all ``k + 1``
+    positions at once — greedy argmax acceptance keeps speculation
+    token-identical to plain greedy decode while emitting (accepted + 1)
+    tokens per fused step. Requests with no match fall back to
+    single-token decode; sampled generation (``temperature > 0``)
+    disables speculation for the call (acceptance is argmax-exact).
+    ``mode="auto"`` is RESERVED for a future draft-model speculator and
+    resolves to "off" today.
+    """
+    mode: str = "off"       # off | ngram | auto (auto reserved: off today)
+    k: int = 4              # max candidate tokens proposed per request/step
+    min_match: int = 2      # shortest tail n-gram the proposer may match
+    max_match: int = 4      # longest tail n-gram tried (longest first)
+
+
 class ServingConfig(ConfigModel):
     """Continuous-batching serving config ("serving" section).
 
@@ -132,6 +154,9 @@ class ServingConfig(ConfigModel):
     multiple of 128) and interleaves one chunk with each fused decode
     step — running decodes keep making progress instead of stalling for a
     whole long prompt. 0 = whole-prompt prefill (the default).
+
+    ``speculative`` configures n-gram self-speculation (verified
+    multi-token decode steps) — see :class:`SpeculativeConfig`.
     """
     block_size: int = 128          # tokens per KV block (128 = kernel path;
     # smaller blocks pack tighter but decode through the gather fallback)
@@ -141,6 +166,8 @@ class ServingConfig(ConfigModel):
     paged: str = "auto"            # auto | on | off
     prefix_caching: str = "auto"   # auto | on | off (auto = on when paged)
     prefill_chunk_tokens: int = 0  # 0 = whole-prompt; else chunk size
+    speculative: SpeculativeConfig = Field(
+        default_factory=SpeculativeConfig)
 
 
 class InferenceCheckpointConfig(ConfigModel):

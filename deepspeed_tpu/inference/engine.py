@@ -30,7 +30,7 @@ import numpy as np
 
 import deepspeed_tpu.comm as dist
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
-from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.logging import log_dist, logger, warn_once
 
 
 class InferenceEngine:
@@ -905,6 +905,14 @@ class InferenceEngine:
                                 p, t, pools, bt, slots, sp, li),
                             donate_argnums=(2,)),
                     "inference.paged_prefill_chunk")
+            verify = None
+            if hasattr(mod, "forward_paged_verify"):
+                verify = self._watched(
+                    jax.jit(lambda p, t, pools, bt, slots, pos:
+                            mod.forward_paged_verify(
+                                p, t, pools, bt, slots, pos),
+                            donate_argnums=(2,)),
+                    "inference.paged_verify")
             self._paged_jits = (
                 self._watched(
                     jax.jit(lambda p, t, pools, slots, li:
@@ -919,6 +927,7 @@ class InferenceEngine:
                 chunk,
                 self._watched(jax.jit(copy_paged_block, donate_argnums=(0,)),
                               "inference.paged_cow"),
+                verify,
             )
         return self._paged_jits
 
@@ -949,9 +958,12 @@ class InferenceEngine:
         static ``generate`` path per request. ``prefix_caching`` (default
         auto = on) shares already-computed KV blocks across requests AND
         across calls (the pool workspace persists); ``prefill_chunk_tokens``
-        interleaves prefill chunks with decode steps. Greedy decoding
-        (``temperature=0``) reproduces the static path's tokens exactly in
-        every mode.
+        interleaves prefill chunks with decode steps;
+        ``speculative: {mode: "ngram", k}`` turns on draft-free
+        self-speculation — verified multi-token decode steps that emit
+        (accepted + 1) tokens per fused step on repetitive workloads.
+        Greedy decoding (``temperature=0``) reproduces the static path's
+        tokens exactly in every mode, speculation included.
         """
         prompts = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
         if not prompts:
@@ -970,6 +982,12 @@ class InferenceEngine:
         max_new = (max_new_tokens if max_new_tokens is not None
                    else self._config.max_out_tokens)
         if mode == "off" or not supported:
+            if str(srv.speculative.mode) == "ngram":
+                # the same courtesy the temperature>0 case gets: the user
+                # configured speculation, and silence would read as "on"
+                warn_once("serving.speculative is ignored on the static "
+                          "(non-paged) serving path — speculation needs "
+                          "the paged engine (serving.paged)")
             # static fallback: each request through the (batched-workspace)
             # generate path, one at a time — correct for every engine mode.
             # Per-request seed offset: sampled mode must not hand every
@@ -1016,6 +1034,39 @@ class InferenceEngine:
                     "forward_paged_prefill_chunk")
         caching = chunk_ok and pc_mode != "off"
 
+        # ---- speculative decoding (n-gram self-speculation) ----
+        spec = srv.speculative
+        spec_mode = str(spec.mode)
+        if spec_mode not in ("off", "ngram", "auto"):
+            raise ValueError(f"serving.speculative.mode={spec_mode!r} "
+                             "(expected off|ngram|auto)")
+        # "auto" is reserved for a future draft-model speculator: off today
+        spec_on = spec_mode == "ngram"
+        if spec_on and not hasattr(self.module, "forward_paged_verify"):
+            raise ValueError(
+                "serving.speculative.mode='ngram' but the model has no "
+                "forward_paged_verify (the fused multi-position verify "
+                "step); serve a zoo causal LM or set mode='off'")
+        if spec_on and temperature > 0.0:
+            # acceptance is greedy-argmax-exact; lossless sampled
+            # speculation needs rejection sampling over the verify logits
+            warn_once("serving.speculative is greedy-only: temperature > 0 "
+                      "disables speculation for this call")
+            spec_on = False
+        spec_k = int(spec.k)
+        if spec_on and spec_k < 1:
+            raise ValueError("serving.speculative.k must be >= 1")
+        proposer = None
+        spec_wb = 0
+        if spec_on:
+            from deepspeed_tpu.inference.spec import NgramProposer
+            proposer = NgramProposer(min_match=int(spec.min_match),
+                                     max_match=int(spec.max_match))
+            # verify window compile bucket: next power of two of k+1, so
+            # sweeping k costs <= log2 programs (pinned by the
+            # serving_speculative compile-budget contract)
+            spec_wb = 1 << int(spec_k).bit_length()
+
         pools, pools_reused = self._paged_pools(num_blocks, bs)
         alloc = self._paged_allocator(num_blocks, bs, caching, pools_reused)
         ev = self._events
@@ -1027,8 +1078,11 @@ class InferenceEngine:
                                             prefix_caching=caching,
                                             chunk_tokens=chunk_tokens,
                                             events=ev,
-                                            rid_base=self._serve_rid_base)
-        prefill_jit, decode_jit, chunk_jit, cow_jit = self._ensure_paged_jits()
+                                            rid_base=self._serve_rid_base,
+                                            spec_k=spec_k if spec_on else 0,
+                                            spec_proposer=proposer)
+        prefill_jit, decode_jit, chunk_jit, cow_jit, verify_jit = \
+            self._ensure_paged_jits()
         rng = jax.random.key(seed)
 
         # the try/finally guards rid uniqueness: even when a serve aborts
@@ -1125,6 +1179,65 @@ class InferenceEngine:
                                                    int(np.asarray(tok)[0]))
                     else:
                         sched.record_prefill_chunk(req, step)
+                elif kind == "verify":
+                    # speculative multi-token step: the fused decode math
+                    # over each request's window (pending token + proposed
+                    # candidates) at once, then greedy argmax acceptance —
+                    # the accepted candidate prefix plus the first-mismatch
+                    # token is exactly what token-by-token decode would emit
+                    reqs = payload
+                    bt = np.zeros((W, n_max), np.int32)       # zeros → dummy
+                    pos = np.zeros((W,), np.int32)
+                    toks = np.zeros((W, spec_wb), np.int32)
+                    slotm = np.zeros((W, spec_wb), np.int32)
+                    zt = np.zeros((1,), np.int32)
+                    for i in range(W):
+                        if i >= len(reqs):
+                            # inactive rows: junk routed to the dummy block
+                            slotm[i] = self._flat_slots(zt, 0, 0, spec_wb, bs)
+                            continue
+                        r = reqs[i]
+                        nv = 1 + len(r.spec_tokens)
+                        toks[i, 0] = r.last_token
+                        toks[i, 1:nv] = r.spec_tokens
+                        table = np.asarray(r.blocks, np.int32)
+                        bt[i, :table.size] = table
+                        pos[i] = r.pos
+                        slotm[i] = self._flat_slots(table, r.pos, nv,
+                                                    spec_wb, bs)
+                    t0 = time.monotonic_ns() if ev is not None else 0
+                    logits, pools = verify_jit(self.params,
+                                               jnp.asarray(toks), pools,
+                                               jnp.asarray(bt),
+                                               jnp.asarray(slotm),
+                                               jnp.asarray(pos))
+                    # same argmax the decode path's _sample_host runs, at
+                    # every window position; the fetch is the sync point,
+                    # so the spec_verify slices below clock device time
+                    greedy = np.asarray(jnp.argmax(
+                        logits.astype(jnp.float32), axis=-1))
+                    dur = time.monotonic_ns() - t0 if ev is not None else 0
+                    for i, r in enumerate(reqs):
+                        cands = r.spec_tokens
+                        n_acc = 0
+                        while n_acc < len(cands) \
+                                and int(greedy[i, n_acc]) == cands[n_acc]:
+                            n_acc += 1
+                        emitted = list(cands[:n_acc]) + [int(greedy[i, n_acc])]
+                        # truncate at eos HERE so the event's accepted=
+                        # matches what record_verify will commit (its own
+                        # truncation stays as the invariant check)
+                        if eos_token_id is not None \
+                                and int(eos_token_id) in emitted:
+                            emitted = emitted[
+                                :emitted.index(int(eos_token_id)) + 1]
+                        if ev is not None:
+                            # emitted BEFORE record_verify so a retirement
+                            # this step triggers lands after its slice
+                            ev.emit("req.spec_verify", rid=r.rid, t_ns=t0,
+                                    dur_ns=dur, window=1 + len(cands),
+                                    accepted=len(emitted) - 1)
+                        sched.record_verify(r, emitted)
                 else:
                     reqs = payload
                     bt = np.zeros((W, n_max), np.int32)       # zeros → dummy
@@ -1151,6 +1264,10 @@ class InferenceEngine:
                         sched.record_decode(r, int(tok[i]))
         finally:
             self._serve_rid_base = sched._next_rid
+            # step accounting for the serve that just ran (plain host
+            # counters, kept even when the metrics registry is off):
+            # accepted_tokens_per_step > 1 is the speculation win
+            self._last_serve_stats = dict(sched.stats)
         if ev is not None:
             ev.emit("serve.end", t_ns=t_serve0,
                     dur_ns=time.monotonic_ns() - t_serve0,

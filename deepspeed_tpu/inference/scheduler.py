@@ -12,11 +12,33 @@ The engine drives one *step* at a time: :meth:`next_action` returns one of
   with decode steps instead of stalling every running decode for a whole
   long prompt);
 - ``("decode", running)`` — one fused decode step over every running
-  request that finished prefilling.
+  request that finished prefilling;
+- ``("verify", running)`` — the speculative form of the decode step
+  (``spec_k > 0`` with an n-gram proposer): each request carries up to
+  ``spec_k`` proposed candidate tokens in ``req.spec_tokens`` and one
+  fused verify step checks all of them at once, emitting the accepted
+  prefix plus one token — requests with no match ride along with an
+  empty window (single-token decode inside the same program), and a
+  step where NO request found a match degrades to plain ``decode``.
 
 Finished requests retire between steps (their blocks return to the pool)
 and queued requests take their slots, so a convoying long request never
 stalls the batch the way the static ``generate`` loop does.
+
+**Speculative decoding** (``spec_k``/``spec_proposer``): before a decode
+turn, each decode-ready request's prompt + generated history is handed to
+the proposer (``inference/spec.py``) and the candidates' KV slots are
+secured up front — window growth only draws on the free pool (free list +
+reclaimable cold blocks) and TRUNCATES the window when it runs dry, never
+preempting: speculation must not evict work plain decode would have kept,
+so eviction behavior is identical with speculation on or off. After the
+engine's greedy acceptance, :meth:`record_verify` commits the accepted
+tokens and ROLLS BACK the rest: ``pos`` rewinds past the rejected
+candidates (their k/v stays in the pools beyond ``pos`` — never read,
+overwritten as decode advances) and any block that crossed its fill
+boundary inside the rejected span is unregistered from the prefix cache
+via ``unregister_if_owner`` — unless a first writer already owned the
+hash, in which case that owner's (committed) content keeps the mapping.
 
 Request lifecycle::
 
@@ -95,7 +117,9 @@ class ServingTelemetry:
                "decode_steps", "prefix_cache_lookups", "prefix_cache_hits",
                "prefix_cache_hit_tokens",
                "preemptions", "recompute_tokens", "requests", "finished",
-               "generated_tokens")
+               "generated_tokens", "spec_verify_steps",
+               "spec_proposed_tokens", "spec_accepted_tokens",
+               "spec_rollbacks", "spec_acceptance_rate")
 
     def __init__(self, registry=None):
         if registry is None:
@@ -224,6 +248,37 @@ class ServingTelemetry:
     def generated_tokens(self):
         return self.registry.counter("serving/generated_tokens")
 
+    @property
+    def spec_verify_steps(self):
+        return self.registry.counter(
+            "serving/spec_verify_steps",
+            "fused speculative verify steps (all rows at once)")
+
+    @property
+    def spec_proposed_tokens(self):
+        return self.registry.counter(
+            "serving/spec_proposed_tokens",
+            "candidate tokens proposed by the n-gram speculator")
+
+    @property
+    def spec_accepted_tokens(self):
+        return self.registry.counter(
+            "serving/spec_accepted_tokens",
+            "proposed candidates greedy verification accepted")
+
+    @property
+    def spec_rollbacks(self):
+        return self.registry.counter(
+            "serving/spec_rollbacks",
+            "verify steps that rejected candidates (pos rewound, "
+            "uncommitted prefix-cache registrations withdrawn)")
+
+    @property
+    def spec_acceptance_rate(self):
+        return self.registry.gauge(
+            "serving/spec_acceptance_rate",
+            "accepted / proposed candidate tokens (cumulative)")
+
 
 @dataclasses.dataclass
 class Request:
@@ -247,6 +302,8 @@ class Request:
     # chain keys of this request's REGISTERED-or-matched full blocks
     cow_pending: Optional[Tuple[int, int]] = None  # (src, dst) device copy
     error: Optional[str] = None     # set when retired without completing
+    # ---- speculative decoding state ----
+    spec_tokens: Tuple[int, ...] = ()  # candidates for the pending verify
 
     def prefix(self) -> np.ndarray:
         """The token prefix a (re)admission must have cached before decode
@@ -279,16 +336,30 @@ class ContinuousBatchingScheduler:
                  max_blocks_per_seq: int,
                  telemetry: Optional[ServingTelemetry] = None,
                  prefix_caching: bool = False, chunk_tokens: int = 0,
-                 events=None, rid_base: int = 0):
+                 events=None, rid_base: int = 0,
+                 spec_k: int = 0, spec_proposer=None):
         if max_running < 1:
             raise ValueError("max_running must be >= 1")
         if chunk_tokens < 0:
             raise ValueError("chunk_tokens must be >= 0 (0 = whole-prompt)")
+        if spec_k < 0:
+            raise ValueError("spec_k must be >= 0 (0 = speculation off)")
         self.allocator = allocator
         self.max_running = max_running
         self.max_blocks_per_seq = max_blocks_per_seq
         self.prefix_caching = prefix_caching and allocator.prefix_cache
         self.chunk_tokens = chunk_tokens
+        # speculative decoding: propose up to spec_k candidates per decode-
+        # ready request and verify them in one fused step (0/None = off)
+        self.spec_k = spec_k if spec_proposer is not None else 0
+        self.spec_proposer = spec_proposer
+        # plain host counters, always on (the engine/tests read step
+        # accounting from here even with the metrics registry disabled):
+        # accepted_tokens_per_step = emitted_tokens / (decode + verify)
+        self.stats = {"decode_steps": 0, "verify_steps": 0,
+                      "emitted_tokens": 0, "spec_proposed": 0,
+                      "spec_accepted": 0, "spec_rollbacks": 0,
+                      "preemptions": 0}
         self.telemetry = telemetry
         # flight recorder (monitor/events.py): None when disabled, so
         # every emit site below gates at one None check
@@ -534,6 +605,12 @@ class ContinuousBatchingScheduler:
                 # capacity growth evicted every decodable row (they went
                 # back to the queue); pick again from the new state
                 return self.next_action()
+            if self.spec_k > 0:
+                action = self._prepare_verify(decodable)
+                if action is not None:
+                    self._tel_gauges()   # window growth moved blocks
+                    return action
+            self.stats["decode_steps"] += 1
             if self.telemetry is not None:
                 self.telemetry.decode_steps.inc()
             self._tel_gauges()       # capacity growth/evictions moved blocks
@@ -569,6 +646,66 @@ class ContinuousBatchingScheduler:
                 if victim is req:
                     break  # the requester evicted itself; it re-queued
 
+    def _prepare_verify(self, decodable: List[Request]) \
+            -> Optional[Tuple[str, object]]:
+        """Propose n-gram candidates for every decode-ready request and
+        secure the KV slots their verify windows write (slots ``pos`` ..
+        ``pos + len(candidates)``; slot ``pos`` itself is already assured
+        by ``_ensure_decode_capacity``). Window growth draws ONLY on the
+        free pool and truncates the candidate list when it runs dry —
+        speculation never preempts, so eviction behavior is identical to
+        plain decode (growth therefore cannot drop rows from
+        ``decodable``). Returns ``("verify", decodable)``, or None when no
+        request found a match (the caller emits a plain decode step — the
+        1-wide program is cheaper than an empty verify window)."""
+        ev = self.events
+        bs = self.allocator.block_size
+        any_cands = False
+        for r in decodable:
+            # candidates may never push the request past max_new: a verify
+            # step emits up to len(candidates)+1 tokens
+            headroom = r.max_new - len(r.generated) - 1
+            if headroom <= 0:
+                r.spec_tokens = ()
+                continue
+            t0 = time.monotonic_ns() if ev is not None else 0
+            cands = self.spec_proposer.propose(
+                r.output, min(self.spec_k, headroom))
+            found = len(cands)
+            if len(cands):
+                # clamp to the slots the request owns plus what the free
+                # pool supplies (free list + reclaimable cold), never
+                # evicting: highest written slot is pos + len(cands)
+                need = self.allocator.blocks_for_tokens(
+                    r.pos + 1 + len(cands)) - len(r.blocks)
+                if need > 0:
+                    got = self.allocator.allocate(
+                        min(need, self.allocator.num_free))
+                    if got:
+                        r.blocks.extend(got)
+                    cands = cands[:len(r.blocks) * bs - 1 - r.pos]
+            r.spec_tokens = tuple(int(c) for c in cands)
+            # emitted only when the proposer actually matched: a zero-found
+            # probe per request per decode turn would flood the bounded
+            # ring and evict the lifecycle tail a post-mortem needs (the
+            # same failure mode the per-attempt cache_hit instants had)
+            if ev is not None and found:
+                ev.emit("req.spec_propose", rid=r.rid, t_ns=t0,
+                        dur_ns=time.monotonic_ns() - t0,
+                        tokens=len(r.spec_tokens), found=found)
+            if r.spec_tokens:
+                any_cands = True
+                self.stats["spec_proposed"] += len(r.spec_tokens)
+                if self.telemetry is not None:
+                    self.telemetry.spec_proposed_tokens.inc(
+                        len(r.spec_tokens))
+        if not any_cands:
+            return None
+        self.stats["verify_steps"] += 1
+        if self.telemetry is not None:
+            self.telemetry.spec_verify_steps.inc()
+        return ("verify", decodable)
+
     def _preempt(self, victim: Request) -> None:
         logger.warning(
             f"KV pool exhausted: preempting request {victim.rid} "
@@ -580,6 +717,7 @@ class ContinuousBatchingScheduler:
             self.events.emit("req.preempt", rid=victim.rid,
                              blocks=len(victim.blocks),
                              recompute_tokens=len(victim.prefix()))
+        self.stats["preemptions"] += 1
         if self.telemetry is not None:
             self.telemetry.preemptions.inc()
             self.telemetry.recompute_tokens.inc(len(victim.prefix()))
@@ -588,6 +726,7 @@ class ContinuousBatchingScheduler:
         victim.pos = 0
         victim.prefilling = False
         victim.prefill_target = 0
+        victim.spec_tokens = ()
         victim.state = QUEUED
         victim.preemptions += 1
         # FRONT of the queue: the victim was admitted before anything still
@@ -669,7 +808,96 @@ class ContinuousBatchingScheduler:
         req.pos += 1
         self._register_full_blocks(req)
         req.generated.append(int(token))
+        self.stats["emitted_tokens"] += 1
         self._record_token_time(req)
+        self._maybe_finish(req)
+
+    def record_verify(self, req: Request, tokens: List[int]) -> None:
+        """One fused verify step for ``req``: the engine scattered k/v for
+        the whole window — the pending ``last_token`` plus every candidate
+        in ``req.spec_tokens`` at slots ``pos .. pos + m`` — and greedy
+        acceptance emitted ``tokens``: the accepted candidate prefix plus
+        the first-mismatch (or, on full acceptance, bonus) token.
+
+        Bookkeeping is optimistic-then-rollback, mirroring what the device
+        actually did: ``pos`` first advances over every scattered input and
+        blocks register into the prefix cache as they fill (their hash
+        chains include the candidate tokens — that IS their content right
+        now). A rejection then rewinds: the uncommitted candidates leave
+        ``generated``, ``pos`` rewinds past them (their k/v stays beyond
+        ``pos`` — never read, overwritten as decode advances), and every
+        block whose fill boundary sits inside the rejected span is
+        unregistered via ``unregister_if_owner`` — its slots WILL be
+        overwritten by the real continuation, so a surviving registration
+        would advertise content about to be destroyed. When a first writer
+        (another request whose identical tokens DID commit) already owned
+        the hash, its mapping is preserved untouched."""
+        cands = req.spec_tokens
+        m = len(cands)
+        req.spec_tokens = ()
+        tokens = [int(t) for t in tokens]
+        if not 1 <= len(tokens) <= m + 1:
+            raise ValueError(
+                f"verify of request {req.rid} emitted {len(tokens)} tokens "
+                f"from a window of {m} candidates")
+        # eos can land anywhere in the multi-token window: cut exactly
+        # where token-by-token greedy decode would have stopped
+        if req.eos is not None and req.eos in tokens:
+            tokens = tokens[:tokens.index(req.eos) + 1]
+        a = len(tokens) - 1            # candidates that commit
+
+        # ---- optimistic advance over the whole scattered window ----
+        req.generated.extend(int(c) for c in cands)
+        req.pos += m + 1
+        self._register_full_blocks(req)
+
+        # ---- rollback of the rejected tail ----
+        drop = m - a
+        if drop:
+            req.pos -= drop
+            del req.generated[-drop:]
+            bs = self.allocator.block_size
+            unregistered = 0
+            while len(req.keys) > req.pos // bs:
+                key = req.keys.pop()
+                if self.allocator.unregister_if_owner(
+                        req.blocks[len(req.keys)], key):
+                    unregistered += 1
+            # return the window's surplus whole blocks: a rejected
+            # speculation holding pool capacity would preempt requests
+            # plain decode would have kept (only blocks past the rewound
+            # pos's own slot can be surplus — all unregistered, the pop
+            # loop above already withdrew any boundary-crossing keys)
+            keep = max(self.allocator.blocks_for_tokens(req.pos + 1),
+                       len(req.keys))
+            if len(req.blocks) > keep:
+                tail = req.blocks[keep:]
+                del req.blocks[keep:]
+                self.allocator.free(list(reversed(tail)))
+            self.stats["spec_rollbacks"] += 1
+            if self.telemetry is not None:
+                self.telemetry.spec_rollbacks.inc()
+            if self.events is not None:
+                self.events.emit("req.spec_rollback", rid=req.rid,
+                                 rejected=drop, unregistered=unregistered)
+
+        # ---- commit: accepted candidates are already in ``generated``;
+        # the mismatch/bonus token is the next step's pending input ----
+        req.generated.append(tokens[-1])
+        self.stats["spec_accepted"] += a
+        self.stats["emitted_tokens"] += len(tokens)
+        if self.telemetry is not None:
+            t = self.telemetry
+            t.spec_accepted_tokens.inc(a)
+            # the rate gauge derives from the CUMULATIVE registry counters
+            # (they outlive this scheduler — one per serve call), so it
+            # always equals accepted/proposed as the snapshot reports them
+            proposed = t.spec_proposed_tokens.value
+            if proposed:
+                t.spec_acceptance_rate.set(
+                    t.spec_accepted_tokens.value / proposed)
+        for _ in tokens:
+            self._record_token_time(req)
         self._maybe_finish(req)
 
     def _record_token_time(self, req: Request) -> None:

@@ -1,0 +1,84 @@
+"""Draft-free self-speculation for the paged serving engine: n-gram
+prompt/generation lookup.
+
+Speculative decoding (Leviathan et al., "Fast Inference from Transformers
+via Speculative Decoding") amortises the per-step dispatch + kernel cost
+of autoregressive decode: a cheap *proposer* guesses the next ``k``
+tokens, one fused **verify** step computes the model's logits at all
+``k + 1`` positions (``models/transformer.py::forward_paged_verify``),
+and greedy acceptance keeps the longest candidate prefix the model agrees
+with plus one free token from the first mismatch — by construction
+token-identical to plain greedy decode, at (accepted + 1) tokens per
+fused step instead of 1.
+
+This module is the draft-FREE proposer (prompt-lookup / lookahead
+n-gram family): the candidate continuation is read straight out of the
+request's own prompt + generated history. No draft model, no extra
+weights, no device work — a pure host-side tail match. It is strong
+exactly where the serving engine already wins: repetitive and
+shared-prefix workloads (templated prompts, extraction/summarisation
+over quoted context, greedy loops) where the continuation has literally
+been seen before. On non-repetitive text it simply finds no match and
+the scheduler falls back to single-token decode per request — speculation
+never changes tokens, only step count.
+
+``serving.speculative: {mode: "ngram", k, min_match}`` turns it on
+(``inference/config.py``); the scheduler owns one proposer per serve
+call and stashes candidates on each request before a ``("verify", reqs)``
+action (``inference/scheduler.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class NgramProposer:
+    """Tail n-gram lookup over a request's token history.
+
+    ``propose(seq, k)`` matches the LONGEST tail n-gram of ``seq`` (from
+    ``max_match`` down to ``min_match`` tokens) against its most recent
+    earlier occurrence in ``seq`` and returns up to ``k`` tokens that
+    followed that occurrence — the speculated continuation. Empty when no
+    tail n-gram repeats (the caller decodes one token as usual).
+
+    Determinism: a pure function of the token sequence — longest match
+    first, most recent occurrence on ties — so identical request streams
+    speculate identically (the scheduler's determinism pin extends to
+    speculation). Matching is O(len(seq) x max_match) numpy per call; the
+    sequences the paged engine serves are bounded by ``max_seq``, so this
+    stays noise next to a fused decode step.
+    """
+
+    def __init__(self, min_match: int = 2, max_match: int = 4):
+        if min_match < 1:
+            raise ValueError(f"min_match={min_match} must be >= 1")
+        if max_match < min_match:
+            raise ValueError(f"max_match={max_match} must be >= "
+                             f"min_match={min_match}")
+        self.min_match = min_match
+        self.max_match = max_match
+
+    def propose(self, seq, k: int) -> np.ndarray:
+        """Up to ``k`` candidate continuation tokens for ``seq`` (1-D
+        int32, the request's prompt + generated history). [] when ``k``
+        < 1, the sequence is too short, or no tail n-gram recurs."""
+        empty = np.zeros((0,), np.int32)
+        if k < 1:
+            return empty
+        seq = np.asarray(seq, np.int32).reshape(-1)
+        L = seq.size
+        for n in range(min(self.max_match, L - 1), self.min_match - 1, -1):
+            tail = seq[L - n:]
+            # windows over seq[:-1]: starts 0..L-1-n, so the tail's own
+            # occurrence (start L-n) is excluded — overlapping earlier
+            # matches stay in (that's what extends periodic text)
+            windows = np.lib.stride_tricks.sliding_window_view(seq[:L - 1], n)
+            hits = np.nonzero((windows == tail).all(axis=1))[0]
+            if hits.size == 0:
+                continue
+            start = int(hits[-1]) + n      # most recent occurrence's end
+            cands = seq[start:start + k]
+            if cands.size:
+                return np.ascontiguousarray(cands, np.int32)
+        return empty

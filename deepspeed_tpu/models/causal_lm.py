@@ -79,6 +79,11 @@ class CausalLM:
         return T.forward_paged_decode(self.config, params, tokens, pools,
                                       block_tables, pos, pad_bias)
 
+    def forward_paged_verify(self, params, tokens, pools, block_tables,
+                             slots, pos):
+        return T.forward_paged_verify(self.config, params, tokens, pools,
+                                      block_tables, slots, pos)
+
     @property
     def num_parameters(self) -> int:
         cfg = self.config

@@ -1247,6 +1247,100 @@ def copy_paged_block(pools, src, dst):
             "v": pools["v"].at[:, dst].set(pools["v"][:, src])}
 
 
+def _paged_verify_attention(cfg: TransformerConfig, x, lp, positions,
+                            kp, vp, block_tables, slots):
+    """Verify attention over ALL running requests at once: each row's
+    speculation window (its pending last token + proposed candidates) has
+    its k/v scattered into the row's pool blocks at ``slots`` ([B, W] flat
+    slots, pads and inactive rows routed to the dummy block), then every
+    window query attends causally over the row's whole table with per-row
+    position WINDOWS ``positions[b, t] = pos_b + t``.
+
+    Token-identity with plain decode requires the SAME attention
+    implementation the decode step dispatches to — an argmax near-tie
+    resolved differently between two numerically-equivalent kernels would
+    flip an accepted token. So where the decode step takes the Pallas
+    paged kernel, verify runs the kernel once per window position
+    (scatter position t, query position t — exactly the t sequential
+    decode steps it replaces, still one compiled program); everywhere
+    else both use the gather + grouped-einsum masked-softmax core (W = 1
+    degenerates to the off-kernel decode exactly)."""
+    B, W, D = x.shape
+    H, KV, Hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
+
+    q, k, v = _qkv_project(cfg, x, lp, positions)
+
+    if _use_flash(cfg):
+        from deepspeed_tpu.ops.pallas.paged_decode_attention import \
+            paged_decode_attention
+        slopes = _alibi_slopes(H) if cfg.pos_embedding == "alibi" else None
+        outs = []
+        for t in range(W):
+            kp = _pool_scatter(kp, k[:, t], slots[:, t])
+            vp = _pool_scatter(vp, v[:, t], slots[:, t])
+            o = paged_decode_attention(q[:, t], kp, vp, block_tables,
+                                       positions[:, t], alibi_slopes=slopes,
+                                       scale=cfg.attn_scale)
+            if o is None:
+                break          # off-envelope: the einsum core below
+            outs.append(o)
+        if len(outs) == W:
+            out = jnp.stack(outs, axis=1).reshape(B, W, H * Hd)
+            out = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
+            return out, kp, vp
+
+    # re-scattering already-written positions is idempotent (same values
+    # to the same slots), so the off-envelope break above lands here clean
+    kp = _pool_scatter(kp, k.reshape(B * W, KV, Hd), slots.reshape(-1))
+    vp = _pool_scatter(vp, v.reshape(B * W, KV, Hd), slots.reshape(-1))
+
+    # causal mask (kpos <= qpos) bounds each window query at its own
+    # position: candidate t sees the cached context plus window tokens
+    # <= t, junk pad queries see junk but nothing reads their logits
+    out = _grouped_cache_einsum(cfg, q, _paged_gather(kp, block_tables),
+                                _paged_gather(vp, block_tables),
+                                positions, None)
+    out = out @ _w(lp["wo"], out) + (lp["bo"] if cfg.attn_bias else 0)
+    return out, kp, vp
+
+
+def forward_paged_verify(cfg: TransformerConfig, params, tokens, pools,
+                         block_tables, slots, pos, mlp_fn=None):
+    """One fused VERIFY step of speculative decoding over all running
+    requests: the paged-decode math over ``W = k + 1`` positions per
+    request in one program.
+
+    tokens [B, W] — row b is its pending last sampled token followed by
+    its proposed candidate continuation, right-padded to the window
+    bucket; slots [B, W] flat pool slots per window position
+    (block_table[(pos+t) // bs] * bs + (pos+t) % bs, pads and inactive
+    rows routed to the dummy block); pos [B] per-request cache depths.
+    Returns (logits [B, W, vocab] at EVERY window position, new pools).
+
+    Greedy acceptance is host-side: argmax at window offset t is the
+    token plain greedy decode would emit after candidates 1..t, so the
+    longest candidate prefix matched plus the first-mismatch token is
+    token-identical to t+1 sequential decode steps. Rejected candidates'
+    k/v stay in the pools beyond the committed position — never read
+    (attention masks at each row's pos) and overwritten as decode
+    advances; the scheduler handles pos rewind + prefix-cache rollback."""
+    _check_paged_config(cfg)
+    x, positions = cached_embed(cfg, params, tokens, pos, pools["k"].dtype)
+
+    def run_block(h, xs):
+        lp, kp, vp = xs
+        h, nkp, nvp = _decode_block(
+            cfg, h, lp,
+            lambda xn: _paged_verify_attention(cfg, xn, lp["attn"], positions,
+                                               kp, vp, block_tables, slots),
+            mlp_fn)
+        return h, (nkp, nvp)
+
+    x, (nk, nv) = jax.lax.scan(run_block, x,
+                               (params["layers"], pools["k"], pools["v"]))
+    return cached_head(cfg, params, x), {"k": nk, "v": nv}
+
+
 def forward_paged_decode(cfg: TransformerConfig, params, tokens, pools,
                          block_tables, pos, pad_bias=None, mlp_fn=None):
     """One fused decode step over ALL running requests: tokens [B, 1] (each

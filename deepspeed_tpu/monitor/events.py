@@ -5,8 +5,9 @@ detectors); this module records *what happened when*: a ring buffer of
 structured events with monotonic-ns timestamps and request/step identity,
 emitted by the training engine (step / phase / checkpoint phases / fp16
 skip), the continuous-batching scheduler (enqueue / admit / cache hit /
-preempt / retire), the inference engine (prefill, prefill chunk, COW
-copy, fused decode tick), and the crash-safe checkpoint writer
+preempt / retire, speculative propose / rollback), the inference engine
+(prefill, prefill chunk, COW copy, fused decode tick, speculative
+verify), and the crash-safe checkpoint writer
 (snapshot / serialize / commit / retry). The buffer keeps the newest
 ``capacity`` events (a flight recorder preserves the TAIL — the moments
 before the incident), counting evictions in ``dropped``.
@@ -67,6 +68,10 @@ EVENT_KINDS = frozenset({
     "req.prefill_chunk",    # one prefill chunk (start=, tokens=)
     "req.cow_copy",         # copy-on-write block split (src=, dst=)
     "decode.tick",          # one fused decode step (rids=, n=)
+    # serving: speculative decoding (n-gram self-speculation)
+    "req.spec_propose",     # host n-gram proposal (tokens=, found=)
+    "req.spec_verify",      # fused verify step slice (window=, accepted=)
+    "req.spec_rollback",    # rejection rewound pos (rejected=, unregistered=)
     "serve.begin",          # generate_batch entry (requests=)
     "serve.end",            # generate_batch span (dur_ns=, requests=)
     # scheduler occupancy sample (the counter-track source)
@@ -218,10 +223,13 @@ _ENGINE_TID = 0
 
 #: request-track child slices: recorder kind -> slice name
 _CHILD_SLICES = {"req.prefill": "prefill", "req.prefill_chunk": "prefill_chunk",
-                 "req.cow_copy": "cow_copy"}
+                 "req.cow_copy": "cow_copy",
+                 "req.spec_propose": "spec_propose",
+                 "req.spec_verify": "spec_verify"}
 #: request-track instants
 _INSTANTS = {"req.enqueue": "enqueue", "req.cache_hit": "cache_hit",
-             "req.cache_miss": "cache_miss", "req.preempt": "preempt"}
+             "req.cache_miss": "cache_miss", "req.preempt": "preempt",
+             "req.spec_rollback": "spec_rollback"}
 
 
 def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:

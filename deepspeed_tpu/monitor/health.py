@@ -774,6 +774,15 @@ def render_summary_table(s: Dict[str, Any]) -> str:
             if "cold_blocks" in serving:
                 line += f" cold {int(serving['cold_blocks'])}"
             parts.append(line)
+        prop = serving.get("spec_proposed_tokens", 0)
+        if prop:
+            # speculation on: accepted/proposed candidates + rate
+            acc = serving.get("spec_accepted_tokens", 0)
+            line = f"spec {int(acc)}/{int(prop)} ({acc / prop:.0%})"
+            rb = serving.get("spec_rollbacks", 0)
+            if rb:
+                line += f" rb {int(rb)}"
+            parts.append(line)
         if "preemptions" in serving:
             parts.append(f"preempt {int(serving['preemptions'])}")
         if parts:
@@ -866,13 +875,18 @@ def health_summary(rec: Dict, prev: Optional[Dict] = None) -> Dict[str, Any]:
                       ("serving/kv_block_utilization", "kv_block_utilization"),
                       ("serving/kv_blocks_free", "kv_blocks_free"),
                       ("serving/kv_fragmentation", "kv_fragmentation"),
-                      ("serving/cold_blocks", "cold_blocks")):
+                      ("serving/cold_blocks", "cold_blocks"),
+                      ("serving/spec_acceptance_rate",
+                       "spec_acceptance_rate")):
         if key in g:
             serving[name] = g[key]
     for key, name in (("serving/prefix_cache_lookups", "prefix_cache_lookups"),
                       ("serving/prefix_cache_hits", "prefix_cache_hits"),
                       ("serving/prefix_cache_hit_tokens",
                        "prefix_cache_hit_tokens"),
+                      ("serving/spec_proposed_tokens", "spec_proposed_tokens"),
+                      ("serving/spec_accepted_tokens", "spec_accepted_tokens"),
+                      ("serving/spec_rollbacks", "spec_rollbacks"),
                       ("serving/preemptions", "preemptions")):
         if key in c:
             serving[name] = c[key]

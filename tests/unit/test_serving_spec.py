@@ -1,0 +1,678 @@
+"""Speculative decoding on the paged serving engine: n-gram proposer
+semantics, allocator rollback (``unregister_if_owner``) pinned against the
+refcount/COW invariants, scheduler verify bookkeeping (optimistic
+register + rollback, first-writer-wins, preemption re-admission, window
+truncation), THE acceptance pin — ``generate_batch`` with
+``serving.speculative: {mode: ngram}`` is token-identical to plain greedy
+paged decode in every covered scenario while a repetitive-prompt scenario
+completes in strictly fewer fused steps than its token count — plus the
+flight-recorder/trace surface and the ``serving_speculative``
+compile-budget contract."""
+
+import importlib.util
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.inference.block_allocator import (ROOT_KEY,
+                                                     BlockAllocator)
+from deepspeed_tpu.inference.scheduler import (FINISHED, QUEUED,
+                                               ContinuousBatchingScheduler)
+from deepspeed_tpu.inference.spec import NgramProposer
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.models.transformer import TransformerConfig
+
+_TOOLS = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                      "..", "..", "tools"))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+_VT_PATH = Path(__file__).resolve().parents[2] / "tools" / "validate_trace.py"
+_spec = importlib.util.spec_from_file_location("validate_trace", _VT_PATH)
+validate_trace = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(validate_trace)
+
+
+@pytest.fixture(autouse=True)
+def clean_mesh():
+    dist.set_mesh(None)
+    yield
+    dist.set_mesh(None)
+
+
+def tiny_model(**over):
+    base = dict(vocab_size=64, n_layer=2, n_head=4, d_model=32, d_ff=64,
+                max_seq=128, remat=False)
+    base.update(over)
+    return CausalLM(TransformerConfig(**base))
+
+
+# --------------------------------------------------------------------- #
+# n-gram proposer
+
+
+class TestNgramProposer:
+
+    def test_basic_tail_match(self):
+        # tail [3, 4] recurs at position 2: continuation [5, 6, 7]
+        p = NgramProposer(min_match=2, max_match=2)
+        got = p.propose([1, 2, 3, 4, 5, 6, 7, 3, 4], k=3)
+        assert list(got) == [5, 6, 7]
+
+    def test_longest_match_wins(self):
+        # tail [2, 3, 4] matches at 1 (→ 9) but the 2-gram [3, 4] ALSO
+        # matches later at 5 (→ 8): longest-first must pick the 3-gram
+        p = NgramProposer(min_match=2, max_match=4)
+        got = p.propose([1, 2, 3, 4, 9, 3, 4, 8, 2, 3, 4], k=1)
+        assert list(got) == [9]
+
+    def test_most_recent_occurrence_on_ties(self):
+        # [1, 2] occurs at 0 (→ 7) and at 3 (→ 8): most recent wins
+        p = NgramProposer(min_match=2, max_match=2)
+        got = p.propose([1, 2, 7, 1, 2, 8, 9, 1, 2], k=1)
+        assert list(got) == [8]
+
+    def test_no_match_and_short_sequences(self):
+        p = NgramProposer(min_match=2, max_match=4)
+        assert p.propose([1, 2, 3, 4, 5], k=4).size == 0   # all distinct
+        assert p.propose([1], k=4).size == 0
+        assert p.propose([], k=4).size == 0
+        assert p.propose([1, 2, 1, 2], k=0).size == 0      # k = 0
+
+    def test_min_match_respected(self):
+        # only a 1-gram recurs; min_match=2 must not match it
+        p = NgramProposer(min_match=2, max_match=4)
+        assert p.propose([5, 1, 2, 3, 5], k=2).size == 0
+        assert list(NgramProposer(1, 4).propose([5, 1, 2, 3, 5], k=1)) == [1]
+
+    def test_periodic_extension_and_k_clamp(self):
+        # periodic text: overlapping matches extend the cycle
+        p = NgramProposer(min_match=2, max_match=4)
+        got = p.propose([7, 8, 9, 7, 8, 9, 7, 8, 9], k=8)
+        assert list(got)[:3] == [7, 8, 9]
+        assert got.size <= 8
+        assert list(p.propose([1, 2, 3, 9, 1, 2, 3], k=2)) == [9, 1]
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="min_match"):
+            NgramProposer(min_match=0)
+        with pytest.raises(ValueError, match="max_match"):
+            NgramProposer(min_match=3, max_match=2)
+
+
+# --------------------------------------------------------------------- #
+# allocator rollback: unregister_if_owner x refcount/COW invariants
+
+
+class TestUnregisterIfOwner:
+
+    def test_owner_unregisters_and_key_is_reusable(self):
+        a = BlockAllocator(6, 4, prefix_cache=True)
+        (b,) = a.allocate(1)
+        key = a.chain_key(ROOT_KEY, [1, 2, 3, 4])
+        assert a.register(b, key)
+        assert a.unregister_if_owner(b, key)
+        assert a.match_prefix([1, 2, 3, 4]) == ([], [])
+        assert a.ref_count(b) == 1          # refcount untouched
+        # the key is free again: another block can claim it
+        (b2,) = a.allocate(1)
+        assert a.register(b2, key)
+        assert a.match_prefix([1, 2, 3, 4])[0] == [b2]
+
+    def test_non_owner_is_a_noop(self):
+        # first-writer-wins preserved: a rollback of the block whose
+        # register() never took must not evict the first writer's mapping
+        a = BlockAllocator(6, 4, prefix_cache=True)
+        first, second = a.allocate(2)
+        key = a.chain_key(ROOT_KEY, [1, 2, 3, 4])
+        assert a.register(first, key)
+        assert not a.register(second, key)          # first-writer-wins
+        assert not a.unregister_if_owner(second, key)
+        assert a.match_prefix([1, 2, 3, 4])[0] == [first]
+
+    def test_wrong_key_is_a_noop(self):
+        a = BlockAllocator(6, 4, prefix_cache=True)
+        (b,) = a.allocate(1)
+        key = a.chain_key(ROOT_KEY, [1, 2, 3, 4])
+        a.register(b, key)
+        assert not a.unregister_if_owner(b, a.chain_key(ROOT_KEY, [9]))
+        assert a.match_prefix([1, 2, 3, 4])[0] == [b]
+
+    def test_cold_block_moves_to_free_list(self):
+        # a cold block losing its only address must rejoin the free list
+        # (nothing can resurrect it), not linger unreachable on the LRU
+        a = BlockAllocator(3, 4, prefix_cache=True)
+        got = a.allocate(2)
+        key = a.chain_key(ROOT_KEY, [1, 2, 3, 4])
+        a.register(got[0], key)
+        a.free(list(reversed(got)))
+        assert a.num_cold == 1
+        assert a.unregister_if_owner(got[0], key)
+        assert a.num_cold == 0
+        assert a.num_free == 2
+        assert sorted(a.allocate(2)) == sorted(got)   # both allocatable
+
+    def test_prefix_cache_off_is_a_noop(self):
+        a = BlockAllocator(4, 4)
+        (b,) = a.allocate(1)
+        assert not a.unregister_if_owner(b, b"anything")
+
+
+# --------------------------------------------------------------------- #
+# scheduler: verify actions, optimistic register + rollback
+
+
+class FakeProposer:
+    """Scripted proposer: pops the next canned candidate list per call
+    (empty once exhausted), recording every (sequence, k) it saw."""
+
+    def __init__(self, script=()):
+        self.script = [np.asarray(s, np.int32) for s in script]
+        self.calls = []
+
+    def propose(self, seq, k):
+        self.calls.append((np.asarray(seq, np.int32).copy(), k))
+        if not self.script:
+            return np.zeros((0,), np.int32)
+        return self.script.pop(0)[:k]
+
+
+def make_spec_sched(proposer, num_blocks=9, block_size=4, max_running=2,
+                    n_max=8, k=4, prefix_cache=True):
+    alloc = BlockAllocator(num_blocks, block_size,
+                           prefix_cache=prefix_cache)
+    return ContinuousBatchingScheduler(alloc, max_running, n_max,
+                                       prefix_caching=prefix_cache,
+                                       spec_k=k, spec_proposer=proposer)
+
+
+class TestSchedulerVerify:
+
+    def _admit_one(self, s, prompt=(1, 2, 3, 4), max_new=8, first_tok=5,
+                   eos=None):
+        r = s.add_request(list(prompt), max_new=max_new, eos=eos)
+        kind, req = s.next_action()
+        assert kind == "prefill" and req is r
+        s.record_prefill(r, first_tok)
+        return r
+
+    def test_verify_action_and_full_acceptance(self):
+        s = make_spec_sched(FakeProposer([[9, 8, 7]]))
+        r = self._admit_one(s)
+        kind, reqs = s.next_action()
+        assert kind == "verify" and reqs == [r]
+        assert r.spec_tokens == (9, 8, 7)
+        # engine accepted everything and sampled bonus token 6
+        s.record_verify(r, [9, 8, 7, 6])
+        assert r.generated == [5, 9, 8, 7, 6]
+        # invariant: pos = len(prefix) - 1 (newest token not yet cached)
+        assert r.pos == len(r.prefix()) - 1 == 8
+        assert s.stats["verify_steps"] == 1
+        assert s.stats["spec_accepted"] == 3
+        assert s.stats["spec_rollbacks"] == 0
+
+    def test_no_match_falls_back_to_plain_decode(self):
+        s = make_spec_sched(FakeProposer())     # never proposes
+        r = self._admit_one(s)
+        kind, reqs = s.next_action()
+        assert kind == "decode" and reqs == [r]
+        assert s.stats["verify_steps"] == 0 and s.stats["decode_steps"] == 1
+
+    def test_rollback_unregisters_boundary_crossing_block(self):
+        # bs=4, prompt [1..4] fills block 0 (registered at prefill); a
+        # 4-candidate window writes slots 4..8, optimistically filling and
+        # REGISTERING block 1 with candidates in its hash chain — full
+        # rejection must withdraw exactly that registration
+        s = make_spec_sched(FakeProposer([[9, 9, 9, 9]]))
+        a = s.allocator
+        r = self._admit_one(s)
+        kind, _ = s.next_action()
+        assert kind == "verify"
+        key0 = a.chain_key(ROOT_KEY, [1, 2, 3, 4])
+        bogus = [1, 2, 3, 4, 5, 9, 9, 9]            # prompt+tok+candidates
+        s.record_verify(r, [7])                     # first candidate rejected
+        assert s.stats["spec_rollbacks"] == 1
+        assert r.generated == [5, 7] and r.pos == 5
+        assert len(r.keys) == 1 and r.keys[0] == key0
+        # block 0's committed registration survives; the candidate-hash
+        # block is gone from the table
+        assert a.match_prefix([1, 2, 3, 4])[0] == [r.blocks[0]]
+        assert a.match_prefix(bogus)[0] == [r.blocks[0]]
+
+    def test_rollback_preserves_first_writer(self):
+        # another request already registered the very hash the rejected
+        # window would have claimed: its (committed) mapping must survive
+        s = make_spec_sched(FakeProposer([[9, 9, 9, 9]]))
+        a = s.allocator
+        r = self._admit_one(s)
+        key0 = a.chain_key(ROOT_KEY, [1, 2, 3, 4])
+        key1 = a.chain_key(key0, [5, 9, 9, 9])
+        (other,) = a.allocate(1)
+        assert a.register(other, key1)              # the first writer
+        kind, _ = s.next_action()
+        assert kind == "verify"
+        s.record_verify(r, [7])
+        assert a.match_prefix([1, 2, 3, 4, 5, 9, 9, 9])[0] == [r.blocks[0],
+                                                               other]
+        a.free([other])
+
+    def test_rollback_then_preempt_and_readmit(self):
+        # after a rejected boundary-crossing speculation, preemption frees
+        # the blocks and re-admission must hit ONLY committed content:
+        # the junk block was unregistered, so the probe stops at block 0
+        s = make_spec_sched(FakeProposer([[9, 9, 9, 9]]))
+        a = s.allocator
+        r = self._admit_one(s)
+        kind, _ = s.next_action()
+        s.record_verify(r, [7])                     # rollback (as above)
+        b0 = r.blocks[0]
+        s._preempt(r)
+        assert r.state == QUEUED and not r.blocks
+        hit, _ = a.match_prefix(r.prefix())         # [1,2,3,4,5,7]
+        assert hit == [b0]
+        kind, req = s.next_action()                 # re-admission
+        assert kind == "prefill_chunk" and req is r
+        assert r.pos == 4 and r.blocks[0] == b0     # cache hit, tail only
+
+    def test_window_growth_truncates_instead_of_preempting(self):
+        # pool: 2 allocatable blocks of 4. Prompt fills one, decode
+        # capacity takes the second; the 4-candidate window would need a
+        # third — the proposal must be TRUNCATED to the owned slots, not
+        # preempt anything
+        s = make_spec_sched(FakeProposer([[9, 8, 7, 6]]), num_blocks=3,
+                            max_running=1)
+        r = self._admit_one(s)
+        kind, reqs = s.next_action()
+        assert kind == "verify"
+        # slots pos=4..7 exist (2 blocks x 4): window clamps to 3 cands
+        assert r.spec_tokens == (9, 8, 7)
+        assert r.preemptions == 0 and r.state == "running"
+        s.record_verify(r, [9, 8, 7, 3])
+
+    def test_headroom_clamps_proposal_length(self):
+        # max_new=3, one token already generated: a verify step may emit at
+        # most 2 more tokens, so at most 1 candidate is proposed
+        s = make_spec_sched(FakeProposer([[9, 8, 7, 6]]))
+        r = self._admit_one(s, max_new=3)
+        kind, _ = s.next_action()
+        assert kind == "verify"
+        assert len(r.spec_tokens) == 1
+        s.record_verify(r, [9, 4])
+        assert r.state == FINISHED
+        assert list(np.asarray(r.output)) == [1, 2, 3, 4, 5, 9, 4]
+
+    def test_eos_inside_window_truncates_like_plain_decode(self):
+        # eos accepted mid-window: the request stops exactly there — later
+        # accepted candidates are rolled back, never emitted
+        s = make_spec_sched(FakeProposer([[9, 8, 7]]))
+        r = self._admit_one(s, eos=9)
+        kind, _ = s.next_action()
+        s.record_verify(r, [9, 8, 7, 6])            # engine accepted all
+        assert r.state == FINISHED
+        assert list(np.asarray(r.output)) == [1, 2, 3, 4, 5, 9]
+        assert s.stats["spec_rollbacks"] == 1       # tail beyond eos dropped
+
+    def test_preempt_clears_pending_candidates(self):
+        s = make_spec_sched(FakeProposer([[9, 8, 7]]))
+        r = self._admit_one(s)
+        kind, _ = s.next_action()
+        assert r.spec_tokens
+        s._preempt(r)
+        assert r.spec_tokens == ()
+
+    def test_emitted_vs_window_validation(self):
+        s = make_spec_sched(FakeProposer([[9, 8]]))
+        r = self._admit_one(s)
+        s.next_action()
+        with pytest.raises(ValueError, match="emitted"):
+            s.record_verify(r, [9, 8, 7, 6, 5])
+
+
+# --------------------------------------------------------------------- #
+# engine: THE acceptance pin — token identity + fewer fused steps
+
+
+def spec_engine(model, *, k=4, mode="ngram", **srv):
+    base = {"block_size": 8, "max_running": 2,
+            "speculative": {"mode": mode, "k": k}}
+    base.update(srv)
+    return deepspeed_tpu.init_inference(model, dtype="fp32", serving=base)
+
+
+@pytest.fixture(scope="class")
+def engine_pair():
+    """ONE spec-on and ONE spec-off engine over a shared model, reused by
+    every scenario below (each test re-points the serving knobs —
+    `generate_batch` re-reads them per call). Compiling the paged
+    programs once instead of per test keeps the class inside the tier-1
+    budget; identity is cache-state-independent (PR-5 pin), so the
+    persistent prefix cache carrying over between scenarios is fine."""
+    dist.set_mesh(None)
+    model = tiny_model()
+    on = spec_engine(model)
+    off = deepspeed_tpu.init_inference(
+        model, dtype="fp32", serving={"block_size": 8, "max_running": 2})
+    return on, off
+
+
+class TestSpecGenerateBatch:
+    """THE acceptance pin: ``generate_batch`` with speculation on is
+    token-identical to plain greedy paged decode (spec off, same serving
+    config) in every covered scenario. Paged-vs-static identity is pinned
+    by ``test_serving.py``, so identity vs the static path follows
+    transitively without recompiling the static decode loop per test."""
+
+    def _configure(self, engine_pair, **srv):
+        for eng in engine_pair:
+            s = eng._config.serving
+            s.max_num_blocks = srv.get("max_num_blocks", 0)
+            s.prefix_caching = srv.get("prefix_caching", "auto")
+            s.prefill_chunk_tokens = srv.get("prefill_chunk_tokens", 0)
+        engine_pair[0]._config.serving.speculative.mode = \
+            srv.get("mode", "ngram")
+        engine_pair[0]._config.serving.speculative.k = srv.get("k", 4)
+
+    def _check_identity(self, engine_pair, prompts, max_new, **srv):
+        self._configure(engine_pair, **srv)
+        on, off = engine_pair
+        outs = on.generate_batch(prompts, max_new_tokens=max_new)
+        assert len(outs) == len(prompts)
+        refs = off.generate_batch(prompts, max_new_tokens=max_new)
+        for o, r in zip(outs, refs):
+            np.testing.assert_array_equal(np.asarray(o), np.asarray(r))
+        return on._last_serve_stats, off._last_serve_stats
+
+    def test_repetitive_identity_and_fewer_fused_steps(self, engine_pair):
+        """THE pin: greedy token identity AND strictly fewer fused steps
+        than emitted tokens (accepted_tokens_per_step > 1) on a
+        repetitive workload — from scheduler accounting, CPU-runnable."""
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 64, size=n).astype(np.int32)
+                   for n in (5, 11, 3)]
+        st, off = self._check_identity(engine_pair, prompts, 24)
+        steps = st["decode_steps"] + st["verify_steps"]
+        assert st["verify_steps"] > 0 and st["spec_accepted"] > 0
+        assert steps < st["emitted_tokens"]
+        assert st["emitted_tokens"] / steps > 1.0
+        # same tokens, strictly fewer fused steps than spec-off serving
+        assert st["emitted_tokens"] == off["emitted_tokens"]
+        assert steps < off["decode_steps"]
+
+    def test_identity_with_midwindow_rejection_and_rollback(
+            self, engine_pair):
+        # a narrow token range makes spurious n-gram matches likely: some
+        # proposals MUST be rejected mid-window, exercising rollback
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, 8, size=16).astype(np.int32)
+                   for _ in range(3)]
+        st, _ = self._check_identity(engine_pair, prompts, 20)
+        assert st["spec_rollbacks"] > 0
+        assert st["spec_accepted"] < st["spec_proposed"]
+
+    def test_identity_under_eviction_pressure(self):
+        # 5 blocks of 8 for two ~20+ token streams: speculation must not
+        # change WHAT preemption/recompute reproduce, only the step
+        # count. FRESH engines: the preemption-parity pin needs both
+        # sides to start from identical (empty) cache state
+        model = tiny_model()
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, 8, size=n).astype(np.int32)
+                   for n in (5, 11)]
+        on = spec_engine(model, max_num_blocks=5)
+        outs = on.generate_batch(prompts, max_new_tokens=12)
+        off = deepspeed_tpu.init_inference(
+            model, dtype="fp32", serving={"block_size": 8,
+                                          "max_running": 2,
+                                          "max_num_blocks": 5})
+        refs = off.generate_batch(prompts, max_new_tokens=12)
+        for o, r in zip(outs, refs):
+            np.testing.assert_array_equal(np.asarray(o), np.asarray(r))
+        st = on._last_serve_stats
+        assert st["preemptions"] > 0          # the scenario really evicts
+        # eviction parity: window growth never preempts and rollback
+        # returns surplus blocks, so the eviction schedule is exactly the
+        # one spec-off serving produces
+        assert st["preemptions"] == off._last_serve_stats["preemptions"]
+
+    def test_identity_on_the_paged_kernel_path(self):
+        # attention_backend="flash" forces the Pallas paged-decode kernel
+        # (interpret mode on CPU): verify must dispatch to the SAME kernel
+        # per window position — einsum-vs-kernel argmax near-ties would
+        # silently break identity on TPU otherwise
+        model = tiny_model(vocab_size=32, n_layer=1, n_head=1, d_model=64,
+                           d_ff=64, max_seq=256,
+                           attention_backend="flash")
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 32, size=n).astype(np.int32)
+                   for n in (4, 6)]
+        on = spec_engine(model, k=2, block_size=128)
+        outs = on.generate_batch(prompts, max_new_tokens=8)
+        assert on._last_serve_stats["verify_steps"] > 0
+        off = deepspeed_tpu.init_inference(
+            model, dtype="fp32",
+            serving={"block_size": 128, "max_running": 2})
+        refs = off.generate_batch(prompts, max_new_tokens=8)
+        for o, r in zip(outs, refs):
+            np.testing.assert_array_equal(np.asarray(o), np.asarray(r))
+
+    def test_identity_prefix_cache_off(self, engine_pair):
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(0, 8, size=n).astype(np.int32)
+                   for n in (5, 11)]
+        st, _ = self._check_identity(engine_pair, prompts, 12,
+                                     prefix_caching="off")
+        assert st["verify_steps"] > 0
+
+    def test_no_match_prompts_fall_back_per_request(self, engine_pair):
+        # distinct-token prompts: the first decode turns have no repeating
+        # tail n-gram, so they run as plain decode steps; identity holds
+        prompts = [np.arange(1, 11, dtype=np.int32),
+                   np.arange(20, 27, dtype=np.int32)]
+        st, _ = self._check_identity(engine_pair, prompts, 6)
+        assert st["decode_steps"] >= 1
+
+    def test_identity_with_chunked_prefill_interleave(self, engine_pair):
+        # verify steps take the decode side of the deterministic
+        # prefill/decode turn toggle: a long prompt trickling in chunks
+        # interleaves with speculative steps of the running request
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, 8, size=4).astype(np.int32),
+                   rng.integers(0, 8, size=30).astype(np.int32)]
+        st, _ = self._check_identity(engine_pair, prompts, 14,
+                                     prefill_chunk_tokens=8)
+        assert st["verify_steps"] > 0
+
+    def test_spec_off_by_default_and_auto_reserved(self, engine_pair):
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 64, size=5).astype(np.int32)]
+        self._configure(engine_pair, mode="auto")
+        on, off = engine_pair
+        off.generate_batch(prompts, max_new_tokens=6)
+        assert off._last_serve_stats["verify_steps"] == 0   # default off
+        on.generate_batch(prompts, max_new_tokens=6)        # auto = off
+        assert on._last_serve_stats["verify_steps"] == 0
+
+    @pytest.mark.slow
+    def test_sampled_mode_disables_speculation(self, engine_pair):
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 64, size=5).astype(np.int32)]
+        self._configure(engine_pair)
+        engine = engine_pair[0]
+        outs = engine.generate_batch(prompts, max_new_tokens=6,
+                                     temperature=0.8, top_k=10, seed=3)
+        assert outs[0].shape == (11,)
+        assert engine._last_serve_stats["verify_steps"] == 0
+
+    def test_config_validation(self, engine_pair):
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 64, size=5).astype(np.int32)]
+        engine = engine_pair[0]
+        self._configure(engine_pair, mode="bogus")
+        with pytest.raises(ValueError, match="off.ngram.auto"):
+            engine.generate_batch(prompts, max_new_tokens=2)
+        self._configure(engine_pair, k=0)
+        with pytest.raises(ValueError, match="speculative.k"):
+            engine.generate_batch(prompts, max_new_tokens=2)
+        self._configure(engine_pair)                        # restore
+
+
+# --------------------------------------------------------------------- #
+# flight recorder / serving trace / telemetry surface
+
+
+class TestSpecObservability:
+
+    def _serve(self, tmp_path, prompts, max_new=20):
+        from deepspeed_tpu.monitor.events import get_flight_recorder
+        get_flight_recorder().clear()
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32", telemetry={"events": True},
+            serving={"block_size": 8, "max_running": 2,
+                     "speculative": {"mode": "ngram", "k": 4}})
+        engine.generate_batch(prompts, max_new_tokens=max_new)
+        return engine
+
+    def test_spec_events_and_trace_validate(self, tmp_path):
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, 8, size=16).astype(np.int32)
+                   for _ in range(2)]
+        engine = self._serve(tmp_path, prompts)
+        st = engine._last_serve_stats
+        assert st["verify_steps"] > 0 and st["spec_rollbacks"] > 0
+        events = engine._events.snapshot()
+        kinds = [e.kind for e in events]
+        assert kinds.count("req.spec_verify") >= st["verify_steps"]
+        assert "req.spec_propose" in kinds
+        assert kinds.count("req.spec_rollback") == st["spec_rollbacks"]
+        # every spec event is dur-bracketed where the catalogue says so;
+        # propose instants exist only for ACTUAL matches (zero-found
+        # probes would flood the bounded ring), and the verify slices'
+        # accepted= sums to exactly the committed-candidate counter
+        for e in events:
+            if e.kind in ("req.spec_propose", "req.spec_verify"):
+                assert e.rid is not None and e.dur_ns is not None \
+                    and e.dur_ns >= 0
+            if e.kind == "req.spec_propose":
+                assert e.data["found"] >= 1
+        assert sum(e.data["accepted"] for e in events
+                   if e.kind == "req.spec_verify") == st["spec_accepted"]
+        # the JSONL schema accepts the new kinds...
+        p = str(tmp_path / "events.jsonl")
+        engine._events.write_jsonl(p)
+        assert validate_trace.validate_path(p, kind="events") == []
+        # ...and the chrome-trace render keeps its one-span-per-track
+        # shape with the spec slices as request-track children
+        trace = str(tmp_path / "serve.json")
+        engine.export_serving_trace(trace)
+        assert validate_trace.validate_path(trace, kind="chrome") == []
+        import json
+        doc = json.load(open(trace))
+        names = {e.get("name") for e in doc["traceEvents"]}
+        assert {"spec_propose", "spec_verify", "spec_rollback"} <= names
+
+    def test_spec_telemetry_counters_and_health_pane(self, tmp_path):
+        from deepspeed_tpu.monitor.health import (health_summary,
+                                                  render_summary_table)
+        from deepspeed_tpu.monitor.metrics import get_registry
+        get_registry().reset()
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 64, size=n).astype(np.int32)
+                   for n in (5, 11)]
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32", telemetry=True,
+            serving={"block_size": 8, "max_running": 2,
+                     "speculative": {"mode": "ngram", "k": 4}})
+        engine.generate_batch(prompts, max_new_tokens=20)
+        st1 = dict(engine._last_serve_stats)
+        # a SECOND serve: counters are cumulative across serve calls and
+        # the acceptance-rate gauge must track the cumulative ratio, not
+        # the latest scheduler's per-serve stats
+        engine.generate_batch(prompts, max_new_tokens=20)
+        snap = engine.telemetry_snapshot()
+        c, g = snap["counters"], snap["gauges"]
+        st = engine._last_serve_stats
+        assert c["serving/spec_proposed_tokens"] \
+            == st1["spec_proposed"] + st["spec_proposed"]
+        assert c["serving/spec_accepted_tokens"] \
+            == st1["spec_accepted"] + st["spec_accepted"]
+        assert c["serving/spec_rollbacks"] \
+            == st1["spec_rollbacks"] + st["spec_rollbacks"]
+        assert c["serving/spec_verify_steps"] \
+            == st1["verify_steps"] + st["verify_steps"]
+        rate = g["serving/spec_acceptance_rate"]
+        assert rate == pytest.approx(c["serving/spec_accepted_tokens"]
+                                     / c["serving/spec_proposed_tokens"])
+        summary = health_summary(snap)
+        srv = summary["serving"]
+        assert srv["spec_proposed_tokens"] \
+            == c["serving/spec_proposed_tokens"]
+        assert srv["spec_acceptance_rate"] == pytest.approx(rate)
+        table = render_summary_table(summary)
+        acc = int(c["serving/spec_accepted_tokens"])
+        assert "spec " in table and f"{acc}/" in table
+
+    def test_pane_silent_when_spec_off(self):
+        from deepspeed_tpu.monitor.health import (health_summary,
+                                                  render_summary_table)
+        from deepspeed_tpu.monitor.metrics import get_registry
+        get_registry().reset()
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 64, size=5).astype(np.int32)]
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32", telemetry=True,
+            serving={"block_size": 8, "max_running": 2})
+        engine.generate_batch(prompts, max_new_tokens=4)
+        table = render_summary_table(health_summary(
+            engine.telemetry_snapshot()))
+        assert "spec " not in table
+
+
+# --------------------------------------------------------------------- #
+# compile-budget contract: serving_speculative
+
+
+class TestServingSpeculativeContract:
+
+    @pytest.fixture(autouse=True)
+    def clean_state(self):
+        from deepspeed_tpu.monitor.metrics import get_registry
+        from deepspeed_tpu.monitor.trace import get_compile_watchdog
+        dist.set_mesh(None)
+        get_registry().reset()
+        get_registry().set_enabled(True)
+        get_compile_watchdog().reset()
+        yield
+        dist.set_mesh(None)
+        get_registry().reset()
+        get_registry().set_enabled(True)
+        get_compile_watchdog().reset()
+
+    def test_serving_speculative_contract(self):
+        """Pins the fused verify step at ONE compile for a whole
+        speculative generate_batch (fixed window bucket over max_running
+        rows), with the fallback decode/prefill entries inside their
+        existing budgets — verified through the CompileWatchdog like the
+        serving_steady pin."""
+        from dslint.contracts import check_compile_budgets
+
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32", telemetry=True,
+            serving={"block_size": 8, "max_running": 2,
+                     "speculative": {"mode": "ngram", "k": 4}})
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 64, size=n).astype(np.int32)
+                   for n in (5, 11, 3)]
+        engine.generate_batch(prompts, max_new_tokens=16)
+        st = engine._last_serve_stats
+        assert st["verify_steps"] > 1, "scenario never speculated"
+        by_fn = engine.telemetry_snapshot()["compile"]["by_fn"]
+        assert by_fn.get("inference.paged_verify") == 1, (
+            "fused verify step recompiled during serving")
+        violations = check_compile_budgets(by_fn, "serving_speculative",
+                                           strict=True)
+        assert violations == [], "\n".join(violations)

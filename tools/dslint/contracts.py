@@ -37,6 +37,9 @@ class CompileBudget:
 #:                     default serving config (prompt lengths within one
 #:                     128-token prefill bucket)
 #:   serving_chunked — generate_batch with chunked prefill + prefix cache
+#:   serving_speculative — generate_batch with serving.speculative
+#:                     {mode: ngram} at one fixed k (repetitive prompts,
+#:                     verify + fallback decode steps interleaved)
 BUDGETS: List[CompileBudget] = [
     CompileBudget(
         "engine.train_batch[gas=1]", "steady_train", 1,
@@ -76,6 +79,27 @@ BUDGETS: List[CompileBudget] = [
         "the acceptance scenario touches at most four"),
     CompileBudget(
         "inference.paged_cow", "serving_chunked", 1,
+        "copy-on-write block copy: fixed block geometry"),
+    CompileBudget(
+        "inference.paged_verify", "serving_speculative", 1,
+        "THE fused verify step: fixed max_running rows x a window "
+        "bucketed to the next power of two of k+1, per-request position "
+        "WINDOWS are traced vectors — one program per k bucket (<= log2 "
+        "programs over any k sweep), and the scenario holds k fixed"),
+    CompileBudget(
+        "inference.paged_decode", "serving_speculative", 1,
+        "no-match fallback steps ride the SAME fused decode program "
+        "speculation-off serving uses"),
+    CompileBudget(
+        "inference.paged_prefill", "serving_speculative", 2,
+        "admission prefill is untouched by speculation: one compile per "
+        "128-token prompt bucket, the scenario stays within two"),
+    CompileBudget(
+        "inference.paged_prefill_chunk", "serving_speculative", 4,
+        "cache-hit tails/chunked prefill interleave unchanged: one "
+        "program per (chunk bucket, table-width power-of-two) pair"),
+    CompileBudget(
+        "inference.paged_cow", "serving_speculative", 1,
         "copy-on-write block copy: fixed block geometry"),
 ]
 
