@@ -60,6 +60,12 @@ Env knobs (defaults are the chip-measured fast path):
                            prompts prefilling whole vs chunked
                            (vs_baseline = whole/chunked p99 ratio);
                            BENCH_SERVE_LONG_LEN=896 BENCH_SERVE_CHUNK=256
+  BENCH_SERVE_TP=1         multi-chip tensor-parallel serving probe: paged
+                           decode tokens/s at serving.tp=1 vs tp=N on the
+                           same prompt set (vs_baseline = scaling
+                           efficiency, (tpN/tp1)/N); skip record on a
+                           single-device backend; BENCH_SERVE_TP_N=auto
+                           BENCH_SERVE_TP_REQS=8 BENCH_SERVE_TP_NEW=64
   BENCH_SKIP_PROBE=0       skip the subprocess backend probe
   BENCH_PROBE_RETRIES=1    probe retries before giving up on the backend
   BENCH_ALLOW_CPU=0        on probe failure, run a tiny CPU smoke metric
@@ -404,6 +410,7 @@ BENCH_METRICS = [
     ("BENCH_SERVE_PREFIX", "1", "gpt2_serving_prefix_cache_ttft_ms"),
     ("BENCH_SERVE_CHUNKED", "1", "gpt2_serving_chunked_prefill_tpot_p99_ms"),
     ("BENCH_SERVE_SPEC", "1", "gpt2_serving_spec_decode_tpot_ms"),
+    ("BENCH_SERVE_TP", "1", "gpt2_serving_tp_tokens_per_sec"),
     ("BENCH_CKPT", "1", "gpt2_ckpt_async_stall_ms_per_step"),
 ]
 
@@ -689,6 +696,106 @@ def run_spec_decode_bench():
             tel["spec_stats"] = st
             rec["telemetry"] = tel
             print(json.dumps(rec), flush=True)
+        # free this mode's engine (params + pools + executables) BEFORE
+        # building the next one: both resident at once doubles peak HBM
+        # and perturbs the very TPOT number the probe measures
+        del engine
+
+
+def run_serving_tp_bench():
+    """Tensor-parallel serving scaling probe: the same mixed prompt set
+    through the paged engine at serving.tp=1 and serving.tp=N on one
+    slice. Value = paged decode throughput (generated tokens/s) at tp=N;
+    vs_baseline = SCALING EFFICIENCY, (tpN tokens/s ÷ tp1 tokens/s) ÷ N —
+    1.0 means decode scales linearly with the slice, and anything near it
+    means one model's max size scales with the slice too (params and KV
+    pools are really sharded: per-chip bytes drop to 1/N). Emits a skip
+    record on a single-device backend (nothing to shard over)."""
+    import time as _t
+
+    import numpy as np
+
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+
+    import jax
+    n_dev = jax.device_count()
+    if n_dev < 2:
+        print(json.dumps({
+            "metric": _metric_name("BENCH_SERVE_TP"),
+            "value": 0.0,
+            "unit": "tokens/s (skipped: single-device backend, nothing to "
+                    "shard over)",
+            "vs_baseline": 0.0,
+            "skipped": True,
+            "skip_stage": "single_device",
+            "skip_error": f"jax.device_count()={n_dev}",
+        }), flush=True)
+        return
+
+    from deepspeed_tpu.models import gpt2
+    model = gpt2("125m", remat=False,
+                 attention_backend=os.environ.get("BENCH_ATTN", "auto"))
+    heads = model.config.kv_heads
+    tp_env = os.environ.get("BENCH_SERVE_TP_N", "auto")
+    if tp_env == "auto":
+        # largest tp <= min(devices, 4) that divides BOTH the device count
+        # and the KV heads (gpt2-125m: 12 heads -> 2, 3, 4 all legal);
+        # no legal degree (e.g. 5 devices) -> skip record, not a crash
+        TP = max((t for t in range(2, min(n_dev, 4) + 1)
+                  if n_dev % t == 0 and heads % t == 0), default=0)
+    else:
+        TP = int(tp_env)
+    if TP < 2:
+        print(json.dumps({
+            "metric": _metric_name("BENCH_SERVE_TP"),
+            "value": 0.0,
+            "unit": "tokens/s (skipped: no tp in 2..4 divides both "
+                    f"device count {n_dev} and kv heads {heads})",
+            "vs_baseline": 0.0,
+            "skipped": True,
+            "skip_stage": "no_divisible_tp",
+            "skip_error": f"devices={n_dev}, kv_heads={heads}",
+        }), flush=True)
+        return
+    NREQ = int(os.environ.get("BENCH_SERVE_TP_REQS", 8))
+    MAX_NEW = int(os.environ.get("BENCH_SERVE_TP_NEW", 64))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 50257, size=int(n)).astype(np.int32)
+               for n in rng.integers(64, 192, size=NREQ)]
+
+    results = {}
+    for tp in (1, TP):
+        dist.set_mesh(None)
+        _reset_telemetry()
+        engine = deepspeed_tpu.init_inference(
+            model, dtype="bf16", telemetry=True,
+            serving={"block_size": 128, "max_running": 8,
+                     # cold decode both times: the cache win is
+                     # BENCH_SERVE_PREFIX's story, this one is scaling
+                     "prefix_caching": "off", "tp": tp})
+        engine.generate_batch(prompts, max_new_tokens=MAX_NEW)   # warm
+        t0 = _t.perf_counter()
+        outs = engine.generate_batch(prompts, max_new_tokens=MAX_NEW)
+        dt = _t.perf_counter() - t0
+        gen = sum(int(o.shape[0]) - len(p) for o, p in zip(outs, prompts))
+        results[tp] = gen / dt
+        if tp == TP:
+            rec = {
+                "metric": _metric_name("BENCH_SERVE_TP"),
+                "value": round(results[TP], 1),
+                "unit": f"generated tokens/s (bf16 paged decode, tp={TP} "
+                        f"over {n_dev} devices, {NREQ} reqs x {MAX_NEW} "
+                        f"new; tp=1 = {results[1]:.1f} tok/s)",
+                # scaling efficiency: 1.0 = linear decode scaling
+                "vs_baseline": (round(results[TP] / results[1] / TP, 3)
+                                if results[1] else 0.0),
+            }
+            tel = _telemetry_blob(engine)
+            if tel:
+                rec["telemetry"] = tel
+            print(json.dumps(rec), flush=True)
+        del engine
 
 
 def run_checkpoint_bench():
@@ -899,7 +1006,7 @@ def main():
     if any(_metric_enabled(g) for g in
            ("BENCH_DECODE_DENSE", "BENCH_DECODE_PAGED",
             "BENCH_SERVE_PREFIX", "BENCH_SERVE_CHUNKED",
-            "BENCH_SERVE_SPEC")):
+            "BENCH_SERVE_SPEC", "BENCH_SERVE_TP")):
         # free the last training engine's device state before serving
         if engine is not None:
             del engine, model, batch
@@ -917,6 +1024,9 @@ def main():
             gc.collect()
         if _metric_enabled("BENCH_SERVE_SPEC"):
             run_spec_decode_bench()
+            gc.collect()
+        if _metric_enabled("BENCH_SERVE_TP"):
+            run_serving_tp_bench()
 
 
 if __name__ == "__main__":

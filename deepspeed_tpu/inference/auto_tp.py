@@ -8,10 +8,17 @@ PartitionSpecs; XLA places the collectives.
 
 Heuristics (Megatron layout):
 - names containing q/k/v/query/key/value/up/gate/fc1/w_up/wi → column shard
-  (last dim over ``tp``)
+  (last dim over ``tp``); their 1-D biases shard the same way
 - names containing o_proj/out/down/fc2/w_down/wo/dense_4h → row shard
-  (first non-batch dim over ``tp``) — XLA inserts the psum after it
-- embeddings → vocab shard; norms/biases of row-sharded layers → replicate
+  (first non-batch dim over ``tp``) — XLA inserts the psum after it; their
+  biases replicate (added once, after the reduce)
+- embeddings → vocab shard; norms and other 1-D leaves → replicate
+
+Every pattern rule is guarded by a divisibility check when the tensor-
+parallel degree is known: a dim that ``tp`` does not divide replicates
+(with a rate-limited warning naming the param) instead of crashing — the
+engine serves correctly either way, just without the memory split on that
+tensor.
 """
 
 from __future__ import annotations
@@ -21,6 +28,8 @@ from typing import Any, Optional
 import jax
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.utils.logging import warn_once
+
 COLUMN_PAT = ("wq", "wk", "wv", "q_proj", "k_proj", "v_proj", "query", "key", "value", "w_up", "up_proj", "w_gate",
               "gate_proj", "fc1", "wi", "c_fc", "dense_h_to_4h")
 ROW_PAT = ("wo", "o_proj", "out_proj", "w_down", "down_proj", "fc2", "wo_proj", "c_proj", "dense_4h_to_h",
@@ -28,30 +37,100 @@ ROW_PAT = ("wo", "o_proj", "out_proj", "w_down", "down_proj", "fc2", "wo_proj", 
 EMBED_PAT = ("embed", "wte", "word_embeddings", "tok_embeddings")
 
 
-def _spec_for(path: str, shape) -> P:
+def _guard(path: str, shape, dim: int, tp: Optional[int]) -> bool:
+    """Whether sharding ``shape[dim]`` over ``tp`` ways is legal. ``tp``
+    None/0 = unknown degree (spec emission only): always allowed — the
+    downstream placement (``sanitize_tp_spec``) re-checks against the
+    actual mesh. A known, non-dividing degree warns once per param."""
+    if not tp or tp <= 1:
+        return True
+    if shape[dim] % tp == 0:
+        return True
+    warn_once(f"auto_tp: {path} dim {dim} (size {shape[dim]}) is not "
+              f"divisible by tp={tp}; replicating this tensor (it gets no "
+              "memory split or compute speedup from the tp axis)")
+    return False
+
+
+def _spec_for(path: str, shape, tp: Optional[int] = None) -> P:
     ndim = len(shape)
     lower = path.lower()
-    if ndim < 2:
+    if ndim == 0:
+        return P()
+    if ndim >= 2 and any(p in lower for p in EMBED_PAT):
+        if _guard(path, shape, 0, tp):
+            return P(*(["tp"] + [None] * (ndim - 1)))
         return P(*([None] * ndim))
-    if any(p in lower for p in EMBED_PAT):
-        return P(*(["tp"] + [None] * (ndim - 1)))
-    if any(p in lower for p in COLUMN_PAT):
-        spec = [None] * ndim
-        spec[-1] = "tp"
-        return P(*spec)
+    # row patterns first: several row names contain column substrings
+    # ("out_proj" contains neither, but e.g. "wo" is a prefix of nothing
+    # column-side; checking row first keeps "attention.dense" row-sharded
+    # even though "dense" alone matches nothing) — and row BIASES replicate
+    # (the bias is added once, after the tp all-reduce)
     if any(p in lower for p in ROW_PAT):
-        spec = [None] * ndim
-        spec[-2] = "tp"
-        return P(*spec)
+        if ndim < 2:
+            return P(*([None] * ndim))
+        if _guard(path, shape, ndim - 2, tp):
+            spec = [None] * ndim
+            spec[-2] = "tp"
+            return P(*spec)
+        return P(*([None] * ndim))
+    if any(p in lower for p in COLUMN_PAT):
+        # column shard the output dim — for 1-D biases that IS the last
+        # (only) dim, so a column layer's bias shards with its weight
+        if _guard(path, shape, ndim - 1, tp):
+            spec = [None] * ndim
+            spec[-1] = "tp"
+            return P(*spec)
+        return P(*([None] * ndim))
     return P(*([None] * ndim))
 
 
-def auto_tp_specs(params) -> Any:
-    """PartitionSpec pytree congruent with ``params`` chosen by name."""
+def _leaf_path(keypath) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in keypath)
+
+
+def auto_tp_specs(params, tp: Optional[int] = None) -> Any:
+    """PartitionSpec pytree congruent with ``params`` chosen by name.
+
+    ``tp`` (the tensor-parallel degree, when known) arms the divisibility
+    guards: any pattern rule whose target dim ``tp`` does not divide emits
+    a replicated spec with a rate-limited warning instead of a spec the
+    mesh placement would have to silently drop (or worse, crash on)."""
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     treedef = jax.tree.structure(params)
     specs = []
     for keypath, leaf in flat:
-        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in keypath)
-        specs.append(_spec_for(path, getattr(leaf, "shape", ())))
+        specs.append(_spec_for(_leaf_path(keypath),
+                               getattr(leaf, "shape", ()), tp))
     return jax.tree.unflatten(treedef, specs)
+
+
+def validate_tp_specs(params, specs, mesh) -> Any:
+    """Sanitize a TP spec tree (model-provided ``tp_specs`` or
+    :func:`auto_tp_specs`) against the actual mesh before param placement:
+    axis entries absent from the mesh, or whose axis size does not divide
+    the dim, fall back to replication on that dim — with a rate-limited
+    warning naming the param, so a silent no-split is at least a loud
+    no-split. The single divisibility gate the inference engine routes
+    EVERY param layout through."""
+    from deepspeed_tpu.runtime.zero.partition import sanitize_tp_spec
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    spec_leaves = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    if len(spec_leaves) != len(flat):
+        # non-congruent trees are the placement layer's problem (ZeRO rules
+        # match specs by tree path); validate only the congruent case
+        return specs
+    out = []
+    for (keypath, leaf), spec in zip(flat, spec_leaves):
+        shape = getattr(leaf, "shape", ())
+        clean = sanitize_tp_spec(mesh, shape, spec)
+        if clean is not None and tuple(clean) != tuple(spec):
+            warn_once(
+                f"tp specs: {_leaf_path(keypath)} spec {tuple(spec)} does "
+                f"not fit shape {tuple(shape)} on mesh "
+                f"{dict(mesh.shape)}; replicating the non-dividing dims")
+        out.append(clean if clean is not None else spec)
+    return jax.tree.unflatten(jax.tree.structure(
+        specs, is_leaf=lambda x: isinstance(x, P)), out)

@@ -92,6 +92,15 @@ class BlockAllocator:
         return self.num_blocks - 1 - self._num_used
 
     @property
+    def num_free_list(self) -> int:
+        """Blocks on the plain free list ONLY — allocating this many never
+        reclaims a cold cached block (no prefix-cache registration is
+        destroyed). Opportunistic consumers (the speculative verify window)
+        bound themselves here so best-effort capacity never cannibalizes
+        the cache that mandatory allocation would have hit."""
+        return len(self._free)
+
+    @property
     def num_used(self) -> int:
         """Blocks referenced by at least one live request."""
         return self._num_used

@@ -157,6 +157,16 @@ class ServingConfig(ConfigModel):
 
     ``speculative`` configures n-gram self-speculation (verified
     multi-token decode steps) — see :class:`SpeculativeConfig`.
+
+    ``tp`` > 0 shards the serving engine over a ``tp`` mesh axis (tensor
+    parallelism): model params lay out column/row-sharded (the model's
+    ``tp_specs`` or the ``auto_tp`` heuristics) and the KV block pools
+    split on the KV-head dim, so one model spans ``tp`` chips and pool
+    bytes per chip drop to 1/tp. Block tables, the allocator and the
+    scheduler stay replicated — per-shard block indices are identical.
+    0 (the default) follows ``tensor_parallel.tp_size``; setting both to
+    different values is a loud error. KV heads that don't divide ``tp``
+    replicate the pools (rate-limited warning, never a crash).
     """
     block_size: int = 128          # tokens per KV block (128 = kernel path;
     # smaller blocks pack tighter but decode through the gather fallback)
@@ -164,6 +174,8 @@ class ServingConfig(ConfigModel):
     # max_running requests can reach the model's max_seq (no eviction)
     max_running: int = 8           # fused-decode width / running request cap
     paged: str = "auto"            # auto | on | off
+    tp: int = 0                    # serving tensor-parallel degree; 0 =
+    # follow tensor_parallel.tp_size
     prefix_caching: str = "auto"   # auto | on | off (auto = on when paged)
     prefill_chunk_tokens: int = 0  # 0 = whole-prompt; else chunk size
     speculative: SpeculativeConfig = Field(

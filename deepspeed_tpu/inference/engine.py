@@ -49,6 +49,19 @@ class InferenceEngine:
                       "bf16": jnp.bfloat16, "int8": jnp.bfloat16}[dt]
 
         tp_size = self._config.tensor_parallel.tp_size
+        # serving.tp: the paged serving engine's tensor-parallel degree —
+        # one knob that implies the whole sharded-serving layout (params
+        # via tp_specs/auto_tp, KV pools head-sharded, shard_map'd paged
+        # kernel). 0 follows tensor_parallel.tp_size; both set and
+        # disagreeing is a config contradiction, not a tie to break
+        srv_tp = int(getattr(self._config.serving, "tp", 0) or 0)
+        if srv_tp > 0:
+            if tp_size > 1 and srv_tp != tp_size:
+                raise ValueError(
+                    f"serving.tp={srv_tp} conflicts with "
+                    f"tensor_parallel.tp_size={tp_size}; set one (serving.tp"
+                    " alone is enough for the serving engine)")
+            tp_size = srv_tp
         # MoE serving (reference inference/engine.py:209-216 _create_ep_parallel_group):
         # the ep axis shards the expert dimension at serve time; gating and
         # attention replicate over it
@@ -66,15 +79,35 @@ class InferenceEngine:
                 f"MoE inference type {moe_type!r} is not implemented; "
                 "'standard' and 'residual' (PR-MoE) are supported")
         self._moe_type = moe_type
+        axes = {}
+        if self._ep_size > 1:
+            axes["ep"] = self._ep_size
+        if tp_size > 1:
+            axes["tp"] = tp_size
+        axes["dp"] = -1
         if not dist.has_mesh():
-            axes = {}
-            if self._ep_size > 1:
-                axes["ep"] = self._ep_size
-            if tp_size > 1:
-                axes["tp"] = tp_size
-            axes["dp"] = -1
             dist.init_mesh(axes)
-        self.mesh = dist.get_mesh()
+            self.mesh = dist.get_mesh()
+        else:
+            mesh = dist.get_mesh()
+            need = {a: s for a, s in axes.items() if a != "dp"}
+            if all(mesh.shape.get(a, 1) == s for a, s in need.items()):
+                self.mesh = mesh
+            else:
+                # the live mesh (a training run's, or another engine's)
+                # does not carry this engine's tp/ep axes: silently
+                # adopting it would serve UNSHARDED despite the explicit
+                # config (every spec would sanitize to replicated). Build
+                # a private mesh instead — the global one is left alone
+                # (a training engine may own it) and ``_mesh_scope`` pins
+                # ours around every forward/serve trace.
+                from deepspeed_tpu.comm.mesh import build_mesh
+                self.mesh = build_mesh(axes)
+                log_dist(
+                    f"InferenceEngine: existing mesh "
+                    f"{dict(mesh.shape)} lacks the configured axes "
+                    f"{need}; serving on a private mesh "
+                    f"{dict(self.mesh.shape)}", ranks=[0])
 
         # checkpoint loading (reference inference/engine.py:354-419
         # _load_checkpoint): an HF checkpoint dir/file (or a model given as a
@@ -147,7 +180,13 @@ class InferenceEngine:
             tp_specs = model.tp_specs() if callable(model.tp_specs) else model.tp_specs
         elif tp_size > 1:
             from deepspeed_tpu.inference.auto_tp import auto_tp_specs
-            tp_specs = auto_tp_specs(params)
+            tp_specs = auto_tp_specs(params, tp=tp_size)
+        if tp_specs is not None and tp_size > 1:
+            # one divisibility gate for EVERY param layout (model-provided
+            # and auto): a dim tp does not divide replicates with a warning
+            # instead of relying on each placement path's silent drop
+            from deepspeed_tpu.inference.auto_tp import validate_tp_specs
+            tp_specs = validate_tp_specs(params, tp_specs, self.mesh)
 
         if self._weight_quant:
             from deepspeed_tpu.ops.quant import quantize_params, tree_nbytes
@@ -424,6 +463,10 @@ class InferenceEngine:
         return self._forward_impl(input_ids, attention_mask)
 
     def _forward_impl(self, input_ids, attention_mask=None):
+        with self._mesh_scope():
+            return self._forward_on_mesh(input_ids, attention_mask)
+
+    def _forward_on_mesh(self, input_ids, attention_mask=None):
         input_ids = jnp.asarray(input_ids, jnp.int32)
         if self._stream_weights:
             if input_ids.ndim == 1:
@@ -655,6 +698,12 @@ class InferenceEngine:
         when kernel injection is enabled. ``max_out_tokens`` semantics follow
         the reference (inference/engine.py:523 token-length check).
         """
+        with self._mesh_scope():
+            return self._generate(input_ids, max_new_tokens, temperature,
+                                  top_k, seed, eos_token_id)
+
+    def _generate(self, input_ids, max_new_tokens, temperature, top_k, seed,
+                  eos_token_id):
         input_ids = jnp.asarray(input_ids, jnp.int32)
         if input_ids.ndim == 1:
             input_ids = input_ids[None, :]
@@ -703,6 +752,46 @@ class InferenceEngine:
     # recompilation (reference workspace/KV design: inference_context.h:49,
     # softmax_context pt_binding.cpp:1668-1793)
 
+    def _mesh_scope(self):
+        """Pin the framework-global mesh to THIS engine's mesh for the
+        duration of a serve. The transformer-level kernel dispatch
+        (``_flash_mesh`` / ``_bare_pallas_legal``) reads the GLOBAL mesh at
+        trace time, so two engines with different tp degrees serving from
+        one process must not trace against each other's mesh."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            prev = dist.get_mesh() if dist.has_mesh() else None
+            dist.set_mesh(self.mesh)
+            try:
+                yield
+            finally:
+                dist.set_mesh(prev)
+        return scope()
+
+    def _kv_head_sharding(self):
+        """NamedSharding for the KV workspaces — the dense cache
+        [L, B, S, KV, Hd] and the paged pools [L, blocks, bs, KV, Hd] share
+        the rank-5 KV-heads-at-axis-3 layout: head-sharded over ``tp``
+        when the model's KV heads divide the axis (per-chip KV bytes drop
+        to 1/tp; block tables stay replicated because per-shard block
+        indices are identical), replicated with a rate-limited warning
+        otherwise — serving stays correct, just without the memory split."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        tp = self.mesh.shape.get("tp", 1)
+        if tp > 1:
+            kvh = getattr(getattr(self.module, "config", None),
+                          "kv_heads", None)
+            if kvh is not None and kvh % tp == 0:
+                return NamedSharding(self.mesh,
+                                     P(None, None, None, "tp", None))
+            warn_once(f"serving tp={tp} does not divide the model's "
+                      f"kv_heads={kvh}: KV caches/pools replicate over the "
+                      "tp axis (params still shard, but there is no KV "
+                      "memory split)")
+        return NamedSharding(self.mesh, P())
+
     def _kv_workspace(self, B: int, need_len: int):
         """Persistent KV workspace (reference ``inference_context.h:49``:
         one workspace allocated once and reused across calls). Grows
@@ -712,8 +801,6 @@ class InferenceEngine:
         tells the caller not to store the sliced copy back). Reuse is safe
         because the causal mask hides slots beyond the current position.
         Returns ``(cache, Smax, owned)``."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         ws = getattr(self, "_workspace", None)
         if ws is not None and ws[0] >= B and ws[1] >= need_len:
             leaves = jax.tree.leaves(ws[2])
@@ -727,10 +814,8 @@ class InferenceEngine:
         cfg = self.module.config
         Smax = min(cfg.max_seq, max(need_len, int(self._config.max_out_tokens)))
         cache = self.module.init_cache(B, Smax, dtype=self.dtype)
-        kv_spec = (P(None, None, None, "tp", None)
-                   if self.mesh.shape.get("tp", 1) > 1 else P())
-        cache = jax.tree.map(
-            lambda a: jax.device_put(a, NamedSharding(self.mesh, kv_spec)), cache)
+        kv_sh = self._kv_head_sharding()
+        cache = jax.tree.map(lambda a: jax.device_put(a, kv_sh), cache)
         self._workspace = (B, Smax, cache)
         return cache, Smax, True
 
@@ -852,8 +937,6 @@ class InferenceEngine:
         block in the prefix cache). Returns ``(pools, reused)`` — a fresh
         workspace has no valid cached content, so the caller must drop any
         persisted prefix-cache state alongside it."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         pw = getattr(self, "_paged_workspace", None)
         if pw is not None and pw[0] == num_blocks and pw[1] == block_size:
             leaves = jax.tree.leaves(pw[2])
@@ -861,10 +944,8 @@ class InferenceEngine:
                 return pw[2], True
         pools = self.module.init_paged_cache(num_blocks, block_size,
                                              dtype=self.dtype)
-        kv_spec = (P(None, None, None, "tp", None)
-                   if self.mesh.shape.get("tp", 1) > 1 else P())
-        pools = jax.tree.map(
-            lambda a: jax.device_put(a, NamedSharding(self.mesh, kv_spec)), pools)
+        kv_sh = self._kv_head_sharding()
+        pools = jax.tree.map(lambda a: jax.device_put(a, kv_sh), pools)
         self._paged_workspace = (num_blocks, block_size, pools)
         return pools, False
 
@@ -897,11 +978,33 @@ class InferenceEngine:
         if self._paged_jits is None:
             from deepspeed_tpu.models.transformer import copy_paged_block
             mod = self.module
+            kv_sh = self._kv_head_sharding()
+            pin_sh = kv_sh if any(s is not None for s in kv_sh.spec) else None
+
+            def _pin(pools):
+                # NamedSharding-constrained workspaces: under tp the pools
+                # must come OUT of every fused step still head-sharded
+                # (donation pairs the constrained output with the sharded
+                # input buffer), so the row-projection psum is each layer's
+                # only collective — unconstrained, the partitioner is free
+                # to gather the pool on the way out
+                if pin_sh is None:
+                    return pools
+                return jax.tree.map(
+                    lambda a: jax.lax.with_sharding_constraint(a, pin_sh),
+                    pools)
+
+            def _pinned(fn):
+                def run(*args):
+                    logits, pools = fn(*args)
+                    return logits, _pin(pools)
+                return run
+
             chunk = None
             if hasattr(mod, "forward_paged_prefill_chunk"):
                 chunk = self._watched(
                     jax.jit(lambda p, t, pools, bt, slots, sp, li:
-                            mod.forward_paged_prefill_chunk(
+                            _pinned(mod.forward_paged_prefill_chunk)(
                                 p, t, pools, bt, slots, sp, li),
                             donate_argnums=(2,)),
                     "inference.paged_prefill_chunk")
@@ -909,24 +1012,29 @@ class InferenceEngine:
             if hasattr(mod, "forward_paged_verify"):
                 verify = self._watched(
                     jax.jit(lambda p, t, pools, bt, slots, pos:
-                            mod.forward_paged_verify(
+                            _pinned(mod.forward_paged_verify)(
                                 p, t, pools, bt, slots, pos),
                             donate_argnums=(2,)),
                     "inference.paged_verify")
             self._paged_jits = (
                 self._watched(
                     jax.jit(lambda p, t, pools, slots, li:
-                            mod.forward_paged_prefill(p, t, pools, slots, li),
+                            _pinned(mod.forward_paged_prefill)(
+                                p, t, pools, slots, li),
                             donate_argnums=(2,)),
                     "inference.paged_prefill"),
                 self._watched(
                     jax.jit(lambda p, t, pools, bt, pos:
-                            mod.forward_paged_decode(p, t, pools, bt, pos),
+                            _pinned(mod.forward_paged_decode)(
+                                p, t, pools, bt, pos),
                             donate_argnums=(2,)),
                     "inference.paged_decode"),
                 chunk,
-                self._watched(jax.jit(copy_paged_block, donate_argnums=(0,)),
-                              "inference.paged_cow"),
+                self._watched(
+                    jax.jit(lambda pools, src, dst:
+                            _pin(copy_paged_block(pools, src, dst)),
+                            donate_argnums=(0,)),
+                    "inference.paged_cow"),
                 verify,
             )
         return self._paged_jits
@@ -962,9 +1070,19 @@ class InferenceEngine:
         ``speculative: {mode: "ngram", k}`` turns on draft-free
         self-speculation — verified multi-token decode steps that emit
         (accepted + 1) tokens per fused step on repetitive workloads.
-        Greedy decoding (``temperature=0``) reproduces the static path's
-        tokens exactly in every mode, speculation included.
+        ``serving.tp`` > 0 serves tensor-parallel over a ``tp`` mesh axis:
+        params column/row-sharded, KV pools split on the KV-head dim,
+        the fused steps running with exactly one all-reduce per layer and
+        the Pallas paged kernel dispatched per-shard via shard_map —
+        token-identical to the tp=1 engine (greedy), with decode
+        throughput and max model size scaling with the slice.
         """
+        with self._mesh_scope():
+            return self._generate_batch(prompts, max_new_tokens, temperature,
+                                        top_k, seed, eos_token_id)
+
+    def _generate_batch(self, prompts, max_new_tokens, temperature, top_k,
+                        seed, eos_token_id):
         prompts = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
         if not prompts:
             return []
@@ -1069,6 +1187,12 @@ class InferenceEngine:
 
         pools, pools_reused = self._paged_pools(num_blocks, bs)
         alloc = self._paged_allocator(num_blocks, bs, caching, pools_reused)
+        if self._serving_tel is not None:
+            # KV gauges (blocks free/used, fragmentation) are GLOBAL per
+            # slice — the allocator is replicated and block ids are shard-
+            # invariant; this gauge annotates them so a head-sharded pool
+            # is not misread as 1/tp of the memory
+            self._serving_tel.tp.set(float(self.mesh.shape.get("tp", 1)))
         ev = self._events
         t_serve0 = time.monotonic_ns() if ev is not None else 0
         if ev is not None:

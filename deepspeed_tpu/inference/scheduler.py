@@ -119,7 +119,7 @@ class ServingTelemetry:
                "preemptions", "recompute_tokens", "requests", "finished",
                "generated_tokens", "spec_verify_steps",
                "spec_proposed_tokens", "spec_accepted_tokens",
-               "spec_rollbacks", "spec_acceptance_rate")
+               "spec_rollbacks", "spec_acceptance_rate", "tp")
 
     def __init__(self, registry=None):
         if registry is None:
@@ -169,7 +169,17 @@ class ServingTelemetry:
     def kv_blocks_free(self):
         return self.registry.gauge(
             "serving/kv_blocks_free",
-            "allocatable pool blocks: free list + reclaimable cold")
+            "allocatable pool blocks: free list + reclaimable cold "
+            "(GLOBAL per slice under tensor parallelism — block ids are "
+            "shard-invariant, see serving/tp)")
+
+    @property
+    def tp(self):
+        return self.registry.gauge(
+            "serving/tp",
+            "tensor-parallel degree of the serving mesh: KV pools are "
+            "head-sharded over tp, so block-count gauges are global per "
+            "slice while per-shard pool BYTES are 1/tp")
 
     @property
     def kv_block_utilization(self):
@@ -673,14 +683,17 @@ class ContinuousBatchingScheduler:
                 r.output, min(self.spec_k, headroom))
             found = len(cands)
             if len(cands):
-                # clamp to the slots the request owns plus what the free
-                # pool supplies (free list + reclaimable cold), never
-                # evicting: highest written slot is pos + len(cands)
+                # clamp to the slots the request owns plus what the PLAIN
+                # free list supplies — never evicting AND never reclaiming
+                # a cold cached block: speculation is best-effort, so it
+                # must not destroy a prefix-cache registration (and the
+                # later cache miss + recompute) that spec-off serving
+                # would have kept. Highest written slot is pos + len(cands)
                 need = self.allocator.blocks_for_tokens(
                     r.pos + 1 + len(cands)) - len(r.blocks)
                 if need > 0:
                     got = self.allocator.allocate(
-                        min(need, self.allocator.num_free))
+                        min(need, self.allocator.num_free_list))
                     if got:
                         r.blocks.extend(got)
                     cands = cands[:len(r.blocks) * bs - 1 - r.pos]

@@ -727,6 +727,68 @@ def _decode_sharded(q1, ck, cv, pos, pad_bias, slopes, mesh, scale=None):
     return wrapped(*operands)
 
 
+def _paged_shard_ok(mesh, H: int, KV: int, Hd: int, bs: int) -> bool:
+    """Whether the shard_map'd paged kernel applies on ``mesh``: heads and
+    KV heads must divide the tp axis, and the PER-SHARD shape must sit
+    inside the kernel envelope (a shard_map body cannot fall back
+    per-shard, so the check happens out here)."""
+    from deepspeed_tpu.ops.pallas.paged_decode_attention import \
+        paged_envelope_ok
+    nh = mesh.shape.get("tp", 1)
+    if H % nh or KV % nh:
+        return False
+    return paged_envelope_ok(H // nh, KV // nh, Hd, bs)
+
+
+def _paged_decode_sharded(q1, kp, vp, block_tables, pos, pad_bias, slopes,
+                          mesh, scale=None):
+    """Paged decode-attention kernel under an SPMD mesh: shard_map over the
+    KV-HEAD axis — q and the block pools split over ``tp``, while block
+    tables, positions and the logical-position bias stay REPLICATED
+    (per-shard block indices are identical; the head split is the only
+    partition, so shards need no communication). dp/fsdp/ep axes replicate
+    the whole fused step: continuous batching is ONE program over all
+    running rows and the pool is shared state, not batch data. This is how
+    multi-chip TP serving keeps the scalar-prefetched Pallas kernel
+    instead of falling back to the gather + einsum path.
+    Returns None when :func:`_paged_shard_ok` rejects the split."""
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    B, H, Hd = q1.shape
+    bs, KV = kp.shape[1], kp.shape[2]
+    if not _paged_shard_ok(mesh, H, KV, Hd, bs):
+        return None
+    head_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
+
+    from deepspeed_tpu.ops.pallas.paged_decode_attention import \
+        paged_decode_attention
+
+    qspec = P(None, head_axis, None)
+    pspec = P(None, None, head_axis, None)
+    operands = [q1, kp, vp, jnp.asarray(block_tables, jnp.int32),
+                jnp.asarray(pos, jnp.int32)]
+    specs = [qspec, pspec, pspec, P(), P()]
+    if pad_bias is not None:
+        operands.append(pad_bias.astype(jnp.float32))
+        specs.append(P(None, None))
+    if slopes is not None:
+        # contiguous head chunks of H/nh = G * (KV/nh) heads: each shard's
+        # slopes regroup to its own (KV_shard, G) exactly like q does
+        operands.append(jnp.asarray(slopes, jnp.float32).reshape(H))
+        specs.append(P(head_axis))
+
+    def inner(qs, kps, vps, bts, ps, *rest):
+        rest = list(rest)
+        ms = rest.pop(0) if pad_bias is not None else None
+        ss = rest.pop(0) if slopes is not None else None
+        return paged_decode_attention(qs, kps, vps, bts, ps, pad_bias=ms,
+                                      alibi_slopes=ss, scale=scale)
+
+    wrapped = shard_map(inner, mesh=mesh, in_specs=tuple(specs),
+                        out_specs=qspec, check_vma=False)
+    return wrapped(*operands)
+
+
 def _sp_mesh(cfg: TransformerConfig):
     """The active mesh when sequence parallelism is configured AND the mesh
     carries an sp axis of size > 1; else None (dense attention)."""
@@ -1094,6 +1156,15 @@ def _paged_decode_attention(cfg: TransformerConfig, x, lp, positions, pos,
         o = paged_decode_attention(q[:, 0], kp, vp, block_tables, pos,
                                    pad_bias=pad_bias, alibi_slopes=slopes,
                                    scale=cfg.attn_scale)
+    else:
+        # SPMD mesh (a bare pallas_call is illegal): shard_map the kernel
+        # over the KV-head/tp axis — the head-sharded pool's shards each
+        # stream their local heads, tables stay replicated
+        pmesh = _flash_mesh(cfg)
+        if pmesh is not None:
+            o = _paged_decode_sharded(q[:, 0], kp, vp, block_tables, pos,
+                                      pad_bias, slopes, pmesh,
+                                      scale=cfg.attn_scale)
     if o is not None:
         out = o.reshape(B, 1, H * cfg.head_dim)
     else:
@@ -1270,7 +1341,18 @@ def _paged_verify_attention(cfg: TransformerConfig, x, lp, positions,
 
     q, k, v = _qkv_project(cfg, x, lp, positions)
 
-    if _use_flash(cfg):
+    # kernel dispatch mirrors the decode step's exactly (direct where a
+    # bare pallas_call is legal, shard_map over the KV-head axis on SPMD
+    # meshes) — token identity demands verify resolve argmax near-ties
+    # with the SAME implementation decode would have used
+    direct = _use_flash(cfg)
+    pmesh = None
+    if not direct:
+        pmesh = _flash_mesh(cfg)
+        if pmesh is not None and not _paged_shard_ok(
+                pmesh, H, KV, Hd, kp.shape[1]):
+            pmesh = None
+    if direct or pmesh is not None:
         from deepspeed_tpu.ops.pallas.paged_decode_attention import \
             paged_decode_attention
         slopes = _alibi_slopes(H) if cfg.pos_embedding == "alibi" else None
@@ -1278,9 +1360,15 @@ def _paged_verify_attention(cfg: TransformerConfig, x, lp, positions,
         for t in range(W):
             kp = _pool_scatter(kp, k[:, t], slots[:, t])
             vp = _pool_scatter(vp, v[:, t], slots[:, t])
-            o = paged_decode_attention(q[:, t], kp, vp, block_tables,
-                                       positions[:, t], alibi_slopes=slopes,
-                                       scale=cfg.attn_scale)
+            if direct:
+                o = paged_decode_attention(q[:, t], kp, vp, block_tables,
+                                           positions[:, t],
+                                           alibi_slopes=slopes,
+                                           scale=cfg.attn_scale)
+            else:
+                o = _paged_decode_sharded(q[:, t], kp, vp, block_tables,
+                                          positions[:, t], None, slopes,
+                                          pmesh, scale=cfg.attn_scale)
             if o is None:
                 break          # off-envelope: the einsum core below
             outs.append(o)

@@ -763,6 +763,11 @@ def render_summary_table(s: Dict[str, Any]) -> str:
                 line += f" free {int(serving['kv_blocks_free'])}"
             if "kv_fragmentation" in serving:
                 line += f" frag {serving['kv_fragmentation']:.2f}"
+            if serving.get("tp", 1) > 1:
+                # head-sharded pools: the block counts above are GLOBAL
+                # per slice, not per shard — annotate so a tp pool is not
+                # misread as 1/tp of the memory
+                line += f" [tp={int(serving['tp'])}]"
             parts.append(line)
         lookups = serving.get("prefix_cache_lookups", 0)
         if lookups:
@@ -876,6 +881,7 @@ def health_summary(rec: Dict, prev: Optional[Dict] = None) -> Dict[str, Any]:
                       ("serving/kv_blocks_free", "kv_blocks_free"),
                       ("serving/kv_fragmentation", "kv_fragmentation"),
                       ("serving/cold_blocks", "cold_blocks"),
+                      ("serving/tp", "tp"),
                       ("serving/spec_acceptance_rate",
                        "spec_acceptance_rate")):
         if key in g:

@@ -143,6 +143,15 @@ def _paged_call(q, kp, vp, bt, pos, bias, slopes, *, bs, has_bias, has_alibi,
     return out
 
 
+def paged_envelope_ok(H: int, KV: int, Hd: int, bs: int) -> bool:
+    """Whether a (heads, kv_heads, head_dim, block_size) shape sits inside
+    the kernel's envelope. The ONE home of the envelope — the transformer's
+    shard_map dispatch checks it against PER-SHARD shapes before entering a
+    manual region (a shard_map body cannot fall back per-shard), and
+    :func:`paged_decode_attention` checks it to decide None-vs-kernel."""
+    return H % KV == 0 and Hd % 64 == 0 and bs % 128 == 0
+
+
 def paged_decode_attention(q, kp, vp, block_tables, pos, *, pad_bias=None,
                            alibi_slopes=None, scale: Optional[float] = None,
                            interpret: Optional[bool] = None):
@@ -166,7 +175,7 @@ def paged_decode_attention(q, kp, vp, block_tables, pos, *, pad_bias=None,
     """
     B, H, Hd = q.shape
     bs, KV = kp.shape[1], kp.shape[2]
-    if H % KV != 0 or Hd % 64 != 0 or bs % 128 != 0:
+    if not paged_envelope_ok(H, KV, Hd, bs):
         return None
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

@@ -40,6 +40,14 @@ class CompileBudget:
 #:   serving_speculative — generate_batch with serving.speculative
 #:                     {mode: ngram} at one fixed k (repetitive prompts,
 #:                     verify + fallback decode steps interleaved)
+#:   serving_sharded_steady — generate_batch under serving.tp > 1 (head-
+#:                     sharded KV pools, shard_map'd paged kernel), prefix
+#:                     cache + speculation on, prompts within two 128-token
+#:                     buckets: SHARDING MUST NOT MULTIPLY PROGRAMS — each
+#:                     fused entry compiles exactly as often as its tp=1
+#:                     counterpart (the shard_map and the sharding
+#:                     constraints are part of the traced program, not a
+#:                     per-shard re-trace)
 BUDGETS: List[CompileBudget] = [
     CompileBudget(
         "engine.train_batch[gas=1]", "steady_train", 1,
@@ -101,6 +109,27 @@ BUDGETS: List[CompileBudget] = [
     CompileBudget(
         "inference.paged_cow", "serving_speculative", 1,
         "copy-on-write block copy: fixed block geometry"),
+    CompileBudget(
+        "inference.paged_decode", "serving_sharded_steady", 1,
+        "THE fused decode step under tp>1: the head split rides the "
+        "traced shard_map, per-request positions stay traced vectors — "
+        "one program, same as tp=1 (sharding must not multiply programs)"),
+    CompileBudget(
+        "inference.paged_prefill", "serving_sharded_steady", 2,
+        "whole-prompt prefill under tp>1: one compile per 128-token "
+        "prompt bucket exactly as at tp=1; the scenario spans two"),
+    CompileBudget(
+        "inference.paged_prefill_chunk", "serving_sharded_steady", 4,
+        "cache-hit tails / chunked prefill under tp>1: one program per "
+        "(chunk bucket, table-width power-of-two) pair, same as tp=1"),
+    CompileBudget(
+        "inference.paged_verify", "serving_sharded_steady", 1,
+        "THE fused verify step under tp>1: one program per k window "
+        "bucket (the scenario holds k fixed), same as tp=1"),
+    CompileBudget(
+        "inference.paged_cow", "serving_sharded_steady", 1,
+        "copy-on-write block copy: fixed block geometry, sharding rides "
+        "the constrained pool layout"),
 ]
 
 
