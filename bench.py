@@ -66,6 +66,15 @@ Env knobs (defaults are the chip-measured fast path):
                            efficiency, (tpN/tp1)/N); skip record on a
                            single-device backend; BENCH_SERVE_TP_N=auto
                            BENCH_SERVE_TP_REQS=8 BENCH_SERVE_TP_NEW=64
+  BENCH_SERVE_ASYNC=1      open-loop async serving probe: Poisson arrivals
+                           through the always-on AsyncServingEngine, value
+                           = GOODPUT (generated tokens/s from requests
+                           whose own p99 TPOT met the target), vs_baseline
+                           = goodput/throughput (SLO attainment, <= 1);
+                           BENCH_SERVE_ASYNC_RATE=8 (req/s)
+                           BENCH_SERVE_ASYNC_REQS=24
+                           BENCH_SERVE_ASYNC_NEW=32
+                           BENCH_SERVE_ASYNC_TPOT_MS=50 (p99 target)
   BENCH_SKIP_PROBE=0       skip the subprocess backend probe
   BENCH_PROBE_RETRIES=1    probe retries before giving up on the backend
   BENCH_ALLOW_CPU=0        on probe failure, run a tiny CPU smoke metric
@@ -157,6 +166,7 @@ def _telemetry_blob(engine):
         if k in g:
             blob[k] = round(g[k], 6)
     for k in ("train/step_time_ms", "serving/ttft_ms", "serving/tpot_ms",
+              "serving/queue_wait_ms",
               "checkpoint/save_ms", "checkpoint/snapshot_ms",
               "checkpoint/bytes"):
         if k in h:
@@ -165,7 +175,8 @@ def _telemetry_blob(engine):
               "serving/prefill_steps", "serving/decode_steps",
               "serving/generated_tokens", "serving/spec_verify_steps",
               "serving/spec_proposed_tokens", "serving/spec_accepted_tokens",
-              "serving/spec_rollbacks", "checkpoint/saves",
+              "serving/spec_rollbacks", "serving/rejected_requests",
+              "checkpoint/saves",
               "checkpoint/failures"):
         if k in c:
             blob[k] = c[k]
@@ -410,6 +421,7 @@ BENCH_METRICS = [
     ("BENCH_SERVE_PREFIX", "1", "gpt2_serving_prefix_cache_ttft_ms"),
     ("BENCH_SERVE_CHUNKED", "1", "gpt2_serving_chunked_prefill_tpot_p99_ms"),
     ("BENCH_SERVE_SPEC", "1", "gpt2_serving_spec_decode_tpot_ms"),
+    ("BENCH_SERVE_ASYNC", "1", "gpt2_serving_async_goodput_tokens_per_sec"),
     ("BENCH_SERVE_TP", "1", "gpt2_serving_tp_tokens_per_sec"),
     ("BENCH_CKPT", "1", "gpt2_ckpt_async_stall_ms_per_step"),
 ]
@@ -700,6 +712,135 @@ def run_spec_decode_bench():
         # building the next one: both resident at once doubles peak HBM
         # and perturbs the very TPOT number the probe measures
         del engine
+
+
+def run_async_serving_bench():
+    """Open-loop async serving probe: Poisson arrivals (exponential
+    inter-arrival gaps at BENCH_SERVE_ASYNC_RATE req/s, seeded — the
+    trace replays) submitted to the always-on ``AsyncServingEngine``
+    while earlier requests are mid-decode — the arrival pattern
+    ``generate_batch`` benches can never produce. Value = GOODPUT at a
+    p99 TPOT target: generated tokens/s counted only from requests whose
+    own p99 per-token latency met BENCH_SERVE_ASYNC_TPOT_MS;
+    vs_baseline = goodput / raw throughput (SLO attainment, 1.0 = every
+    request met the target). The same run exercises the open-loop
+    telemetry (TTFT/TPOT/queue-wait histograms ride the record's blob)
+    and the flight recorder — the per-request chrome trace is exported
+    next to the tempdir and its path embedded. Failures degrade to the
+    standard skip record (skip_stage/skip_error), never an rc!=0."""
+    import tempfile
+    import threading
+    import time as _t
+
+    import numpy as np
+
+    RATE = float(os.environ.get("BENCH_SERVE_ASYNC_RATE", 8.0))
+    NREQ = int(os.environ.get("BENCH_SERVE_ASYNC_REQS", 24))
+    MAX_NEW = int(os.environ.get("BENCH_SERVE_ASYNC_NEW", 32))
+    TARGET = float(os.environ.get("BENCH_SERVE_ASYNC_TPOT_MS", 50.0))
+    serving = engine = None
+    try:
+        import deepspeed_tpu
+        import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.inference.serve import AsyncServingEngine
+        from deepspeed_tpu.models import gpt2
+
+        dist.set_mesh(None)
+        _reset_telemetry()
+        model = gpt2("125m", remat=False,
+                     attention_backend=os.environ.get("BENCH_ATTN", "auto"))
+        engine = deepspeed_tpu.init_inference(
+            model, dtype="bf16", telemetry={"events": True},
+            serving={"block_size": 128, "max_running": 8,
+                     "prefix_caching": "off"})
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 50257, size=int(n)).astype(np.int32)
+                   for n in rng.integers(64, 192, size=NREQ)]
+        gaps = rng.exponential(1.0 / max(RATE, 1e-6), size=NREQ)
+        # warm the fused programs CLOSED-loop — the open loop reuses them
+        # (the serving_async_steady contract), so compile time never
+        # pollutes the measured arrival window
+        engine.generate_batch(prompts[:2], max_new_tokens=MAX_NEW)
+        _reset_telemetry()
+
+        def consume(h, rec):
+            last = None
+            for burst in h.stream():
+                now = _t.perf_counter()
+                if last is not None:
+                    rec["tpot"] += [(now - last) / len(burst)] * len(burst)
+                last = now
+                rec["tokens"] += len(burst)
+            rec["status"] = h.status
+
+        serving = AsyncServingEngine(engine, max_new_tokens=MAX_NEW)
+        recs, threads = [], []
+        t0 = _t.perf_counter()
+        for p, gap in zip(prompts, gaps):
+            _t.sleep(gap)
+            h = serving.add_request(p)
+            rec = {"tpot": [], "tokens": 0}
+            th = threading.Thread(target=consume, args=(h, rec), daemon=True)
+            th.start()
+            recs.append(rec)
+            threads.append(th)
+        for th in threads:
+            th.join(600)
+        serving.shutdown(drain=True, timeout=600)
+        wall = _t.perf_counter() - t0
+
+        good = total = met = 0
+        for rec in recs:
+            total += rec["tokens"]
+            p99_ms = (float(np.percentile(rec["tpot"], 99)) * 1e3
+                      if rec["tpot"] else 0.0)
+            if rec.get("status") == "finished" and p99_ms <= TARGET:
+                good += rec["tokens"]
+                met += 1
+        goodput = good / wall if wall > 0 else 0.0
+        throughput = total / wall if wall > 0 else 0.0
+        out = {
+            "metric": _metric_name("BENCH_SERVE_ASYNC"),
+            "value": round(goodput, 1),
+            "unit": f"goodput tokens/s (bf16 open loop, Poisson {RATE}/s x "
+                    f"{NREQ} reqs x {MAX_NEW} new, p99 TPOT target "
+                    f"{TARGET:.0f} ms: {met}/{NREQ} requests met it; raw "
+                    f"throughput = {throughput:.1f} tok/s)",
+            # SLO attainment: 1.0 = every request inside the TPOT target
+            "vs_baseline": (round(goodput / throughput, 3)
+                            if throughput else 0.0),
+        }
+        tel = _telemetry_blob(engine) or {}
+        tel["slo_met_requests"] = met
+        tel["throughput_tokens_per_sec"] = round(throughput, 1)
+        trace_path = os.path.join(tempfile.gettempdir(),
+                                  "bench_serve_async_trace.json")
+        try:
+            # the open-loop per-request chrome trace, finally exercised
+            # under realistic arrivals (ROADMAP item 1's telemetry ask)
+            tel["serving_trace"] = engine.export_serving_trace(trace_path)
+        except Exception:  # noqa: BLE001 — trace export is best-effort
+            pass
+        out["telemetry"] = tel
+        print(json.dumps(out), flush=True)
+    except Exception as e:  # noqa: BLE001 — probe failure => skip record
+        print(json.dumps({
+            "metric": _metric_name("BENCH_SERVE_ASYNC"),
+            "value": 0.0,
+            "unit": "goodput tokens/s (skipped: async serving probe "
+                    "failed)",
+            "vs_baseline": 0.0,
+            "skipped": True,
+            "skip_stage": "serve_async_run",
+            "skip_error": f"{type(e).__name__}: {e}",
+        }), flush=True)
+    finally:
+        if serving is not None and not serving._stopped:
+            try:
+                serving.shutdown(drain=False, timeout=60)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        del serving, engine
 
 
 def run_serving_tp_bench():
@@ -1006,7 +1147,7 @@ def main():
     if any(_metric_enabled(g) for g in
            ("BENCH_DECODE_DENSE", "BENCH_DECODE_PAGED",
             "BENCH_SERVE_PREFIX", "BENCH_SERVE_CHUNKED",
-            "BENCH_SERVE_SPEC", "BENCH_SERVE_TP")):
+            "BENCH_SERVE_SPEC", "BENCH_SERVE_ASYNC", "BENCH_SERVE_TP")):
         # free the last training engine's device state before serving
         if engine is not None:
             del engine, model, batch
@@ -1024,6 +1165,9 @@ def main():
             gc.collect()
         if _metric_enabled("BENCH_SERVE_SPEC"):
             run_spec_decode_bench()
+            gc.collect()
+        if _metric_enabled("BENCH_SERVE_ASYNC"):
+            run_async_serving_bench()
             gc.collect()
         if _metric_enabled("BENCH_SERVE_TP"):
             run_serving_tp_bench()

@@ -3,6 +3,8 @@
 Subcommands mirror the reference's script family:
 
 - ``dscli run <script> [args...]``  — the ``deepspeed`` launcher CLI
+- ``dscli serve [--model m] [--port p]`` — OpenAI-style completions endpoint
+  (``/v1/completions``, SSE streaming) over the async paged serving loop
 - ``dscli report [--telemetry f]``  — ``ds_report`` environment/op/memory report
 - ``dscli health <jsonl> [--once|--json]`` — live health screen over a telemetry sink
 - ``dscli bench``                   — ``ds_bench`` collective micro-benchmarks
@@ -23,6 +25,15 @@ import sys
 def _run(argv):
     from deepspeed_tpu.launcher import runner
     runner.main(argv)
+
+
+def _serve(argv):
+    """``dscli serve`` — stand up the always-on async serving loop behind
+    an OpenAI-style HTTP endpoint (``POST /v1/completions``, with
+    ``"stream": true`` server-sent events). Prompts are token-id lists;
+    see ``docs/api.md`` "Async serving" for a curl example."""
+    from deepspeed_tpu.inference.serve import serve_main
+    return serve_main(argv)
 
 
 def _report(argv):
@@ -316,7 +327,8 @@ def _dlts_hostfile():
     return DLTS_HOSTFILE
 
 
-_COMMANDS = {"run": _run, "report": _report, "health": _health, "bench": _bench,
+_COMMANDS = {"run": _run, "serve": _serve, "report": _report,
+             "health": _health, "bench": _bench,
              "ckpt": _ckpt, "lint": _lint, "trace": _trace,
              "profile": _profile, "elastic": _elastic, "autotune": _autotune,
              "ssh": _ssh}
@@ -325,7 +337,7 @@ _COMMANDS = {"run": _run, "report": _report, "health": _health, "bench": _bench,
 def main():
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
         print(__doc__)
-        print("usage: dscli {run|report|health|bench|ckpt|lint|trace|"
+        print("usage: dscli {run|serve|report|health|bench|ckpt|lint|trace|"
               "profile|elastic|autotune|ssh} [args...]")
         return 0
     cmd = sys.argv[1]

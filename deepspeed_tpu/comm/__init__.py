@@ -12,6 +12,7 @@ from deepspeed_tpu.comm.comm import (ReduceOp, all_gather, all_gather_into_tenso
                                      axis_index, barrier, broadcast, comms_logger, configure, destroy_process_group,
                                      gather, get_global_rank, get_local_rank, get_mesh, get_rank, get_world_group,
                                      get_world_size, has_mesh, inference_all_reduce, init_distributed, init_mesh,
+                                     mesh_override,
                                      irecv, is_available, is_initialized, isend, log_summary, monitored_barrier,
                                      new_group, recv, reduce, reduce_scatter, reduce_scatter_tensor, ring_send_recv,
                                      scatter, send, set_mesh)
@@ -24,6 +25,6 @@ __all__ = [
     "broadcast", "comms_logger", "configure", "destroy_process_group", "gather", "get_global_rank", "get_local_rank",
     "get_mesh", "get_rank", "get_world_group", "get_world_size", "has_mesh", "inference_all_reduce",
     "init_distributed", "init_mesh", "irecv", "is_available", "is_initialized", "isend", "log_summary",
-    "monitored_barrier", "new_group", "recv", "reduce", "reduce_scatter", "reduce_scatter_tensor", "ring_send_recv",
+    "mesh_override", "monitored_barrier", "new_group", "recv", "reduce", "reduce_scatter", "reduce_scatter_tensor", "ring_send_recv",
     "scatter", "send", "set_mesh", "axis_size", "bound_axis_size", "build_hybrid_mesh", "build_mesh", "data_parallel_axes",
 ]

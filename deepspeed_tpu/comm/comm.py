@@ -37,6 +37,7 @@ from deepspeed_tpu.utils import comms_logging
 from deepspeed_tpu.utils.logging import logger
 
 _mesh = None  # the framework-wide mesh, set by init_mesh/set_mesh
+_mesh_tls = None  # lazy threading.local: per-thread mesh-override stack
 _comms_logger = None
 _initialized = False
 
@@ -85,7 +86,47 @@ def set_mesh(mesh) -> None:
     _mesh = mesh
 
 
+def _mesh_override():
+    """The CURRENT thread's innermost mesh override, or None."""
+    tls = _mesh_tls
+    stack = getattr(tls, "stack", None) if tls is not None else None
+    return stack[-1] if stack else None
+
+
+def mesh_override(mesh):
+    """Context manager pinning :func:`get_mesh`/:func:`has_mesh` to
+    ``mesh`` for the CURRENT THREAD only (re-entrant: a stack). This is
+    how an engine scopes its traces to its own mesh — the always-on
+    serving loop runs on a dedicated thread, and mutating the
+    process-global ``_mesh`` from there would race a training engine (or
+    another serving engine) tracing concurrently on another thread. The
+    global mesh is never touched: other threads keep seeing it."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def scope():
+        global _mesh_tls
+        if mesh is None:
+            raise ValueError("mesh_override needs a mesh (None would "
+                             "shadow the global instead of pinning one)")
+        if _mesh_tls is None:
+            import threading
+            _mesh_tls = threading.local()
+        stack = getattr(_mesh_tls, "stack", None)
+        if stack is None:
+            stack = _mesh_tls.stack = []
+        stack.append(mesh)
+        try:
+            yield mesh
+        finally:
+            stack.pop()
+    return scope()
+
+
 def get_mesh():
+    ov = _mesh_override()
+    if ov is not None:
+        return ov
     global _mesh
     if _mesh is None:
         from deepspeed_tpu.comm.mesh import build_mesh
@@ -94,7 +135,7 @@ def get_mesh():
 
 
 def has_mesh() -> bool:
-    return _mesh is not None
+    return _mesh_override() is not None or _mesh is not None
 
 
 def init_mesh(axes=None, devices=None):

@@ -1,0 +1,26 @@
+"""Inference package: the paged continuous-batching engine and its
+serving surfaces. Heavy modules load lazily — importing the package must
+not drag in jax before the caller configures platforms."""
+
+_LAZY = {
+    "InferenceEngine": ("deepspeed_tpu.inference.engine", "InferenceEngine"),
+    "AsyncServingEngine": ("deepspeed_tpu.inference.serve",
+                           "AsyncServingEngine"),
+    "RequestHandle": ("deepspeed_tpu.inference.serve", "RequestHandle"),
+    "SchedulingPolicy": ("deepspeed_tpu.inference.policy",
+                         "SchedulingPolicy"),
+    "get_policy": ("deepspeed_tpu.inference.policy", "get_policy"),
+}
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+    return getattr(importlib.import_module(mod_name), attr)
+
+
+__all__ = sorted(_LAZY)

@@ -158,6 +158,17 @@ class ServingConfig(ConfigModel):
     ``speculative`` configures n-gram self-speculation (verified
     multi-token decode steps) — see :class:`SpeculativeConfig`.
 
+    ``policy`` selects the scheduling policy for the serving loop
+    (``inference/policy.py``): ``"fifo"`` (default — the pinned behavior
+    every release has had), ``"priority"`` (strict priority classes on
+    each request's ``priority``), or ``"sla"`` (TTFT-slack-aware
+    admission and preemption). A dict form passes constructor kwargs,
+    e.g. ``{"name": "sla", "default_ttft_budget": 64,
+    "admission_max_queue": 128, "admission_min_free_blocks": 2}`` — the
+    ``admission_*`` knobs are the async front-end's admission control
+    (submissions refused under queue/pool pressure instead of queueing
+    unboundedly). All policies are deterministic given a request trace.
+
     ``tp`` > 0 shards the serving engine over a ``tp`` mesh axis (tensor
     parallelism): model params lay out column/row-sharded (the model's
     ``tp_specs`` or the ``auto_tp`` heuristics) and the KV block pools
@@ -180,6 +191,9 @@ class ServingConfig(ConfigModel):
     prefill_chunk_tokens: int = 0  # 0 = whole-prompt; else chunk size
     speculative: SpeculativeConfig = Field(
         default_factory=SpeculativeConfig)
+    policy: Union[str, Dict[str, Any]] = "fifo"   # fifo | priority | sla,
+    # or {"name": ..., **kwargs} (see inference/policy.py); the serving
+    # loop's scheduling policy — generate_batch always runs FIFO
 
 
 class InferenceCheckpointConfig(ConfigModel):

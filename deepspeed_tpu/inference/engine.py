@@ -368,6 +368,9 @@ class InferenceEngine:
         # None check and allocates nothing
         self._events = None
         self._serve_rid_base = 0   # rids unique across generate_batch calls
+        self._active_session = None  # at most ONE paged serving session
+        # owns the pools/jits at a time (generate_batch drain or an
+        # AsyncServingEngine loop)
         if self._telemetry is not None:
             from deepspeed_tpu.inference.scheduler import ServingTelemetry
             from deepspeed_tpu.monitor.metrics import get_registry
@@ -753,22 +756,17 @@ class InferenceEngine:
     # softmax_context pt_binding.cpp:1668-1793)
 
     def _mesh_scope(self):
-        """Pin the framework-global mesh to THIS engine's mesh for the
+        """Pin the framework mesh VIEW to THIS engine's mesh for the
         duration of a serve. The transformer-level kernel dispatch
-        (``_flash_mesh`` / ``_bare_pallas_legal``) reads the GLOBAL mesh at
-        trace time, so two engines with different tp degrees serving from
-        one process must not trace against each other's mesh."""
-        import contextlib
-
-        @contextlib.contextmanager
-        def scope():
-            prev = dist.get_mesh() if dist.has_mesh() else None
-            dist.set_mesh(self.mesh)
-            try:
-                yield
-            finally:
-                dist.set_mesh(prev)
-        return scope()
+        (``_flash_mesh`` / ``_bare_pallas_legal``) reads ``dist.get_mesh``
+        at trace time, so two engines with different tp degrees serving
+        from one process must not trace against each other's mesh. The pin
+        is a THREAD-LOCAL override (``dist.mesh_override``), never a write
+        to the process-global mesh: the always-on serving loop traces from
+        its own thread, and toggling the global there would race a
+        training engine (or another serving engine) tracing concurrently
+        on another thread."""
+        return dist.mesh_override(self.mesh)
 
     def _kv_head_sharding(self):
         """NamedSharding for the KV workspaces — the dense cache
@@ -1117,6 +1115,78 @@ class InferenceEngine:
         if max_new <= 0:
             return [jnp.asarray(p) for p in prompts]
 
+        session = self.open_serve_session(
+            max_new=max_new, temperature=temperature, top_k=top_k,
+            seed=seed, eos_token_id=eos_token_id)
+        ev = self._events
+        t_serve0 = time.monotonic_ns() if ev is not None else 0
+        if ev is not None:
+            ev.emit("serve.begin", t_ns=t_serve0, requests=len(prompts))
+        # the try/finally guards rid uniqueness: even when a serve aborts
+        # (oversized prompt, pool exhaustion) the next serve's rids must
+        # not collide with this one's in the shared flight-recorder ring
+        try:
+            for p in prompts:
+                session.add(p)
+            while session.step():
+                pass
+        finally:
+            session.close()
+        if ev is not None:
+            ev.emit("serve.end", t_ns=t_serve0,
+                    dur_ns=time.monotonic_ns() - t_serve0,
+                    requests=len(prompts))
+        session.end()
+        sched = session.sched
+        failed = [r for r in sched.finished if r.error is not None]
+        if failed:
+            # a silently truncated generation is worse than a loud failure:
+            # this only happens when preemption grew a request's prefix past
+            # what the pool can EVER hold — the same misconfiguration
+            # add_request rejects up front, arising dynamically
+            raise RuntimeError(
+                f"{len(failed)} request(s) retired without completing "
+                "(KV pool too small for the workload — raise "
+                "serving.max_num_blocks): "
+                + "; ".join(f"request {r.rid}: {r.error}" for r in failed))
+        done = sorted(sched.finished, key=lambda r: r.rid)
+        return [jnp.asarray(r.output) for r in done]
+
+    def open_serve_session(self, *, max_new: int, temperature: float = 0.0,
+                           top_k: int = 0, seed: int = 0,
+                           eos_token_id: Optional[int] = None, policy=None,
+                           on_tokens=None, on_finish=None,
+                           retain_finished: bool = True):
+        """Open one paged serving session: the scheduler, the persistent
+        pool workspace, and the fused-step jit context, bundled behind a
+        step API (:class:`_ServeSession`). BOTH entry points run through
+        it — ``generate_batch`` adds its whole batch and drains, the
+        always-on ``AsyncServingEngine`` (``inference/serve.py``) feeds
+        arrivals in as they come — so the open-loop path executes exactly
+        the closed-loop compiled programs (the ``serving_async_steady``
+        compile-budget contract). At most one session may be active per
+        engine: the pools are donated through every fused step, so a
+        second concurrent user would read deleted buffers.
+
+        ``policy`` plugs a scheduling policy (``inference/policy.py``)
+        into the scheduler; ``on_tokens(req, tokens)`` streams each
+        emitted burst (speculation emits multi-token bursts) and
+        ``on_finish(req)`` fires once per retired request — both host-side
+        callbacks on the serving thread."""
+        if self._active_session is not None:
+            raise RuntimeError(
+                "another serving session is active on this engine (an "
+                "AsyncServingEngine loop, or a generate_batch in flight); "
+                "drain/shutdown it before opening a new one")
+        srv = self._config.serving
+        if str(srv.paged) == "off" or not self._paged_supported():
+            raise ValueError(
+                "a serving session needs the paged engine (zoo causal LM, "
+                "not weight-streaming/MoE, serving.paged != 'off') — the "
+                "serving loop has no static fallback")
+        if max_new <= 0:
+            raise ValueError("a serving session needs max_new >= 1")
+
         from deepspeed_tpu.inference.scheduler import \
             ContinuousBatchingScheduler
 
@@ -1125,11 +1195,6 @@ class InferenceEngine:
         W = int(srv.max_running)
         n_max = -(-cfg.max_seq // bs)          # block-table width
         num_blocks = int(srv.max_num_blocks) or (W * n_max + 1)
-        for p in prompts:
-            if p.size + max_new > cfg.max_seq:
-                raise ValueError(
-                    f"prompt ({p.size}) + max_new_tokens ({max_new}) exceeds "
-                    f"model max_seq {cfg.max_seq}")
 
         # prefix caching + chunked prefill both ride the chunk forward
         pc_mode = str(srv.prefix_caching)
@@ -1194,9 +1259,6 @@ class InferenceEngine:
             # is not misread as 1/tp of the memory
             self._serving_tel.tp.set(float(self.mesh.shape.get("tp", 1)))
         ev = self._events
-        t_serve0 = time.monotonic_ns() if ev is not None else 0
-        if ev is not None:
-            ev.emit("serve.begin", t_ns=t_serve0, requests=len(prompts))
         sched = ContinuousBatchingScheduler(alloc, W, n_max,
                                             telemetry=self._serving_tel,
                                             prefix_caching=caching,
@@ -1204,217 +1266,18 @@ class InferenceEngine:
                                             events=ev,
                                             rid_base=self._serve_rid_base,
                                             spec_k=spec_k if spec_on else 0,
-                                            spec_proposer=proposer)
-        prefill_jit, decode_jit, chunk_jit, cow_jit, verify_jit = \
-            self._ensure_paged_jits()
-        rng = jax.random.key(seed)
-
-        # the try/finally guards rid uniqueness: even when a serve aborts
-        # (oversized prompt, pool exhaustion) the next serve's rids must
-        # not collide with this one's in the shared flight-recorder ring
-        try:
-            for p in prompts:
-                sched.add_request(p, max_new, eos_token_id)
-
-            while True:
-                action = sched.next_action()
-                if action is None:
-                    break
-                kind, payload = action
-                if kind == "prefill":
-                    req = payload
-                    prefix = req.prefix()
-                    L = prefix.size
-                    Tb = self._bucket(L, cfg.max_seq)
-                    toks = np.zeros((1, Tb), np.int32)
-                    toks[0, :L] = prefix
-                    table = np.asarray(req.blocks, np.int32)
-                    slots = self._flat_slots(table, 0, L, Tb, bs)
-                    t0 = time.monotonic_ns() if ev is not None else 0
-                    logits, pools = prefill_jit(self.params, jnp.asarray(toks),
-                                                pools,
-                                                jnp.asarray(slots, jnp.int32),
-                                                jnp.int32(L - 1))
-                    rng, sub = jax.random.split(rng)
-                    # fetch the sampled token BEFORE emitting: _sample_host
-                    # is device-only (argmax/categorical), so the np.asarray
-                    # here is the sync — emitting first would clock async
-                    # dispatch while the device work lands later (DS005)
-                    tok = np.asarray(self._sample_host(
-                        logits.astype(jnp.float32), temperature, top_k, sub))
-                    if ev is not None:
-                        ev.emit("req.prefill", rid=req.rid, t_ns=t0,
-                                dur_ns=time.monotonic_ns() - t0, tokens=L)
-                    sched.record_prefill(req, int(tok[0]))
-                elif kind == "prefill_chunk":
-                    req = payload
-                    if req.cow_pending is not None:
-                        # copy-on-write split: the request restarts mid-block
-                        # inside a SHARED cached block — give it a private
-                        # device copy before any of its writes land
-                        src, dst = req.cow_pending
-                        t0 = time.monotonic_ns() if ev is not None else 0
-                        pools = cow_jit(pools, jnp.int32(src), jnp.int32(dst))
-                        if ev is not None:
-                            # dispatch is async: wait for the copy so the
-                            # span covers device work, not µs of dispatch
-                            jax.block_until_ready(pools)
-                            ev.emit("req.cow_copy", rid=req.rid, t_ns=t0,
-                                    dur_ns=time.monotonic_ns() - t0,
-                                    src=src, dst=dst)
-                        req.cow_pending = None
-                    start = req.pos
-                    remaining = req.prefill_target - start
-                    step = min(chunk_tokens, remaining) if chunk_tokens \
-                        else remaining
-                    Tb = self._bucket(step, cfg.max_seq)
-                    prefix = req.prefix()
-                    toks = np.zeros((1, Tb), np.int32)
-                    toks[0, :step] = prefix[start:start + step]
-                    table = np.asarray(req.blocks, np.int32)
-                    slots = self._flat_slots(table, start, step, Tb, bs)
-                    # the chunk attends over the gathered table, so its cost is
-                    # O(table width × block_size) per layer — bucket the width
-                    # to the next power of two of the request's OWN block count
-                    # (≤ log2(n_max) compiles) instead of paying n_max (=
-                    # max_seq worth of KV) for every short cache-hit tail
-                    nb = min(n_max, 1 << max(int(table.size) - 1, 0).bit_length())
-                    bt = np.zeros((1, nb), np.int32)
-                    bt[0, :table.size] = table
-                    t0 = time.monotonic_ns() if ev is not None else 0
-                    logits, pools = chunk_jit(self.params, jnp.asarray(toks),
-                                              pools, jnp.asarray(bt),
-                                              jnp.asarray(slots, jnp.int32),
-                                              jnp.int32(start),
-                                              jnp.int32(step - 1))
-                    if ev is not None:
-                        # non-final chunks never fetch a result, so the
-                        # dispatch alone would clock near-zero: sync first
-                        # (tracing-only cost) so the slice is device time
-                        jax.block_until_ready(logits)
-                        ev.emit("req.prefill_chunk", rid=req.rid, t_ns=t0,
-                                dur_ns=time.monotonic_ns() - t0,
-                                start=start, tokens=step)
-                    if start + step == req.prefill_target:
-                        rng, sub = jax.random.split(rng)
-                        tok = self._sample_host(logits.astype(jnp.float32),
-                                                temperature, top_k, sub)
-                        sched.record_prefill_chunk(req, step,
-                                                   int(np.asarray(tok)[0]))
-                    else:
-                        sched.record_prefill_chunk(req, step)
-                elif kind == "verify":
-                    # speculative multi-token step: the fused decode math
-                    # over each request's window (pending token + proposed
-                    # candidates) at once, then greedy argmax acceptance —
-                    # the accepted candidate prefix plus the first-mismatch
-                    # token is exactly what token-by-token decode would emit
-                    reqs = payload
-                    bt = np.zeros((W, n_max), np.int32)       # zeros → dummy
-                    pos = np.zeros((W,), np.int32)
-                    toks = np.zeros((W, spec_wb), np.int32)
-                    slotm = np.zeros((W, spec_wb), np.int32)
-                    zt = np.zeros((1,), np.int32)
-                    for i in range(W):
-                        if i >= len(reqs):
-                            # inactive rows: junk routed to the dummy block
-                            slotm[i] = self._flat_slots(zt, 0, 0, spec_wb, bs)
-                            continue
-                        r = reqs[i]
-                        nv = 1 + len(r.spec_tokens)
-                        toks[i, 0] = r.last_token
-                        toks[i, 1:nv] = r.spec_tokens
-                        table = np.asarray(r.blocks, np.int32)
-                        bt[i, :table.size] = table
-                        pos[i] = r.pos
-                        slotm[i] = self._flat_slots(table, r.pos, nv,
-                                                    spec_wb, bs)
-                    t0 = time.monotonic_ns() if ev is not None else 0
-                    logits, pools = verify_jit(self.params,
-                                               jnp.asarray(toks), pools,
-                                               jnp.asarray(bt),
-                                               jnp.asarray(slotm),
-                                               jnp.asarray(pos))
-                    # same argmax the decode path's _sample_host runs, at
-                    # every window position; the fetch is the sync point,
-                    # so the spec_verify slices below clock device time
-                    greedy = np.asarray(jnp.argmax(
-                        logits.astype(jnp.float32), axis=-1))
-                    dur = time.monotonic_ns() - t0 if ev is not None else 0
-                    for i, r in enumerate(reqs):
-                        cands = r.spec_tokens
-                        n_acc = 0
-                        while n_acc < len(cands) \
-                                and int(greedy[i, n_acc]) == cands[n_acc]:
-                            n_acc += 1
-                        emitted = list(cands[:n_acc]) + [int(greedy[i, n_acc])]
-                        # truncate at eos HERE so the event's accepted=
-                        # matches what record_verify will commit (its own
-                        # truncation stays as the invariant check)
-                        if eos_token_id is not None \
-                                and int(eos_token_id) in emitted:
-                            emitted = emitted[
-                                :emitted.index(int(eos_token_id)) + 1]
-                        if ev is not None:
-                            # emitted BEFORE record_verify so a retirement
-                            # this step triggers lands after its slice
-                            ev.emit("req.spec_verify", rid=r.rid, t_ns=t0,
-                                    dur_ns=dur, window=1 + len(cands),
-                                    accepted=len(emitted) - 1)
-                        sched.record_verify(r, emitted)
-                else:
-                    reqs = payload
-                    bt = np.zeros((W, n_max), np.int32)       # zeros → dummy
-                    pos = np.zeros((W,), np.int32)
-                    toks = np.zeros((W, 1), np.int32)
-                    for i, r in enumerate(reqs):
-                        bt[i, :len(r.blocks)] = r.blocks
-                        pos[i] = r.pos
-                        toks[i, 0] = r.last_token
-                    t0 = time.monotonic_ns() if ev is not None else 0
-                    logits, pools = decode_jit(self.params, jnp.asarray(toks),
-                                               pools, jnp.asarray(bt),
-                                               jnp.asarray(pos))
-                    rng, sub = jax.random.split(rng)
-                    tok = np.asarray(self._sample_host(
-                        logits.astype(jnp.float32), temperature, top_k, sub))
-                    if ev is not None:
-                        # emitted BEFORE record_decode so a retirement this
-                        # tick triggers lands after its final decode slice
-                        ev.emit("decode.tick", t_ns=t0,
-                                dur_ns=time.monotonic_ns() - t0,
-                                rids=[r.rid for r in reqs], n=len(reqs))
-                    for i, r in enumerate(reqs):
-                        sched.record_decode(r, int(tok[i]))
-        finally:
-            self._serve_rid_base = sched._next_rid
-            # step accounting for the serve that just ran (plain host
-            # counters, kept even when the metrics registry is off):
-            # accepted_tokens_per_step > 1 is the speculation win
-            self._last_serve_stats = dict(sched.stats)
-        if ev is not None:
-            ev.emit("serve.end", t_ns=t_serve0,
-                    dur_ns=time.monotonic_ns() - t_serve0,
-                    requests=len(prompts))
-        if self._telemetry is not None:
-            # HBM live/peak + host RSS after the serve (the pools and the
-            # decode workspace are the serving memory story)
-            from deepspeed_tpu.monitor.health import sample_memory_gauges
-            sample_memory_gauges(self._tel_reg)
-        self._paged_workspace = (num_blocks, bs, pools)
-        failed = [r for r in sched.finished if r.error is not None]
-        if failed:
-            # a silently truncated generation is worse than a loud failure:
-            # this only happens when preemption grew a request's prefix past
-            # what the pool can EVER hold — the same misconfiguration
-            # add_request rejects up front, arising dynamically
-            raise RuntimeError(
-                f"{len(failed)} request(s) retired without completing "
-                "(KV pool too small for the workload — raise "
-                "serving.max_num_blocks): "
-                + "; ".join(f"request {r.rid}: {r.error}" for r in failed))
-        done = sorted(sched.finished, key=lambda r: r.rid)
-        return [jnp.asarray(r.output) for r in done]
+                                            spec_proposer=proposer,
+                                            policy=policy)
+        session = _ServeSession(
+            self, sched, pools, self._ensure_paged_jits(),
+            max_new=max_new, temperature=temperature, top_k=top_k,
+            rng=jax.random.key(seed), eos_token_id=eos_token_id,
+            spec_wb=spec_wb, W=W, n_max=n_max, bs=bs,
+            num_blocks=num_blocks, chunk_tokens=chunk_tokens, ev=ev,
+            on_tokens=on_tokens, on_finish=on_finish,
+            retain_finished=retain_finished)
+        self._active_session = session
+        return session
 
     @staticmethod
     def _sample_jit(logits, temperature, top_k, rng):
@@ -1429,3 +1292,321 @@ class InferenceEngine:
     @property
     def config(self):
         return self._config
+
+
+class _ServeSession:
+    """One paged serving session: scheduler + pools + jit context behind a
+    step API. ``generate_batch`` (closed loop) and ``AsyncServingEngine``
+    (open loop) both execute scheduler actions THROUGH this class, so an
+    action compiles and dispatches identically no matter which front-end
+    drove it — the ``serving_async_steady`` contract's mechanism, not just
+    its test. Single-threaded by contract: every method must run on the
+    thread that owns the engine's jit dispatch (the caller's thread for
+    generate_batch, the serving loop thread for the async engine), under
+    the engine's ``_mesh_scope``."""
+
+    def __init__(self, engine, sched, pools, jits, *, max_new, temperature,
+                 top_k, rng, eos_token_id, spec_wb, W, n_max, bs, num_blocks,
+                 chunk_tokens, ev, on_tokens=None, on_finish=None,
+                 retain_finished=True):
+        self.engine = engine
+        self.sched = sched
+        self.pools = pools
+        (self._prefill_jit, self._decode_jit, self._chunk_jit,
+         self._cow_jit, self._verify_jit) = jits
+        self.max_new = int(max_new)
+        self.temperature = temperature
+        self.top_k = top_k
+        self.rng = rng
+        self.eos_token_id = eos_token_id
+        self.spec_wb = spec_wb
+        self.W = W
+        self.n_max = n_max
+        self.bs = bs
+        self.num_blocks = num_blocks
+        self.chunk_tokens = chunk_tokens
+        self.ev = ev
+        self.on_tokens = on_tokens
+        self.on_finish = on_finish
+        # closed loop reads sched.finished for its outputs; the ALWAYS-ON
+        # loop must not retain every Request forever (unbounded growth) —
+        # it consumes results through on_finish and sets this False
+        self.retain_finished = retain_finished
+        self._finished_seen = 0
+        self._closed = False
+
+    # ---- request front-end ---- #
+
+    _UNSET = object()
+
+    def add(self, prompt, max_new=None, eos=_UNSET, priority: int = 0,
+            ttft_budget=None, t_submit=None):
+        """Enqueue one request (any time — mid-decode arrivals are the
+        point). ``max_new``/``eos`` default to the session-wide values."""
+        if self._closed:
+            raise RuntimeError("serving session is closed")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        mn = self.max_new if max_new is None else int(max_new)
+        if mn < 1:
+            # the session-level guard only covers the default; a per-
+            # request 0 would still emit the prefill-sampled token
+            raise ValueError(f"max_new_tokens must be >= 1, got {mn}")
+        cfg = self.engine.module.config
+        if prompt.size + mn > cfg.max_seq:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({mn}) exceeds "
+                f"model max_seq {cfg.max_seq}")
+        return self.sched.add_request(
+            prompt, mn, self.eos_token_id if eos is self._UNSET else eos,
+            priority=priority, ttft_budget=ttft_budget, t_submit=t_submit)
+
+    def cancel(self, req) -> bool:
+        """Cancel between engine steps; fires ``on_finish`` for the
+        retired request."""
+        ok = self.sched.cancel_request(req)
+        self._flush_finished()
+        return ok
+
+    # ---- stepping ---- #
+
+    def step(self) -> bool:
+        """Execute ONE scheduler action (admission prefill, prefill
+        chunk, fused decode or fused verify). Returns False when nothing
+        is runnable — queue and running batch both empty."""
+        if self._closed:
+            raise RuntimeError("serving session is closed")
+        action = self.sched.next_action()
+        if action is None:
+            self._flush_finished()   # admission-time error retirements
+            return False
+        self._exec(action)
+        self._flush_finished()
+        return True
+
+    def _emit_tokens(self, req, tokens) -> None:
+        if self.on_tokens is not None:
+            self.on_tokens(req, [int(t) for t in tokens])
+
+    def _flush_finished(self) -> None:
+        fin = self.sched.finished
+        while self._finished_seen < len(fin):
+            r = fin[self._finished_seen]
+            self._finished_seen += 1
+            if self.on_finish is not None:
+                self.on_finish(r)
+        if not self.retain_finished and self._finished_seen:
+            del fin[:self._finished_seen]
+            self._finished_seen = 0
+
+    def _exec(self, action) -> None:
+        engine, sched, ev = self.engine, self.sched, self.ev
+        cfg = engine.module.config
+        bs, W, n_max, spec_wb = self.bs, self.W, self.n_max, self.spec_wb
+        temperature, top_k = self.temperature, self.top_k
+        pools = self.pools
+        kind, payload = action
+        try:
+            if kind == "prefill":
+                req = payload
+                prefix = req.prefix()
+                L = prefix.size
+                Tb = engine._bucket(L, cfg.max_seq)
+                toks = np.zeros((1, Tb), np.int32)
+                toks[0, :L] = prefix
+                table = np.asarray(req.blocks, np.int32)
+                slots = engine._flat_slots(table, 0, L, Tb, bs)
+                t0 = time.monotonic_ns() if ev is not None else 0
+                logits, pools = self._prefill_jit(
+                    engine.params, jnp.asarray(toks), pools,
+                    jnp.asarray(slots, jnp.int32), jnp.int32(L - 1))
+                self.rng, sub = jax.random.split(self.rng)
+                # fetch the sampled token BEFORE emitting: _sample_host
+                # is device-only (argmax/categorical), so the np.asarray
+                # here is the sync — emitting first would clock async
+                # dispatch while the device work lands later (DS005)
+                tok = np.asarray(engine._sample_host(
+                    logits.astype(jnp.float32), temperature, top_k, sub))
+                if ev is not None:
+                    ev.emit("req.prefill", rid=req.rid, t_ns=t0,
+                            dur_ns=time.monotonic_ns() - t0, tokens=L)
+                sched.record_prefill(req, int(tok[0]))
+                self._emit_tokens(req, [int(tok[0])])
+            elif kind == "prefill_chunk":
+                req = payload
+                if req.cow_pending is not None:
+                    # copy-on-write split: the request restarts mid-block
+                    # inside a SHARED cached block — give it a private
+                    # device copy before any of its writes land
+                    src, dst = req.cow_pending
+                    t0 = time.monotonic_ns() if ev is not None else 0
+                    pools = self._cow_jit(pools, jnp.int32(src),
+                                          jnp.int32(dst))
+                    if ev is not None:
+                        # dispatch is async: wait for the copy so the
+                        # span covers device work, not µs of dispatch
+                        jax.block_until_ready(pools)
+                        ev.emit("req.cow_copy", rid=req.rid, t_ns=t0,
+                                dur_ns=time.monotonic_ns() - t0,
+                                src=src, dst=dst)
+                    req.cow_pending = None
+                start = req.pos
+                remaining = req.prefill_target - start
+                step = min(self.chunk_tokens, remaining) \
+                    if self.chunk_tokens else remaining
+                Tb = engine._bucket(step, cfg.max_seq)
+                prefix = req.prefix()
+                toks = np.zeros((1, Tb), np.int32)
+                toks[0, :step] = prefix[start:start + step]
+                table = np.asarray(req.blocks, np.int32)
+                slots = engine._flat_slots(table, start, step, Tb, bs)
+                # the chunk attends over the gathered table, so its cost is
+                # O(table width × block_size) per layer — bucket the width
+                # to the next power of two of the request's OWN block count
+                # (≤ log2(n_max) compiles) instead of paying n_max (=
+                # max_seq worth of KV) for every short cache-hit tail
+                nb = min(n_max, 1 << max(int(table.size) - 1, 0).bit_length())
+                bt = np.zeros((1, nb), np.int32)
+                bt[0, :table.size] = table
+                t0 = time.monotonic_ns() if ev is not None else 0
+                logits, pools = self._chunk_jit(
+                    engine.params, jnp.asarray(toks), pools, jnp.asarray(bt),
+                    jnp.asarray(slots, jnp.int32), jnp.int32(start),
+                    jnp.int32(step - 1))
+                if ev is not None:
+                    # non-final chunks never fetch a result, so the
+                    # dispatch alone would clock near-zero: sync first
+                    # (tracing-only cost) so the slice is device time
+                    jax.block_until_ready(logits)
+                    ev.emit("req.prefill_chunk", rid=req.rid, t_ns=t0,
+                            dur_ns=time.monotonic_ns() - t0,
+                            start=start, tokens=step)
+                if start + step == req.prefill_target:
+                    self.rng, sub = jax.random.split(self.rng)
+                    tok = engine._sample_host(logits.astype(jnp.float32),
+                                              temperature, top_k, sub)
+                    sched.record_prefill_chunk(req, step,
+                                               int(np.asarray(tok)[0]))
+                    self._emit_tokens(req, [int(np.asarray(tok)[0])])
+                else:
+                    sched.record_prefill_chunk(req, step)
+            elif kind == "verify":
+                # speculative multi-token step: the fused decode math
+                # over each request's window (pending token + proposed
+                # candidates) at once, then greedy argmax acceptance —
+                # the accepted candidate prefix plus the first-mismatch
+                # token is exactly what token-by-token decode would emit
+                reqs = payload
+                bt = np.zeros((W, n_max), np.int32)       # zeros → dummy
+                pos = np.zeros((W,), np.int32)
+                toks = np.zeros((W, spec_wb), np.int32)
+                slotm = np.zeros((W, spec_wb), np.int32)
+                zt = np.zeros((1,), np.int32)
+                for i in range(W):
+                    if i >= len(reqs):
+                        # inactive rows: junk routed to the dummy block
+                        slotm[i] = engine._flat_slots(zt, 0, 0, spec_wb, bs)
+                        continue
+                    r = reqs[i]
+                    nv = 1 + len(r.spec_tokens)
+                    toks[i, 0] = r.last_token
+                    toks[i, 1:nv] = r.spec_tokens
+                    table = np.asarray(r.blocks, np.int32)
+                    bt[i, :table.size] = table
+                    pos[i] = r.pos
+                    slotm[i] = engine._flat_slots(table, r.pos, nv,
+                                                  spec_wb, bs)
+                t0 = time.monotonic_ns() if ev is not None else 0
+                logits, pools = self._verify_jit(
+                    engine.params, jnp.asarray(toks), pools,
+                    jnp.asarray(bt), jnp.asarray(slotm), jnp.asarray(pos))
+                # same argmax the decode path's _sample_host runs, at
+                # every window position; the fetch is the sync point,
+                # so the spec_verify slices below clock device time
+                greedy = np.asarray(jnp.argmax(
+                    logits.astype(jnp.float32), axis=-1))
+                dur = time.monotonic_ns() - t0 if ev is not None else 0
+                for i, r in enumerate(reqs):
+                    cands = r.spec_tokens
+                    n_acc = 0
+                    while n_acc < len(cands) \
+                            and int(greedy[i, n_acc]) == cands[n_acc]:
+                        n_acc += 1
+                    emitted = list(cands[:n_acc]) + [int(greedy[i, n_acc])]
+                    # truncate at eos HERE so the event's accepted=
+                    # matches what record_verify will commit (its own
+                    # truncation stays as the invariant check)
+                    eos_r = r.eos
+                    if eos_r is not None and int(eos_r) in emitted:
+                        emitted = emitted[:emitted.index(int(eos_r)) + 1]
+                    if ev is not None:
+                        # emitted BEFORE record_verify so a retirement
+                        # this step triggers lands after its slice
+                        ev.emit("req.spec_verify", rid=r.rid, t_ns=t0,
+                                dur_ns=dur, window=1 + len(cands),
+                                accepted=len(emitted) - 1)
+                    sched.record_verify(r, emitted)
+                    self._emit_tokens(r, emitted)
+            else:
+                reqs = payload
+                bt = np.zeros((W, n_max), np.int32)       # zeros → dummy
+                pos = np.zeros((W,), np.int32)
+                toks = np.zeros((W, 1), np.int32)
+                for i, r in enumerate(reqs):
+                    bt[i, :len(r.blocks)] = r.blocks
+                    pos[i] = r.pos
+                    toks[i, 0] = r.last_token
+                t0 = time.monotonic_ns() if ev is not None else 0
+                logits, pools = self._decode_jit(
+                    engine.params, jnp.asarray(toks), pools,
+                    jnp.asarray(bt), jnp.asarray(pos))
+                self.rng, sub = jax.random.split(self.rng)
+                tok = np.asarray(engine._sample_host(
+                    logits.astype(jnp.float32), temperature, top_k, sub))
+                if ev is not None:
+                    # emitted BEFORE record_decode so a retirement this
+                    # tick triggers lands after its final decode slice
+                    ev.emit("decode.tick", t_ns=t0,
+                            dur_ns=time.monotonic_ns() - t0,
+                            rids=[r.rid for r in reqs], n=len(reqs))
+                for i, r in enumerate(reqs):
+                    sched.record_decode(r, int(tok[i]))
+                    self._emit_tokens(r, [int(tok[i])])
+        finally:
+            # rebind even when a record_* invariant raised: the donated
+            # input buffers are gone either way, and close()/end() must
+            # see the live pools
+            self.pools = pools
+
+    # ---- lifecycle ---- #
+
+    def close(self) -> None:
+        """Always-run bookkeeping (the closed loop runs this in its
+        ``finally``): rid uniqueness across serves — even an aborted serve
+        must not let the next one reuse rids in the shared flight-recorder
+        ring — the serve-stats stash, and releasing the engine's
+        active-session slot. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        engine = self.engine
+        engine._serve_rid_base = self.sched._next_rid
+        # step accounting for the serve that just ran (plain host
+        # counters, kept even when the metrics registry is off):
+        # accepted_tokens_per_step > 1 is the speculation win
+        engine._last_serve_stats = dict(self.sched.stats)
+        if engine._active_session is self:
+            engine._active_session = None
+
+    def end(self) -> None:
+        """Success-path epilogue: serving memory gauges and the hand-back
+        of the (donated-through) pools into the engine's persistent
+        workspace, so the next session — or ``generate_batch`` call —
+        reuses them and, with prefix caching, re-hits this session's
+        registered blocks."""
+        engine = self.engine
+        if engine._telemetry is not None:
+            # HBM live/peak + host RSS after the serve (the pools and the
+            # decode workspace are the serving memory story)
+            from deepspeed_tpu.monitor.health import sample_memory_gauges
+            sample_memory_gauges(engine._tel_reg)
+        engine._paged_workspace = (self.num_blocks, self.bs, self.pools)

@@ -64,9 +64,11 @@ re-admission hit.
 
 Preemption is recompute-style (vLLM's default): when a running request
 needs one more KV block and the pool (free + reclaimable cold blocks) is
-dry, the LATEST-admitted running request is evicted — its blocks are
-dereferenced and it re-queues at the FRONT with its prompt extended by the
-tokens it already generated. With prefix caching on, its own still-cold
+dry, the policy-selected victim — LATEST-admitted under the default FIFO
+policy; ``inference/policy.py`` plugs in priority-class and SLA-aware
+(most-TTFT-slack) victim choice, plus which waiting request admits next —
+is evicted: its blocks are dereferenced and it re-queues at the FRONT
+with its prompt extended by the tokens it already generated. With prefix caching on, its own still-cold
 blocks usually satisfy the re-admission probe, so "recompute" preemption
 costs a cache hit instead of a full re-prefill. Victim choice, the FIFO
 free list, the LRU cold list, and the prefill/decode interleave toggle are
@@ -96,6 +98,18 @@ from deepspeed_tpu.utils.logging import logger
 QUEUED, RUNNING, FINISHED = "queued", "running", "finished"
 
 
+class PoolExhausted(RuntimeError):
+    """The KV pool cannot supply one more block for ``req`` and there is
+    nothing to evict. The closed loop propagates this (a misconfigured
+    pool should fail the call loudly); the always-on loop catches it and
+    retires ``req`` with an error instead — one oversized request must
+    not take the server down for everyone else."""
+
+    def __init__(self, msg: str, req: "Request"):
+        super().__init__(msg)
+        self.req = req
+
+
 class ServingTelemetry:
     """Registry adapter for the Orca/vLLM-style iteration-level serving
     stats: the scheduler calls these hooks as its state machine moves and
@@ -111,12 +125,14 @@ class ServingTelemetry:
     was SKIPPED via cache hits (hits / lookups is the admission hit rate),
     and ``serving/cold_blocks`` gauges the freed-but-cached pool blocks."""
 
-    _SERIES = ("ttft", "tpot", "queue_depth", "running", "kv_blocks_used",
+    _SERIES = ("ttft", "tpot", "queue_wait", "queue_depth", "running",
+               "kv_blocks_used",
                "kv_blocks_free", "kv_block_utilization", "kv_fragmentation",
                "cold_blocks", "prefill_steps", "prefill_chunks",
                "decode_steps", "prefix_cache_lookups", "prefix_cache_hits",
                "prefix_cache_hit_tokens",
                "preemptions", "recompute_tokens", "requests", "finished",
+               "rejected_requests",
                "generated_tokens", "spec_verify_steps",
                "spec_proposed_tokens", "spec_accepted_tokens",
                "spec_rollbacks", "spec_acceptance_rate", "tp")
@@ -148,6 +164,20 @@ class ServingTelemetry:
     def tpot(self):
         return self.registry.histogram(
             "serving/tpot_ms", "per-output-token latency after the first")
+
+    @property
+    def queue_wait(self):
+        return self.registry.histogram(
+            "serving/queue_wait_ms",
+            "request submission -> first admission wait (one observation "
+            "per request; preemption re-admissions do not re-observe)")
+
+    @property
+    def rejected_requests(self):
+        return self.registry.counter(
+            "serving/rejected_requests",
+            "submissions refused by admission control (queue bound / pool "
+            "pressure) before enqueueing")
 
     @property
     def queue_depth(self):
@@ -303,8 +333,17 @@ class Request:
     admit_seq: int = -1             # admission stamp (eviction order)
     preemptions: int = 0
     t_arrival: float = 0.0          # perf_counter at add_request
+    t_submit: float = 0.0           # perf_counter at SUBMISSION (async
+    # front-end hand-off; == t_arrival for closed-loop generate_batch) —
+    # the serving/queue_wait_ms base
     t_first_token: Optional[float] = None   # TTFT stamp (set once, ever)
     t_last_token: float = 0.0       # previous token's stamp (TPOT base)
+    # ---- scheduling-policy inputs (inference/policy.py) ----
+    priority: int = 0               # PriorityPolicy class (higher = sooner)
+    ttft_budget: Optional[int] = None  # SlaPolicy: scheduler steps past
+    # arrival_step before the first token is late (logical clock, not ms)
+    arrival_step: int = 0           # sched.step_seq at enqueue
+    cancelled: bool = False         # retired by cancellation, not eos/max
     # ---- prefix caching / chunked prefill state ----
     prefilling: bool = False        # admitted but pos < prefill_target
     prefill_target: int = 0         # len(prefix()) captured at admission
@@ -347,7 +386,7 @@ class ContinuousBatchingScheduler:
                  telemetry: Optional[ServingTelemetry] = None,
                  prefix_caching: bool = False, chunk_tokens: int = 0,
                  events=None, rid_base: int = 0,
-                 spec_k: int = 0, spec_proposer=None):
+                 spec_k: int = 0, spec_proposer=None, policy=None):
         if max_running < 1:
             raise ValueError("max_running must be >= 1")
         if chunk_tokens < 0:
@@ -376,6 +415,17 @@ class ContinuousBatchingScheduler:
         self.events = events
         if telemetry is not None:
             telemetry.ensure()
+        # scheduling policy (inference/policy.py): admission pick, victim
+        # pick, submission-time admission control. None = the FIFO rules
+        # every decision here used before policies existed.
+        if policy is None:
+            from deepspeed_tpu.inference.policy import FifoPolicy
+            policy = FifoPolicy()
+        self.policy = policy
+        # logical clock: one tick per compute action handed to the engine.
+        # SlaPolicy measures TTFT slack against THIS (replay-deterministic),
+        # never against wall time.
+        self.step_seq = 0
         self.waiting: deque = deque()
         self.running: List[Request] = []   # admission-ordered
         self.finished: List[Request] = []
@@ -430,7 +480,9 @@ class ContinuousBatchingScheduler:
     # ------------------------------------------------------------------ #
 
     def add_request(self, prompt, max_new: int,
-                    eos: Optional[int] = None) -> Request:
+                    eos: Optional[int] = None, priority: int = 0,
+                    ttft_budget: Optional[int] = None,
+                    t_submit: Optional[float] = None) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -451,8 +503,17 @@ class ContinuousBatchingScheduler:
                 f"the pool only has {self.allocator.capacity} allocatable "
                 f"blocks in total — it can never be admitted; raise "
                 "serving.max_num_blocks or shorten the prompt")
+        now = time.perf_counter()
         req = Request(rid=self._next_rid, prompt=prompt, max_new=max_new,
-                      eos=eos, t_arrival=time.perf_counter())
+                      eos=eos, t_arrival=now,
+                      t_submit=t_submit if t_submit is not None else now,
+                      # coerce HERE so a garbage budget fails the one
+                      # submission (ValueError/TypeError at add time), not
+                      # SlaPolicy's slack math mid-loop for everyone
+                      priority=int(priority),
+                      ttft_budget=(None if ttft_budget is None
+                                   else int(ttft_budget)),
+                      arrival_step=self.step_seq)
         self._next_rid += 1
         self.waiting.append(req)
         if self.events is not None:
@@ -466,6 +527,58 @@ class ContinuousBatchingScheduler:
     def all_done(self) -> bool:
         return not self.waiting and not self.running
 
+    def cancel_request(self, req: Request) -> bool:
+        """Retire ``req`` by cancellation at any lifecycle point: a QUEUED
+        request leaves the waiting queue, a RUNNING one leaves the batch
+        with ALL its blocks dereferenced (prefix-cache registrations stay
+        — committed content another request may hit). The request lands in
+        ``finished`` with ``cancelled=True`` and whatever it generated so
+        far. Returns False when it had already finished (nothing to do).
+        The caller owns the engine-step boundary: cancellations must land
+        BETWEEN scheduler actions, never between a returned action and its
+        ``record_*`` callback."""
+        return self._force_retire(req, error=None)
+
+    def fail_request(self, req: Request, error: str) -> bool:
+        """Retire ``req`` with ``error`` at any lifecycle point — the
+        always-on loop's answer to :class:`PoolExhausted`: same cleanup as
+        :meth:`cancel_request`, but the request's handle terminates with
+        status "error" while the loop keeps serving everyone else."""
+        return self._force_retire(req, error=str(error))
+
+    def _force_retire(self, req: Request, error: Optional[str]) -> bool:
+        if req.state == FINISHED:
+            return False
+        if req.state == QUEUED:
+            for i, r in enumerate(self.waiting):   # identity, not __eq__
+                if r is req:
+                    del self.waiting[i]
+                    break
+            else:
+                raise ValueError(f"request {req.rid} is QUEUED but not in "
+                                 "this scheduler's waiting queue")
+        else:
+            self.running.remove(req)
+            self._free_blocks(req)
+        req.spec_tokens = ()
+        req.state = FINISHED
+        self.finished.append(req)
+        if error is None:
+            req.cancelled = True
+            if self.events is not None:
+                self.events.emit("req.cancel", rid=req.rid,
+                                 generated=len(req.generated))
+        else:
+            req.error = error
+            logger.warning(f"request {req.rid} retired: {error}")
+            if self.events is not None:
+                self.events.emit("req.retire", rid=req.rid,
+                                 generated=len(req.generated), error=error)
+        if self.telemetry is not None:
+            self.telemetry.finished.inc()
+        self._tel_gauges()
+        return True
+
     # ------------------------------------------------------------------ #
     # admission
 
@@ -476,7 +589,15 @@ class ContinuousBatchingScheduler:
         Returns the prefill action, or None when nothing was admitted."""
         if not self.waiting or len(self.running) >= self.max_running:
             return None
-        req = self.waiting[0]
+        # the policy picks WHICH waiting request this attempt tries (FIFO:
+        # the head); one candidate per attempt keeps admission all-or-
+        # nothing and deterministic
+        idx = int(self.policy.select_admission(self))
+        if not 0 <= idx < len(self.waiting):
+            raise ValueError(
+                f"policy {self.policy.name!r} selected waiting index {idx} "
+                f"out of range (queue depth {len(self.waiting)})")
+        req = self.waiting[idx]
         prefix = req.prefix()
         target = int(prefix.size)
         bs = self.allocator.block_size
@@ -485,7 +606,7 @@ class ContinuousBatchingScheduler:
             # prompt fit at add_request but preemption-appended generated
             # tokens grew the prefix past the whole pool: retire with an
             # error instead of wedging the FIFO head forever
-            self.waiting.popleft()
+            del self.waiting[idx]
             req.state = FINISHED
             req.error = (
                 f"prefix of {target} tokens (prompt + {len(req.generated)} "
@@ -543,15 +664,20 @@ class ContinuousBatchingScheduler:
             # orphans its still-cached children for every future probe)
             self.allocator.free(list(reversed(shared)))
             if not self.running:
-                raise RuntimeError(
+                raise PoolExhausted(
                     f"prefix of request {req.rid} needs {tail_needed} more "
                     f"KV blocks but the pool only has "
                     f"{self.allocator.num_free} available and nothing is "
                     "running to evict; raise serving.max_num_blocks or "
-                    "shrink the prompt")
+                    "shrink the prompt", req)
             return None
 
-        self.waiting.popleft()
+        del self.waiting[idx]
+        if self.telemetry is not None and req.admit_seq == -1:
+            # first admission only: the submit->admit wait (a preemption
+            # re-admission is recompute latency, not queueing delay)
+            self.telemetry.queue_wait.observe(
+                (time.perf_counter() - req.t_submit) * 1e3)
         req.blocks = shared + tail
         req.keys = list(keys)
         req.pos = cached
@@ -591,12 +717,19 @@ class ContinuousBatchingScheduler:
     # ------------------------------------------------------------------ #
 
     def next_action(self) -> Optional[Tuple[str, object]]:
-        """Pick the next engine step: admit+start the queue head when a
-        slot and its tail blocks are available (admission has priority —
-        back-fill freed slots immediately), else alternate one prefill
-        chunk of the oldest mid-prefill request with one fused decode step
-        over the prefill-complete running set. None when everything is
-        finished."""
+        """Pick the next engine step: admit+start the policy-selected
+        waiting request when a slot and its tail blocks are available
+        (admission has priority — back-fill freed slots immediately), else
+        alternate one prefill chunk of the oldest mid-prefill request with
+        one fused decode step over the prefill-complete running set. None
+        when everything is finished. Every returned action advances the
+        logical ``step_seq`` clock (the SLA policies' time base)."""
+        action = self._next_action()
+        if action is not None:
+            self.step_seq += 1
+        return action
+
+    def _next_action(self) -> Optional[Tuple[str, object]]:
         action = self._try_admit()
         if action is not None:
             return action
@@ -613,8 +746,10 @@ class ContinuousBatchingScheduler:
             decodable = [r for r in self.running if not r.prefilling]
             if not decodable:
                 # capacity growth evicted every decodable row (they went
-                # back to the queue); pick again from the new state
-                return self.next_action()
+                # back to the queue); pick again from the new state (the
+                # outer next_action ticks step_seq once for whatever comes
+                # out)
+                return self._next_action()
             if self.spec_k > 0:
                 action = self._prepare_verify(decodable)
                 if action is not None:
@@ -636,8 +771,9 @@ class ContinuousBatchingScheduler:
     def _ensure_decode_capacity(self) -> None:
         """Every decode-ready request writes its next token at slot
         ``pos``; grow its block list when that slot crosses a block
-        boundary, evicting from the back (latest admitted) when the pool —
-        free list AND reclaimable cold blocks — is dry."""
+        boundary, evicting the policy's victim (FIFO: latest admitted,
+        SLA: most TTFT slack) when the pool — free list AND reclaimable
+        cold blocks — is dry."""
         for req in list(self.running):
             if req.state != RUNNING or req.prefilling:
                 continue  # evicted by an earlier iteration, or mid-prefill
@@ -646,12 +782,18 @@ class ContinuousBatchingScheduler:
                 if got is not None:
                     req.blocks.extend(got)
                     break
-                victim = self.running[-1]
+                victim = self.policy.select_victim(self, req)
+                # identity scan: Request's dataclass __eq__ compares numpy
+                # fields (ambiguous truth value) — never use `in` here
+                if not any(victim is r for r in self.running):
+                    raise ValueError(
+                        f"policy {self.policy.name!r} selected a victim "
+                        "that is not running")
                 if victim is req and len(self.running) == 1:
-                    raise RuntimeError(
+                    raise PoolExhausted(
                         f"request {req.rid} needs one more KV block but the "
                         "pool is exhausted and it is the only running "
-                        "request; raise serving.max_num_blocks")
+                        "request; raise serving.max_num_blocks", req)
                 self._preempt(victim)
                 if victim is req:
                     break  # the requester evicted itself; it re-queued

@@ -1,0 +1,718 @@
+"""Always-on async serving front-end over the paged engine.
+
+``generate_batch`` is a CLOSED loop: the request set is fixed up front and
+the call returns when the last one retires. This module opens it:
+:class:`AsyncServingEngine` runs the same serving session
+(``engine.open_serve_session`` — same scheduler, same pools, exactly the
+same compiled programs, pinned by the ``serving_async_steady`` contract)
+on a dedicated serving thread, and accepts :meth:`add_request` from ANY
+thread at ANY time. Each submission returns a :class:`RequestHandle` that
+streams token bursts back as they are emitted — speculation's verified
+multi-token steps arrive as multi-token bursts — and terminates with a
+status (``finished`` / ``cancelled`` / ``error`` / ``rejected``).
+
+Threading model (one sentence): the serving thread OWNS the engine's jit
+dispatch — submissions and cancellations are commands on a lock-guarded
+intake deque the loop drains between engine steps, so the scheduler and
+the donated pool buffers are only ever touched single-threaded. The loop
+idles on a condition variable when nothing is queued or running (an idle
+server burns no CPU and no device cycles).
+
+Determinism: the scheduler and its policies (``inference/policy.py``)
+make every decision from trace state (arrival order, priorities, the
+logical step clock) — given the same interleaving of submissions,
+cancellations and steps, admission / preemption / retirement sequences
+and greedy tokens replay identically. Tests drive that interleaving
+synchronously (``start=False`` + :meth:`AsyncServingEngine.step`); the
+background thread runs the very same step function.
+
+On top sits an OpenAI-style HTTP endpoint — ``POST /v1/completions``
+with ``"stream": true`` server-sent events — exposed as ``dscli serve``
+(:func:`serve_main`). Prompts are token-id lists unless a tokenizer
+callable is supplied; completions carry ``token_ids`` (and text when a
+detokenizer is supplied).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+#: terminal handle statuses
+FINISHED, CANCELLED, ERROR, REJECTED = ("finished", "cancelled", "error",
+                                        "rejected")
+
+
+class RequestFailed(RuntimeError):
+    """The serving loop retired this request with an error (pool
+    misconfiguration, loop crash)."""
+
+
+class RequestHandle:
+    """One submitted request's streaming surface. Produced by
+    :meth:`AsyncServingEngine.add_request`; all methods are safe from any
+    thread. ``status`` moves ``pending -> queued/running -> one of
+    finished | cancelled | error | rejected``."""
+
+    def __init__(self, owner: "AsyncServingEngine", prompt: np.ndarray,
+                 max_new: int, eos: Optional[int], priority: int,
+                 ttft_budget: Optional[int]):
+        self._owner = owner
+        self.prompt = prompt
+        self.max_new = max_new
+        self.eos = eos
+        self.priority = priority
+        self.ttft_budget = ttft_budget
+        self.rid: Optional[int] = None     # filled once the loop enqueues it
+        self.status = "pending"
+        self.error: Optional[str] = None
+        self._tokens: List[int] = []
+        self._q: "queue.Queue" = queue.Queue()
+        self._done = threading.Event()
+        self._submit_perf = time.perf_counter()
+        self._submit_ns = time.monotonic_ns()
+
+    # ---- serving-thread side ---- #
+
+    def _push(self, burst: List[int]) -> None:
+        self._tokens.extend(burst)
+        self._q.put(("tokens", burst))
+
+    def _finish(self, status: str, error: Optional[str] = None) -> None:
+        if self._done.is_set():
+            return
+        self.status = status
+        self.error = error
+        self._done.set()
+        self._q.put(("done", status, error))
+
+    # ---- consumer side ---- #
+
+    @property
+    def generated(self) -> List[int]:
+        """Tokens streamed so far (a snapshot copy)."""
+        return list(self._tokens)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def cancel(self) -> None:
+        """Ask the loop to cancel this request (idempotent; a request that
+        already retired keeps its terminal status)."""
+        self._owner._submit_cancel(self)
+
+    def stream(self, timeout: Optional[float] = None):
+        """Iterate token bursts in emission order: each item is a
+        ``list[int]`` — one token per fused decode step, several per
+        accepted speculative verify step. StopIteration on any terminal
+        status except ``error``, which raises :class:`RequestFailed`;
+        ``timeout`` (per burst) raises ``queue.Empty``."""
+        while True:
+            item = self._q.get(timeout=timeout)
+            if item[0] == "tokens":
+                yield item[1]
+                continue
+            _, status, error = item
+            if status == ERROR:
+                raise RequestFailed(error or "request failed")
+            return
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until terminal; the full sequence (prompt + generated —
+        possibly partial for a cancelled request) as 1-D int32. Raises
+        :class:`RequestFailed` on ``error``/``rejected`` status."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.rid} still in flight after "
+                               f"{timeout}s")
+        if self.status in (ERROR, REJECTED):
+            raise RequestFailed(
+                f"request {self.rid} {self.status}: {self.error}")
+        if not self._tokens:
+            return self.prompt.copy()
+        return np.concatenate(
+            [self.prompt, np.asarray(self._tokens, np.int32)])
+
+
+class AsyncServingEngine:
+    """The persistent serving loop: a thread-safe front-end over ONE
+    :class:`~deepspeed_tpu.inference.engine.InferenceEngine` serving
+    session.
+
+    ``policy`` overrides ``engine.config.serving.policy`` (a name, a
+    ``{"name": ..., **kwargs}`` dict, or a
+    :class:`~deepspeed_tpu.inference.policy.SchedulingPolicy` instance).
+    ``start=False`` skips the background thread — the embedder (tests,
+    trace replay) drives :meth:`step` itself for a fully deterministic
+    interleaving of arrivals and engine steps.
+
+    Lifecycle: :meth:`drain` stops intake and serves out the backlog;
+    :meth:`shutdown` drains (or aborts: ``drain=False`` cancels whatever
+    is in flight), stops the thread, and hands the pool workspace back to
+    the engine so a later ``generate_batch`` / loop re-hits the prefix
+    cache. Also a context manager (``with`` = ``shutdown(drain=True)``).
+    """
+
+    def __init__(self, engine, *, max_new_tokens: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 eos_token_id: Optional[int] = None, policy=None,
+                 start: bool = True):
+        from deepspeed_tpu.inference.policy import get_policy
+        self.engine = engine
+        if policy is None:
+            policy = getattr(engine.config.serving, "policy", "fifo")
+        self.policy = get_policy(policy)
+        max_new = (max_new_tokens if max_new_tokens is not None
+                   else engine.config.max_out_tokens)
+        with engine._mesh_scope():
+            self._session = engine.open_serve_session(
+                max_new=max_new, temperature=temperature, top_k=top_k,
+                seed=seed, eos_token_id=eos_token_id, policy=self.policy,
+                on_tokens=self._on_tokens, on_finish=self._on_finish,
+                # results flow through on_finish; an always-on loop must
+                # not accumulate every retired Request forever
+                retain_finished=False)
+        self._handles: Dict[int, RequestHandle] = {}     # rid -> handle
+        self._cv = threading.Condition()
+        self._intake: deque = deque()      # ("submit"|"cancel", handle)
+        self._draining = False
+        self._stop_now = False
+        self._stopped = False
+        self._finalized = False
+        self._n_submitted = 0
+        self.error: Optional[BaseException] = None
+        self._t0 = time.monotonic_ns()
+        ev = engine._events
+        if ev is not None:
+            ev.emit("serve.begin", t_ns=self._t0, requests=0)
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(target=self._run,
+                                            name="ds-serve-loop", daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------------ #
+    # front-end (any thread)
+
+    def add_request(self, prompt, max_new_tokens: Optional[int] = None,
+                    eos_token_id: Optional[int] = None, priority: int = 0,
+                    ttft_budget: Optional[int] = None) -> RequestHandle:
+        """Submit one request; returns immediately with its streaming
+        handle. Raises RuntimeError once the loop is draining/stopped.
+        Admission control (the policy's queue/pool-pressure bounds) is
+        applied on the serving thread — a refused submission terminates
+        the handle with status ``"rejected"`` instead of raising here."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        h = RequestHandle(self, prompt,
+                          max_new=(max_new_tokens if max_new_tokens
+                                   is not None else self._session.max_new),
+                          eos=(eos_token_id if eos_token_id is not None
+                               else self._session.eos_token_id),
+                          priority=int(priority), ttft_budget=ttft_budget)
+        with self._cv:
+            if self._draining or self._stop_now or self._stopped:
+                raise RuntimeError(
+                    "serving loop is draining/stopped; no new requests")
+            self._intake.append(("submit", h))
+            self._n_submitted += 1
+            self._cv.notify_all()
+        return h
+
+    def _submit_cancel(self, h: RequestHandle) -> None:
+        with self._cv:
+            if self._stopped:
+                return               # finalize already terminated every handle
+            self._intake.append(("cancel", h))
+            self._cv.notify_all()
+
+    def drain(self) -> None:
+        """Stop intake; the loop keeps stepping until everything in
+        flight has retired. Non-blocking — pair with :meth:`join` or
+        :meth:`shutdown`."""
+        ev = self.engine._events
+        with self._cv:
+            if not self._draining:
+                self._draining = True
+                if ev is not None:
+                    sched = self._session.sched
+                    ev.emit("serve.drain", waiting=len(sched.waiting),
+                            running=len(sched.running),
+                            pending=len(self._intake))
+            self._cv.notify_all()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for the loop thread to exit (after :meth:`drain` /
+        :meth:`shutdown`). True when it did."""
+        if self._thread is None:
+            return self._stopped
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop the loop. ``drain=True`` serves out the backlog first;
+        ``drain=False`` cancels everything still in flight. Re-raises a
+        loop crash (the handles it failed carry the same message)."""
+        if drain:
+            self.drain()
+        else:
+            with self._cv:
+                self._stop_now = True
+                self._draining = True
+                self._cv.notify_all()
+        if self._thread is not None:
+            if not self.join(timeout):
+                raise TimeoutError("serving loop did not stop in "
+                                   f"{timeout}s")
+        else:
+            # synchronous mode: run the drain out (or abort) inline
+            if drain:
+                while self.step():
+                    pass
+            self._finalize()
+        if self.error is not None:
+            raise RequestFailed(
+                f"serving loop crashed: {self.error!r}") from self.error
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.shutdown(drain=exc_type is None)
+
+    # ------------------------------------------------------------------ #
+    # serving thread
+
+    def _run(self) -> None:
+        try:
+            while True:
+                with self._cv:
+                    while (not self._intake and not self._stop_now
+                           and not self._draining
+                           and self._session.sched.all_done()):
+                        self._cv.wait()      # idle: nothing queued/running
+                if not self._step_once():
+                    break
+        except BaseException as e:  # noqa: BLE001 — loop must fail handles
+            self.error = e
+        finally:
+            self._finalize()
+
+    def step(self) -> bool:
+        """Synchronous single step (``start=False`` mode): drain the
+        intake, then run at most one engine step. Returns False when the
+        loop would exit (drained) or idle (nothing runnable)."""
+        if self._thread is not None:
+            raise RuntimeError("step() is for start=False sessions; the "
+                               "background thread owns this loop")
+        if self._stopped:
+            return False
+        try:
+            alive = self._step_once()
+            if not alive:
+                return False
+            # "alive but idle" reads as False for a synchronous driver
+            return (not self._session.sched.all_done()
+                    or bool(self._intake))
+        except BaseException as e:  # noqa: BLE001
+            self.error = e
+            self._finalize()
+            raise
+
+    def _step_once(self) -> bool:
+        """One loop iteration: commands, exit checks, one engine step.
+        Returns False when the loop should exit."""
+        with self._cv:
+            cmds = list(self._intake)
+            self._intake.clear()
+        for kind, h in cmds:
+            if kind == "submit":
+                self._process_submit(h)
+            else:
+                self._process_cancel(h)
+        if self._stop_now:
+            return False
+        if self._session.sched.all_done():
+            return not self._draining
+        from deepspeed_tpu.inference.scheduler import PoolExhausted
+        try:
+            with self.engine._mesh_scope():
+                self._session.step()
+        except PoolExhausted as e:
+            # one request outgrew the pool with nothing left to evict: the
+            # closed loop fails the whole call, but an always-on server
+            # must not die for everyone — retire the culprit with an error
+            # (its handle reads status "error") and keep serving
+            self._session.sched.fail_request(e.req, str(e))
+            self._session._flush_finished()
+        return True
+
+    def _process_submit(self, h: RequestHandle) -> None:
+        sched = self._session.sched
+        if not self.policy.admit_ok(sched, int(h.prompt.size)):
+            if sched.telemetry is not None:
+                sched.telemetry.rejected_requests.inc()
+            h._finish(REJECTED, "admission control refused the request "
+                                "(queue bound / KV pool pressure)")
+            return
+        try:
+            req = self._session.add(h.prompt, max_new=h.max_new, eos=h.eos,
+                                    priority=h.priority,
+                                    ttft_budget=h.ttft_budget,
+                                    t_submit=h._submit_perf)
+        except (ValueError, TypeError) as e:
+            # oversized prompt / never-admittable: reject THIS handle, the
+            # loop itself stays healthy
+            h._finish(REJECTED, str(e))
+            return
+        h.rid = req.rid
+        h.status = "queued"
+        self._handles[req.rid] = h
+        ev = self.engine._events
+        if ev is not None:
+            # after add_request (the rid is the scheduler's), stamped with
+            # the caller-side submission time: ring order is emit order,
+            # timestamps tell the true story (the validator does not
+            # require monotone ts for exactly this reason)
+            ev.emit("req.submit", rid=req.rid, t_ns=h._submit_ns,
+                    prompt_tokens=int(h.prompt.size), priority=h.priority)
+
+    def _process_cancel(self, h: RequestHandle) -> None:
+        if h.done():
+            return
+        if h.rid is None:
+            # submitted and cancelled inside one intake batch: the submit
+            # was processed first (deque order), so rid is set unless the
+            # submit was rejected — either way nothing is scheduled now
+            h._finish(CANCELLED)
+            return
+        req = self._req_by_rid(h.rid)
+        if req is not None:
+            self._session.cancel(req)   # _on_finish terminates the handle
+        else:
+            h._finish(CANCELLED)
+
+    def _req_by_rid(self, rid: int):
+        sched = self._session.sched
+        for r in list(sched.waiting) + sched.running:
+            if r.rid == rid:
+                return r
+        return None
+
+    # session callbacks (serving thread)
+
+    def _on_tokens(self, req, tokens: List[int]) -> None:
+        h = self._handles.get(req.rid)
+        if h is not None:
+            if h.status == "queued":
+                h.status = "running"
+            h._push(tokens)
+
+    def _on_finish(self, req) -> None:
+        h = self._handles.pop(req.rid, None)
+        if h is None:
+            return
+        if req.cancelled:
+            h._finish(CANCELLED)
+        elif req.error is not None:
+            h._finish(ERROR, req.error)
+        else:
+            h._finish(FINISHED)
+
+    def _finalize(self) -> None:
+        """Terminal bookkeeping (idempotent): fail/cancel whatever is
+        still in flight, close the session (rid uniqueness), and on a
+        clean exit emit ``serve.end`` + hand the pools back."""
+        if self._finalized:
+            return
+        self._finalized = True
+        with self._cv:
+            self._stopped = True
+            leftovers = list(self._intake)
+            self._intake.clear()
+            self._cv.notify_all()
+        msg = (f"serving loop terminated: {self.error!r}"
+               if self.error is not None else None)
+        for kind, h in leftovers:
+            if kind == "submit":
+                h._finish(REJECTED, msg or "serving loop stopped")
+        if self.error is None and not self._session._closed:
+            # aborting shutdown: retire everything still scheduled THROUGH
+            # the scheduler so its KV blocks free and the persistent
+            # allocator stays leak-free for the next session (on_finish
+            # terminates each handle as "cancelled")
+            sched = self._session.sched
+            for r in list(sched.waiting) + list(sched.running):
+                try:
+                    self._session.cancel(r)
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    break
+        for h in list(self._handles.values()):
+            if self.error is not None:
+                h._finish(ERROR, msg)
+            else:
+                h._finish(CANCELLED, "serving loop shut down")
+        self._handles.clear()
+        try:
+            self._session.close()
+            if self.error is None:
+                ev = self.engine._events
+                if ev is not None:
+                    ev.emit("serve.end", t_ns=self._t0,
+                            dur_ns=time.monotonic_ns() - self._t0,
+                            requests=self._n_submitted)
+                self._session.end()
+        except Exception as e:  # noqa: BLE001 — shutdown must not raise
+            if self.error is None:
+                self.error = e
+
+
+# ---------------------------------------------------------------------- #
+# OpenAI-style HTTP front door (``dscli serve``)
+
+
+def _sse(chunk: Dict[str, Any]) -> bytes:
+    return b"data: " + json.dumps(chunk).encode() + b"\n\n"
+
+
+def build_http_server(serving: AsyncServingEngine, host: str = "127.0.0.1",
+                      port: int = 8000,
+                      tokenizer: Optional[Callable[[str], List[int]]] = None,
+                      detokenizer: Optional[Callable[[List[int]], str]]
+                      = None):
+    """An ``http.server`` speaking the OpenAI completions shape over the
+    async engine. ``POST /v1/completions`` accepts::
+
+        {"prompt": [token ids] | "text" (needs a tokenizer),
+         "max_tokens": 16, "stream": false, "priority": 0,
+         "ttft_budget": null, "eos_token_id": null}
+
+    Non-streaming responses return one ``text_completion`` object whose
+    choice carries ``token_ids`` (and ``text`` when a detokenizer is
+    wired). ``"stream": true`` responds ``text/event-stream``: one SSE
+    ``data:`` chunk per emitted burst — speculation's multi-token bursts
+    arrive as multi-id chunks — a final chunk with ``finish_reason``, then
+    ``data: [DONE]``. ``GET /healthz`` reports loop liveness. Returns the
+    (threaded) server; run ``serve_forever()`` on it — every connection
+    handler thread only touches the thread-safe handle API."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    def _ids(body):
+        prompt = body.get("prompt")
+        if isinstance(prompt, str):
+            if tokenizer is None:
+                raise ValueError("string prompts need a tokenizer; POST "
+                                 "token ids: {\"prompt\": [464, 3290, ...]}")
+            prompt = tokenizer(prompt)
+        if (not isinstance(prompt, list) or not prompt
+                or not all(isinstance(t, int) for t in prompt)):
+            raise ValueError("prompt must be a non-empty list of token ids")
+        return prompt
+
+    def _text(ids: List[int]) -> str:
+        return detokenizer(ids) if detokenizer is not None else ""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):   # quiet: dscli owns the console
+            pass
+
+        def _json(self, code: int, obj: Dict[str, Any]) -> None:
+            payload = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                # load balancers key on the STATUS CODE: a stopped or
+                # crashed loop must read unhealthy, not 200-with-caveats
+                dead = serving._stopped or serving.error is not None
+                self._json(503 if dead else 200,
+                           {"status": ("stopped" if dead else
+                                       "draining" if serving._draining
+                                       else "ok"),
+                            "stopped": serving._stopped})
+            else:
+                self._json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/v1/completions":
+                self._json(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n) or b"{}")
+                ids = _ids(body)
+                # every body field coerced INSIDE the 400 path: a garbage
+                # max_tokens/priority/ttft_budget is the client's error,
+                # never a handler traceback (or, worse, a value smuggled
+                # into the scheduling policy's math on the loop thread)
+                max_tokens = int(body.get("max_tokens", 16))
+                if max_tokens < 1:
+                    raise ValueError("max_tokens must be >= 1")
+                priority = int(body.get("priority", 0))
+                ttft_budget = body.get("ttft_budget")
+                if ttft_budget is not None:
+                    ttft_budget = int(ttft_budget)
+                eos = body.get("eos_token_id")
+                if eos is not None:
+                    eos = int(eos)
+            except (ValueError, TypeError) as e:
+                self._json(400, {"error": str(e)})
+                return
+            try:
+                h = serving.add_request(
+                    ids, max_new_tokens=max_tokens, priority=priority,
+                    ttft_budget=ttft_budget, eos_token_id=eos)
+            except RuntimeError as e:   # draining/stopped
+                self._json(503, {"error": str(e)})
+                return
+            rid_name = f"cmpl-{id(h):x}"
+            if body.get("stream"):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.close_connection = True
+                self.end_headers()
+                try:
+                    try:
+                        for burst in h.stream():
+                            self.wfile.write(_sse({
+                                "id": rid_name,
+                                "object": "text_completion",
+                                "choices": [{"index": 0,
+                                             "text": _text(burst),
+                                             "token_ids": burst,
+                                             "finish_reason": None}]}))
+                            self.wfile.flush()
+                        finish = {"finished": "stop"}.get(h.status, h.status)
+                    except RequestFailed as e:
+                        self.wfile.write(_sse({
+                            "id": rid_name, "object": "text_completion",
+                            "error": str(e)}))
+                        finish = "error"
+                    self.wfile.write(_sse({
+                        "id": rid_name, "object": "text_completion",
+                        "choices": [{"index": 0, "text": "",
+                                     "token_ids": [],
+                                     "finish_reason": finish}]}))
+                    self.wfile.write(b"data: [DONE]\n\n")
+                except OSError:
+                    # client went away mid-stream: cancel the request so
+                    # it stops burning decode steps and KV blocks — an
+                    # abandoned stream must not decode to max_new
+                    h.cancel()
+                return
+            try:
+                h.result()
+            except RequestFailed as e:
+                self._json(409 if h.status == REJECTED else 500,
+                           {"error": str(e)})
+                return
+            gen = h.generated
+            self._json(200, {
+                "id": rid_name, "object": "text_completion",
+                "model": type(serving.engine.module).__name__,
+                "choices": [{"index": 0, "text": _text(gen),
+                             "token_ids": gen,
+                             "finish_reason": "stop"
+                             if h.status == FINISHED else h.status}],
+                "usage": {"prompt_tokens": len(ids),
+                          "completion_tokens": len(gen),
+                          "total_tokens": len(ids) + len(gen)}})
+
+    class Server(ThreadingHTTPServer):
+        daemon_threads = True
+        allow_reuse_address = True
+
+    return Server((host, port), Handler)
+
+
+def serve_main(argv=None, model=None, params=None,
+               ready_cb: Optional[Callable] = None) -> int:
+    """``dscli serve`` — stand up the always-on loop behind the HTTP
+    endpoint. ``model``/``params``/``ready_cb`` are injection points for
+    in-process tests (``ready_cb(server, serving)`` fires once the socket
+    is bound; shut the server down from there)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="dscli serve",
+        description="OpenAI-style completions endpoint over the paged "
+                    "continuous-batching engine (token-id prompts)")
+    parser.add_argument("--model", default="gpt2:125m",
+                        help="model zoo preset, e.g. gpt2:125m, llama:tiny")
+    parser.add_argument("--checkpoint", default=None,
+                        help="HF checkpoint dir/file to load weights from "
+                             "(default: random init — smoke serving)")
+    parser.add_argument("--dtype", default="bf16")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8000,
+                        help="TCP port (0 = ephemeral, printed once bound)")
+    parser.add_argument("--max-new", type=int, default=128,
+                        help="default max_tokens when a request omits it")
+    parser.add_argument("--policy", default=None,
+                        help="scheduling policy: fifo | priority | sla "
+                             "(default: config serving.policy)")
+    parser.add_argument("--block-size", type=int, default=128)
+    parser.add_argument("--max-running", type=int, default=8)
+    parser.add_argument("--max-blocks", type=int, default=0)
+    parser.add_argument("--spec", default="off",
+                        help="speculative decoding: off | ngram")
+    parser.add_argument("--telemetry", action="store_true",
+                        help="enable telemetry + flight recorder (the "
+                             "serving trace / dscli health surfaces)")
+    args = parser.parse_args(argv)
+
+    import deepspeed_tpu
+
+    if model is None:
+        from deepspeed_tpu.models.presets import get_model
+        name, _, size = args.model.partition(":")
+        model = get_model(name, *([size] if size else []))
+    serving_cfg = {"block_size": args.block_size,
+                   "max_running": args.max_running,
+                   "max_num_blocks": args.max_blocks,
+                   "speculative": {"mode": args.spec}}
+    if args.policy is not None:
+        serving_cfg["policy"] = args.policy
+    kwargs: Dict[str, Any] = {"dtype": args.dtype, "serving": serving_cfg}
+    if args.telemetry:
+        kwargs["telemetry"] = {"events": True}
+    if args.checkpoint:
+        kwargs["checkpoint"] = args.checkpoint
+    engine = deepspeed_tpu.init_inference(model, params=params, **kwargs)
+
+    serving = AsyncServingEngine(engine, max_new_tokens=args.max_new)
+    server = build_http_server(serving, host=args.host, port=args.port)
+    host, port = server.server_address[:2]
+    print(f"dscli serve: {args.model} listening on "
+          f"http://{host}:{port}/v1/completions "
+          f"(policy={serving.policy.name}, max_running={args.max_running})",
+          flush=True)
+    if ready_cb is not None:
+        ready_cb(server, serving)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        try:
+            serving.shutdown(drain=True, timeout=60)
+        except Exception as e:  # noqa: BLE001 — exit path
+            print(f"dscli serve: shutdown error: {e}")
+            return 1
+    return 0

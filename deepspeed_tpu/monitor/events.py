@@ -5,10 +5,10 @@ detectors); this module records *what happened when*: a ring buffer of
 structured events with monotonic-ns timestamps and request/step identity,
 emitted by the training engine (step / phase / checkpoint phases / fp16
 skip), the continuous-batching scheduler (enqueue / admit / cache hit /
-preempt / retire, speculative propose / rollback), the inference engine
-(prefill, prefill chunk, COW copy, fused decode tick, speculative
-verify), and the crash-safe checkpoint writer
-(snapshot / serialize / commit / retry). The buffer keeps the newest
+preempt / retire / cancel, speculative propose / rollback), the inference
+engine (prefill, prefill chunk, COW copy, fused decode tick, speculative
+verify), the async serving front-end (submit / drain), and the crash-safe
+checkpoint writer (snapshot / serialize / commit / retry). The buffer keeps the newest
 ``capacity`` events (a flight recorder preserves the TAIL — the moments
 before the incident), counting evictions in ``dropped``.
 
@@ -57,8 +57,12 @@ EVENT_KINDS = frozenset({
     "ckpt.commit",          # atomic rename + dir fsync (dur_ns=, tag=, bytes=)
     "ckpt.retry",           # transient I/O fault retried (what=, attempt=, error=)
     # serving: scheduler state machine (rid= identity)
+    "req.submit",           # async front-end accepted a submission
+    #                         (prompt_tokens=, priority=; ts = caller-side
+    #                         submit time, may precede ring neighbors)
     "req.enqueue",          # add_request (prompt_tokens=, max_new=)
     "req.admit",            # admission (cached_tokens=, blocks=)
+    "req.cancel",           # cancellation retired the request (generated=)
     "req.cache_hit",        # admission prefix-cache probe hit (tokens=)
     "req.cache_miss",       # admission prefix-cache probe miss
     "req.preempt",          # recompute-preemption (blocks=, recompute_tokens=)
@@ -72,8 +76,10 @@ EVENT_KINDS = frozenset({
     "req.spec_propose",     # host n-gram proposal (tokens=, found=)
     "req.spec_verify",      # fused verify step slice (window=, accepted=)
     "req.spec_rollback",    # rejection rewound pos (rejected=, unregistered=)
-    "serve.begin",          # generate_batch entry (requests=)
-    "serve.end",            # generate_batch span (dur_ns=, requests=)
+    "serve.begin",          # generate_batch / async-loop entry (requests=)
+    "serve.end",            # serve span (dur_ns=, requests=)
+    "serve.drain",          # async loop stopped intake (waiting=,
+    #                         running=, pending=)
     # scheduler occupancy sample (the counter-track source)
     "sched.gauge",          # queued=, running=, kv_used=, kv_free=
 })
@@ -227,8 +233,10 @@ _CHILD_SLICES = {"req.prefill": "prefill", "req.prefill_chunk": "prefill_chunk",
                  "req.spec_propose": "spec_propose",
                  "req.spec_verify": "spec_verify"}
 #: request-track instants
-_INSTANTS = {"req.enqueue": "enqueue", "req.cache_hit": "cache_hit",
+_INSTANTS = {"req.enqueue": "enqueue", "req.submit": "submit",
+             "req.cache_hit": "cache_hit",
              "req.cache_miss": "cache_miss", "req.preempt": "preempt",
+             "req.cancel": "cancel",
              "req.spec_rollback": "spec_rollback"}
 
 
@@ -274,8 +282,12 @@ def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
             meta["prompt_tokens"] = (e.data or {}).get("prompt_tokens")
         elif e.kind == "req.preempt":
             meta["preemptions"] += 1
-        elif e.kind == "req.retire":
+        elif e.kind in ("req.retire", "req.cancel"):
+            # cancellation ends the request's lifetime exactly like a
+            # retirement: the span closes at the cancel instant
             retires[rid] = e
+            if e.kind == "req.cancel":
+                meta["cancelled"] = True
 
     for rid in sorted(admits):
         out.append({"ph": "M", "name": "thread_name", "pid": _SERVING_PID,
@@ -327,6 +339,10 @@ def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
                         "ph": "X", "pid": _ENGINE_PID, "tid": _ENGINE_TID,
                         "ts": us(e.ts_ns), "dur": (e.dur_ns or 0) / 1e3,
                         "args": dict(e.data or {})})
+        elif e.kind == "serve.drain":
+            out.append({"name": "drain", "cat": "serving", "ph": "i",
+                        "s": "p", "pid": _ENGINE_PID, "tid": _ENGINE_TID,
+                        "ts": us(e.ts_ns), "args": dict(e.data or {})})
 
     out.append({"ph": "M", "name": "process_name", "pid": _SERVING_PID,
                 "args": {"name": "serving requests"}})

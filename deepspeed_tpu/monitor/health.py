@@ -753,6 +753,10 @@ def render_summary_table(s: Dict[str, Any]) -> str:
         tpot = serving.get("tpot_ms")
         if tpot:
             parts.append(f"TPOT p50 {tpot['p50']:.2f}ms")
+        qw = serving.get("queue_wait_ms")
+        if qw:
+            # submit->admit wait: the async loop's queueing-delay readout
+            parts.append(f"wait p50 {qw['p50']:.1f}ms")
         if "queue_depth" in serving:
             parts.append(f"queue {int(serving['queue_depth'])}")
         if "running" in serving:
@@ -790,6 +794,9 @@ def render_summary_table(s: Dict[str, Any]) -> str:
             parts.append(line)
         if "preemptions" in serving:
             parts.append(f"preempt {int(serving['preemptions'])}")
+        if serving.get("rejected_requests"):
+            # admission control is turning traffic away: pool pressure
+            parts.append(f"rejected {int(serving['rejected_requests'])}")
         if parts:
             lines.append("serving  " + "   ".join(parts))
 
@@ -872,7 +879,8 @@ def health_summary(rec: Dict, prev: Optional[Dict] = None) -> Dict[str, Any]:
 
     serving: Dict[str, Any] = {}
     for key, name in (("serving/ttft_ms", "ttft_ms"),
-                      ("serving/tpot_ms", "tpot_ms")):
+                      ("serving/tpot_ms", "tpot_ms"),
+                      ("serving/queue_wait_ms", "queue_wait_ms")):
         if h.get(key, {}).get("count"):
             serving[name] = h[key]
     for key, name in (("serving/queue_depth", "queue_depth"),
@@ -893,7 +901,8 @@ def health_summary(rec: Dict, prev: Optional[Dict] = None) -> Dict[str, Any]:
                       ("serving/spec_proposed_tokens", "spec_proposed_tokens"),
                       ("serving/spec_accepted_tokens", "spec_accepted_tokens"),
                       ("serving/spec_rollbacks", "spec_rollbacks"),
-                      ("serving/preemptions", "preemptions")):
+                      ("serving/preemptions", "preemptions"),
+                      ("serving/rejected_requests", "rejected_requests")):
         if key in c:
             serving[name] = c[key]
     if serving:

@@ -1,0 +1,893 @@
+"""Always-on async serving front-end: pluggable scheduling policies
+(FIFO / priority / SLA-slack, admission control) pinned deterministic on
+fixed request traces, request cancellation at every lifecycle point, THE
+acceptance pin — a loop serving interleaved arrivals (requests added
+while others are mid-decode, mixed priorities, one cancellation) yields
+per-request tokens greedy-identical to per-request ``generate_batch``,
+with streaming callbacks receiving speculation's multi-token bursts in
+order — the ``serving_async_steady`` compile-budget contract (the open
+loop reuses the closed loop's programs), the new flight-recorder
+lifecycle edges (``req.submit`` / ``req.cancel`` / ``serve.drain``)
+through ``export_serving_trace`` and ``tools/validate_trace.py``, the
+``serving/queue_wait_ms`` + ``serving/rejected_requests`` telemetry
+surfaces, and ``dscli serve`` answering a streamed SSE completion
+end-to-end against an in-process HTTP client."""
+
+import http.client
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.inference.block_allocator import BlockAllocator
+from deepspeed_tpu.inference.policy import (FifoPolicy, PriorityPolicy,
+                                            SchedulingPolicy, SlaPolicy,
+                                            get_policy)
+from deepspeed_tpu.inference.scheduler import (FINISHED, QUEUED,
+                                               ContinuousBatchingScheduler)
+from deepspeed_tpu.inference.serve import (AsyncServingEngine, RequestFailed,
+                                           build_http_server, serve_main)
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.models.transformer import TransformerConfig
+
+_TOOLS = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                      "..", "..", "tools"))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+_VT_PATH = Path(__file__).resolve().parents[2] / "tools" / "validate_trace.py"
+_spec = importlib.util.spec_from_file_location("validate_trace", _VT_PATH)
+validate_trace = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(validate_trace)
+
+
+@pytest.fixture(autouse=True)
+def clean_mesh():
+    dist.set_mesh(None)
+    yield
+    dist.set_mesh(None)
+
+
+def tiny_model(**over):
+    base = dict(vocab_size=64, n_layer=2, n_head=4, d_model=32, d_ff=64,
+                max_seq=64, remat=False)
+    base.update(over)
+    return CausalLM(TransformerConfig(**base))
+
+
+def _prompts(lens=(5, 11, 3, 8), vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, size=n).astype(np.int32) for n in lens]
+
+
+def _drive(serving):
+    """Run a start=False loop dry: deterministic synchronous stepping."""
+    while serving.step():
+        pass
+
+
+# --------------------------------------------------------------------- #
+# policy objects
+
+
+class TestPolicyFactory:
+
+    def test_forms(self):
+        assert isinstance(get_policy(None), FifoPolicy)
+        assert isinstance(get_policy("priority"), PriorityPolicy)
+        p = get_policy({"name": "sla", "default_ttft_budget": 7,
+                        "admission_max_queue": 3})
+        assert isinstance(p, SlaPolicy)
+        assert p.default_ttft_budget == 7 and p.admission_max_queue == 3
+        inst = SlaPolicy()
+        assert get_policy(inst) is inst
+
+    def test_bad_specs(self):
+        with pytest.raises(ValueError, match="unknown scheduling policy"):
+            get_policy("edf")
+        with pytest.raises(ValueError, match="bad arguments"):
+            get_policy({"name": "fifo", "nope": 1})
+        with pytest.raises(ValueError, match=">= 0"):
+            SchedulingPolicy(admission_max_queue=-1)
+
+    def test_admission_control_knobs(self):
+        s = ContinuousBatchingScheduler(BlockAllocator(9, 8), 2, 8)
+        pol = SchedulingPolicy(admission_max_queue=1)
+        assert pol.admit_ok(s, 4)
+        s.add_request([1] * 4, max_new=2)
+        s.add_request([1] * 4, max_new=2)      # slots free, queue depth 2
+        s.add_request([1] * 4, max_new=2)
+        assert not pol.admit_ok(s, 4)
+        pool = SchedulingPolicy(admission_min_free_blocks=9)
+        assert not pool.admit_ok(s, 4)         # only 8 allocatable blocks
+        assert SchedulingPolicy().admit_ok(s, 4)   # knobs off = always yes
+
+
+class TestPolicyScheduling:
+
+    def _sched(self, policy, num_blocks=9, block_size=8, max_running=2,
+               chunk_tokens=0):
+        return ContinuousBatchingScheduler(
+            BlockAllocator(num_blocks, block_size), max_running, 8,
+            chunk_tokens=chunk_tokens, policy=policy)
+
+    def test_priority_admission_order(self):
+        s = self._sched(PriorityPolicy(), max_running=1)
+        r_lo = s.add_request([1] * 4, max_new=2, priority=0)
+        r_hi = s.add_request([2] * 4, max_new=2, priority=5)
+        r_mid = s.add_request([3] * 4, max_new=2, priority=1)
+        kind, first = s.next_action()
+        assert (kind, first) == ("prefill", r_hi)
+        s.record_prefill(r_hi, 9)
+        s.record_decode(r_hi, 9)   # max_new=2 -> retires, frees the slot
+        assert r_hi.state == FINISHED
+        assert s.next_action()[1] is r_mid
+        s.record_prefill(r_mid, 9)
+        s.record_decode(r_mid, 9)
+        assert s.next_action()[1] is r_lo
+
+    def test_priority_ties_are_fifo(self):
+        s = self._sched(PriorityPolicy(), max_running=1)
+        first = s.add_request([1] * 4, max_new=2, priority=3)
+        s.add_request([2] * 4, max_new=2, priority=3)
+        assert s.next_action()[1] is first    # equal class: earliest wins
+
+    def test_priority_victim_is_lowest_class(self):
+        # pool: 4 allocatable blocks of 4; two 8-token prompts fill it.
+        # r0 (low class) admits BEFORE r1 (high class) even exists, so
+        # FIFO would evict the latest-admitted r1 — priority must evict
+        # the LOWEST class r0 despite its earlier admission.
+        s = self._sched(PriorityPolicy(), num_blocks=5, block_size=4)
+        r0 = s.add_request([1] * 8, max_new=8, priority=0)
+        kind, r = s.next_action()
+        assert r is r0
+        s.record_prefill(r0, 5)
+        r1 = s.add_request([2] * 8, max_new=8, priority=5)
+        kind, r = s.next_action()
+        assert r is r1
+        s.record_prefill(r1, 5)
+        kind, batch = s.next_action()
+        assert kind == "decode" and batch == [r1]
+        assert r0.state == QUEUED and r0.preemptions == 1
+        assert r1.state == "running" and r1.preemptions == 0
+
+    def test_sla_victim_is_most_slack(self):
+        """THE SLA eviction pin: a fixed trace where the FIFO victim and
+        the SLA victim differ. r0 has met its TTFT (slack = +inf); r1 is
+        mid-prefill on a tight budget (negative slack). FIFO evicts the
+        latest-admitted r1; SLA evicts r0 — the request that can best
+        afford the recompute. Both choices are deterministic."""
+        def run(policy):
+            s = self._sched(policy, num_blocks=6, block_size=4,
+                            chunk_tokens=4)
+            # r0 carries the TIGHT budget so SLA's EDF admission still
+            # takes it first (same trace as FIFO); once its first token
+            # lands its slack is +inf regardless of the budget
+            r0 = s.add_request([1] * 7, max_new=8, ttft_budget=1)
+            r1 = s.add_request([2] * 12, max_new=8, ttft_budget=100)
+            k, r = s.next_action()                   # admit r0, chunk 1
+            assert (k, r) == ("prefill_chunk", r0)
+            s.record_prefill_chunk(r0, 4)
+            k, r = s.next_action()                   # admit r1, chunk 1
+            assert (k, r) == ("prefill_chunk", r1)
+            s.record_prefill_chunk(r1, 4)
+            k, r = s.next_action()                   # r0 final chunk
+            assert (k, r) == ("prefill_chunk", r0)
+            s.record_prefill_chunk(r0, 3, 9)         # r0 first token
+            k, batch = s.next_action()               # decode r0 (pos 7->8)
+            assert k == "decode" and batch == [r0]
+            s.record_decode(r0, 9)
+            k, r = s.next_action()                   # r1 chunk 2
+            assert (k, r) == ("prefill_chunk", r1)
+            s.record_prefill_chunk(r1, 4)
+            # next decode: r0 needs a 3rd block, the pool is dry -> evict
+            action = s.next_action()
+            return s, r0, r1, action
+
+        s, r0, r1, action = run(FifoPolicy())
+        assert r1.state == QUEUED and r1.preemptions == 1   # latest admitted
+        assert r0.state == "running" and action[0] == "decode"
+
+        s, r0, r1, action = run(SlaPolicy())
+        assert r0.state == QUEUED and r0.preemptions == 1   # most slack
+        assert r1.state == "running"
+
+    def test_sla_without_budgets_matches_fifo(self):
+        # no ttft_budget anywhere: every slack is +inf, every tie-break is
+        # the FIFO rule — the two policies must make identical choices
+        def run(policy):
+            s = self._sched(policy, num_blocks=5, block_size=4)
+            r0 = s.add_request([1] * 8, max_new=8)
+            r1 = s.add_request([2] * 8, max_new=8)
+            for r in (r0, r1):
+                s.next_action()
+                s.record_prefill(r, 5)
+            s.next_action()
+            return r0.state, r1.state, r1.preemptions
+
+        assert run(FifoPolicy()) == run(SlaPolicy())
+
+    def test_sla_admission_is_edf(self):
+        s = self._sched(SlaPolicy(), max_running=1)
+        loose = s.add_request([1] * 4, max_new=2, ttft_budget=50)
+        tight = s.add_request([2] * 4, max_new=2, ttft_budget=2)
+        assert s.next_action()[1] is tight     # least slack admits first
+        assert loose.state == QUEUED
+
+    def test_bogus_policy_selection_raises(self):
+        class Broken(SchedulingPolicy):
+            def select_admission(self, sched):
+                return 99
+        s = self._sched(Broken())
+        s.add_request([1] * 4, max_new=2)
+        with pytest.raises(ValueError, match="out of range"):
+            s.next_action()
+
+
+# --------------------------------------------------------------------- #
+# scheduler cancellation
+
+
+class TestSchedulerCancel:
+
+    def test_cancel_queued(self):
+        s = ContinuousBatchingScheduler(BlockAllocator(9, 8), 1, 8)
+        r0 = s.add_request([1] * 4, max_new=4)
+        r1 = s.add_request([2] * 4, max_new=4)
+        assert s.cancel_request(r1)
+        assert r1.state == FINISHED and r1.cancelled and not r1.blocks
+        assert list(s.waiting) == [r0] and r1 in s.finished
+        s.next_action()
+        s.record_prefill(r0, 9)
+        assert s.cancel_request(r0)   # running: blocks freed, slot empty
+        assert s.allocator.num_used == 0 and s.all_done()
+
+    def test_cancel_finished_is_noop(self):
+        s = ContinuousBatchingScheduler(BlockAllocator(9, 8), 1, 8)
+        r = s.add_request([1] * 4, max_new=1)
+        s.next_action()
+        s.record_prefill(r, 9)
+        assert r.state == FINISHED
+        assert not s.cancel_request(r)
+        assert not r.cancelled         # terminal status untouched
+
+    def test_cancel_mid_batch_keeps_others_decoding(self):
+        s = ContinuousBatchingScheduler(BlockAllocator(9, 8), 2, 8)
+        r0 = s.add_request([1] * 4, max_new=8)
+        r1 = s.add_request([2] * 4, max_new=8)
+        for r in (r0, r1):
+            s.next_action()
+            s.record_prefill(r, 5)
+        s.cancel_request(r0)
+        kind, batch = s.next_action()
+        assert kind == "decode" and batch == [r1]
+
+
+# --------------------------------------------------------------------- #
+# the async engine, driven synchronously (start=False): deterministic
+# interleaving of arrivals / cancellations / engine steps
+
+
+class TestAsyncServing:
+
+    def _engine(self, **serving):
+        cfg = {"block_size": 8, "max_running": 2}
+        cfg.update(serving)
+        return deepspeed_tpu.init_inference(tiny_model(), dtype="fp32",
+                                            serving=cfg)
+
+    def test_interleaved_arrivals_greedy_identity(self):
+        """THE acceptance pin: requests added while others are mid-decode,
+        mixed priorities, one cancellation — every completed request's
+        tokens are greedy-identical to its own closed-loop serve, and
+        every handle's streamed bursts concatenate to exactly its
+        generated tokens, in order."""
+        engine = self._engine(policy="priority")
+        prompts = _prompts((5, 11, 3, 8))
+        refs = [np.asarray(engine.generate(p[None, :], max_new_tokens=8))[0]
+                for p in prompts]
+
+        serving = AsyncServingEngine(engine, max_new_tokens=8, start=False)
+        bursts = {}
+
+        def collect(h):
+            bursts[h] = []
+            for b in h.stream(timeout=0):
+                bursts[h].append(b)
+
+        h0 = serving.add_request(prompts[0])
+        h1 = serving.add_request(prompts[1])
+        for _ in range(5):
+            serving.step()                      # h0/h1 mid-decode
+        h2 = serving.add_request(prompts[2], priority=5)   # jumps the queue
+        h3 = serving.add_request(prompts[3], priority=1)
+        for _ in range(3):
+            serving.step()
+        h3.cancel()                             # cancelled while queued
+        _drive(serving)
+        serving.shutdown(drain=True)
+
+        for h in (h0, h1, h2):
+            assert h.status == "finished"
+            collect(h)
+        assert h3.status == "cancelled"
+        for h, ref in ((h0, refs[0]), (h1, refs[1]), (h2, refs[2])):
+            np.testing.assert_array_equal(np.asarray(h.result(1)), ref)
+            streamed = [t for b in bursts[h] for t in b]
+            assert streamed == h.generated     # burst order == emission
+
+    def test_streaming_carries_spec_bursts(self):
+        """Speculation's verified multi-token steps must arrive as
+        multi-token bursts on the stream, in order."""
+        engine = self._engine(speculative={"mode": "ngram", "k": 4})
+        rng = np.random.default_rng(1)
+        motif = rng.integers(0, 8, size=8).astype(np.int32)
+        prompt = np.tile(motif, 3)
+        ref = np.asarray(engine.generate(prompt[None, :],
+                                         max_new_tokens=16))[0]
+
+        serving = AsyncServingEngine(engine, max_new_tokens=16, start=False)
+        h = serving.add_request(prompt)
+        _drive(serving)
+        serving.shutdown(drain=True)
+        got = list(h.stream(timeout=0))
+        assert any(len(b) > 1 for b in got), \
+            "no multi-token burst despite speculation on"
+        np.testing.assert_array_equal(np.asarray(h.result(1)), ref)
+        assert [t for b in got for t in b] == h.generated
+        assert engine._last_serve_stats["spec_accepted"] > 0
+
+    def test_trace_replay_is_deterministic_and_cross_policy(self):
+        """The pinned request trace replays identically: the same
+        admission / preemption / retirement / cancellation sequence and
+        the same greedy tokens across runs — and across policies on a
+        trace that declares no priorities or budgets (their tie-breaks
+        ARE the FIFO rules)."""
+        from deepspeed_tpu.monitor.events import get_flight_recorder
+
+        def run(policy):
+            get_flight_recorder().clear()
+            engine = deepspeed_tpu.init_inference(
+                tiny_model(), dtype="fp32", telemetry={"events": True},
+                serving={"block_size": 8, "max_running": 2,
+                         "max_num_blocks": 5, "policy": policy})
+            serving = AsyncServingEngine(engine, max_new_tokens=10,
+                                         start=False)
+            prompts = _prompts((5, 11, 7))
+            h0 = serving.add_request(prompts[0])
+            h1 = serving.add_request(prompts[1])
+            for _ in range(4):
+                serving.step()
+            h2 = serving.add_request(prompts[2])
+            for _ in range(2):
+                serving.step()
+            h0.cancel()     # frees its blocks; r1 + r2 then contend for
+            # the 4-block pool (3 + 3 blocks at full length -> preemption)
+            _drive(serving)
+            serving.shutdown(drain=True)
+            seq = [(e.kind, e.rid) for e in engine._events.snapshot()
+                   if e.kind in ("req.submit", "req.admit", "req.preempt",
+                                 "req.retire", "req.cancel", "serve.drain")]
+            toks = [h.generated for h in (h0, h1, h2)]
+            return seq, toks
+
+        seq_a, toks_a = run("fifo")
+        seq_b, toks_b = run("fifo")
+        assert seq_a == seq_b and toks_a == toks_b     # replay identical
+        seq_c, toks_c = run("sla")                     # no budgets: agrees
+        assert seq_c == seq_a and toks_c == toks_a
+        assert any(k == "req.preempt" for k, _ in seq_a), \
+            "trace never exercised preemption (pool too large?)"
+        assert any(k == "req.cancel" for k, _ in seq_a)
+
+    def test_admission_control_rejects_under_pressure(self):
+        engine = self._engine()
+        serving = AsyncServingEngine(
+            engine, max_new_tokens=4, start=False,
+            policy={"name": "fifo", "admission_max_queue": 1})
+        hs = [serving.add_request(p) for p in _prompts((5, 5, 5, 5, 5))]
+        serving.step()        # intake processed: queue bound kicks in
+        rejected = [h for h in hs if h.status == "rejected"]
+        assert rejected, "admission control never rejected"
+        with pytest.raises(RequestFailed, match="admission control"):
+            rejected[0].result(1)
+        _drive(serving)
+        serving.shutdown(drain=True)
+        assert all(h.status == "finished" for h in hs
+                   if h not in rejected)
+
+    def test_oversized_prompt_rejects_handle_not_loop(self):
+        engine = self._engine()
+        serving = AsyncServingEngine(engine, max_new_tokens=4, start=False)
+        bad = serving.add_request(np.ones(80, np.int32))   # > max_seq
+        zero = serving.add_request(_prompts((5,))[0], max_new_tokens=0)
+        ok = serving.add_request(_prompts((5,))[0])
+        _drive(serving)
+        serving.shutdown(drain=True)
+        assert bad.status == "rejected" and "max_seq" in bad.error
+        # a per-request 0 must not emit the prefill-sampled token anyway
+        assert zero.status == "rejected" and ">= 1" in zero.error
+        assert ok.status == "finished"
+
+    def test_generate_batch_guarded_while_loop_active(self):
+        engine = self._engine()
+        serving = AsyncServingEngine(engine, max_new_tokens=4, start=False)
+        with pytest.raises(RuntimeError, match="active"):
+            engine.generate_batch(_prompts((4,)), max_new_tokens=2)
+        serving.shutdown(drain=True)
+        engine.generate_batch(_prompts((4,)), max_new_tokens=2)  # ok now
+
+    def test_shutdown_without_drain_cancels_in_flight(self):
+        engine = self._engine()
+        serving = AsyncServingEngine(engine, max_new_tokens=8, start=False)
+        hs = [serving.add_request(p) for p in _prompts((5, 11))]
+        for _ in range(3):
+            serving.step()
+        serving.shutdown(drain=False)
+        assert all(h.done() for h in hs)
+        assert {h.status for h in hs} == {"cancelled"}
+        # the engine is reusable: the session closed cleanly
+        engine.generate_batch(_prompts((4,)), max_new_tokens=2)
+
+    def test_pool_exhaustion_fails_request_not_loop(self):
+        """One request outgrowing an exhausted pool must retire with an
+        error — the closed loop's PoolExhausted raise must NOT take the
+        always-on loop (and every other request) down with it."""
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32",
+            serving={"block_size": 8, "max_running": 2,
+                     "max_num_blocks": 3})     # 2 allocatable = 16 slots
+        serving = AsyncServingEngine(engine, max_new_tokens=30, start=False)
+        big = serving.add_request(np.arange(1, 9, dtype=np.int32))
+        _drive(serving)                        # grows past 16 slots alone
+        assert big.status == "error"
+        with pytest.raises(RequestFailed, match="max_num_blocks"):
+            big.result(1)
+        assert serving.error is None           # the LOOP survived
+        ok = serving.add_request(np.arange(1, 5, dtype=np.int32),
+                                 max_new_tokens=4)
+        _drive(serving)
+        serving.shutdown(drain=True)
+        assert ok.status == "finished" and len(ok.generated) == 4
+
+    def test_open_loop_trims_finished_requests(self):
+        """An always-on loop must not retain every retired Request
+        forever: results flow through the handles, so the scheduler's
+        finished list stays empty after each flush."""
+        engine = self._engine()
+        serving = AsyncServingEngine(engine, max_new_tokens=4, start=False)
+        hs = [serving.add_request(p) for p in _prompts((5, 11, 3))]
+        _drive(serving)
+        assert all(h.status == "finished" for h in hs)
+        assert serving._session.sched.finished == []
+        assert serving._handles == {}
+        serving.shutdown(drain=True)
+
+    def test_add_after_drain_raises(self):
+        engine = self._engine()
+        serving = AsyncServingEngine(engine, max_new_tokens=4, start=False)
+        serving.drain()
+        with pytest.raises(RuntimeError, match="draining"):
+            serving.add_request(_prompts((5,))[0])
+        serving.shutdown(drain=True)
+
+    def test_per_request_max_new_and_eos(self):
+        engine = self._engine()
+        free = engine.generate_batch(_prompts((5,)), max_new_tokens=8)
+        eos = int(np.asarray(free[0])[5])      # a token really emitted
+        serving = AsyncServingEngine(engine, max_new_tokens=8, start=False)
+        h_short = serving.add_request(_prompts((5,))[0], max_new_tokens=3)
+        h_eos = serving.add_request(_prompts((5,))[0], eos_token_id=eos)
+        _drive(serving)
+        serving.shutdown(drain=True)
+        assert len(h_short.generated) == 3
+        assert h_eos.generated[0] == eos and len(h_eos.generated) == 1
+
+
+# --------------------------------------------------------------------- #
+# the background thread: same loop, real concurrency
+
+
+class TestAsyncThreaded:
+
+    def test_threaded_end_to_end(self):
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32",
+            serving={"block_size": 8, "max_running": 2})
+        prompts = _prompts((5, 11, 3))
+        refs = [np.asarray(engine.generate(p[None, :], max_new_tokens=8))[0]
+                for p in prompts]
+        with AsyncServingEngine(engine, max_new_tokens=8) as serving:
+            hs = [serving.add_request(p) for p in prompts]
+            outs = [h.result(timeout=120) for h in hs]
+        for o, ref in zip(outs, refs):
+            np.testing.assert_array_equal(np.asarray(o), ref)
+        assert serving._stopped and serving.error is None
+
+    def test_threaded_cancel_mid_flight(self):
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32",
+            serving={"block_size": 8, "max_running": 2})
+        p_long, p_short = _prompts((6, 4))
+        ref_short = np.asarray(engine.generate(p_short[None, :],
+                                               max_new_tokens=8))[0]
+        serving = AsyncServingEngine(engine, max_new_tokens=40)
+        h_long = serving.add_request(p_long)
+        h_short = serving.add_request(p_short, max_new_tokens=8)
+        for _ in h_short.stream(timeout=120):
+            pass                      # short one finished; long mid-decode
+        h_long.cancel()
+        serving.shutdown(drain=True, timeout=120)
+        assert h_long.status == "cancelled"
+        assert 0 < len(h_long.generated) < 40   # partial progress kept
+        np.testing.assert_array_equal(np.asarray(h_short.result(1)),
+                                      ref_short)
+
+    def test_mesh_override_is_thread_local_unit(self):
+        from deepspeed_tpu.comm.mesh import build_mesh
+        a, b = build_mesh({"dp": 8}), build_mesh({"dp": 8})
+        dist.set_mesh(a)
+        seen = {}
+        with dist.mesh_override(b):
+            assert dist.get_mesh() is b and dist.has_mesh()
+            with dist.mesh_override(a):       # re-entrant: a stack
+                assert dist.get_mesh() is a
+            assert dist.get_mesh() is b
+            t = threading.Thread(
+                target=lambda: seen.setdefault("mesh", dist.get_mesh()))
+            t.start()
+            t.join(30)
+            assert seen["mesh"] is a          # other threads: the global
+        assert dist.get_mesh() is a
+        with pytest.raises(ValueError, match="needs a mesh"):
+            with dist.mesh_override(None):
+                pass
+
+    def test_serving_thread_never_touches_global_mesh(self):
+        """The always-on loop pins ITS mesh as a thread-local override:
+        another thread's view of the framework-global mesh must stay
+        untouched while the loop traces/steps concurrently (the PR-10
+        foreign-mesh bug class, cross-thread)."""
+        from deepspeed_tpu.comm.mesh import build_mesh
+        foreign = build_mesh({"dp": 8})
+        dist.set_mesh(foreign)                 # e.g. a training run's mesh
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32",
+            serving={"block_size": 8, "max_running": 2, "tp": 2})
+        assert engine.mesh is not foreign      # private tp=2 serving mesh
+        serving = AsyncServingEngine(engine, max_new_tokens=8)
+        hs = [serving.add_request(p) for p in _prompts((5, 11))]
+        while not all(h.done() for h in hs):
+            # polled THROUGHOUT the loop's stepping: a global set_mesh in
+            # the serving thread would flip this mid-serve
+            assert dist.get_mesh() is foreign
+            time.sleep(0.01)
+        serving.shutdown(drain=True, timeout=120)
+        assert dist.get_mesh() is foreign
+        assert all(h.status == "finished" for h in hs)
+
+    def test_idle_loop_accepts_late_arrivals(self):
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32",
+            serving={"block_size": 8, "max_running": 2})
+        serving = AsyncServingEngine(engine, max_new_tokens=4)
+        h1 = serving.add_request(_prompts((5,))[0])
+        h1.result(timeout=120)
+        time.sleep(0.2)               # loop goes idle (cv wait)
+        h2 = serving.add_request(_prompts((7,))[0])   # wakes it
+        assert h2.result(timeout=120) is not None
+        serving.shutdown(drain=True, timeout=120)
+        assert h2.status == "finished"
+
+
+# --------------------------------------------------------------------- #
+# flight recorder lifecycle edges + serving trace + telemetry surfaces
+
+
+class TestAsyncObservability:
+
+    def _serve_with_cancel(self):
+        from deepspeed_tpu.monitor.events import get_flight_recorder
+        get_flight_recorder().clear()
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32", telemetry={"events": True},
+            serving={"block_size": 8, "max_running": 2})
+        serving = AsyncServingEngine(engine, max_new_tokens=6, start=False)
+        hs = [serving.add_request(p) for p in _prompts((5, 11, 3))]
+        for _ in range(4):
+            serving.step()
+        hs[1].cancel()
+        serving.drain()
+        _drive(serving)
+        serving.shutdown(drain=True)
+        return engine, serving, hs
+
+    def test_lifecycle_events_emitted(self):
+        engine, serving, hs = self._serve_with_cancel()
+        events = engine._events.snapshot()
+        kinds = [e.kind for e in events]
+        assert kinds.count("req.submit") == 3
+        assert kinds.count("req.cancel") == 1
+        assert kinds.count("serve.drain") == 1
+        assert kinds.count("serve.end") == 1
+        # submit carries the caller-side stamp and identity
+        subs = [e for e in events if e.kind == "req.submit"]
+        assert all(e.rid is not None and e.data["prompt_tokens"] > 0
+                   for e in subs)
+        drain = next(e for e in events if e.kind == "serve.drain")
+        assert set(drain.data) == {"waiting", "running", "pending"}
+        # the cancelled request's lifecycle: submitted, never retired
+        rid_cancel = next(e.rid for e in events if e.kind == "req.cancel")
+        retired = {e.rid for e in events if e.kind == "req.retire"}
+        assert rid_cancel not in retired
+
+    def test_serving_trace_validates_with_cancel_span(self, tmp_path):
+        engine, serving, hs = self._serve_with_cancel()
+        path = str(tmp_path / "async_trace.json")
+        engine.export_serving_trace(path)
+        assert validate_trace.validate_path(path, kind="chrome") == []
+        doc = json.load(open(path))
+        spans = [e for e in doc["traceEvents"]
+                 if e.get("cat") == "request" and e["ph"] == "X"]
+        assert len(spans) == 3          # cancellation CLOSES its span
+        cancelled = [s for s in spans if s["args"].get("cancelled")]
+        assert len(cancelled) == 1 and \
+            not cancelled[0]["args"].get("incomplete")
+        instants = [e["name"] for e in doc["traceEvents"]
+                    if e.get("ph") == "i"]
+        assert "submit" in instants and "cancel" in instants \
+            and "drain" in instants
+
+    def test_events_jsonl_validates_new_kinds(self, tmp_path):
+        engine, serving, hs = self._serve_with_cancel()
+        path = str(tmp_path / "events.jsonl")
+        engine._events.write_jsonl(path)
+        assert validate_trace.validate_path(path, kind="events") == []
+        kinds = {json.loads(l)["kind"] for l in open(path)}
+        assert {"req.submit", "req.cancel", "serve.drain"} <= kinds
+
+    def test_queue_wait_and_rejected_telemetry(self):
+        from deepspeed_tpu.monitor.health import (health_summary,
+                                                  render_summary_table)
+        from deepspeed_tpu.monitor.metrics import get_registry
+        get_registry().reset()
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32", telemetry=True,
+            serving={"block_size": 8, "max_running": 2})
+        serving = AsyncServingEngine(
+            engine, max_new_tokens=4, start=False,
+            policy={"name": "fifo", "admission_max_queue": 2})
+        hs = [serving.add_request(p) for p in _prompts((5, 5, 5, 5, 5, 5))]
+        _drive(serving)
+        serving.shutdown(drain=True)
+        snap = engine.telemetry_snapshot()
+        n_rejected = snap["counters"]["serving/rejected_requests"]
+        assert n_rejected == sum(h.status == "rejected" for h in hs) > 0
+        qw = snap["histograms"]["serving/queue_wait_ms"]
+        # one observation per ADMITTED request (rejected ones never wait)
+        assert qw["count"] == len(hs) - n_rejected
+        s = health_summary(snap)
+        assert s["serving"]["rejected_requests"] == n_rejected
+        assert s["serving"]["queue_wait_ms"]["count"] == qw["count"]
+        table = render_summary_table(s)
+        assert "wait p50" in table and f"rejected {int(n_rejected)}" in table
+
+    def test_queue_wait_not_reobserved_on_preemption(self):
+        from deepspeed_tpu.monitor.metrics import get_registry
+        get_registry().reset()
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32", telemetry=True,
+            serving={"block_size": 8, "max_running": 2,
+                     "max_num_blocks": 5})
+        engine.generate_batch(_prompts((5, 11)), max_new_tokens=10)
+        snap = engine.telemetry_snapshot()
+        assert snap["counters"]["serving/preemptions"] > 0
+        assert snap["histograms"]["serving/queue_wait_ms"]["count"] == 2
+
+
+# --------------------------------------------------------------------- #
+# compile-budget contract: the open loop reuses the closed loop's programs
+
+
+class TestServingAsyncContract:
+
+    @pytest.fixture(autouse=True)
+    def clean_state(self):
+        from deepspeed_tpu.monitor.metrics import get_registry
+        from deepspeed_tpu.monitor.trace import get_compile_watchdog
+        dist.set_mesh(None)
+        get_registry().reset()
+        get_registry().set_enabled(True)
+        get_compile_watchdog().reset()
+        yield
+        dist.set_mesh(None)
+        get_registry().reset()
+        get_registry().set_enabled(True)
+        get_compile_watchdog().reset()
+
+    def test_serving_async_steady_contract(self):
+        """A closed-loop warm-up followed by open-loop traffic —
+        interleaved arrivals, a cache-hit re-submission, speculation, a
+        cancellation — must add ZERO compiles: both front-ends execute
+        through one _ServeSession, so each fused entry stays within the
+        closed loop's budget (decode==1, verify==1, bucketed prefill /
+        chunk), verified through the CompileWatchdog."""
+        from dslint.contracts import check_compile_budgets
+
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32", telemetry=True,
+            serving={"block_size": 8, "max_running": 2,
+                     "speculative": {"mode": "ngram", "k": 4}})
+        rng = np.random.default_rng(0)
+        motif = rng.integers(0, 8, size=8).astype(np.int32)
+        prompts = [np.tile(motif, 3),
+                   rng.integers(0, 64, size=11).astype(np.int32),
+                   rng.integers(0, 64, size=5).astype(np.int32)]
+        engine.generate_batch(prompts, max_new_tokens=12)       # closed loop
+        # second closed-loop serve re-hits the prefix cache, compiling the
+        # cache-hit tail chunk + COW programs the open loop will reuse
+        engine.generate_batch(prompts, max_new_tokens=12)
+        warm = dict(engine.telemetry_snapshot()["compile"]["by_fn"])
+
+        serving = AsyncServingEngine(engine, max_new_tokens=12, start=False)
+        h0 = serving.add_request(prompts[0])     # prefix-cache re-hit + spec
+        for _ in range(3):
+            serving.step()
+        h1 = serving.add_request(prompts[1])     # arrival mid-decode
+        h2 = serving.add_request(prompts[2])
+        for _ in range(3):
+            serving.step()
+        h2.cancel()
+        _drive(serving)
+        serving.shutdown(drain=True)
+        assert h0.status == h1.status == "finished"
+
+        by_fn = engine.telemetry_snapshot()["compile"]["by_fn"]
+        assert by_fn == warm, (
+            f"the open loop recompiled: closed-loop {warm} -> {by_fn}")
+        violations = check_compile_budgets(by_fn, "serving_async_steady",
+                                           strict=True)
+        assert violations == [], "\n".join(violations)
+
+
+# --------------------------------------------------------------------- #
+# dscli serve: streamed completion end-to-end over in-process HTTP
+
+
+class TestServeHTTP:
+
+    @pytest.fixture(scope="class")
+    def served(self):
+        """serve_main (the dscli serve entry) on a background thread with
+        an injected tiny model, bound to an ephemeral port."""
+        dist.set_mesh(None)
+        model = tiny_model()
+        import jax
+        params = model.init_params(jax.random.key(0))
+        ref_engine = deepspeed_tpu.init_inference(
+            model, params=params, dtype="fp32",
+            serving={"block_size": 8, "max_running": 2})
+        holder, ready = {}, threading.Event()
+
+        def cb(server, serving):
+            holder.update(server=server, serving=serving)
+            ready.set()
+
+        t = threading.Thread(
+            target=serve_main,
+            args=(["--port", "0", "--dtype", "fp32", "--max-new", "6",
+                   "--block-size", "8", "--max-running", "2"],),
+            kwargs=dict(model=model, params=params, ready_cb=cb),
+            daemon=True)
+        t.start()
+        assert ready.wait(300), "dscli serve never bound its socket"
+        yield holder["server"].server_address[1], ref_engine
+        holder["server"].shutdown()
+        t.join(120)
+        dist.set_mesh(None)
+
+    def _post(self, port, body, timeout=300):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        conn.request("POST", "/v1/completions", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        return conn.getresponse()
+
+    def test_streamed_completion_end_to_end(self, served):
+        """THE dscli serve pin: a streamed completion over real HTTP,
+        SSE chunk per burst, token-identical to the engine's own greedy
+        decode of the same prompt."""
+        port, ref_engine = served
+        prompt = _prompts((5,))[0]
+        ref = np.asarray(ref_engine.generate(prompt[None, :],
+                                             max_new_tokens=6))[0]
+        r = self._post(port, {"prompt": [int(t) for t in prompt],
+                              "max_tokens": 6, "stream": True})
+        assert r.status == 200
+        assert r.getheader("Content-Type") == "text/event-stream"
+        lines = r.read().decode().splitlines()
+        assert lines[-2:] == ["data: [DONE]", ""] or lines[-1] == "data: [DONE]"
+        chunks = [json.loads(l[len("data: "):]) for l in lines
+                  if l.startswith("data: ") and l != "data: [DONE]"]
+        toks = [t for c in chunks for t in c["choices"][0]["token_ids"]]
+        np.testing.assert_array_equal(np.asarray(toks),
+                                      ref[len(prompt):])
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+        assert all(c["object"] == "text_completion" for c in chunks)
+
+    def test_nonstream_completion_and_usage(self, served):
+        port, ref_engine = served
+        prompt = _prompts((7,))[0]
+        ref = np.asarray(ref_engine.generate(prompt[None, :],
+                                             max_new_tokens=6))[0]
+        r = self._post(port, {"prompt": [int(t) for t in prompt],
+                              "max_tokens": 6})
+        assert r.status == 200
+        body = json.loads(r.read())
+        np.testing.assert_array_equal(
+            np.asarray(body["choices"][0]["token_ids"]), ref[len(prompt):])
+        assert body["usage"] == {"prompt_tokens": 7, "completion_tokens": 6,
+                                 "total_tokens": 13}
+
+    def test_bad_requests(self, served):
+        port, _ = served
+        assert self._post(port, {"prompt": "text"}).status == 400   # no tok
+        assert self._post(port, {"prompt": []}).status == 400
+        # garbage body fields are the CLIENT's error (400), never a
+        # handler traceback — and never a value smuggled into the
+        # scheduling policy's math on the loop thread
+        assert self._post(port, {"prompt": [1, 2],
+                                 "max_tokens": "lots"}).status == 400
+        assert self._post(port, {"prompt": [1, 2],
+                                 "ttft_budget": "fast"}).status == 400
+        assert self._post(port, {"prompt": [1, 2],
+                                 "priority": [3]}).status == 400
+        assert self._post(port, {"prompt": [1, 2],
+                                 "max_tokens": 0}).status == 400
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/healthz")
+        health = json.loads(conn.getresponse().read())
+        assert health["status"] == "ok" and health["stopped"] is False
+        conn2 = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn2.request("POST", "/nope", "{}")
+        assert conn2.getresponse().status == 404
+
+    def test_cli_routes_serve(self):
+        from deepspeed_tpu import cli
+        assert cli._COMMANDS["serve"] is cli._serve
+
+    def test_healthz_503_once_stopped(self):
+        """Load balancers key on the status code: a stopped loop must
+        read 503, not 200-with-caveats."""
+        dist.set_mesh(None)
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32",
+            serving={"block_size": 8, "max_running": 2})
+        serving = AsyncServingEngine(engine, max_new_tokens=4, start=False)
+        server = build_http_server(serving, port=0)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            port = server.server_address[1]
+
+            def health():
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+                conn.request("GET", "/healthz")
+                r = conn.getresponse()
+                return r.status, json.loads(r.read())
+
+            assert health() == (200, {"status": "ok", "stopped": False})
+            serving.shutdown(drain=True)
+            status, body = health()
+            assert status == 503 and body["status"] == "stopped"
+        finally:
+            server.shutdown()
+            t.join(60)

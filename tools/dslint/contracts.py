@@ -40,6 +40,16 @@ class CompileBudget:
 #:   serving_speculative — generate_batch with serving.speculative
 #:                     {mode: ngram} at one fixed k (repetitive prompts,
 #:                     verify + fallback decode steps interleaved)
+#:   serving_async_steady — the ALWAYS-ON serving loop (AsyncServingEngine)
+#:                     fed interleaved arrivals — requests submitted while
+#:                     others are mid-decode, mixed priorities, a
+#:                     cancellation — with prefix cache + speculation on,
+#:                     prompts within two 128-token buckets: THE OPEN LOOP
+#:                     MUST REUSE THE CLOSED LOOP'S PROGRAMS — both run
+#:                     scheduler actions through the same _ServeSession
+#:                     executor, so a generate_batch warm-up followed by
+#:                     any amount of open-loop traffic compiles each fused
+#:                     entry exactly as often as generate_batch alone
 #:   serving_sharded_steady — generate_batch under serving.tp > 1 (head-
 #:                     sharded KV pools, shard_map'd paged kernel), prefix
 #:                     cache + speculation on, prompts within two 128-token
@@ -108,6 +118,29 @@ BUDGETS: List[CompileBudget] = [
         "program per (chunk bucket, table-width power-of-two) pair"),
     CompileBudget(
         "inference.paged_cow", "serving_speculative", 1,
+        "copy-on-write block copy: fixed block geometry"),
+    CompileBudget(
+        "inference.paged_decode", "serving_async_steady", 1,
+        "THE fused decode step is front-end-independent: the open loop "
+        "executes through the same _ServeSession as generate_batch, the "
+        "batch stays fixed-width over max_running slots, positions stay "
+        "traced vectors — arrivals mid-flight must not retrace"),
+    CompileBudget(
+        "inference.paged_verify", "serving_async_steady", 1,
+        "fused verify under the open loop: one program per k window "
+        "bucket (the scenario holds k fixed), same as closed-loop "
+        "speculation"),
+    CompileBudget(
+        "inference.paged_prefill", "serving_async_steady", 2,
+        "admission prefill of open-loop arrivals: one compile per "
+        "128-token prompt bucket, the scenario stays within two"),
+    CompileBudget(
+        "inference.paged_prefill_chunk", "serving_async_steady", 4,
+        "cache-hit tails / chunked prefill of open-loop arrivals: one "
+        "program per (chunk bucket, table-width power-of-two) pair — "
+        "chunk-bucketed exactly like the closed loop"),
+    CompileBudget(
+        "inference.paged_cow", "serving_async_steady", 1,
         "copy-on-write block copy: fixed block geometry"),
     CompileBudget(
         "inference.paged_decode", "serving_sharded_steady", 1,
