@@ -50,6 +50,11 @@ Env knobs (defaults are the chip-measured fast path):
                            off vs on (vs_baseline = off/on TTFT ratio);
                            BENCH_SERVE_REQS=8 BENCH_SERVE_PREFIX_LEN=768
                            BENCH_SERVE_NEW=16
+  BENCH_KV_TIER=1          tiered-KV re-hit probe: shared-prefix TTFT at
+                           forced cache pressure, host spill on vs
+                           destroy-on-reclaim (vs_baseline = off/on);
+                           BENCH_KV_TIER_PREFIX_LEN=512
+                           BENCH_KV_TIER_BLOCKS=24
   BENCH_SERVE_SPEC=1       speculative-decode probe: p50 TPOT on repetitive
                            motif prompts, serving.speculative off vs ngram
                            (vs_baseline = off/on p50 ratio; accepted
@@ -162,7 +167,8 @@ def _telemetry_blob(engine):
     for k in ("train/mfu", "train/tokens_per_sec",
               "train/achieved_tflops_per_chip", "train/data_stall_fraction",
               "serving/queue_depth", "serving/kv_block_utilization",
-              "serving/kv_fragmentation", "serving/running"):
+              "serving/kv_fragmentation", "serving/running",
+              "serving/kv_host_blocks", "serving/kv_host_bytes"):
         if k in g:
             blob[k] = round(g[k], 6)
     for k in ("train/step_time_ms", "serving/ttft_ms", "serving/tpot_ms",
@@ -176,6 +182,8 @@ def _telemetry_blob(engine):
               "serving/generated_tokens", "serving/spec_verify_steps",
               "serving/spec_proposed_tokens", "serving/spec_accepted_tokens",
               "serving/spec_rollbacks", "serving/rejected_requests",
+              "serving/kv_spills", "serving/kv_fetch_hits",
+              "serving/kv_fetch_tokens", "serving/kv_host_errors",
               "checkpoint/saves",
               "checkpoint/failures"):
         if k in c:
@@ -419,6 +427,7 @@ BENCH_METRICS = [
     ("BENCH_DECODE_DENSE", "1", "gpt2_decode_dense_tokens_per_sec_per_chip"),
     ("BENCH_DECODE_PAGED", "1", "gpt2_decode_paged_tokens_per_sec_per_chip"),
     ("BENCH_SERVE_PREFIX", "1", "gpt2_serving_prefix_cache_ttft_ms"),
+    ("BENCH_KV_TIER", "1", "gpt2_serving_kv_tier_ttft_ms"),
     ("BENCH_SERVE_CHUNKED", "1", "gpt2_serving_chunked_prefill_tpot_p99_ms"),
     ("BENCH_SERVE_SPEC", "1", "gpt2_serving_spec_decode_tpot_ms"),
     ("BENCH_SERVE_ASYNC", "1", "gpt2_serving_async_goodput_tokens_per_sec"),
@@ -581,6 +590,74 @@ def run_prefix_cache_bench():
             if tel:
                 rec["telemetry"] = tel
             print(json.dumps(rec), flush=True)
+
+
+def run_kv_tier_bench():
+    """Tiered-KV re-hit probe at FORCED cache pressure: NREQ requests
+    share a long prefix, then a scratch burst floods the (deliberately
+    small) device pool so the shared prefix's cold blocks are reclaimed
+    before the requests return. With ``kv_host`` off, reclaim destroys —
+    the re-hit re-prefills the whole prefix; on, reclaim demotes to host
+    RAM and the re-hit re-materializes it H2D. Value = p50 re-hit TTFT
+    with tiering ON, vs_baseline = OFF/ON (>1 = spilling beat
+    destroy-on-reclaim)."""
+    import numpy as np
+
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models import gpt2
+
+    dist.set_mesh(None)
+    NREQ = int(os.environ.get("BENCH_SERVE_REQS", 4))
+    SYS = int(os.environ.get("BENCH_KV_TIER_PREFIX_LEN", 512))
+    TAIL, MAX_NEW = 32, int(os.environ.get("BENCH_SERVE_NEW", 8))
+    POOL = int(os.environ.get("BENCH_KV_TIER_BLOCKS", 24))
+    model = gpt2("125m", remat=False,
+                 attention_backend=os.environ.get("BENCH_ATTN", "auto"))
+    rng = np.random.default_rng(0)
+    system = rng.integers(0, 50257, size=SYS).astype(np.int32)
+    prompts = [np.concatenate([system, rng.integers(0, 50257, size=TAIL)
+                               .astype(np.int32)]) for _ in range(NREQ)]
+    # the pressure burst: enough cold-block churn to reclaim every shared
+    # block between re-hits (the tier's whole reason to exist)
+    scratch = [rng.integers(0, 50257, size=SYS + 128).astype(np.int32)
+               for _ in range(6)]
+
+    results = {}
+    for mode in (False, True):
+        _reset_telemetry()
+        engine = deepspeed_tpu.init_inference(
+            model, dtype="bf16", telemetry=True,
+            serving={"block_size": 128, "max_running": 4,
+                     "max_num_blocks": POOL,
+                     "kv_host": {"enabled": mode}})
+        engine.generate_batch(prompts, max_new_tokens=MAX_NEW)   # warm +
+        # populate; the burst then reclaims (destroys or demotes) the
+        # shared prefix's cold blocks
+        engine.generate_batch(scratch, max_new_tokens=MAX_NEW)
+        _reset_telemetry()
+        engine.generate_batch(prompts, max_new_tokens=MAX_NEW)   # re-hit
+        results[mode] = _serve_hist(engine, "serving/ttft_ms", "p50")
+        if mode:
+            snap = engine.telemetry_snapshot().get("counters", {})
+            rec = {
+                "metric": _metric_name("BENCH_KV_TIER"),
+                "value": round(results[True], 2),
+                "unit": f"p50 re-hit TTFT ms (bf16, {NREQ} reqs sharing a "
+                        f"{SYS}-tok prefix, {POOL}-block pool + scratch "
+                        f"burst; destroy-on-reclaim = "
+                        f"{results[False]:.1f} ms; "
+                        f"fetch_hits={int(snap.get('serving/kv_fetch_hits', 0))}"
+                        f" spills={int(snap.get('serving/kv_spills', 0))})",
+                # >1 = demote+fetch cut re-hit TTFT by this factor
+                "vs_baseline": (round(results[False] / results[True], 3)
+                                if results[True] else 0.0),
+            }
+            tel = _telemetry_blob(engine)
+            if tel:
+                rec["telemetry"] = tel
+            print(json.dumps(rec), flush=True)
+        del engine
 
 
 def run_chunked_prefill_bench():
@@ -1146,7 +1223,7 @@ def main():
 
     if any(_metric_enabled(g) for g in
            ("BENCH_DECODE_DENSE", "BENCH_DECODE_PAGED",
-            "BENCH_SERVE_PREFIX", "BENCH_SERVE_CHUNKED",
+            "BENCH_SERVE_PREFIX", "BENCH_KV_TIER", "BENCH_SERVE_CHUNKED",
             "BENCH_SERVE_SPEC", "BENCH_SERVE_ASYNC", "BENCH_SERVE_TP")):
         # free the last training engine's device state before serving
         if engine is not None:
@@ -1159,6 +1236,9 @@ def main():
             gc.collect()
         if _metric_enabled("BENCH_SERVE_PREFIX"):
             run_prefix_cache_bench()
+            gc.collect()
+        if _metric_enabled("BENCH_KV_TIER"):
+            run_kv_tier_bench()
             gc.collect()
         if _metric_enabled("BENCH_SERVE_CHUNKED"):
             run_chunked_prefill_bench()

@@ -10,6 +10,7 @@ _LAZY = {
     "SchedulingPolicy": ("deepspeed_tpu.inference.policy",
                          "SchedulingPolicy"),
     "get_policy": ("deepspeed_tpu.inference.policy", "get_policy"),
+    "KvHostPool": ("deepspeed_tpu.inference.kv_host_pool", "KvHostPool"),
 }
 
 

@@ -82,6 +82,14 @@ class BlockAllocator:
         self._cold: "OrderedDict[int, bytes]" = OrderedDict()  # LRU: old first
         self._table: Dict[bytes, int] = {}       # chain key -> block id
         self._key_of: Dict[int, bytes] = {}      # registered block -> its key
+        # tiered KV cache (inference/kv_host_pool.py): when a host pool is
+        # attached, reclaiming a cold block DEMOTES it — the spill hook
+        # (engine-bound: it owns the pools and the D2H gather program)
+        # copies the block's content host-side under its chain key before
+        # the block id is reused — and the tiered match walk below finds
+        # demoted chains for re-materialization on admission
+        self.host_pool = None
+        self._spill_fn = None       # (block, key) -> bool; session-scoped
 
     # ------------------------------------------------------------------ #
     # capacity accounting
@@ -144,6 +152,14 @@ class BlockAllocator:
                 self._free_set.discard(b)
             else:
                 b, key = self._cold.popitem(last=False)   # LRU eviction
+                if self._spill_fn is not None:
+                    # demote instead of destroy: the hook D2H-copies the
+                    # block's content into the host pool under its chain
+                    # key (dispatched BEFORE the new owner's writes, so
+                    # stream order reads the pre-overwrite content); hook
+                    # failures degrade to today's destroy-on-reclaim and
+                    # never surface here
+                    self._spill_fn(b, key)
                 del self._table[key]
                 del self._key_of[b]
             self._ref[b] = 1
@@ -233,7 +249,78 @@ class BlockAllocator:
             return False
         self._table[key] = block
         self._key_of[block] = key
+        if self.host_pool is not None:
+            # a device registration supersedes any host copy of the same
+            # content (a recompute landed the identical bytes on device) —
+            # a chain key lives in at most one tier. Safe against the
+            # speculative optimistic-register-then-rollback flow: under
+            # greedy-only speculation a rolled-back candidate chain can
+            # only collide with a demoted COMMITTED key if the model
+            # would re-commit those exact tokens — in which case verify
+            # accepts them and no rollback happens (revisit if sampled
+            # speculation ever registers candidate-keyed blocks).
+            self.host_pool.discard(key)
         return True
+
+    # ------------------------------------------------------------------ #
+    # tiered KV cache (host-RAM spill pool)
+
+    def attach_host_pool(self, host_pool) -> None:
+        """Attach (or detach with None) the host-memory tier. Attaching
+        makes the tiered match walk probe demoted chains; demotion itself
+        additionally needs a spill hook (:meth:`set_spill`)."""
+        self.host_pool = host_pool if self.prefix_cache else None
+
+    def set_spill(self, spill_fn) -> None:
+        """Install the session-scoped demotion hook ``(block, key) ->
+        bool``. The hook is engine-bound (it reads the live pools and runs
+        the jitted per-block gather), must never raise, and is cleared at
+        session close — a stale hook would capture freed pool buffers."""
+        self._spill_fn = spill_fn if self.host_pool is not None else None
+
+    def match_prefix_tiered(self, tokens) -> Tuple[List[Tuple], List[bytes]]:
+        """Longest chain of cached FULL blocks matching the front of
+        ``tokens`` across BOTH tiers: each chain position resolves to
+        ``("dev", block_id)`` (device-registered) or ``("host", key)``
+        (demoted to the host pool), stopping at the first key in neither.
+        Read-only — no ref counts, no host LRU reordering. With no host
+        pool attached this degenerates to :meth:`match_prefix`."""
+        if not self.prefix_cache:
+            return [], []
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        entries: List[Tuple] = []
+        keys: List[bytes] = []
+        parent = ROOT_KEY
+        for j in range(tokens.size // bs):
+            key = self.chain_key(parent, tokens[j * bs:(j + 1) * bs])
+            b = self._table.get(key)
+            if b is not None:
+                entries.append(("dev", b))
+            elif self.host_pool is not None and self.host_pool.contains(key):
+                entries.append(("host", key))
+            else:
+                break
+            keys.append(key)
+            parent = key
+        return entries, keys
+
+    def host_consistency(self) -> List[str]:
+        """Tier-discipline violations (empty = consistent): the host
+        pool's own invariants plus the cross-tier rule that a chain key
+        lives in at most one tier. The conftest ``_no_kv_block_leaks``
+        fixture asserts this on every drained scheduler — demoted blocks
+        are cache copies, never leaks."""
+        if self.host_pool is None:
+            return []
+        probs = self.host_pool.consistency_report()
+        for key in self.host_pool.keys():
+            if key in self._table:
+                probs.append(
+                    f"chain key {key.hex()[:12]} registered on device "
+                    f"(block {self._table[key]}) AND resident in the host "
+                    "pool — a key must live in exactly one tier")
+        return probs
 
     def unregister_if_owner(self, block: int, key: bytes) -> bool:
         """Withdraw ``block``'s registration under ``key`` — the rollback

@@ -132,6 +132,35 @@ class SpeculativeConfig(ConfigModel):
     max_match: int = 4      # longest tail n-gram tried (longest first)
 
 
+class KvHostConfig(ConfigModel):
+    """Tiered KV cache ("serving.kv_host" sub-section).
+
+    ``enabled=True`` attaches a host-memory tier
+    (``inference/kv_host_pool.py``) behind the paged block allocator:
+    instead of destroying a cold prefix-cache block under allocation
+    pressure, the allocator *demotes* it — an async D2H copy of the
+    block's ``[L, bs, KV, Hd]`` k/v slices keyed by its blake2b hash
+    chain — and a later admission whose prefix walks onto a demoted
+    chain re-materializes it H2D into fresh device blocks instead of
+    recomputing the prefill. Host RAM is ~10x HBM, so effective cache
+    capacity (hence hit rate and TTFT at scale) grows accordingly.
+    Requires prefix caching on the paged path; greedy token identity is
+    unchanged (a fetched block is a bit-identical copy of what recompute
+    would produce).
+
+    ``max_host_blocks`` bounds the tier with its own LRU; 0 = auto (4x
+    the device pool's allocatable blocks). ``spill="off"`` keeps the
+    tier read-only — existing demoted chains still serve hits, but
+    reclaim destroys (no new demotions). Injected D2H/H2D faults
+    (``utils/fault_injection``) degrade to destroy-on-reclaim with a
+    warning and the ``serving/kv_host_errors`` counter; the serving
+    loop never wedges.
+    """
+    enabled: bool = False
+    max_host_blocks: int = 0   # 0 = auto: 4x device pool capacity
+    spill: str = "auto"        # auto | off (off = fetch-only, no demotion)
+
+
 class ServingConfig(ConfigModel):
     """Continuous-batching serving config ("serving" section).
 
@@ -189,6 +218,9 @@ class ServingConfig(ConfigModel):
     # follow tensor_parallel.tp_size
     prefix_caching: str = "auto"   # auto | on | off (auto = on when paged)
     prefill_chunk_tokens: int = 0  # 0 = whole-prompt; else chunk size
+    kv_host: KvHostConfig = Field(default_factory=KvHostConfig)
+    # tiered KV cache: spill cold prefix-cache blocks to a host-RAM pool
+    # (see KvHostConfig)
     speculative: SpeculativeConfig = Field(
         default_factory=SpeculativeConfig)
     policy: Union[str, Dict[str, Any]] = "fifo"   # fifo | priority | sla,

@@ -358,6 +358,10 @@ class InferenceEngine:
         self._stream_jits = None
         self._paged_jits = None
         self._paged_alloc = None   # persistent prefix-cache allocator
+        self._kv_host_pool = None  # persistent host-RAM KV tier (tiered
+        # KV cache: cold prefix-cache blocks demote here instead of being
+        # destroyed; content-addressed, so it outlives pool workspaces and
+        # cache-off serves — only a geometry/dtype change rebuilds it)
 
         # ---- telemetry (serving stats + compile watchdog) ----
         tcfg = getattr(self._config, "telemetry", None)
@@ -790,6 +794,48 @@ class InferenceEngine:
                       "memory split)")
         return NamedSharding(self.mesh, P())
 
+    def _kv_slice_sharding(self):
+        """NamedSharding for ONE block's per-layer k/v slice
+        ``[L, bs, KV, Hd]`` — the tiered KV cache's D2H/H2D unit. Under
+        ``serving.tp`` the slice lands head-sharded exactly like the
+        pools themselves (axis 2 here = axis 3 of the rank-5 pool), so a
+        spill gathers each shard's local heads and a fetch scatters them
+        back without ever gathering the pool."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        pool_sh = self._kv_head_sharding()
+        if any(s is not None for s in pool_sh.spec):
+            return NamedSharding(self.mesh, P(None, None, "tp", None))
+        return NamedSharding(self.mesh, P())
+
+    def _kv_host_pool_for(self, num_blocks: int, block_size: int,
+                          caching: bool):
+        """The persistent host-RAM KV tier for the current serving
+        geometry, or None when ``serving.kv_host`` is off (or prefix
+        caching is — the tier is keyed by the cache's hash chains).
+        Content addressing makes entries valid across serves and even
+        fresh pool workspaces; only a geometry/dtype change rebuilds."""
+        kh = getattr(self._config.serving, "kv_host", None)
+        if kh is None or not kh.enabled or not caching:
+            return None
+        if str(kh.spill) not in ("auto", "off"):
+            raise ValueError(
+                f"serving.kv_host.spill={kh.spill!r} (expected auto|off)")
+        cfg = self.module.config
+        shape = (cfg.n_layer, block_size, cfg.kv_heads, cfg.head_dim)
+        dtype = self.dtype.__name__
+        cap = int(kh.max_host_blocks) or 4 * max(num_blocks - 1, 1)
+        pool = self._kv_host_pool
+        if pool is not None and pool.matches_geometry(shape, dtype) \
+                and pool.max_blocks == cap:
+            return pool
+        from deepspeed_tpu.inference.kv_host_pool import KvHostPool
+        pool = KvHostPool(cap, shape, dtype, telemetry=self._serving_tel)
+        self._kv_host_pool = pool
+        log_dist(f"tiered KV cache: host pool of {cap} blocks "
+                 f"({shape}, {dtype}) attached behind the block allocator",
+                 ranks=[0])
+        return pool
+
     def _kv_workspace(self, B: int, need_len: int):
         """Persistent KV workspace (reference ``inference_context.h:49``:
         one workspace allocated once and reused across calls). Grows
@@ -1014,6 +1060,36 @@ class InferenceEngine:
                                 p, t, pools, bt, slots, pos),
                             donate_argnums=(2,)),
                     "inference.paged_verify")
+            # tiered KV cache copy programs: the per-block D2H gather
+            # (spill) and H2D scatter (fetch). The block index is traced,
+            # so each is ONE program regardless of which block moves; the
+            # slice is pinned to the pool's head sharding (under tp each
+            # shard moves only its local heads). Gather does NOT donate —
+            # the pools live on; scatter donates like every fused step.
+            slice_sh = self._kv_slice_sharding()
+            slice_pin = slice_sh if any(s is not None for s in slice_sh.spec)\
+                else None
+
+            def _pin_slice(a):
+                if slice_pin is None:
+                    return a
+                return jax.lax.with_sharding_constraint(a, slice_pin)
+
+            spill_gather = self._watched(
+                jax.jit(lambda pools, b: {
+                    "k": _pin_slice(jax.lax.dynamic_index_in_dim(
+                        pools["k"], b, axis=1, keepdims=False)),
+                    "v": _pin_slice(jax.lax.dynamic_index_in_dim(
+                        pools["v"], b, axis=1, keepdims=False))}),
+                "inference.paged_spill_gather")
+            fetch_scatter = self._watched(
+                jax.jit(lambda pools, b, ks, vs: _pin({
+                    "k": jax.lax.dynamic_update_index_in_dim(
+                        pools["k"], ks.astype(pools["k"].dtype), b, axis=1),
+                    "v": jax.lax.dynamic_update_index_in_dim(
+                        pools["v"], vs.astype(pools["v"].dtype), b, axis=1)}),
+                        donate_argnums=(0,)),
+                "inference.paged_fetch_scatter")
             self._paged_jits = (
                 self._watched(
                     jax.jit(lambda p, t, pools, slots, li:
@@ -1034,6 +1110,8 @@ class InferenceEngine:
                             donate_argnums=(0,)),
                     "inference.paged_cow"),
                 verify,
+                spill_gather,
+                fetch_scatter,
             )
         return self._paged_jits
 
@@ -1252,6 +1330,13 @@ class InferenceEngine:
 
         pools, pools_reused = self._paged_pools(num_blocks, bs)
         alloc = self._paged_allocator(num_blocks, bs, caching, pools_reused)
+        # tiered KV cache: attach the persistent host-RAM tier (content-
+        # addressed, so it survives pool/allocator rebuilds) and decide
+        # whether this session demotes (spill) or only serves host hits
+        host_pool = self._kv_host_pool_for(num_blocks, bs, caching)
+        alloc.attach_host_pool(host_pool)
+        kv_spill = (host_pool is not None
+                    and str(srv.kv_host.spill) != "off")
         if self._serving_tel is not None:
             # KV gauges (blocks free/used, fragmentation) are GLOBAL per
             # slice — the allocator is replicated and block ids are shard-
@@ -1275,7 +1360,7 @@ class InferenceEngine:
             spec_wb=spec_wb, W=W, n_max=n_max, bs=bs,
             num_blocks=num_blocks, chunk_tokens=chunk_tokens, ev=ev,
             on_tokens=on_tokens, on_finish=on_finish,
-            retain_finished=retain_finished)
+            retain_finished=retain_finished, kv_spill=kv_spill)
         self._active_session = session
         return session
 
@@ -1308,12 +1393,20 @@ class _ServeSession:
     def __init__(self, engine, sched, pools, jits, *, max_new, temperature,
                  top_k, rng, eos_token_id, spec_wb, W, n_max, bs, num_blocks,
                  chunk_tokens, ev, on_tokens=None, on_finish=None,
-                 retain_finished=True):
+                 retain_finished=True, kv_spill=False):
         self.engine = engine
         self.sched = sched
         self.pools = pools
         (self._prefill_jit, self._decode_jit, self._chunk_jit,
-         self._cow_jit, self._verify_jit) = jits
+         self._cow_jit, self._verify_jit, self._spill_jit,
+         self._fetch_jit) = jits
+        # tiered KV cache: the demotion hook is session-scoped — it reads
+        # the LIVE (donated-through) pools, so it must never outlive this
+        # session (close() clears it)
+        if kv_spill:
+            sched.allocator.set_spill(self._spill_block)
+        else:
+            sched.allocator.set_spill(None)
         self.max_new = int(max_new)
         self.temperature = temperature
         self.top_k = top_k
@@ -1398,6 +1491,90 @@ class _ServeSession:
             del fin[:self._finished_seen]
             self._finished_seen = 0
 
+    # ---- tiered KV cache: demote (D2H) / re-materialize (H2D) ---- #
+
+    def _spill_block(self, block: int, key: bytes) -> bool:
+        """Allocator demotion hook: gather ``block``'s per-layer k/v
+        slices (one jitted program, block index traced) and hand them to
+        the host pool, which starts the async D2H copy — dispatched
+        BEFORE the reclaiming owner's writes, so stream order reads the
+        pre-overwrite content, and overlapping the running decode loop
+        the way weight streaming overlaps layer copies. Never raises:
+        any failure degrades to today's destroy-on-reclaim (the host
+        pool counts and warns)."""
+        sched, ev = self.sched, self.ev
+        hp = sched.allocator.host_pool
+        if hp is None:
+            return False
+        try:
+            t0 = time.monotonic_ns() if ev is not None else 0
+            sl = self._spill_jit(self.pools, jnp.int32(block))
+            ok = hp.put(key, sl["k"], sl["v"])
+        except Exception as e:          # SimulatedCrash (BaseException)
+            # and record_* invariants still propagate; everything else
+            # must degrade — a spill is best-effort cache retention
+            hp._count_error("spill (gather)", e)
+            return False
+        if ok:
+            if ev is not None:
+                # dur DELIBERATELY brackets only the gather dispatch +
+                # async-copy kick-off: the D2H itself overlaps the next
+                # fused steps (that overlap is the whole point), so a
+                # sync here would serialize what the tier exists to hide
+                ev.emit("kv.spill", t_ns=t0,
+                        dur_ns=time.monotonic_ns() - t0,  # dslint: disable=DS005
+                        blocks=1,
+                        bytes=int(sl["k"].nbytes) + int(sl["v"].nbytes),
+                        block=block)
+            if sched.telemetry is not None:
+                sched.telemetry.kv_spills.inc()
+        return ok
+
+    def _run_fetches(self, req, pools):
+        """Land the admission's host-tier hits H2D: device_put each
+        demoted ``[L, bs, KV, Hd]`` slice (head-sharded under tp, like
+        the pools) and scatter it into the request's freshly allocated
+        block via the jitted per-block program. Runs BEFORE any of the
+        request's prefill compute reads the blocks. Each promoted block
+        registers under its chain key only NOW — content actually on
+        device — and its host entry is dropped (a key lives in one
+        tier); the COW split's private copy (key None) stays
+        unregistered and keeps its host entry cached."""
+        fetches = req.fetch_pending
+        req.fetch_pending = []
+        if not fetches:
+            return pools
+        engine, sched, ev = self.engine, self.sched, self.ev
+        alloc = sched.allocator
+        sh = engine._kv_slice_sharding()
+        t0 = time.monotonic_ns() if ev is not None else 0
+        nbytes = 0
+        ntokens = 0
+        for dst, key, k_np, v_np, tokens in fetches:
+            ks = jax.device_put(jnp.asarray(k_np), sh)
+            vs = jax.device_put(jnp.asarray(v_np), sh)
+            pools = self._fetch_jit(pools, jnp.int32(dst), ks, vs)
+            nbytes += int(k_np.nbytes) + int(v_np.nbytes)
+            ntokens += int(tokens)
+            if key is not None:
+                alloc.register(dst, key)
+                if alloc.host_pool is not None:
+                    alloc.host_pool.remove(key)
+        if sched.telemetry is not None:
+            # observed at LANDING, not admission: a preempt-before-fetch
+            # re-admission must not double-count an H2D that never ran
+            sched.telemetry.kv_fetch_hits.inc(len(fetches))
+            if ntokens:
+                sched.telemetry.kv_fetch_tokens.inc(ntokens)
+        if ev is not None:
+            # the scatters are async dispatches: sync so the slice covers
+            # device work, not µs of dispatch (the DS005 rule)
+            jax.block_until_ready(pools)
+            ev.emit("kv.fetch", rid=req.rid, t_ns=t0,
+                    dur_ns=time.monotonic_ns() - t0,
+                    blocks=len(fetches), bytes=nbytes)
+        return pools
+
     def _exec(self, action) -> None:
         engine, sched, ev = self.engine, self.sched, self.ev
         cfg = engine.module.config
@@ -1408,6 +1585,7 @@ class _ServeSession:
         try:
             if kind == "prefill":
                 req = payload
+                pools = self._run_fetches(req, pools)
                 prefix = req.prefix()
                 L = prefix.size
                 Tb = engine._bucket(L, cfg.max_seq)
@@ -1433,6 +1611,7 @@ class _ServeSession:
                 self._emit_tokens(req, [int(tok[0])])
             elif kind == "prefill_chunk":
                 req = payload
+                pools = self._run_fetches(req, pools)
                 if req.cow_pending is not None:
                     # copy-on-write split: the request restarts mid-block
                     # inside a SHARED cached block — give it a private
@@ -1589,6 +1768,9 @@ class _ServeSession:
             return
         self._closed = True
         engine = self.engine
+        # the demotion hook captures THIS session's live pools: a stale
+        # hook on the persistent allocator would gather freed buffers
+        self.sched.allocator.set_spill(None)
         engine._serve_rid_base = self.sched._next_rid
         # step accounting for the serve that just ran (plain host
         # counters, kept even when the metrics registry is off):

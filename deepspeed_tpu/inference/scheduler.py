@@ -62,6 +62,17 @@ boundaries — they are registered back into the cache, so repeated system
 prompts, multi-turn continuations, and even a preempted request's own
 re-admission hit.
 
+**Tiered KV cache** (``serving.kv_host``): with a host pool attached to
+the allocator, admission's cache probe walks BOTH tiers
+(``match_prefix_tiered``) — device hits acquire as always, host hits
+(cold blocks demoted to host RAM instead of destroyed) read their bytes
+at admission, take freshly allocated device blocks, and ride
+``req.fetch_pending`` to the engine, which lands them H2D before the
+request's first prefill work: a host hit is a cache hit whose tail needs
+only H2D, not recompute. Promoted blocks register under their chain keys
+only once the copy lands, so a preemption between admission and fetch
+loses nothing (the host entries survive).
+
 Preemption is recompute-style (vLLM's default): when a running request
 needs one more KV block and the pool (free + reclaimable cold blocks) is
 dry, the policy-selected victim — LATEST-admitted under the default FIFO
@@ -131,6 +142,8 @@ class ServingTelemetry:
                "cold_blocks", "prefill_steps", "prefill_chunks",
                "decode_steps", "prefix_cache_lookups", "prefix_cache_hits",
                "prefix_cache_hit_tokens",
+               "kv_host_blocks", "kv_host_bytes", "kv_spills",
+               "kv_fetch_hits", "kv_fetch_tokens", "kv_host_errors",
                "preemptions", "recompute_tokens", "requests", "finished",
                "rejected_requests",
                "generated_tokens", "spec_verify_steps",
@@ -266,6 +279,48 @@ class ServingTelemetry:
             "prompt tokens whose prefill was skipped via cache hits")
 
     @property
+    def kv_host_blocks(self):
+        return self.registry.gauge(
+            "serving/kv_host_blocks",
+            "demoted KV blocks resident in the host-RAM tier (tiered KV "
+            "cache; LRU-bounded by serving.kv_host.max_host_blocks)")
+
+    @property
+    def kv_host_bytes(self):
+        return self.registry.gauge(
+            "serving/kv_host_bytes",
+            "host RAM held by demoted KV blocks (k+v slices)")
+
+    @property
+    def kv_spills(self):
+        return self.registry.counter(
+            "serving/kv_spills",
+            "cold blocks demoted D2H to the host pool instead of being "
+            "destroyed under allocation pressure")
+
+    @property
+    def kv_fetch_hits(self):
+        return self.registry.counter(
+            "serving/kv_fetch_hits",
+            "admission prefix probes served from the host tier: demoted "
+            "blocks re-materialized H2D instead of recomputed (counted "
+            "per block)")
+
+    @property
+    def kv_fetch_tokens(self):
+        return self.registry.counter(
+            "serving/kv_fetch_tokens",
+            "prompt tokens whose prefill was skipped via host-tier "
+            "fetches (subset of prefix_cache_hit_tokens)")
+
+    @property
+    def kv_host_errors(self):
+        return self.registry.counter(
+            "serving/kv_host_errors",
+            "D2H/H2D failures degraded to destroy-on-reclaim / recompute "
+            "(allocation errors, injected I/O faults)")
+
+    @property
     def preemptions(self):
         return self.registry.counter(
             "serving/preemptions", "recompute-preempt eviction events")
@@ -350,6 +405,13 @@ class Request:
     keys: List[bytes] = dataclasses.field(default_factory=list)
     # chain keys of this request's REGISTERED-or-matched full blocks
     cow_pending: Optional[Tuple[int, int]] = None  # (src, dst) device copy
+    # host-tier fetches the engine must land H2D before this request's
+    # next prefill work: (dst_block, chain_key_or_None, k_np, v_np,
+    # tokens) per demoted block — key None for the COW split's private
+    # (unregistered) copy, tokens the prompt tokens the fetch saves from
+    # recompute (the engine's kv_fetch counter base). Bytes in hand, so a
+    # host-LRU eviction after admission is safe.
+    fetch_pending: List[Tuple] = dataclasses.field(default_factory=list)
     error: Optional[str] = None     # set when retired without completing
     # ---- speculative decoding state ----
     spec_tokens: Tuple[int, ...] = ()  # candidates for the pending verify
@@ -459,6 +521,10 @@ class ContinuousBatchingScheduler:
         t.kv_blocks_used.set(used)
         t.kv_blocks_free.set(a.num_free)
         t.cold_blocks.set(a.num_cold)
+        hp = a.host_pool
+        if hp is not None:
+            t.kv_host_blocks.set(hp.num_blocks)
+            t.kv_host_bytes.set(hp.nbytes)
         t.kv_block_utilization.set(used / max(1, a.capacity))
         # internal fragmentation: slots allocated to requests but not yet
         # holding cached k/v (last-block waste + blocks grown ahead of
@@ -623,32 +689,56 @@ class ContinuousBatchingScheduler:
             self._tel_gauges()
             return self._try_admit()
 
-        shared: List[int] = []
+        entries: List[Tuple] = []       # chain order: ("dev", b) | ("host",
+        #                                 key, k_np, v_np) — host bytes in hand
         keys: List[bytes] = []
         cow_src: Optional[int] = None
+        cow_fetch = None                # (k_np, v_np): host-resident COW src
         cached = 0
         had_hit = False
         if self.prefix_caching:
-            hit_blocks, hit_keys = self.allocator.match_prefix(prefix)
-            had_hit = bool(hit_blocks)
+            hits, hit_keys = self.allocator.match_prefix_tiered(prefix)
+            # resolve host entries NOW — bytes in hand before any
+            # allocation below can demote-evict them from the host LRU. A
+            # vanished/faulted entry truncates the usable chain at its
+            # position (the hit must stay a contiguous prefix).
+            resolved: List[Tuple] = []
+            for ent, key in zip(hits, hit_keys):
+                if ent[0] == "dev":
+                    resolved.append((ent, key))
+                    continue
+                data = self.allocator.host_pool.get(ent[1])
+                if data is None:
+                    break
+                resolved.append((("host", ent[1], data[0], data[1]), key))
+            had_hit = bool(resolved)
             if self.telemetry is not None:
                 self.telemetry.prefix_cache_lookups.inc()
-                if hit_blocks:
+                if resolved:
                     self.telemetry.prefix_cache_hits.inc()
-            cached = len(hit_blocks) * bs
+            cached = len(resolved) * bs
             if cached >= target:
                 # full prefix cached: cap the hit at target-1 (the last
                 # token's logits must still be computed to sample the
                 # continuation), which restarts mid-block inside the last
                 # shared block — copy-on-write it (partial blocks are
-                # never shared)
+                # never shared). A host-resident COW source fetches into
+                # the private block directly (no device registration to
+                # split; the host entry stays cached for future hits).
                 cached = target - 1
-                cow_src = hit_blocks[-1]
-                shared, keys = hit_blocks[:-1], hit_keys[:-1]
-            else:
-                shared, keys = hit_blocks, hit_keys
+                last, _ = resolved[-1]
+                resolved = resolved[:-1]
+                if last[0] == "dev":
+                    cow_src = last[1]
+                else:
+                    cow_fetch = (last[2], last[3])
+            entries = [e for e, _ in resolved]
+            keys = [k for _, k in resolved]
 
-        tail_needed = need_total - len(shared)
+        shared = [e[1] for e in entries if e[0] == "dev"]
+        # host-hit blocks need fresh device placements, so they come out
+        # of the same allocation as the uncached tail
+        alloc_needed = need_total - len(shared)
         # acquire the hit FIRST so the tail allocation's cold-list reclaim
         # can't cannibalize the very blocks we are about to share. The COW
         # source is NOT acquired: the only allocation between here and the
@@ -657,20 +747,73 @@ class ContinuousBatchingScheduler:
         # the identity (content still intact — nothing writes between
         # admission and the engine processing the returned action).
         self.allocator.acquire(shared)
-        tail = self.allocator.allocate(tail_needed)
-        if tail is None:
+        # with host hits in the chain, the single-allocation guarantee
+        # behind the un-acquired COW source no longer holds: the
+        # allocation below also covers fetch destinations, and LRU
+        # reclaim could hand the (cold) source out as one of them — the
+        # H2D scatter would then overwrite it BEFORE the COW copy reads
+        # it. Pin the source with a temporary reference for the
+        # allocation (released right after placement); without host hits
+        # the degenerate src==dst identity-copy case stays exactly as
+        # before.
+        protect_cow = cow_src is not None \
+            and any(e[0] == "host" for e in entries)
+        if protect_cow:
+            self.allocator.acquire([cow_src])
+        got = self.allocator.allocate(alloc_needed)
+        if got is None and protect_cow:
+            # the pool can't place the fetches AND preserve the pinned COW
+            # source: degrade the full-prefix hit — drop the COW (the last
+            # block's tokens recompute in the tail chunk; alloc_needed
+            # already covers that block as plain tail) and retry unpinned
+            self.allocator.free([cow_src])
+            cow_src = None
+            protect_cow = False
+            cached = bs * len(entries)
+            got = self.allocator.allocate(alloc_needed)
+        if got is None:
             # roll the probe back — in REVERSE like _free_blocks, so LRU
             # reclaim takes chain tails before parents (a reclaimed parent
-            # orphans its still-cached children for every future probe)
+            # orphans its still-cached children for every future probe).
+            # Host entries were only read (get), never removed: nothing to
+            # restore there.
             self.allocator.free(list(reversed(shared)))
             if not self.running:
                 raise PoolExhausted(
-                    f"prefix of request {req.rid} needs {tail_needed} more "
+                    f"prefix of request {req.rid} needs {alloc_needed} more "
                     f"KV blocks but the pool only has "
                     f"{self.allocator.num_free} available and nothing is "
                     "running to evict; raise serving.max_num_blocks or "
                     "shrink the prompt", req)
             return None
+
+        # interleave: chain positions keep their tier order — device hits
+        # keep their blocks, host hits take fresh placements that the
+        # engine fills H2D (fetch_pending) before this request's first
+        # prefill work; the remainder is the uncached tail
+        it = iter(got)
+        blocks: List[int] = []
+        fetches: List[Tuple] = []
+        for e in entries:
+            if e[0] == "dev":
+                blocks.append(e[1])
+            else:
+                dst = next(it)
+                blocks.append(dst)
+                # key and token count ride along: the engine registers dst
+                # under the key — and observes the fetch counters — only
+                # once the copy actually lands (a preemption between
+                # admission and fetch must not advertise unwritten content
+                # nor count an H2D that never happened)
+                fetches.append((dst, e[1], e[2], e[3], bs))
+        tail = list(it)
+        blocks += tail
+        if protect_cow:
+            self.allocator.free([cow_src])   # placement done: back cold
+        if cow_fetch is not None:
+            # the COW split's private copy: fetched, never registered
+            fetches.append((tail[0], None, cow_fetch[0], cow_fetch[1],
+                            cached - bs * len(entries)))
 
         del self.waiting[idx]
         if self.telemetry is not None and req.admit_seq == -1:
@@ -678,13 +821,14 @@ class ContinuousBatchingScheduler:
             # re-admission is recompute latency, not queueing delay)
             self.telemetry.queue_wait.observe(
                 (time.perf_counter() - req.t_submit) * 1e3)
-        req.blocks = shared + tail
+        req.blocks = blocks
         req.keys = list(keys)
         req.pos = cached
         req.prefill_target = target
         req.prefilling = True
         req.cow_pending = None if cow_src is None \
             else (cow_src, tail[0])
+        req.fetch_pending = fetches
         req.state = RUNNING
         req.admit_seq = self._admit_counter
         self._admit_counter += 1
@@ -696,7 +840,8 @@ class ContinuousBatchingScheduler:
             if self.prefix_caching:
                 if had_hit:
                     self.events.emit("req.cache_hit", rid=req.rid,
-                                     tokens=cached)
+                                     tokens=cached,
+                                     host_blocks=len(fetches))
                 else:
                     self.events.emit("req.cache_miss", rid=req.rid)
             self.events.emit("req.admit", rid=req.rid,
@@ -900,6 +1045,10 @@ class ContinuousBatchingScheduler:
         req.blocks = []
         req.keys = []
         req.cow_pending = None
+        # un-landed host fetches die with the placement: the host pool
+        # still holds the entries (removed only when a fetch lands), so a
+        # re-admission re-hits them
+        req.fetch_pending = []
 
     def _register_full_blocks(self, req: Request) -> None:
         """Publish every newly-FILLED block (all ``pos`` tokens' k/v are in

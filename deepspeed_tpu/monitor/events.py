@@ -7,7 +7,8 @@ emitted by the training engine (step / phase / checkpoint phases / fp16
 skip), the continuous-batching scheduler (enqueue / admit / cache hit /
 preempt / retire / cancel, speculative propose / rollback), the inference
 engine (prefill, prefill chunk, COW copy, fused decode tick, speculative
-verify), the async serving front-end (submit / drain), and the crash-safe
+verify, tiered-KV spill / fetch), the async serving front-end (submit /
+drain), and the crash-safe
 checkpoint writer (snapshot / serialize / commit / retry). The buffer keeps the newest
 ``capacity`` events (a flight recorder preserves the TAIL — the moments
 before the incident), counting evictions in ``dropped``.
@@ -76,6 +77,13 @@ EVENT_KINDS = frozenset({
     "req.spec_propose",     # host n-gram proposal (tokens=, found=)
     "req.spec_verify",      # fused verify step slice (window=, accepted=)
     "req.spec_rollback",    # rejection rewound pos (rejected=, unregistered=)
+    # serving: tiered KV cache (host-RAM spill pool)
+    "kv.spill",             # cold block demoted D2H (blocks=, bytes=,
+    #                         block=; dur_ns brackets the gather dispatch +
+    #                         async-copy kick-off)
+    "kv.fetch",             # host hit re-materialized H2D into the rid's
+    #                         fresh blocks (blocks=, bytes=; dur_ns
+    #                         brackets the synced scatters)
     "serve.begin",          # generate_batch / async-loop entry (requests=)
     "serve.end",            # serve span (dur_ns=, requests=)
     "serve.drain",          # async loop stopped intake (waiting=,
@@ -231,7 +239,8 @@ _ENGINE_TID = 0
 _CHILD_SLICES = {"req.prefill": "prefill", "req.prefill_chunk": "prefill_chunk",
                  "req.cow_copy": "cow_copy",
                  "req.spec_propose": "spec_propose",
-                 "req.spec_verify": "spec_verify"}
+                 "req.spec_verify": "spec_verify",
+                 "kv.fetch": "kv_fetch"}
 #: request-track instants
 _INSTANTS = {"req.enqueue": "enqueue", "req.submit": "submit",
              "req.cache_hit": "cache_hit",
@@ -249,7 +258,8 @@ def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
     ``queue_depth`` and ``kv_blocks`` counter tracks and the
     ``generate_batch`` engine spans (pid 2)."""
     events = [e for e in events
-              if e.kind.startswith(("req.", "serve.", "decode.", "sched."))]
+              if e.kind.startswith(("req.", "serve.", "decode.", "sched.",
+                                    "kv."))]
     out: List[Dict[str, Any]] = []
     if not events:
         return {"traceEvents": out, "displayTimeUnit": "ms"}
@@ -334,6 +344,13 @@ def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
                         "tid": _ENGINE_TID, "ts": us(e.ts_ns),
                         "args": {"used": d.get("kv_used", 0),
                                  "free": d.get("kv_free", 0)}})
+        elif e.kind == "kv.spill":
+            # demotions have no single request: they happen inside another
+            # request's allocation, so they render on the engine track
+            out.append({"name": "kv_spill", "cat": "serving", "ph": "X",
+                        "pid": _ENGINE_PID, "tid": _ENGINE_TID,
+                        "ts": us(e.ts_ns), "dur": (e.dur_ns or 0) / 1e3,
+                        "args": dict(e.data or {})})
         elif e.kind == "serve.end":
             out.append({"name": "generate_batch", "cat": "serving",
                         "ph": "X", "pid": _ENGINE_PID, "tid": _ENGINE_TID,

@@ -783,6 +783,24 @@ def render_summary_table(s: Dict[str, Any]) -> str:
             if "cold_blocks" in serving:
                 line += f" cold {int(serving['cold_blocks'])}"
             parts.append(line)
+        spills = serving.get("kv_spills", 0)
+        fh = serving.get("kv_fetch_hits", 0)
+        if spills or fh or serving.get("kv_host_blocks"):
+            # tiered KV cache: host-tier hits / spills (the re-hit rate of
+            # demoted content) + what the host pool currently holds
+            line = f"host {int(fh)}H/{int(spills)}S"
+            if spills:
+                line += f" ({fh / spills:.0%})"
+            ft = serving.get("kv_fetch_tokens", 0)
+            if ft:
+                line += f" +{int(ft)}tok"
+            if "kv_host_blocks" in serving:
+                line += f" {int(serving['kv_host_blocks'])}blk"
+                if serving.get("kv_host_bytes"):
+                    line += f"/{_fmt_bytes(serving['kv_host_bytes'])}"
+            if serving.get("kv_host_errors"):
+                line += f" err {int(serving['kv_host_errors'])}"
+            parts.append(line)
         prop = serving.get("spec_proposed_tokens", 0)
         if prop:
             # speculation on: accepted/proposed candidates + rate
@@ -889,6 +907,8 @@ def health_summary(rec: Dict, prev: Optional[Dict] = None) -> Dict[str, Any]:
                       ("serving/kv_blocks_free", "kv_blocks_free"),
                       ("serving/kv_fragmentation", "kv_fragmentation"),
                       ("serving/cold_blocks", "cold_blocks"),
+                      ("serving/kv_host_blocks", "kv_host_blocks"),
+                      ("serving/kv_host_bytes", "kv_host_bytes"),
                       ("serving/tp", "tp"),
                       ("serving/spec_acceptance_rate",
                        "spec_acceptance_rate")):
@@ -901,6 +921,10 @@ def health_summary(rec: Dict, prev: Optional[Dict] = None) -> Dict[str, Any]:
                       ("serving/spec_proposed_tokens", "spec_proposed_tokens"),
                       ("serving/spec_accepted_tokens", "spec_accepted_tokens"),
                       ("serving/spec_rollbacks", "spec_rollbacks"),
+                      ("serving/kv_spills", "kv_spills"),
+                      ("serving/kv_fetch_hits", "kv_fetch_hits"),
+                      ("serving/kv_fetch_tokens", "kv_fetch_tokens"),
+                      ("serving/kv_host_errors", "kv_host_errors"),
                       ("serving/preemptions", "preemptions"),
                       ("serving/rejected_requests", "rejected_requests")):
         if key in c:

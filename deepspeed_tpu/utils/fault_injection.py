@@ -47,7 +47,7 @@ from typing import List, Optional
 
 __all__ = [
     "SimulatedCrash", "FaultInjector", "install", "clear", "active",
-    "inject", "guarded_write", "bit_flip",
+    "inject", "guarded_write", "guarded_io", "bit_flip",
 ]
 
 
@@ -167,6 +167,24 @@ def guarded_write(fileobj, data, path: str) -> None:
         raise SimulatedCrash(
             f"simulated crash after {inj.kill_at_byte} bytes (in {path})")
     fileobj.write(view)
+
+
+def guarded_io(path: str, nbytes: int) -> None:
+    """Fault gate for non-file byte movement (the tiered KV cache's
+    D2H/H2D copies route through here under virtual paths like
+    ``kv_host_pool/spill``). No injector: one ``None`` check. Installed:
+    scheduled :meth:`FaultInjector.fail_writes` faults fire by path match
+    (``OSError`` — the caller degrades gracefully), and the kill-at-byte
+    crash plan advances too (a byte offered to storage is a byte,
+    whichever channel carries it) — a kill point inside this transfer
+    raises :class:`SimulatedCrash`, which callers must NOT catch."""
+    inj = _active
+    if inj is None:
+        return
+    allowed = inj.on_write(path, int(nbytes))
+    if allowed < int(nbytes):
+        raise SimulatedCrash(
+            f"simulated crash after {inj.kill_at_byte} bytes (in {path})")
 
 
 def bit_flip(path: str, byte_index: Optional[int] = None, bit: int = 0) -> int:

@@ -100,6 +100,15 @@ def _no_kv_block_leaks(request):
         assert not leaked, (
             f"KV pool blocks leaked after all requests retired "
             f"(block -> refcount): {leaked}")
+        # tiered KV cache: a drained scheduler must also leave the host
+        # tier consistent — LRU within bound, byte accounting exact, and
+        # no chain key resident in BOTH tiers (demoted blocks are cache
+        # copies, never leaks; a double-tier key means a promote/discard
+        # hand-off was dropped)
+        host_probs = sched.allocator.host_consistency()
+        assert not host_probs, (
+            "KV host-tier inconsistency after all requests retired: "
+            + "; ".join(host_probs))
 
 
 @pytest.fixture(scope="session")

@@ -50,6 +50,16 @@ class CompileBudget:
 #:                     executor, so a generate_batch warm-up followed by
 #:                     any amount of open-loop traffic compiles each fused
 #:                     entry exactly as often as generate_batch alone
+#:   serving_tiered_steady — generate_batch with the tiered KV cache on
+#:                     (serving.kv_host.enabled, spill FORCED by a device
+#:                     pool small enough that demotion and fetch actually
+#:                     fire), prefix cache + speculation on, prompts within
+#:                     two 128-token buckets: TIERING MUST NOT MULTIPLY
+#:                     PROGRAMS — the fused steps compile exactly as often
+#:                     as without the tier, and the spill/fetch copy
+#:                     programs are block-index-traced (one program each no
+#:                     matter which block moves; budget 2 for the donation/
+#:                     layout variants a re-entered workspace can add)
 #:   serving_sharded_steady — generate_batch under serving.tp > 1 (head-
 #:                     sharded KV pools, shard_map'd paged kernel), prefix
 #:                     cache + speculation on, prompts within two 128-token
@@ -142,6 +152,34 @@ BUDGETS: List[CompileBudget] = [
     CompileBudget(
         "inference.paged_cow", "serving_async_steady", 1,
         "copy-on-write block copy: fixed block geometry"),
+    CompileBudget(
+        "inference.paged_decode", "serving_tiered_steady", 1,
+        "THE fused decode step is tier-independent: demotion/fetch are "
+        "separate copy programs, the decode signature never changes"),
+    CompileBudget(
+        "inference.paged_verify", "serving_tiered_steady", 1,
+        "THE fused verify step under tiering: one program per k window "
+        "bucket (the scenario holds k fixed), same as untied serving"),
+    CompileBudget(
+        "inference.paged_prefill", "serving_tiered_steady", 2,
+        "admission prefill: one compile per 128-token prompt bucket, the "
+        "scenario stays within two"),
+    CompileBudget(
+        "inference.paged_prefill_chunk", "serving_tiered_steady", 4,
+        "cache-hit tails (incl. host-hit tails) ride the chunk program: "
+        "one per (chunk bucket, table-width power-of-two) pair"),
+    CompileBudget(
+        "inference.paged_cow", "serving_tiered_steady", 1,
+        "copy-on-write block copy: fixed block geometry"),
+    CompileBudget(
+        "inference.paged_spill_gather", "serving_tiered_steady", 2,
+        "per-block D2H gather: the block index is a traced scalar, so "
+        "every demotion shares one program (2 covers a donation/layout "
+        "variant when the pool workspace is re-entered)"),
+    CompileBudget(
+        "inference.paged_fetch_scatter", "serving_tiered_steady", 2,
+        "per-block H2D scatter: traced block index + fixed slice shape "
+        "— one program however many blocks re-materialize"),
     CompileBudget(
         "inference.paged_decode", "serving_sharded_steady", 1,
         "THE fused decode step under tp>1: the head split rides the "
