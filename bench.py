@@ -194,6 +194,13 @@ def _telemetry_blob(engine):
              for k, v in labeled_series(c, "health/anomalies").items()}
     if anoms:
         blob["health_anomalies"] = anoms
+    # SLO burn-rate alerts + flight-recorder ring loss, when the plane ran
+    slo_fired = {k: int(v)
+                 for k, v in labeled_series(c, "slo/breaches").items() if v}
+    if slo_fired:
+        blob["slo_breaches"] = slo_fired
+    if g.get("events/dropped"):
+        blob["events/dropped"] = int(g["events/dropped"])
     # peak HBM straight from the accelerator — device truth, present even
     # when gauge sampling never ran (e.g. telemetry flush cadence 0)
     try:
@@ -815,7 +822,7 @@ def run_async_serving_bench():
     NREQ = int(os.environ.get("BENCH_SERVE_ASYNC_REQS", 24))
     MAX_NEW = int(os.environ.get("BENCH_SERVE_ASYNC_NEW", 32))
     TARGET = float(os.environ.get("BENCH_SERVE_ASYNC_TPOT_MS", 50.0))
-    serving = engine = None
+    serving = engine = sampler = None
     try:
         import deepspeed_tpu
         import deepspeed_tpu.comm as dist
@@ -850,6 +857,19 @@ def run_async_serving_bench():
                 rec["tokens"] += len(burst)
             rec["status"] = h.status
 
+        # the SLO plane rides the run: default serving objectives at the
+        # probe's own TPOT target, evaluated on background sampler ticks
+        # (zero compiles — the serving_metrics_steady contract), so the
+        # record can report whether the burn-rate alerts fired
+        from deepspeed_tpu.monitor.sampler import MetricsSampler
+        from deepspeed_tpu.monitor.slo import (SloEngine, parse_objectives,
+                                               serving_objectives)
+        slo = SloEngine(
+            parse_objectives(serving_objectives(tpot_p99_ms=TARGET),
+                             default_windows=[16, 4]),
+            events=engine._events)
+        sampler = MetricsSampler(interval_s=0.25, slo=slo).start()
+
         serving = AsyncServingEngine(engine, max_new_tokens=MAX_NEW)
         recs, threads = [], []
         t0 = _t.perf_counter()
@@ -864,6 +884,7 @@ def run_async_serving_bench():
         for th in threads:
             th.join(600)
         serving.shutdown(drain=True, timeout=600)
+        sampler.stop()                  # final tick lands shutdown state
         wall = _t.perf_counter() - t0
 
         good = total = met = 0
@@ -890,6 +911,24 @@ def run_async_serving_bench():
         tel = _telemetry_blob(engine) or {}
         tel["slo_met_requests"] = met
         tel["throughput_tokens_per_sec"] = round(throughput, 1)
+        # final registry snapshot (the sampler's last tick) + any SLO
+        # breach events the burn-rate engine fired during the run
+        if sampler.ring:
+            final = dict(sampler.ring[-1])
+            final.pop("ts", None)
+            tel["final_metrics_snapshot"] = final
+        from deepspeed_tpu.monitor.health import labeled_series
+        breaches = {k: int(v) for k, v in labeled_series(
+            (engine.telemetry_snapshot() or {}).get("counters", {}),
+            "slo/breaches").items() if v}
+        if breaches:
+            tel["slo_breaches"] = breaches
+        ev = engine._events
+        if ev is not None:
+            breach_events = [e.to_dict() for e in ev.snapshot()
+                             if e.kind == "slo.breach"]
+            if breach_events:
+                tel["slo_breach_events"] = breach_events
         trace_path = os.path.join(tempfile.gettempdir(),
                                   "bench_serve_async_trace.json")
         try:
@@ -912,6 +951,8 @@ def run_async_serving_bench():
             "skip_error": f"{type(e).__name__}: {e}",
         }), flush=True)
     finally:
+        if sampler is not None:
+            sampler.stop(final_tick=False)
         if serving is not None and not serving._stopped:
             try:
                 serving.shutdown(drain=False, timeout=60)

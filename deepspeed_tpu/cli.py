@@ -7,6 +7,8 @@ Subcommands mirror the reference's script family:
   (``/v1/completions``, SSE streaming) over the async paged serving loop
 - ``dscli report [--telemetry f]``  — ``ds_report`` environment/op/memory report
 - ``dscli health <jsonl> [--once|--json]`` — live health screen over a telemetry sink
+- ``dscli top <url|jsonl>``         — refreshing serving/training dashboard (scrapes
+  ``/metrics`` or tails a sampler JSONL; SLO burn rates, KV tiers, percentiles)
 - ``dscli bench``                   — ``ds_bench`` collective micro-benchmarks
 - ``dscli ckpt verify <dir>``       — checkpoint integrity audit (per-tag manifest check)
 - ``dscli lint``                    — dslint trace-safety static analysis (rc=1 on new findings)
@@ -54,6 +56,15 @@ def _health(argv):
     telemetry sink (``telemetry.jsonl_path``); ``--once`` renders once."""
     from deepspeed_tpu.monitor.health import health_cli
     return health_cli(argv)
+
+
+def _top(argv):
+    """``dscli top`` — refreshing serving/training dashboard over a
+    ``/metrics`` scrape URL (``dscli serve`` exposes one) or a sampler/
+    telemetry JSONL: queue depth, TTFT/TPOT percentiles, KV pool + host
+    tier, SLO burn rates, loss EWMA, tokens/s."""
+    from deepspeed_tpu.monitor.top import top_cli
+    return top_cli(argv)
 
 
 def _bench(argv):
@@ -328,7 +339,7 @@ def _dlts_hostfile():
 
 
 _COMMANDS = {"run": _run, "serve": _serve, "report": _report,
-             "health": _health, "bench": _bench,
+             "health": _health, "top": _top, "bench": _bench,
              "ckpt": _ckpt, "lint": _lint, "trace": _trace,
              "profile": _profile, "elastic": _elastic, "autotune": _autotune,
              "ssh": _ssh}
@@ -337,8 +348,8 @@ _COMMANDS = {"run": _run, "serve": _serve, "report": _report,
 def main():
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
         print(__doc__)
-        print("usage: dscli {run|serve|report|health|bench|ckpt|lint|trace|"
-              "profile|elastic|autotune|ssh} [args...]")
+        print("usage: dscli {run|serve|report|health|top|bench|ckpt|lint|"
+              "trace|profile|elastic|autotune|ssh} [args...]")
         return 0
     cmd = sys.argv[1]
     if cmd not in _COMMANDS:

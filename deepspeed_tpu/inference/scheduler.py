@@ -820,7 +820,8 @@ class ContinuousBatchingScheduler:
             # first admission only: the submit->admit wait (a preemption
             # re-admission is recompute latency, not queueing delay)
             self.telemetry.queue_wait.observe(
-                (time.perf_counter() - req.t_submit) * 1e3)
+                (time.perf_counter() - req.t_submit) * 1e3,
+                exemplar={"rid": str(req.rid)})
         req.blocks = blocks
         req.keys = list(keys)
         req.pos = cached
@@ -1211,10 +1212,15 @@ class ContinuousBatchingScheduler:
         now = time.perf_counter()
         t = self.telemetry
         if t is not None:
+            # the exemplar links the histogram's newest observation back
+            # to its flight-recorder request track: a scraped p99 spike
+            # carries the rid whose trace explains it
             if req.t_first_token is None:
-                t.ttft.observe((now - req.t_arrival) * 1e3)
+                t.ttft.observe((now - req.t_arrival) * 1e3,
+                               exemplar={"rid": str(req.rid)})
             else:
-                t.tpot.observe((now - req.t_last_token) * 1e3)
+                t.tpot.observe((now - req.t_last_token) * 1e3,
+                               exemplar={"rid": str(req.rid)})
             t.generated_tokens.inc()
         if req.t_first_token is None:
             req.t_first_token = now

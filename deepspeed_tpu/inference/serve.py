@@ -543,6 +543,28 @@ def build_http_server(serving: AsyncServingEngine, host: str = "127.0.0.1",
                                        "draining" if serving._draining
                                        else "ok"),
                             "stopped": serving._stopped})
+            elif self.path == "/metrics":
+                # Prometheus exposition of the process registry — the
+                # scrape-and-alert plane's front door (one shared
+                # rendering path with the standalone exporter; exemplars
+                # only under negotiated OpenMetrics). Same liveness rule
+                # as /healthz: a stopped loop's stale numbers must not
+                # scrape as healthy 200s.
+                dead = serving._stopped or serving.error is not None
+                if dead:
+                    self._json(503, {"error": "serving loop stopped"})
+                    return
+                from deepspeed_tpu.monitor.exporter import (
+                    render_exposition, wants_openmetrics)
+                text, ctype = render_exposition(
+                    openmetrics=wants_openmetrics(
+                        self.headers.get("Accept")))
+                payload = text.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
             else:
                 self._json(404, {"error": f"no route {self.path}"})
 
@@ -674,6 +696,19 @@ def serve_main(argv=None, model=None, params=None,
     parser.add_argument("--telemetry", action="store_true",
                         help="enable telemetry + flight recorder (the "
                              "serving trace / dscli health surfaces)")
+    parser.add_argument("--sample-jsonl", default=None, metavar="PATH",
+                        help="start the background metrics sampler, "
+                             "appending registry snapshots to this "
+                             "rotated JSONL (dscli top / dscli health "
+                             "source); implies --telemetry")
+    parser.add_argument("--sample-interval", type=float, default=1.0,
+                        help="sampler cadence in seconds (default 1)")
+    parser.add_argument("--slo-ttft-ms", type=float, default=0.0,
+                        help="p99 TTFT objective in ms (0 = off): burn-"
+                             "rate breaches fire slo.breach events and "
+                             "slo/breaches counters; implies the sampler")
+    parser.add_argument("--slo-tpot-ms", type=float, default=0.0,
+                        help="p99 TPOT objective in ms (0 = off)")
     args = parser.parse_args(argv)
 
     import deepspeed_tpu
@@ -688,19 +723,39 @@ def serve_main(argv=None, model=None, params=None,
                    "speculative": {"mode": args.spec}}
     if args.policy is not None:
         serving_cfg["policy"] = args.policy
+    slo_on = bool(args.slo_ttft_ms or args.slo_tpot_ms)
+    want_plane = bool(args.sample_jsonl or slo_on)
     kwargs: Dict[str, Any] = {"dtype": args.dtype, "serving": serving_cfg}
-    if args.telemetry:
+    if args.telemetry or want_plane:
         kwargs["telemetry"] = {"events": True}
     if args.checkpoint:
         kwargs["checkpoint"] = args.checkpoint
     engine = deepspeed_tpu.init_inference(model, params=params, **kwargs)
+
+    sampler = None
+    if want_plane:
+        # the SLO engine evaluates on the sampler's ticks; either flag
+        # stands the sampling plane up (ring-only without --sample-jsonl)
+        from deepspeed_tpu.monitor.sampler import MetricsSampler
+        from deepspeed_tpu.monitor.slo import (SloEngine, parse_objectives,
+                                               serving_objectives)
+        slo = None
+        if slo_on:
+            slo = SloEngine(
+                parse_objectives(serving_objectives(
+                    ttft_p99_ms=args.slo_ttft_ms or None,
+                    tpot_p99_ms=args.slo_tpot_ms or None)),
+                events=engine._events)
+        sampler = MetricsSampler(interval_s=args.sample_interval,
+                                 path=args.sample_jsonl, slo=slo).start()
 
     serving = AsyncServingEngine(engine, max_new_tokens=args.max_new)
     server = build_http_server(serving, host=args.host, port=args.port)
     host, port = server.server_address[:2]
     print(f"dscli serve: {args.model} listening on "
           f"http://{host}:{port}/v1/completions "
-          f"(policy={serving.policy.name}, max_running={args.max_running})",
+          f"(policy={serving.policy.name}, max_running={args.max_running}; "
+          f"metrics at /metrics)",
           flush=True)
     if ready_cb is not None:
         ready_cb(server, serving)
@@ -715,4 +770,7 @@ def serve_main(argv=None, model=None, params=None,
         except Exception as e:  # noqa: BLE001 — exit path
             print(f"dscli serve: shutdown error: {e}")
             return 1
+        finally:
+            if sampler is not None:
+                sampler.stop()
     return 0

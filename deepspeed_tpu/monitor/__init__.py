@@ -1,12 +1,20 @@
 from deepspeed_tpu.monitor.config import (DeepSpeedMonitorConfig,
                                           EventsConfig, HealthConfig,
-                                          ProfileConfig, TelemetryConfig,
+                                          ProfileConfig, SamplerConfig,
+                                          SloConfig, TelemetryConfig,
                                           get_monitor_config,
                                           get_telemetry_config)
 from deepspeed_tpu.monitor.events import (EVENT_KINDS, Event, FlightRecorder,
+                                          export_recorder_metrics,
                                           export_serving_trace,
                                           get_flight_recorder,
                                           render_serving_trace)
+from deepspeed_tpu.monitor.exporter import (MetricsExporter,
+                                            render_exposition)
+from deepspeed_tpu.monitor.sampler import MetricsSampler, sampler_from_config
+from deepspeed_tpu.monitor.slo import (SloEngine, SloObjective,
+                                       parse_objectives, serving_objectives,
+                                       slo_from_config)
 from deepspeed_tpu.monitor.health import (HealthMonitor, StepHealth,
                                           compute_sentinels,
                                           make_bucket_assignment,
@@ -14,6 +22,7 @@ from deepspeed_tpu.monitor.health import (HealthMonitor, StepHealth,
                                           sample_memory_gauges,
                                           sentinel_to_dict)
 from deepspeed_tpu.monitor.metrics import (MetricsRegistry, get_registry,
+                                           parse_prometheus_text,
                                            validate_snapshot)
 from deepspeed_tpu.monitor.monitor import MonitorMaster
 from deepspeed_tpu.monitor.trace import (CompileWatchdog, ProfileWindow,
@@ -22,9 +31,14 @@ from deepspeed_tpu.monitor.trace import (CompileWatchdog, ProfileWindow,
 
 __all__ = [
     "DeepSpeedMonitorConfig", "EventsConfig", "HealthConfig",
-    "ProfileConfig", "TelemetryConfig",
+    "ProfileConfig", "SamplerConfig", "SloConfig", "TelemetryConfig",
     "EVENT_KINDS", "Event", "FlightRecorder", "get_flight_recorder",
-    "export_serving_trace", "render_serving_trace",
+    "export_recorder_metrics", "export_serving_trace",
+    "render_serving_trace",
+    "MetricsExporter", "render_exposition",
+    "MetricsSampler", "sampler_from_config",
+    "SloEngine", "SloObjective", "parse_objectives", "serving_objectives",
+    "slo_from_config", "parse_prometheus_text",
     "get_monitor_config", "get_telemetry_config", "MetricsRegistry",
     "get_registry", "validate_snapshot", "MonitorMaster", "CompileWatchdog",
     "ProfileWindow", "StepTracer", "get_compile_watchdog", "get_tracer",

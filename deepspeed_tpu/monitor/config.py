@@ -5,7 +5,7 @@ Reference parity: ``deepspeed/monitor/config.py``.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, List, Optional
 
 from pydantic import Field
 
@@ -88,6 +88,50 @@ class ProfileConfig(ConfigModel):
     dir: str = "ds_profile"
 
 
+class SamplerConfig(ConfigModel):
+    """"telemetry.sampler" sub-block: the background snapshot daemon
+    (``monitor/sampler.py``) — periodic registry snapshots appended to a
+    rotated JSONL time series plus an in-memory ring (the SLO engine's
+    input and ``dscli top``'s offline source). The sampler thread does
+    host-side dict work ONLY: zero device work, zero added compiles
+    (pinned by the ``serving_metrics_steady`` contract and dslint
+    DS009)."""
+    enabled: bool = False
+    # seconds between snapshots (the background thread's cadence; tests
+    # and trace replay drive tick() directly instead)
+    interval_s: float = 1.0
+    # JSONL sink (None = ring only). Rotated at max_bytes: path -> path.1
+    # -> ... -> path.<keep>, oldest dropped
+    path: Optional[str] = None
+    max_bytes: int = 16 << 20
+    keep: int = 2
+    # in-memory snapshot ring length (newest retained)
+    ring: int = 512
+
+
+class SloConfig(ConfigModel):
+    """"telemetry.slo" sub-block: service-level objectives evaluated by
+    ``monitor/slo.py`` as multi-window burn rates over the sampler's
+    ring. Each objective dict declares either a latency target
+    (``{"name": "ttft_p99", "metric": "serving/ttft_ms", "kind":
+    "latency", "threshold_ms": 500, "objective": 0.99}``: at most 1 % of
+    observations above 500 ms) or a ratio (``{"kind": "ratio", "metric":
+    "serving/rejected_requests", "total_metric": "serving/requests",
+    "objective": 0.999}``). Breaches emit ``slo.breach`` flight-recorder
+    events, increment ``slo/breaches{objective=}``, and surface in
+    ``health_summary`` / ``dscli top``. Enabling SLOs implies the
+    sampler (something must tick the evaluation)."""
+    enabled: bool = False
+    objectives: List[Dict] = Field(default_factory=list)
+    # default evaluation windows in sampler ticks (long, short): a breach
+    # needs EVERY window burning — the long window proves sustained
+    # budget loss, the short one proves it is still happening now
+    windows: List[int] = Field(default_factory=lambda: [60, 5])
+    # burn-rate alarm level: 1.0 = budget exhausted exactly at the SLO
+    # period's end; paging setups usually alarm well above 1
+    burn_rate_threshold: float = 1.0
+
+
 class TelemetryConfig(ConfigModel):
     """"telemetry" section: the cross-layer metrics registry + tracing.
 
@@ -123,6 +167,15 @@ class TelemetryConfig(ConfigModel):
     events: EventsConfig = Field(default_factory=EventsConfig)
     # on-demand jax.profiler capture window
     profile: ProfileConfig = Field(default_factory=ProfileConfig)
+    # standalone Prometheus exposition endpoint (monitor/exporter.py):
+    # GET /metrics on this port (0 = ephemeral, logged once bound; None =
+    # no exporter). `dscli serve` exposes /metrics on its own front-end
+    # regardless — this knob is the training-side scrape target.
+    metrics_port: Optional[int] = None
+    # background snapshot daemon (rotated JSONL + ring); bool shorthand
+    sampler: SamplerConfig = Field(default_factory=SamplerConfig)
+    # burn-rate SLO engine over the sampler's ring; bool shorthand
+    slo: SloConfig = Field(default_factory=SloConfig)
 
 
 def get_telemetry_config(param_dict: dict) -> TelemetryConfig:
@@ -163,14 +216,25 @@ def get_telemetry_config(param_dict: dict) -> TelemetryConfig:
 
     health = _sub_shorthand("health")
     events = _sub_shorthand("events")
+    sampler = _sub_shorthand("sampler")
+    slo = _sub_shorthand("slo")
     if t.get("profile") is None and "profile" in t:
         t["profile"] = {}    # null = defaults
     # enabling a sub-block implies the telemetry substrate it rides on,
     # unless the user explicitly disabled telemetry itself
-    for sub in (health, events):
+    for sub in (health, events, sampler, slo):
         if isinstance(sub, dict) and sub.get("enabled") \
                 and "enabled" not in t:
             t["enabled"] = True
+    # a scrape endpoint with nothing behind it would silently serve an
+    # empty registry: asking for /metrics implies telemetry too
+    if t.get("metrics_port") is not None and "enabled" not in t:
+        t["enabled"] = True
+    # SLOs need something ticking the evaluation: enabling slo implies
+    # the sampler (ring-only when no path is configured)
+    if isinstance(slo, dict) and slo.get("enabled") \
+            and isinstance(sampler, dict) and "enabled" not in sampler:
+        sampler["enabled"] = True
     return TelemetryConfig(**t)
 
 

@@ -8,7 +8,7 @@ skip), the continuous-batching scheduler (enqueue / admit / cache hit /
 preempt / retire / cancel, speculative propose / rollback), the inference
 engine (prefill, prefill chunk, COW copy, fused decode tick, speculative
 verify, tiered-KV spill / fetch), the async serving front-end (submit /
-drain), and the crash-safe
+drain), the burn-rate SLO engine (breach), and the crash-safe
 checkpoint writer (snapshot / serialize / commit / retry). The buffer keeps the newest
 ``capacity`` events (a flight recorder preserves the TAIL — the moments
 before the incident), counting evictions in ``dropped``.
@@ -90,6 +90,10 @@ EVENT_KINDS = frozenset({
     #                         running=, pending=)
     # scheduler occupancy sample (the counter-track source)
     "sched.gauge",          # queued=, running=, kv_used=, kv_free=
+    # SLO engine (monitor/slo.py): a burn-rate alert fired
+    "slo.breach",           # objective=, tick=, burn_rate=, threshold=,
+    #                         window= (the longest evaluation window —
+    #                         also the refire period)
 })
 
 
@@ -228,6 +232,33 @@ def get_flight_recorder() -> FlightRecorder:
     return _recorder
 
 
+def export_recorder_metrics(registry=None,
+                            recorder: Optional[FlightRecorder] = None
+                            ) -> None:
+    """Publish the recorder's ring health as ``events/dropped`` /
+    ``events/capacity`` gauges so silent trace loss is visible on the
+    ``/metrics`` plane (a post-mortem that trusts a ring which quietly
+    evicted its incident is worse than no ring). Called by the exporter
+    on every scrape and by the sampler on every tick; a disabled
+    recorder exports nothing (nothing is being lost — it records
+    nothing by design)."""
+    rec = recorder if recorder is not None else get_flight_recorder()
+    if not rec.enabled:
+        return
+    if registry is None:
+        from deepspeed_tpu.monitor.metrics import get_registry
+        registry = get_registry()
+    registry.gauge(
+        "events/capacity",
+        "flight-recorder ring size (events retained before eviction)"
+    ).set(rec.capacity)
+    registry.gauge(
+        "events/dropped",
+        "flight-recorder events evicted since enable/clear — nonzero "
+        "means the trace tail no longer reaches back to the incident"
+    ).set(rec.dropped)
+
+
 # ------------------------------------------------------------------ #
 # serving trace rendering: chrome-trace JSON, one track per request
 
@@ -259,7 +290,7 @@ def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
     ``generate_batch`` engine spans (pid 2)."""
     events = [e for e in events
               if e.kind.startswith(("req.", "serve.", "decode.", "sched.",
-                                    "kv."))]
+                                    "kv.", "slo."))]
     out: List[Dict[str, Any]] = []
     if not events:
         return {"traceEvents": out, "displayTimeUnit": "ms"}
@@ -358,6 +389,12 @@ def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
                         "args": dict(e.data or {})})
         elif e.kind == "serve.drain":
             out.append({"name": "drain", "cat": "serving", "ph": "i",
+                        "s": "p", "pid": _ENGINE_PID, "tid": _ENGINE_TID,
+                        "ts": us(e.ts_ns), "args": dict(e.data or {})})
+        elif e.kind == "slo.breach":
+            # burn-rate alerts belong to the engine timeline: the trace
+            # shows WHEN the budget blew relative to the request spans
+            out.append({"name": "slo_breach", "cat": "serving", "ph": "i",
                         "s": "p", "pid": _ENGINE_PID, "tid": _ENGINE_TID,
                         "ts": us(e.ts_ns), "args": dict(e.data or {})})
 

@@ -609,6 +609,30 @@ def labeled_series(section: Dict, name: str) -> Dict[str, float]:
     return out
 
 
+def multilabel_series(section: Dict, name: str):
+    """``[({label: value}, metric_value)]`` for every ``name{k="v",...}``
+    series — the multi-label sibling of :func:`labeled_series` (e.g.
+    ``slo/burn_rate{objective=,window=}``). Values containing commas or
+    quotes are beyond this tail parser and are skipped, matching the
+    snapshot keys the registry actually writes."""
+    out = []
+    prefix = name + "{"
+    for k, v in section.items():
+        if not (k.startswith(prefix) and k.endswith("}")):
+            continue
+        labels = {}
+        ok = True
+        for part in k[len(prefix):-1].split(","):
+            kk, eq, vv = part.partition("=")
+            if not eq:
+                ok = False
+                break
+            labels[kk.strip()] = vv.strip().strip('"')
+        if ok:
+            out.append((labels, v))
+    return out
+
+
 def _fmt_bytes(n: float) -> str:
     n = float(n)
     for unit in ("B", "KB", "MB", "GB", "TB"):
@@ -818,6 +842,34 @@ def render_summary_table(s: Dict[str, Any]) -> str:
         if parts:
             lines.append("serving  " + "   ".join(parts))
 
+    # ---- SLO burn rates ---- #
+    slo = s.get("slo")
+    if slo is not None:
+        parts = []
+        burn = slo.get("burn_rate") or {}
+        fired = slo.get("breaches") or {}
+        for obj in sorted(set(burn) | set(fired)):
+            wins = burn.get(obj, {})
+            # longest window first, matching the (long, short) config order
+            ws = " ".join(
+                f"{w}t {wins[w]:.2f}x"
+                for w in sorted(wins, key=lambda x: -int(x)
+                                if str(x).lstrip("-").isdigit() else 0))
+            line = f"{obj} " + (f"burn {ws}" if ws else "burn -")
+            n = int(fired.get(obj, 0))
+            if n:
+                line += f" BREACH x{n}"
+            parts.append(line)
+        if parts:
+            lines.append("slo      " + "   ".join(parts))
+
+    # ---- flight-recorder ring loss ---- #
+    ev = s.get("events")
+    if ev and ev.get("dropped"):
+        lines.append(f"events   dropped {int(ev['dropped'])} "
+                     f"(ring {int(ev.get('capacity', 0))}) — trace tail "
+                     "truncated")
+
     if len(lines) == 2:
         lines.append("(no recognized series in this snapshot)")
     return "\n".join(lines)
@@ -931,6 +983,27 @@ def health_summary(rec: Dict, prev: Optional[Dict] = None) -> Dict[str, Any]:
             serving[name] = c[key]
     if serving:
         out["serving"] = serving
+
+    # ---- SLO burn rates / breaches (monitor/slo.py) ---- #
+    slo: Dict[str, Any] = {}
+    breaches = labeled_series(c, "slo/breaches")
+    if breaches:
+        slo["breaches"] = {k: int(v) for k, v in sorted(breaches.items())}
+    burn: Dict[str, Dict[str, float]] = {}
+    for labels, v in multilabel_series(g, "slo/burn_rate"):
+        obj = labels.get("objective")
+        win = labels.get("window")
+        if obj is not None and win is not None:
+            burn.setdefault(obj, {})[win] = v
+    if burn:
+        slo["burn_rate"] = burn
+    if slo:
+        out["slo"] = slo
+
+    # ---- flight-recorder ring loss (events/dropped gauges) ---- #
+    if "events/dropped" in g:
+        out["events"] = {"dropped": int(g["events/dropped"]),
+                         "capacity": int(g.get("events/capacity", 0))}
 
     out["snapshot"] = rec
     return out

@@ -246,6 +246,12 @@ class DeepSpeedEngine:
         # (telemetry.profile) or engine.profile(steps=N). One None check
         # per train_batch when absent.
         self._profiler = None
+        # metrics exposition plane (monitor/exporter.py, monitor/
+        # sampler.py): a standalone /metrics endpoint + the background
+        # snapshot/SLO sampler — both config-driven, both host-only
+        # daemon threads, stopped in destroy()
+        self._tel_exporter = None
+        self._tel_sampler = None
         pcfg = tcfg.profile
         if pcfg.num_steps > 0:
             from deepspeed_tpu.monitor.trace import ProfileWindow
@@ -309,6 +315,17 @@ class DeepSpeedEngine:
                     snapshot_fn=self.telemetry_snapshot,
                     trace_export_fn=self._tel_tracer.export_chrome_trace)
                 self._sentinels_on = bool(hcfg.sentinels)
+            if tcfg.metrics_port is not None:
+                from deepspeed_tpu.monitor.exporter import MetricsExporter
+                self._tel_exporter = MetricsExporter(
+                    reg, port=tcfg.metrics_port)
+                ehost, eport = self._tel_exporter.start()
+                logger.info(f"telemetry: /metrics exposition on "
+                            f"http://{ehost}:{eport}/metrics")
+            from deepspeed_tpu.monitor.sampler import sampler_from_config
+            sampler = sampler_from_config(tcfg, reg, self._tel_events)
+            if sampler is not None:
+                self._tel_sampler = sampler.start()
 
         # ---- curriculum learning (reference engine.py:1691 legacy path +
         # data_efficiency data_sampling.curriculum_learning) ----
@@ -1562,6 +1579,12 @@ class DeepSpeedEngine:
         self.disable_preemption_handler()
         if self._profiler is not None:
             self._profiler.stop()   # a dangling capture wedges the profiler
+        if self._tel_sampler is not None:
+            self._tel_sampler.stop()
+            self._tel_sampler = None
+        if self._tel_exporter is not None:
+            self._tel_exporter.stop()
+            self._tel_exporter = None
         if self._ckpt_writer is not None:
             self._ckpt_writer.stop()
             self._ckpt_writer = None

@@ -669,7 +669,8 @@ class TestCliAndCatalogue:
 
     def test_catalogue_ids_and_rationales(self):
         assert set(dslint.RULES) == {"DS001", "DS002", "DS003", "DS004",
-                                     "DS005", "DS006", "DS007", "DS008"}
+                                     "DS005", "DS006", "DS007", "DS008",
+                                     "DS009"}
         names = [r.name for r in dslint.RULES.values()]
         assert len(set(names)) == len(names)
         for info in dslint.RULES.values():

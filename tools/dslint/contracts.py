@@ -60,6 +60,17 @@ class CompileBudget:
 #:                     programs are block-index-traced (one program each no
 #:                     matter which block moves; budget 2 for the donation/
 #:                     layout variants a re-entered workspace can add)
+#:   serving_metrics_steady — the telemetry exposition plane beside a warm
+#:                     serving loop: a closed-loop warm-up, then open-loop
+#:                     traffic with the background MetricsSampler ticking
+#:                     (snapshots + SLO burn-rate evaluation) and the
+#:                     /metrics exporter being scraped throughout. THE
+#:                     SAMPLER/EXPORTER THREADS DO ZERO DEVICE WORK AND
+#:                     ADD ZERO COMPILES — a scrape or a snapshot is
+#:                     host-side dict work only (dslint DS009 pins the
+#:                     no-jax-import half statically; this contract pins
+#:                     the dynamic half), so each fused entry compiles
+#:                     exactly as often as the unsampled async scenario
 #:   serving_sharded_steady — generate_batch under serving.tp > 1 (head-
 #:                     sharded KV pools, shard_map'd paged kernel), prefix
 #:                     cache + speculation on, prompts within two 128-token
@@ -151,6 +162,27 @@ BUDGETS: List[CompileBudget] = [
         "chunk-bucketed exactly like the closed loop"),
     CompileBudget(
         "inference.paged_cow", "serving_async_steady", 1,
+        "copy-on-write block copy: fixed block geometry"),
+    CompileBudget(
+        "inference.paged_decode", "serving_metrics_steady", 1,
+        "THE fused decode step is observation-independent: sampler ticks "
+        "and /metrics scrapes read host-side registry state under its "
+        "lock — they never touch the jit cache, donate a buffer, or "
+        "perturb an input signature"),
+    CompileBudget(
+        "inference.paged_verify", "serving_metrics_steady", 1,
+        "fused verify under scrape load: one program per k window "
+        "bucket, same as the unobserved loop"),
+    CompileBudget(
+        "inference.paged_prefill", "serving_metrics_steady", 2,
+        "admission prefill: one compile per 128-token prompt bucket, "
+        "the scenario stays within two — scrapes add none"),
+    CompileBudget(
+        "inference.paged_prefill_chunk", "serving_metrics_steady", 4,
+        "cache-hit tails / chunked prefill: one program per (chunk "
+        "bucket, table-width power-of-two) pair, same as unobserved"),
+    CompileBudget(
+        "inference.paged_cow", "serving_metrics_steady", 1,
         "copy-on-write block copy: fixed block geometry"),
     CompileBudget(
         "inference.paged_decode", "serving_tiered_steady", 1,

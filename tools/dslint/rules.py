@@ -11,7 +11,11 @@ hazard classes behind this repo's shipped bugs:
 - DS005 polices host-side timing brackets around jit dispatch (the PR-7
   async-dispatch-clocked-as-device-work class);
 - DS007/DS008 police the pytest marker/tier machinery (the PR-2
-  ``-m``-replaces-addopts trap).
+  ``-m``-replaces-addopts trap);
+- DS009 polices the metrics exposition plane (sampler / exporter / SLO /
+  top): those threads run beside a hot serving loop and must never touch
+  jax or the accelerator — the static half of the
+  ``serving_metrics_steady`` zero-device-work contract.
 """
 
 from __future__ import annotations
@@ -764,4 +768,68 @@ def ungated_tier_marker(ctx: LintContext) -> List[Finding]:
             message=f"tier marker `{marker}` is excluded via addopts -m "
                     "but has no conftest env-gated skip — a command-line "
                     "-m replaces addopts and would unleash the tier"))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# DS009 metrics-plane-device-isolation
+
+#: modules forming the telemetry exposition plane: their code runs on
+#: sampler/exporter scrape threads beside a hot serving loop and must
+#: stay host-side dict work — the static half of the
+#: ``serving_metrics_steady`` contract (the dynamic half is the
+#: zero-added-compiles budget the CompileWatchdog verifies)
+_METRICS_PLANE_SUFFIXES = (
+    "monitor/sampler.py",
+    "monitor/exporter.py",
+    "monitor/slo.py",
+    "monitor/top.py",
+)
+
+#: imports that put device work in reach: jax itself (any submodule) and
+#: the accelerator abstraction (device memory/stat queries)
+_DEVICE_MODULE_HEADS = ("jax", "jaxlib")
+_DEVICE_MODULE_PREFIXES = ("deepspeed_tpu.accelerator",)
+
+
+def _device_module(name: str) -> bool:
+    head = name.split(".")[0]
+    if head in _DEVICE_MODULE_HEADS:
+        return True
+    return any(name == p or name.startswith(p + ".")
+               for p in _DEVICE_MODULE_PREFIXES)
+
+
+@rule("DS009", "metrics-plane-device-isolation")
+def metrics_plane_device_isolation(ctx: LintContext) -> List[Finding]:
+    """The exposition plane (metrics sampler, /metrics exporter, SLO
+    engine, ``dscli top``) runs on background threads whose whole
+    contract is ZERO device work: a scrape or a sampling tick beside a
+    hot serving loop must never trigger a transfer, a device query, or —
+    worst — a compile on a foreign thread. Any ``import jax`` (top-level
+    OR function-local: a lazy import still executes on the sampler
+    thread) or accelerator import inside those modules breaks that
+    isolation; device-derived series (HBM gauges, MFU) belong to the
+    engines, which publish INTO the registry on their own step cadence."""
+    out: List[Finding] = []
+    for mod in ctx.index.modules:
+        if not mod.rel.endswith(_METRICS_PLANE_SUFFIXES):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names
+                         if _device_module(a.name)]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                names = [node.module] \
+                    if _device_module(node.module) else []
+            else:
+                continue
+            for name in names:
+                out.append(Finding(
+                    rule="DS009", path=mod.rel, line=node.lineno,
+                    message=f"`{name}` imported in metrics-plane module "
+                            f"`{mod.rel}` — sampler/exporter threads must "
+                            "do zero device work (the "
+                            "serving_metrics_steady contract); publish "
+                            "device series from the engines instead"))
     return out
