@@ -80,6 +80,16 @@ Env knobs (defaults are the chip-measured fast path):
                            BENCH_SERVE_ASYNC_REQS=24
                            BENCH_SERVE_ASYNC_NEW=32
                            BENCH_SERVE_ASYNC_TPOT_MS=50 (p99 target)
+  BENCH_SERVE_CHAOS=1      serving fault-tolerance probe: the Poisson
+                           async run re-run under a seeded injection
+                           schedule (one engine-fatal fault + scattered
+                           per-request step faults), value = faulted-run
+                           goodput, vs_baseline = GOODPUT RETENTION
+                           (faulted/clean); restart/retry/quarantine
+                           counters ride the telemetry blob;
+                           BENCH_SERVE_CHAOS_RATE=8 (req/s)
+                           BENCH_SERVE_CHAOS_REQS=16
+                           BENCH_SERVE_CHAOS_NEW=32
   BENCH_SKIP_PROBE=0       skip the subprocess backend probe
   BENCH_PROBE_RETRIES=1    probe retries before giving up on the backend
   BENCH_ALLOW_CPU=0        on probe failure, run a tiny CPU smoke metric
@@ -184,12 +194,18 @@ def _telemetry_blob(engine):
               "serving/spec_rollbacks", "serving/rejected_requests",
               "serving/kv_spills", "serving/kv_fetch_hits",
               "serving/kv_fetch_tokens", "serving/kv_host_errors",
+              "serving/engine_restarts", "serving/request_retries",
+              "serving/timeouts", "serving/shed_requests",
               "checkpoint/saves",
               "checkpoint/failures"):
         if k in c:
             blob[k] = c[k]
     # health summary: detector firings (zero-valued on a clean run)
     from deepspeed_tpu.monitor.health import labeled_series
+    faults = {k: int(v)
+              for k, v in labeled_series(c, "serving/step_faults").items()}
+    if faults:
+        blob["serving/step_faults"] = faults
     anoms = {k: int(v)
              for k, v in labeled_series(c, "health/anomalies").items()}
     if anoms:
@@ -438,6 +454,7 @@ BENCH_METRICS = [
     ("BENCH_SERVE_CHUNKED", "1", "gpt2_serving_chunked_prefill_tpot_p99_ms"),
     ("BENCH_SERVE_SPEC", "1", "gpt2_serving_spec_decode_tpot_ms"),
     ("BENCH_SERVE_ASYNC", "1", "gpt2_serving_async_goodput_tokens_per_sec"),
+    ("BENCH_SERVE_CHAOS", "1", "gpt2_serving_chaos_goodput_tokens_per_sec"),
     ("BENCH_SERVE_TP", "1", "gpt2_serving_tp_tokens_per_sec"),
     ("BENCH_CKPT", "1", "gpt2_ckpt_async_stall_ms_per_step"),
 ]
@@ -798,6 +815,51 @@ def run_spec_decode_bench():
         del engine
 
 
+def _drive_open_loop(engine, prompts, gaps, max_new, consume,
+                     injector=None):
+    """Shared Poisson open-loop driver for the async/chaos serving
+    probes: submit the seeded arrival trace (`sleep(gap)` then
+    `add_request`) to a fresh ``AsyncServingEngine``, fan one
+    ``consume(handle, rec)`` thread per request, join, drain — so the
+    two probes' goodput accounting can never drift methodologically.
+    ``injector`` (a ``FaultInjector``) is installed for the run's
+    duration. Returns ``(recs, wall_seconds, serving)``; ``serving`` is
+    already shut down (aborted if the drain failed)."""
+    import threading
+    import time as _t
+
+    from deepspeed_tpu.inference.serve import AsyncServingEngine
+    from deepspeed_tpu.utils import fault_injection as fi
+
+    serving = AsyncServingEngine(engine, max_new_tokens=max_new)
+    recs, threads = [], []
+    t0 = _t.perf_counter()
+    try:
+        if injector is not None:
+            fi.install(injector)
+        for p, gap in zip(prompts, gaps):
+            _t.sleep(gap)
+            h = serving.add_request(p)
+            rec = {"tpot": [], "tokens": 0}
+            th = threading.Thread(target=consume, args=(h, rec),
+                                  daemon=True)
+            th.start()
+            recs.append(rec)
+            threads.append(th)
+        for th in threads:
+            th.join(600)
+        serving.shutdown(drain=True, timeout=600)
+    finally:
+        if injector is not None:
+            fi.clear()
+        if not serving._stopped:
+            try:
+                serving.shutdown(drain=False, timeout=60)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+    return recs, _t.perf_counter() - t0, serving
+
+
 def run_async_serving_bench():
     """Open-loop async serving probe: Poisson arrivals (exponential
     inter-arrival gaps at BENCH_SERVE_ASYNC_RATE req/s, seeded — the
@@ -813,7 +875,6 @@ def run_async_serving_bench():
     next to the tempdir and its path embedded. Failures degrade to the
     standard skip record (skip_stage/skip_error), never an rc!=0."""
     import tempfile
-    import threading
     import time as _t
 
     import numpy as np
@@ -822,11 +883,10 @@ def run_async_serving_bench():
     NREQ = int(os.environ.get("BENCH_SERVE_ASYNC_REQS", 24))
     MAX_NEW = int(os.environ.get("BENCH_SERVE_ASYNC_NEW", 32))
     TARGET = float(os.environ.get("BENCH_SERVE_ASYNC_TPOT_MS", 50.0))
-    serving = engine = sampler = None
+    engine = sampler = None
     try:
         import deepspeed_tpu
         import deepspeed_tpu.comm as dist
-        from deepspeed_tpu.inference.serve import AsyncServingEngine
         from deepspeed_tpu.models import gpt2
 
         dist.set_mesh(None)
@@ -870,22 +930,9 @@ def run_async_serving_bench():
             events=engine._events)
         sampler = MetricsSampler(interval_s=0.25, slo=slo).start()
 
-        serving = AsyncServingEngine(engine, max_new_tokens=MAX_NEW)
-        recs, threads = [], []
-        t0 = _t.perf_counter()
-        for p, gap in zip(prompts, gaps):
-            _t.sleep(gap)
-            h = serving.add_request(p)
-            rec = {"tpot": [], "tokens": 0}
-            th = threading.Thread(target=consume, args=(h, rec), daemon=True)
-            th.start()
-            recs.append(rec)
-            threads.append(th)
-        for th in threads:
-            th.join(600)
-        serving.shutdown(drain=True, timeout=600)
+        recs, wall, _serving = _drive_open_loop(engine, prompts, gaps,
+                                                MAX_NEW, consume)
         sampler.stop()                  # final tick lands shutdown state
-        wall = _t.perf_counter() - t0
 
         good = total = met = 0
         for rec in recs:
@@ -951,14 +998,106 @@ def run_async_serving_bench():
             "skip_error": f"{type(e).__name__}: {e}",
         }), flush=True)
     finally:
+        # the open-loop driver owns the serving teardown
         if sampler is not None:
             sampler.stop(final_tick=False)
-        if serving is not None and not serving._stopped:
-            try:
-                serving.shutdown(drain=False, timeout=60)
-            except Exception:  # noqa: BLE001 — teardown best-effort
-                pass
-        del serving, engine
+        del engine
+
+
+def run_serve_chaos_bench():
+    """Serving fault-tolerance probe: the Poisson-arrival async goodput
+    run executed twice on one engine — CLEAN, then again under a SEEDED
+    fault-injection schedule (one engine-fatal fault that forces a
+    crash-safe engine restart, plus scattered per-request step faults that
+    exercise retry/backoff containment). Value = the faulted run's goodput
+    (generated tokens/s over FINISHED requests); vs_baseline = GOODPUT
+    RETENTION, faulted/clean — 1.0 means the fault-tolerance spine cost
+    nothing, 0 means the loop died (it must not: a crashed loop fails the
+    probe into a skip record). Restart/retry/quarantine counters and the
+    step-fault breakdown ride the record's telemetry blob."""
+    import numpy as np
+
+    RATE = float(os.environ.get("BENCH_SERVE_CHAOS_RATE", 8.0))
+    NREQ = int(os.environ.get("BENCH_SERVE_CHAOS_REQS", 16))
+    MAX_NEW = int(os.environ.get("BENCH_SERVE_CHAOS_NEW", 32))
+    engine = None
+    try:
+        import deepspeed_tpu
+        import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.models import gpt2
+        from deepspeed_tpu.utils import fault_injection as fi
+
+        dist.set_mesh(None)
+        _reset_telemetry()
+        model = gpt2("125m", remat=False,
+                     attention_backend=os.environ.get("BENCH_ATTN", "auto"))
+        engine = deepspeed_tpu.init_inference(
+            model, dtype="bf16", telemetry={"events": True},
+            serving={"block_size": 128, "max_running": 8,
+                     "prefix_caching": "off"})
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(0, 50257, size=int(n)).astype(np.int32)
+                   for n in rng.integers(64, 192, size=NREQ)]
+        gaps = rng.exponential(1.0 / max(RATE, 1e-6), size=NREQ)
+        # closed-loop warm-up so neither run pays compile time in its
+        # arrival window (the faulted run recompiles once mid-run by
+        # design — that recovery cost IS part of what it measures)
+        engine.generate_batch(prompts[:2], max_new_tokens=MAX_NEW)
+
+        def consume(h, rec):
+            for burst in h.stream():
+                rec["tokens"] += len(burst)
+            rec["status"] = h.status
+
+        def one_run(injector):
+            recs, wall, serving = _drive_open_loop(
+                engine, prompts, gaps, MAX_NEW, consume, injector=injector)
+            good = sum(r["tokens"] for r in recs
+                       if r.get("status") == "finished")
+            done = sum(r.get("status") == "finished" for r in recs)
+            return (good / wall if wall > 0 else 0.0, done,
+                    serving.restarts)
+
+        clean, clean_done, _ = one_run(None)
+        _reset_telemetry()       # the record's blob describes the faulted run
+        # the seeded schedule: an engine-fatal mid-run + per-request
+        # faults scattered through the action stream (deterministic given
+        # the injector's step counter)
+        inj = fi.FaultInjector()
+        inj.fail_step("decode", at_step=max(NREQ, 8), count=1, phase="post")
+        inj.fail_step("prefill", at_step=3, count=1)
+        inj.fail_step("decode", at_step=2 * max(NREQ, 8), count=1)
+        faulted, faulted_done, restarts = one_run(inj)
+
+        out = {
+            "metric": _metric_name("BENCH_SERVE_CHAOS"),
+            "value": round(faulted, 1),
+            "unit": f"goodput tokens/s under injected faults (bf16 open "
+                    f"loop, Poisson {RATE}/s x {NREQ} reqs x {MAX_NEW} "
+                    f"new; 1 engine-fatal + 2 per-request faults; "
+                    f"{faulted_done}/{NREQ} finished vs {clean_done}/"
+                    f"{NREQ} clean at {clean:.1f} tok/s)",
+            # goodput retention: how much serving capacity survives the
+            # fault schedule (restart recompiles + recompute retries)
+            "vs_baseline": round(faulted / clean, 3) if clean else 0.0,
+        }
+        tel = _telemetry_blob(engine) or {}
+        tel["engine_restarts"] = restarts
+        out["telemetry"] = tel
+        print(json.dumps(out), flush=True)
+    except Exception as e:  # noqa: BLE001 — probe failure => skip record
+        print(json.dumps({
+            "metric": _metric_name("BENCH_SERVE_CHAOS"),
+            "value": 0.0,
+            "unit": "goodput tokens/s under injected faults (skipped: "
+                    "serving chaos probe failed)",
+            "vs_baseline": 0.0,
+            "skipped": True,
+            "skip_stage": "serve_chaos_run",
+            "skip_error": f"{type(e).__name__}: {e}",
+        }), flush=True)
+    finally:
+        del engine
 
 
 def run_serving_tp_bench():
@@ -1265,7 +1404,8 @@ def main():
     if any(_metric_enabled(g) for g in
            ("BENCH_DECODE_DENSE", "BENCH_DECODE_PAGED",
             "BENCH_SERVE_PREFIX", "BENCH_KV_TIER", "BENCH_SERVE_CHUNKED",
-            "BENCH_SERVE_SPEC", "BENCH_SERVE_ASYNC", "BENCH_SERVE_TP")):
+            "BENCH_SERVE_SPEC", "BENCH_SERVE_ASYNC", "BENCH_SERVE_CHAOS",
+            "BENCH_SERVE_TP")):
         # free the last training engine's device state before serving
         if engine is not None:
             del engine, model, batch
@@ -1289,6 +1429,9 @@ def main():
             gc.collect()
         if _metric_enabled("BENCH_SERVE_ASYNC"):
             run_async_serving_bench()
+            gc.collect()
+        if _metric_enabled("BENCH_SERVE_CHAOS"):
+            run_serve_chaos_bench()
             gc.collect()
         if _metric_enabled("BENCH_SERVE_TP"):
             run_serving_tp_bench()

@@ -161,6 +161,51 @@ class KvHostConfig(ConfigModel):
     spill: str = "auto"        # auto | off (off = fetch-only, no demotion)
 
 
+class ServingFaultConfig(ConfigModel):
+    """Serving-plane fault tolerance ("serving.fault" sub-section).
+
+    Governs how the always-on loop (``inference/serve.py``) contains
+    engine-step failures — the serving mirror of the training side's
+    crash-safe checkpointing:
+
+    - a **per-request** fault (raised before the step's donated pools were
+      consumed — e.g. a poison request crashing host-side prep, an injected
+      ``fail_step(phase="pre")``) re-queues the faulting action's
+      request(s) through the recompute-preemption machinery with
+      exponential backoff in LOGICAL scheduler steps
+      (``retry_backoff_steps * 2**(retry-1)``); after
+      ``max_request_retries`` retries the request **quarantines** — retired
+      with ``req.error`` while the loop keeps serving everyone else;
+    - an **engine-fatal** fault (anything that died with the donated pools
+      already consumed mid-step) triggers a crash-safe engine restart: the
+      pool workspace, allocator and fused-step jits are rebuilt and every
+      in-flight request is re-admitted from prompt + generated tokens —
+      exactly the recovery path recompute-preemption already proves
+      correct — at most ``max_engine_restarts`` times (each preceded by
+      ``restart_backoff_s * 2**(restart-1)`` of wall backoff); exhausted,
+      the **crash-loop breaker** opens: in-flight requests fail, the loop
+      parks, ``/healthz`` reads 503, and ``drain()``/``shutdown()`` still
+      work;
+    - ``shed_queue_depth`` > 0 turns on **load shedding**: whenever the
+      waiting queue exceeds the bound the loop sheds the scheduling
+      policy's ``select_shed_victim`` picks (lowest priority first, newest
+      arrival on ties — deterministic) until it fits, retiring each as
+      ``shed`` (HTTP 429).
+
+    Containment is deterministic given a request trace + injection
+    schedule; every decision emits flight-recorder events (``serve.fault``
+    / ``serve.restart`` / ``req.requeue`` / ``req.timeout`` / ``req.shed``)
+    and counts into ``serving/step_faults{kind=}``,
+    ``serving/engine_restarts``, ``serving/request_retries``,
+    ``serving/timeouts`` and ``serving/shed_requests``.
+    """
+    max_request_retries: int = 3   # retries before a request quarantines
+    retry_backoff_steps: int = 2   # logical-step backoff base (x2 per retry)
+    max_engine_restarts: int = 2   # engine rebuilds before the breaker opens
+    restart_backoff_s: float = 0.0  # wall backoff base between restarts
+    shed_queue_depth: int = 0      # shed waiting requests above this (0=off)
+
+
 class ServingConfig(ConfigModel):
     """Continuous-batching serving config ("serving" section).
 
@@ -223,6 +268,9 @@ class ServingConfig(ConfigModel):
     # (see KvHostConfig)
     speculative: SpeculativeConfig = Field(
         default_factory=SpeculativeConfig)
+    fault: ServingFaultConfig = Field(default_factory=ServingFaultConfig)
+    # serving-plane fault tolerance: step-fault containment, crash-safe
+    # engine restarts, load shedding (see ServingFaultConfig)
     policy: Union[str, Dict[str, Any]] = "fifo"   # fifo | priority | sla,
     # or {"name": ..., **kwargs} (see inference/policy.py); the serving
     # loop's scheduling policy — generate_batch always runs FIFO

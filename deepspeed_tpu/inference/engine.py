@@ -30,6 +30,7 @@ import numpy as np
 
 import deepspeed_tpu.comm as dist
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.utils.fault_injection import step_fault as _step_fault
 from deepspeed_tpu.utils.logging import log_dist, logger, warn_once
 
 
@@ -1400,6 +1401,17 @@ class _ServeSession:
         (self._prefill_jit, self._decode_jit, self._chunk_jit,
          self._cow_jit, self._verify_jit, self._spill_jit,
          self._fetch_jit) = jits
+        # fault containment (serving.fault): the action a fault can be
+        # attributed to, the finer-grained dispatch site for the
+        # step_faults{kind=} label (an action may run cow/fetch sub-steps
+        # before its own dispatch), and the retry/backoff bounds the
+        # always-on loop's containment applies (see contain_fault)
+        self.last_action = None
+        self.fault_site = None
+        fault = engine._config.serving.fault
+        self.fault_max_retries = int(fault.max_request_retries)
+        self.fault_backoff_steps = int(fault.retry_backoff_steps)
+        self._kv_spill = kv_spill
         # tiered KV cache: the demotion hook is session-scoped — it reads
         # the LIVE (donated-through) pools, so it must never outlive this
         # session (close() clears it)
@@ -1433,7 +1445,8 @@ class _ServeSession:
     _UNSET = object()
 
     def add(self, prompt, max_new=None, eos=_UNSET, priority: int = 0,
-            ttft_budget=None, t_submit=None):
+            ttft_budget=None, t_submit=None, deadline_ms=None,
+            deadline_steps=None):
         """Enqueue one request (any time — mid-decode arrivals are the
         point). ``max_new``/``eos`` default to the session-wide values."""
         if self._closed:
@@ -1451,7 +1464,8 @@ class _ServeSession:
                 f"model max_seq {cfg.max_seq}")
         return self.sched.add_request(
             prompt, mn, self.eos_token_id if eos is self._UNSET else eos,
-            priority=priority, ttft_budget=ttft_budget, t_submit=t_submit)
+            priority=priority, ttft_budget=ttft_budget, t_submit=t_submit,
+            deadline_ms=deadline_ms, deadline_steps=deadline_steps)
 
     def cancel(self, req) -> bool:
         """Cancel between engine steps; fires ``on_finish`` for the
@@ -1468,10 +1482,14 @@ class _ServeSession:
         is runnable — queue and running batch both empty."""
         if self._closed:
             raise RuntimeError("serving session is closed")
+        self.last_action = None      # a fault in next_action itself must
+        self.fault_site = None       # not be attributed to the PREVIOUS
+        # step's action or dispatch site
         action = self.sched.next_action()
         if action is None:
             self._flush_finished()   # admission-time error retirements
             return False
+        self.last_action = action
         self._exec(action)
         self._flush_finished()
         return True
@@ -1491,6 +1509,108 @@ class _ServeSession:
             del fin[:self._finished_seen]
             self._finished_seen = 0
 
+    # ---- serving fault containment (serving.fault) ---- #
+
+    def pools_alive(self) -> bool:
+        """Whether the session's pool buffers are still valid. Every
+        fused step DONATES the pools, so an exception between the
+        dispatch and the adoption of its outputs leaves ``self.pools``
+        naming consumed buffers — the definitive engine-fatal signature
+        (a pre-dispatch failure leaves them intact: per-request)."""
+        return not any(getattr(a, "is_deleted", lambda: False)()
+                       for a in jax.tree.leaves(self.pools))
+
+    def contain_fault(self, exc: BaseException) -> str:
+        """Classify and (when possible) contain an exception that escaped
+        :meth:`step`. Returns ``"request"`` when the fault was contained
+        per-request — the faulting action's request(s) re-queued with
+        logical-step backoff, or quarantined with ``req.error`` after
+        ``serving.fault.max_request_retries`` — ``"fatal"`` when the
+        donated pools died mid-step and the caller must run
+        :meth:`restart_engine` (or give up), or ``"unattributed"`` when
+        nothing could be re-queued (the exception fired before an action
+        was chosen, e.g. a broken scheduling policy): per-request retry
+        budgets cannot bound that class, so the caller must escalate
+        rather than spin on a deterministic recurrence. Either way the
+        fault is recorded (``serve.fault`` event,
+        ``serving/step_faults{kind=}``). The closed loop never calls
+        this: ``generate_batch`` propagates, exactly like its
+        :class:`PoolExhausted` contract."""
+        kind, payload = self.last_action if self.last_action is not None \
+            else ("unknown", None)
+        # the LABEL is the finer dispatch site (a cow/fetch sub-step of a
+        # prefill action attributes to cow/fetch); request attribution
+        # below still follows the enclosing action's payload
+        site = self.fault_site if self.fault_site is not None else kind
+        msg = f"{type(exc).__name__}: {exc}"
+        if self.ev is not None:
+            # payload key "action", not "kind": the recorder's own kind
+            # argument is the event type
+            self.ev.emit("serve.fault", action=site, error=msg)
+        if self.sched.telemetry is not None:
+            self.sched.telemetry.step_faults.labels(kind=site).inc()
+        logger.warning(f"serving step fault ({site}): {msg}")
+        if not self.pools_alive():
+            return "fatal"
+        if kind in ("prefill", "prefill_chunk"):
+            reqs = [payload]
+        elif kind in ("decode", "verify"):
+            # a fused step has no single culprit: every row re-queues
+            # (recompute keeps each greedy-identical), so whichever
+            # request is poison accrues retries until quarantine while
+            # the innocent ones recompute (their retry counts reset as
+            # soon as they emit a token again)
+            reqs = [r for r in payload if r.state == "running"]
+        else:
+            reqs = []
+        if not reqs:
+            return "unattributed"
+        # REVERSED: each requeue appendlefts, so walking the batch
+        # back-to-front leaves the earliest-admitted request at the queue
+        # head — the same fairness preemption and reset_pool preserve
+        for r in reversed(reqs):
+            self._retry_or_quarantine(r, msg)
+        self._flush_finished()
+        return "request"
+
+    def _retry_or_quarantine(self, req, msg: str) -> None:
+        req.retry_count += 1
+        if req.retry_count > self.fault_max_retries:
+            self.sched.fail_request(
+                req, f"quarantined after {self.fault_max_retries} "
+                     f"step-fault retries: {msg}")
+            return
+        backoff = self.fault_backoff_steps * (1 << (req.retry_count - 1))
+        self.sched.requeue_for_retry(req, backoff, error=msg)
+
+    def restart_engine(self) -> None:
+        """Crash-safe engine recovery after an engine-fatal step fault:
+        rebuild the pool workspace, the block allocator and the fused-step
+        jits (each entry recompiles AT MOST once per restart — the
+        ``serving_faulted_steady`` contract), then re-admit every
+        in-flight request from prompt + generated tokens through
+        :meth:`ContinuousBatchingScheduler.reset_pool` — the exact
+        recovery recompute-preemption already proves greedy-identical.
+        The content-addressed host KV tier survives (its bytes live in
+        host RAM); the device prefix cache starts cold."""
+        engine, sched = self.engine, self.sched
+        sched.allocator.set_spill(None)      # hook captured the dead pools
+        host_pool = sched.allocator.host_pool
+        engine._paged_workspace = None
+        engine._paged_alloc = None
+        engine._paged_jits = None
+        pools, _ = engine._paged_pools(self.num_blocks, self.bs)
+        alloc = engine._paged_allocator(self.num_blocks, self.bs,
+                                        sched.prefix_caching, False)
+        alloc.attach_host_pool(host_pool)
+        sched.reset_pool(alloc)
+        (self._prefill_jit, self._decode_jit, self._chunk_jit,
+         self._cow_jit, self._verify_jit, self._spill_jit,
+         self._fetch_jit) = engine._ensure_paged_jits()
+        self.pools = pools
+        if self._kv_spill:
+            alloc.set_spill(self._spill_block)
+
     # ---- tiered KV cache: demote (D2H) / re-materialize (H2D) ---- #
 
     def _spill_block(self, block: int, key: bytes) -> bool:
@@ -1506,7 +1626,11 @@ class _ServeSession:
         hp = sched.allocator.host_pool
         if hp is None:
             return False
+        prev_site = self.fault_site
+        self.fault_site = "spill"    # degraded internally below, but a
+        # non-Exception escape (SimulatedCrash) should still read "spill"
         try:
+            _step_fault("spill", "pre")
             t0 = time.monotonic_ns() if ev is not None else 0
             sl = self._spill_jit(self.pools, jnp.int32(block))
             ok = hp.put(key, sl["k"], sl["v"])
@@ -1514,7 +1638,9 @@ class _ServeSession:
             # and record_* invariants still propagate; everything else
             # must degrade — a spill is best-effort cache retention
             hp._count_error("spill (gather)", e)
+            self.fault_site = prev_site
             return False
+        self.fault_site = prev_site
         if ok:
             if ev is not None:
                 # dur DELIBERATELY brackets only the gather dispatch +
@@ -1544,6 +1670,10 @@ class _ServeSession:
         req.fetch_pending = []
         if not fetches:
             return pools
+        prev_site = self.fault_site
+        self.fault_site = "fetch"    # a fault in here labels as "fetch";
+        # restored only on the success path so containment sees the site
+        _step_fault("fetch", "pre")
         engine, sched, ev = self.engine, self.sched, self.ev
         alloc = sched.allocator
         sh = engine._kv_slice_sharding()
@@ -1553,7 +1683,9 @@ class _ServeSession:
         for dst, key, k_np, v_np, tokens in fetches:
             ks = jax.device_put(jnp.asarray(k_np), sh)
             vs = jax.device_put(jnp.asarray(v_np), sh)
-            pools = self._fetch_jit(pools, jnp.int32(dst), ks, vs)
+            out = self._fetch_jit(pools, jnp.int32(dst), ks, vs)
+            _step_fault("fetch", "post")
+            pools = out
             nbytes += int(k_np.nbytes) + int(v_np.nbytes)
             ntokens += int(tokens)
             if key is not None:
@@ -1573,6 +1705,7 @@ class _ServeSession:
             ev.emit("kv.fetch", rid=req.rid, t_ns=t0,
                     dur_ns=time.monotonic_ns() - t0,
                     blocks=len(fetches), bytes=nbytes)
+        self.fault_site = prev_site
         return pools
 
     def _exec(self, action) -> None:
@@ -1582,7 +1715,21 @@ class _ServeSession:
         temperature, top_k = self.temperature, self.top_k
         pools = self.pools
         kind, payload = action
+        # serving fault injection (utils/fault_injection.fail_step): ONE
+        # None check per consult; "pre" fires before any device dispatch
+        # (per-request containable — the pools are intact), "post" fires
+        # between the donating dispatch and the adoption of its outputs
+        # (the local `pools` still names the consumed buffers, so the
+        # exception leaves the session exactly as a mid-step device death
+        # would: engine-fatal). The top consult ticks the injector's
+        # deterministic step counter. fault_site tracks the finer dispatch
+        # site (cow/fetch sub-steps update it) for step_faults{kind=}.
+        self.fault_site = kind
+        _step_fault(kind, "pre", tick=True)
         try:
+            if kind == "wait":
+                # retry-backoff idle tick: no device work, clock advanced
+                return
             if kind == "prefill":
                 req = payload
                 pools = self._run_fetches(req, pools)
@@ -1594,9 +1741,11 @@ class _ServeSession:
                 table = np.asarray(req.blocks, np.int32)
                 slots = engine._flat_slots(table, 0, L, Tb, bs)
                 t0 = time.monotonic_ns() if ev is not None else 0
-                logits, pools = self._prefill_jit(
+                out = self._prefill_jit(
                     engine.params, jnp.asarray(toks), pools,
                     jnp.asarray(slots, jnp.int32), jnp.int32(L - 1))
+                _step_fault("prefill", "post")
+                logits, pools = out
                 self.rng, sub = jax.random.split(self.rng)
                 # fetch the sampled token BEFORE emitting: _sample_host
                 # is device-only (argmax/categorical), so the np.asarray
@@ -1617,9 +1766,14 @@ class _ServeSession:
                     # inside a SHARED cached block — give it a private
                     # device copy before any of its writes land
                     src, dst = req.cow_pending
+                    self.fault_site = "cow"
+                    _step_fault("cow", "pre")
                     t0 = time.monotonic_ns() if ev is not None else 0
-                    pools = self._cow_jit(pools, jnp.int32(src),
-                                          jnp.int32(dst))
+                    out = self._cow_jit(pools, jnp.int32(src),
+                                        jnp.int32(dst))
+                    _step_fault("cow", "post")
+                    pools = out
+                    self.fault_site = kind
                     if ev is not None:
                         # dispatch is async: wait for the copy so the
                         # span covers device work, not µs of dispatch
@@ -1647,10 +1801,12 @@ class _ServeSession:
                 bt = np.zeros((1, nb), np.int32)
                 bt[0, :table.size] = table
                 t0 = time.monotonic_ns() if ev is not None else 0
-                logits, pools = self._chunk_jit(
+                out = self._chunk_jit(
                     engine.params, jnp.asarray(toks), pools, jnp.asarray(bt),
                     jnp.asarray(slots, jnp.int32), jnp.int32(start),
                     jnp.int32(step - 1))
+                _step_fault("prefill_chunk", "post")
+                logits, pools = out
                 if ev is not None:
                     # non-final chunks never fetch a result, so the
                     # dispatch alone would clock near-zero: sync first
@@ -1695,9 +1851,11 @@ class _ServeSession:
                     slotm[i] = engine._flat_slots(table, r.pos, nv,
                                                   spec_wb, bs)
                 t0 = time.monotonic_ns() if ev is not None else 0
-                logits, pools = self._verify_jit(
+                out = self._verify_jit(
                     engine.params, jnp.asarray(toks), pools,
                     jnp.asarray(bt), jnp.asarray(slotm), jnp.asarray(pos))
+                _step_fault("verify", "post")
+                logits, pools = out
                 # same argmax the decode path's _sample_host runs, at
                 # every window position; the fetch is the sync point,
                 # so the spec_verify slices below clock device time
@@ -1735,9 +1893,11 @@ class _ServeSession:
                     pos[i] = r.pos
                     toks[i, 0] = r.last_token
                 t0 = time.monotonic_ns() if ev is not None else 0
-                logits, pools = self._decode_jit(
+                out = self._decode_jit(
                     engine.params, jnp.asarray(toks), pools,
                     jnp.asarray(bt), jnp.asarray(pos))
+                _step_fault("decode", "post")
+                logits, pools = out
                 self.rng, sub = jax.random.split(self.rng)
                 tok = np.asarray(engine._sample_host(
                     logits.astype(jnp.float32), temperature, top_k, sub))

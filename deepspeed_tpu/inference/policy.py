@@ -13,7 +13,12 @@ object:
   accepted at all (admission control: the async front-end consults this
   before enqueueing; a rejection bumps ``serving/rejected_requests`` and
   terminates the request's handle with status ``"rejected"`` instead of
-  letting an unbounded queue build under pool pressure).
+  letting an unbounded queue build under pool pressure);
+- :meth:`SchedulingPolicy.select_shed_victim` — WHICH waiting request
+  load shedding drops when the always-on loop's queue exceeds
+  ``serving.fault.shed_queue_depth`` (default: the lowest-priority
+  waiting request, newest arrival on ties — graceful degradation sheds
+  the least important, least invested work first).
 
 Determinism contract: every decision is a pure function of scheduler
 state that is itself determined by the request trace — arrival order
@@ -82,6 +87,20 @@ class SchedulingPolicy:
         FIFO: the latest-admitted (``running[-1]``) — it has the least
         sunk compute and re-queues at the front."""
         return sched.running[-1]
+
+    def select_shed_victim(self, sched) -> Optional[int]:
+        """Index into ``sched.waiting`` of the request load shedding
+        drops next, or None to refuse (shedding stops). Default: the
+        lowest ``priority`` class; within it the NEWEST arrival (``>=``
+        over queue order keeps the latest) — under overload the oldest
+        waiting work of each class is the closest to being served, so the
+        newest goes first. Deterministic in queue state."""
+        victim, vp = None, None
+        for i, r in enumerate(sched.waiting):
+            p = int(getattr(r, "priority", 0))
+            if vp is None or p <= vp:
+                victim, vp = i, p
+        return victim
 
 
 class FifoPolicy(SchedulingPolicy):

@@ -148,7 +148,9 @@ class ServingTelemetry:
                "rejected_requests",
                "generated_tokens", "spec_verify_steps",
                "spec_proposed_tokens", "spec_accepted_tokens",
-               "spec_rollbacks", "spec_acceptance_rate", "tp")
+               "spec_rollbacks", "spec_acceptance_rate", "tp",
+               "step_faults", "engine_restarts", "request_retries",
+               "timeouts", "shed_requests")
 
     def __init__(self, registry=None):
         if registry is None:
@@ -374,6 +376,45 @@ class ServingTelemetry:
             "serving/spec_acceptance_rate",
             "accepted / proposed candidate tokens (cumulative)")
 
+    # ---- serving-plane fault tolerance (inference/serve.py) ---- #
+
+    @property
+    def step_faults(self):
+        return self.registry.counter(
+            "serving/step_faults",
+            "engine-step exceptions contained by the serving loop, by "
+            "dispatch site (per-request retry/quarantine or engine "
+            "restart — the loop survived either way)", labelnames=("kind",))
+
+    @property
+    def engine_restarts(self):
+        return self.registry.counter(
+            "serving/engine_restarts",
+            "crash-safe engine recoveries: pool workspace + fused jits "
+            "rebuilt, in-flight requests re-admitted from prompt+generated")
+
+    @property
+    def request_retries(self):
+        return self.registry.counter(
+            "serving/request_retries",
+            "per-request fault retries: the faulting action's requests "
+            "re-queued through recompute-preemption with logical-step "
+            "backoff")
+
+    @property
+    def timeouts(self):
+        return self.registry.counter(
+            "serving/timeouts",
+            "requests retired for exceeding their deadline (deadline_ms "
+            "wall clock / deadline_steps scheduler clock)")
+
+    @property
+    def shed_requests(self):
+        return self.registry.counter(
+            "serving/shed_requests",
+            "queued requests dropped by load shedding under queue "
+            "pressure (policy select_shed_victim, lowest priority first)")
+
 
 @dataclasses.dataclass
 class Request:
@@ -399,6 +440,17 @@ class Request:
     # arrival_step before the first token is late (logical clock, not ms)
     arrival_step: int = 0           # sched.step_seq at enqueue
     cancelled: bool = False         # retired by cancellation, not eos/max
+    # ---- deadlines / fault containment (serving.fault) ----
+    deadline_ms: Optional[float] = None   # wall-clock budget from t_submit;
+    # expiry retires the request as timeout (checked at scheduler action
+    # boundaries + the async front-end's intake)
+    deadline_steps: Optional[int] = None  # logical-step budget on the
+    # scheduler clock (like ttft_budget: replay-deterministic)
+    timed_out: bool = False         # retired by deadline expiry
+    shed: bool = False              # dropped by load shedding while queued
+    retry_count: int = 0            # per-request step-fault retries so far
+    retry_at_step: int = 0          # backoff hold-down: not admittable
+    # before sched.step_seq reaches this (exponential in logical steps)
     # ---- prefix caching / chunked prefill state ----
     prefilling: bool = False        # admitted but pos < prefill_target
     prefill_target: int = 0         # len(prefix()) captured at admission
@@ -499,6 +551,11 @@ class ContinuousBatchingScheduler:
         # prefill/decode interleave: after a chunk, give decode a turn (when
         # decodable rows exist) so one long prompt never monopolizes steps
         self._decode_turn = False
+        # deadline-free workloads (every closed-loop generate_batch, any
+        # serve that never sets a deadline) must not pay the per-action
+        # expiry sweep: one integer check, counting LIVE deadline-carrying
+        # requests — the sweep cost ends when the last of them retires
+        self._deadline_live = 0
 
     def _tel_gauges(self) -> None:
         """Refresh the occupancy gauges (queue depth, running rows, KV
@@ -548,7 +605,9 @@ class ContinuousBatchingScheduler:
     def add_request(self, prompt, max_new: int,
                     eos: Optional[int] = None, priority: int = 0,
                     ttft_budget: Optional[int] = None,
-                    t_submit: Optional[float] = None) -> Request:
+                    t_submit: Optional[float] = None,
+                    deadline_ms: Optional[float] = None,
+                    deadline_steps: Optional[int] = None) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -579,8 +638,14 @@ class ContinuousBatchingScheduler:
                       priority=int(priority),
                       ttft_budget=(None if ttft_budget is None
                                    else int(ttft_budget)),
+                      deadline_ms=(None if deadline_ms is None
+                                   else float(deadline_ms)),
+                      deadline_steps=(None if deadline_steps is None
+                                      else int(deadline_steps)),
                       arrival_step=self.step_seq)
         self._next_rid += 1
+        if req.deadline_ms is not None or req.deadline_steps is not None:
+            self._deadline_live += 1
         self.waiting.append(req)
         if self.events is not None:
             self.events.emit("req.enqueue", rid=req.rid,
@@ -592,6 +657,12 @@ class ContinuousBatchingScheduler:
 
     def all_done(self) -> bool:
         return not self.waiting and not self.running
+
+    def _deadline_retired(self, req: Request) -> None:
+        """Called at every permanent retirement: the deadline sweep's
+        cost ends when the last live deadline-carrying request leaves."""
+        if req.deadline_ms is not None or req.deadline_steps is not None:
+            self._deadline_live -= 1
 
     def cancel_request(self, req: Request) -> bool:
         """Retire ``req`` by cancellation at any lifecycle point: a QUEUED
@@ -607,12 +678,34 @@ class ContinuousBatchingScheduler:
 
     def fail_request(self, req: Request, error: str) -> bool:
         """Retire ``req`` with ``error`` at any lifecycle point — the
-        always-on loop's answer to :class:`PoolExhausted`: same cleanup as
+        always-on loop's answer to :class:`PoolExhausted` (and to a
+        quarantined poison request): same cleanup as
         :meth:`cancel_request`, but the request's handle terminates with
         status "error" while the loop keeps serving everyone else."""
         return self._force_retire(req, error=str(error))
 
-    def _force_retire(self, req: Request, error: Optional[str]) -> bool:
+    def timeout_request(self, req: Request, error: str) -> bool:
+        """Retire ``req`` as a deadline expiry (``req.timed_out``): same
+        cleanup as :meth:`cancel_request`, emitting ``req.timeout`` and
+        counting ``serving/timeouts`` — the handle terminates with status
+        "timeout" (HTTP 504 / SSE ``finish_reason: "timeout"``)."""
+        return self._force_retire(req, error=str(error), flavor="timeout")
+
+    def shed_request(self, req: Request) -> bool:
+        """Drop a QUEUED ``req`` under load-shedding pressure: emits
+        ``req.shed`` and counts ``serving/shed_requests``; the handle
+        terminates with status "rejected" (HTTP 429 + Retry-After). Only
+        waiting requests shed — running work is never abandoned for
+        backpressure (preemption owns pool pressure)."""
+        if req.state != QUEUED:
+            raise ValueError(
+                f"request {req.rid} is {req.state}; only QUEUED requests "
+                "can be shed")
+        return self._force_retire(
+            req, error="shed under queue pressure", flavor="shed")
+
+    def _force_retire(self, req: Request, error: Optional[str],
+                      flavor: str = "error") -> bool:
         if req.state == FINISHED:
             return False
         if req.state == QUEUED:
@@ -628,6 +721,7 @@ class ContinuousBatchingScheduler:
             self._free_blocks(req)
         req.spec_tokens = ()
         req.state = FINISHED
+        self._deadline_retired(req)
         self.finished.append(req)
         if error is None:
             req.cancelled = True
@@ -637,13 +731,88 @@ class ContinuousBatchingScheduler:
         else:
             req.error = error
             logger.warning(f"request {req.rid} retired: {error}")
-            if self.events is not None:
+            if flavor == "timeout":
+                req.timed_out = True
+                if self.telemetry is not None:
+                    self.telemetry.timeouts.inc()
+                if self.events is not None:
+                    self.events.emit("req.timeout", rid=req.rid,
+                                     generated=len(req.generated),
+                                     error=error)
+            elif flavor == "shed":
+                req.shed = True
+                if self.telemetry is not None:
+                    self.telemetry.shed_requests.inc()
+                if self.events is not None:
+                    self.events.emit("req.shed", rid=req.rid,
+                                     priority=req.priority)
+            elif self.events is not None:
                 self.events.emit("req.retire", rid=req.rid,
                                  generated=len(req.generated), error=error)
         if self.telemetry is not None:
             self.telemetry.finished.inc()
         self._tel_gauges()
         return True
+
+    def requeue_for_retry(self, req: Request, backoff_steps: int,
+                          error: str = "") -> None:
+        """Per-request step-fault containment: re-queue a RUNNING request
+        through the recompute-preemption machinery (all blocks
+        dereferenced, prompt + generated becomes the new prefix — with
+        prefix caching its own still-cold blocks usually satisfy the
+        re-admission) with an admission hold-down of ``backoff_steps``
+        LOGICAL steps (the ``step_seq`` clock, replay-deterministic).
+        Greedy decoding reproduces the un-faulted continuation exactly,
+        the same guarantee preemption has always carried."""
+        if req.state != RUNNING:
+            raise ValueError(
+                f"request {req.rid} is {req.state}; only RUNNING requests "
+                "retry through re-queue")
+        if self.events is not None:
+            self.events.emit("req.requeue", rid=req.rid,
+                             retry=req.retry_count,
+                             backoff_steps=int(backoff_steps), error=error)
+        if self.telemetry is not None:
+            self.telemetry.request_retries.inc()
+        # FRONT of the queue like preemption: the backoff hold-down, not
+        # queue position, is what delays the retry
+        self._demote_to_queue(req)
+        req.retry_at_step = self.step_seq + max(int(backoff_steps), 0)
+        self._tel_gauges()
+
+    def reset_pool(self, allocator: BlockAllocator) -> None:
+        """Crash-safe engine recovery: the device pools died mid-step, so
+        every block placement is invalid. Swap in the freshly built
+        ``allocator`` and re-queue ALL running requests from prompt +
+        generated tokens — exactly the state recompute-preemption already
+        proves sufficient to continue greedy-identically. Admission order
+        is preserved (earlier-admitted requests re-admit first, ahead of
+        anything that was still waiting). The old allocator's refs are
+        dereferenced first — pure host bookkeeping (the spill hook was
+        already cleared; the buffers its cold cache would describe are
+        gone either way) — so an abandoned allocator ends consistent,
+        which is what the leak-audit fixtures assert."""
+        for req in list(self.running)[::-1]:  # earliest ends at the front
+            self._demote_to_queue(req)
+        self.allocator = allocator
+        self._decode_turn = False
+        self._tel_gauges()
+
+    def _demote_to_queue(self, req: Request) -> None:
+        """The ONE RUNNING -> QUEUED demotion (preemption, step-fault
+        retry, engine restart): every block dereferenced, prefill state
+        reset so prompt + generated becomes the re-admission prefix, and
+        the request re-queued at the FRONT. A Request field that must
+        clear on demotion belongs here (or in ``_free_blocks``), never in
+        one caller."""
+        self.running.remove(req)
+        self._free_blocks(req)
+        req.pos = 0
+        req.prefilling = False
+        req.prefill_target = 0
+        req.spec_tokens = ()
+        req.state = QUEUED
+        self.waiting.appendleft(req)
 
     # ------------------------------------------------------------------ #
     # admission
@@ -664,6 +833,18 @@ class ContinuousBatchingScheduler:
                 f"policy {self.policy.name!r} selected waiting index {idx} "
                 f"out of range (queue depth {len(self.waiting)})")
         req = self.waiting[idx]
+        if req.retry_at_step > self.step_seq:
+            # the policy's pick is holding down after a step-fault retry
+            # (exponential backoff on the logical clock): take the first
+            # ELIGIBLE waiting request in FIFO order instead, or admit
+            # nothing this step — the backoff must never starve the rest
+            # of the queue, and FIFO-among-eligible keeps it deterministic
+            for j, r in enumerate(self.waiting):
+                if r.retry_at_step <= self.step_seq:
+                    idx, req = j, r
+                    break
+            else:
+                return None
         prefix = req.prefix()
         target = int(prefix.size)
         bs = self.allocator.block_size
@@ -674,6 +855,7 @@ class ContinuousBatchingScheduler:
             # error instead of wedging the FIFO head forever
             del self.waiting[idx]
             req.state = FINISHED
+            self._deadline_retired(req)
             req.error = (
                 f"prefix of {target} tokens (prompt + {len(req.generated)} "
                 f"generated) needs {need_total} KV blocks but the pool has "
@@ -869,11 +1051,39 @@ class ContinuousBatchingScheduler:
         alternate one prefill chunk of the oldest mid-prefill request with
         one fused decode step over the prefill-complete running set. None
         when everything is finished. Every returned action advances the
-        logical ``step_seq`` clock (the SLA policies' time base)."""
+        logical ``step_seq`` clock (the SLA policies' time base).
+
+        Deadline-carrying requests are swept first: an expired request —
+        ``deadline_steps`` on the logical clock, ``deadline_ms`` on wall
+        time — retires as ``timeout`` before the next step is chosen.
+        ``("wait", None)`` is returned (and the clock ticked) when the
+        only waiting requests are holding down in step-fault retry
+        backoff — the tick is what moves them toward eligibility."""
+        if self._deadline_live:
+            self._sweep_deadlines()
         action = self._next_action()
         if action is not None:
             self.step_seq += 1
         return action
+
+    def _sweep_deadlines(self) -> None:
+        now = None
+        for req in list(self.waiting) + list(self.running):
+            expired = None
+            if req.deadline_steps is not None and \
+                    self.step_seq - req.arrival_step >= req.deadline_steps:
+                expired = (f"deadline of {req.deadline_steps} scheduler "
+                           f"steps exceeded")
+            elif req.deadline_ms is not None:
+                if now is None:
+                    now = time.perf_counter()
+                waited_ms = (now - req.t_submit) * 1e3
+                if waited_ms > req.deadline_ms:
+                    expired = (f"deadline of {req.deadline_ms:.0f} ms "
+                               f"exceeded ({waited_ms:.0f} ms since "
+                               "submission)")
+            if expired is not None:
+                self.timeout_request(req, expired)
 
     def _next_action(self) -> Optional[Tuple[str, object]]:
         action = self._try_admit()
@@ -907,6 +1117,11 @@ class ContinuousBatchingScheduler:
             self._tel_gauges()       # capacity growth/evictions moved blocks
             return ("decode", decodable)
         if self.waiting:
+            if all(r.retry_at_step > self.step_seq for r in self.waiting):
+                # everything queued is holding down in retry backoff: a
+                # no-op action whose clock tick moves them toward
+                # eligibility (bounded — backoff is finite logical steps)
+                return ("wait", None)
             # slots full but pool dry would have been handled above; here
             # the running set is empty yet requests wait — impossible unless
             # max_running slots are all mid-preemption; defensive guard
@@ -1022,17 +1237,10 @@ class ContinuousBatchingScheduler:
         if self.telemetry is not None:
             self.telemetry.preemptions.inc()
             self.telemetry.recompute_tokens.inc(len(victim.prefix()))
-        self.running.remove(victim)
-        self._free_blocks(victim)
-        victim.pos = 0
-        victim.prefilling = False
-        victim.prefill_target = 0
-        victim.spec_tokens = ()
-        victim.state = QUEUED
-        victim.preemptions += 1
         # FRONT of the queue: the victim was admitted before anything still
         # waiting, so FIFO fairness re-admits it first
-        self.waiting.appendleft(victim)
+        self._demote_to_queue(victim)
+        victim.preemptions += 1
 
     def _free_blocks(self, req: Request) -> None:
         """Dereference a retiring/preempted request's blocks. Freed in
@@ -1209,6 +1417,12 @@ class ContinuousBatchingScheduler:
         """TTFT once per request (first token after the ORIGINAL arrival —
         a post-preemption re-prefill token counts as a per-output-token
         latency, not a second TTFT), TPOT for every token after it."""
+        # an emitted token is real progress: step-fault retries reset, so
+        # an innocent request co-batched with a poison one (whose fused
+        # steps keep faulting) never accrues its way into quarantine —
+        # only a request that cannot progress past its faulting action
+        # exhausts serving.fault.max_request_retries
+        req.retry_count = 0
         now = time.perf_counter()
         t = self.telemetry
         if t is not None:
@@ -1232,6 +1446,7 @@ class ContinuousBatchingScheduler:
             done = True
         if done:
             req.state = FINISHED
+            self._deadline_retired(req)
             self.running.remove(req)
             self._free_blocks(req)
             self.finished.append(req)

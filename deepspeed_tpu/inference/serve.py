@@ -9,7 +9,22 @@ on a dedicated serving thread, and accepts :meth:`add_request` from ANY
 thread at ANY time. Each submission returns a :class:`RequestHandle` that
 streams token bursts back as they are emitted — speculation's verified
 multi-token steps arrive as multi-token bursts — and terminates with a
-status (``finished`` / ``cancelled`` / ``error`` / ``rejected``).
+status (``finished`` / ``cancelled`` / ``error`` / ``rejected`` /
+``timeout``).
+
+Fault tolerance (``serving.fault``): an engine-step exception no longer
+kills the loop — per-request faults re-queue the faulting requests
+through recompute-preemption with logical-step backoff (quarantine after
+``max_request_retries``), engine-fatal faults (the donated pools died
+mid-step) trigger a crash-safe rebuild of pools + jits with every
+in-flight request re-admitted, bounded by ``max_engine_restarts`` before
+the crash-loop breaker parks the loop (``/healthz`` 503; drain still
+works). Requests may carry deadlines (wall-clock ``deadline_ms`` /
+logical ``deadline_steps``) and the loop sheds lowest-priority queued
+work above ``shed_queue_depth``. All of it is deterministic given a
+request trace + injection schedule (``utils/fault_injection.fail_step``)
+— the serving chaos suite (``tests/unit/test_serving_chaos.py``) pins
+token identity through every fault class.
 
 Threading model (one sentence): the serving thread OWNS the engine's jit
 dispatch — submissions and cancellations are commands on a lock-guarded
@@ -36,7 +51,9 @@ detokenizer is supplied).
 from __future__ import annotations
 
 import json
+import math
 import queue
+import signal as _signal
 import threading
 import time
 from collections import deque
@@ -45,33 +62,40 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 #: terminal handle statuses
-FINISHED, CANCELLED, ERROR, REJECTED = ("finished", "cancelled", "error",
-                                        "rejected")
+FINISHED, CANCELLED, ERROR, REJECTED, TIMEOUT = (
+    "finished", "cancelled", "error", "rejected", "timeout")
 
 
 class RequestFailed(RuntimeError):
-    """The serving loop retired this request with an error (pool
-    misconfiguration, loop crash)."""
+    """The serving loop retired this request without completing it
+    (rejected, quarantined after step-fault retries, deadline timeout,
+    pool misconfiguration, loop crash)."""
 
 
 class RequestHandle:
     """One submitted request's streaming surface. Produced by
     :meth:`AsyncServingEngine.add_request`; all methods are safe from any
     thread. ``status`` moves ``pending -> queued/running -> one of
-    finished | cancelled | error | rejected``."""
+    finished | cancelled | error | rejected | timeout``."""
 
     def __init__(self, owner: "AsyncServingEngine", prompt: np.ndarray,
                  max_new: int, eos: Optional[int], priority: int,
-                 ttft_budget: Optional[int]):
+                 ttft_budget: Optional[int],
+                 deadline_ms: Optional[float] = None,
+                 deadline_steps: Optional[int] = None):
         self._owner = owner
         self.prompt = prompt
         self.max_new = max_new
         self.eos = eos
         self.priority = priority
         self.ttft_budget = ttft_budget
+        self.deadline_ms = deadline_ms
+        self.deadline_steps = deadline_steps
         self.rid: Optional[int] = None     # filled once the loop enqueues it
         self.status = "pending"
         self.error: Optional[str] = None
+        self.retry_after: Optional[float] = None   # backpressure hint (s),
+        # set on admission-control rejections (HTTP 429 Retry-After)
         self._tokens: List[int] = []
         self._q: "queue.Queue" = queue.Queue()
         self._done = threading.Event()
@@ -126,11 +150,12 @@ class RequestHandle:
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         """Block until terminal; the full sequence (prompt + generated —
         possibly partial for a cancelled request) as 1-D int32. Raises
-        :class:`RequestFailed` on ``error``/``rejected`` status."""
+        :class:`RequestFailed` on ``error``/``rejected``/``timeout``
+        status."""
         if not self._done.wait(timeout):
             raise TimeoutError(f"request {self.rid} still in flight after "
                                f"{timeout}s")
-        if self.status in (ERROR, REJECTED):
+        if self.status in (ERROR, REJECTED, TIMEOUT):
             raise RequestFailed(
                 f"request {self.rid} {self.status}: {self.error}")
         if not self._tokens:
@@ -186,6 +211,19 @@ class AsyncServingEngine:
         self._finalized = False
         self._n_submitted = 0
         self.error: Optional[BaseException] = None
+        # ---- fault tolerance (serving.fault) ---- #
+        self._fault_cfg = engine.config.serving.fault
+        self.restarts = 0                  # engine-fatal recoveries so far
+        self._unattributed_faults = 0      # consecutive no-op containments
+        self._crash_loop = False           # breaker: restarts exhausted —
+        # the loop parks, /healthz reads 503, drain()/shutdown() still work
+        self._tpot_ema_s = 0.05            # recent per-token WALL rate (the
+        # Retry-After backpressure hint's base): measured over emitted-
+        # token windows, not per-row callback gaps — a fused step fires W
+        # near-simultaneous callbacks, and a gap EMA would under-weight
+        # the one real step-time sample W-fold
+        self._rate_t0: Optional[float] = None   # window start (None = idle)
+        self._rate_tokens = 0              # tokens emitted in the window
         self._t0 = time.monotonic_ns()
         ev = engine._events
         if ev is not None:
@@ -201,12 +239,17 @@ class AsyncServingEngine:
 
     def add_request(self, prompt, max_new_tokens: Optional[int] = None,
                     eos_token_id: Optional[int] = None, priority: int = 0,
-                    ttft_budget: Optional[int] = None) -> RequestHandle:
+                    ttft_budget: Optional[int] = None,
+                    deadline_ms: Optional[float] = None,
+                    deadline_steps: Optional[int] = None) -> RequestHandle:
         """Submit one request; returns immediately with its streaming
-        handle. Raises RuntimeError once the loop is draining/stopped.
-        Admission control (the policy's queue/pool-pressure bounds) is
-        applied on the serving thread — a refused submission terminates
-        the handle with status ``"rejected"`` instead of raising here."""
+        handle. Raises RuntimeError once the loop is draining/stopped or
+        its crash-loop breaker is open. Admission control (the policy's
+        queue/pool-pressure bounds) is applied on the serving thread — a
+        refused submission terminates the handle with status
+        ``"rejected"`` instead of raising here. ``deadline_ms`` (wall
+        clock from submission) / ``deadline_steps`` (scheduler's logical
+        clock) retire the request as ``"timeout"`` on expiry."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -215,8 +258,16 @@ class AsyncServingEngine:
                                    is not None else self._session.max_new),
                           eos=(eos_token_id if eos_token_id is not None
                                else self._session.eos_token_id),
-                          priority=int(priority), ttft_budget=ttft_budget)
+                          priority=int(priority), ttft_budget=ttft_budget,
+                          deadline_ms=(None if deadline_ms is None
+                                       else float(deadline_ms)),
+                          deadline_steps=(None if deadline_steps is None
+                                          else int(deadline_steps)))
         with self._cv:
+            if self._crash_loop:
+                raise RuntimeError(
+                    "serving loop is parked in its crash-loop breaker "
+                    "(engine restarts exhausted); /healthz reads 503")
             if self._draining or self._stop_now or self._stopped:
                 raise RuntimeError(
                     "serving loop is draining/stopped; no new requests")
@@ -327,8 +378,9 @@ class AsyncServingEngine:
             raise
 
     def _step_once(self) -> bool:
-        """One loop iteration: commands, exit checks, one engine step.
-        Returns False when the loop should exit."""
+        """One loop iteration: commands, load shedding, exit checks, one
+        engine step with fault containment. Returns False when the loop
+        should exit."""
         with self._cv:
             cmds = list(self._intake)
             self._intake.clear()
@@ -339,12 +391,24 @@ class AsyncServingEngine:
                 self._process_cancel(h)
         if self._stop_now:
             return False
+        if self._crash_loop:
+            # breaker open: nothing can run — park (the cv-wait predicate
+            # holds, everything in flight was failed) until drain/shutdown
+            return not self._draining
+        self._shed_overload()
         if self._session.sched.all_done():
+            # going idle: a rate window spanning the idle gap would read
+            # as an enormous per-token latency and poison the hint's EMA
+            self._rate_t0 = None
             return not self._draining
         from deepspeed_tpu.inference.scheduler import PoolExhausted
         try:
             with self.engine._mesh_scope():
                 self._session.step()
+            # a healthy step makes "consecutive" mean consecutive: rare
+            # transient unattributed blips separated by normal traffic
+            # must never accumulate their way into a restart/breaker
+            self._unattributed_faults = 0
         except PoolExhausted as e:
             # one request outgrew the pool with nothing left to evict: the
             # closed loop fails the whole call, but an always-on server
@@ -352,13 +416,141 @@ class AsyncServingEngine:
             # (its handle reads status "error") and keep serving
             self._session.sched.fail_request(e.req, str(e))
             self._session._flush_finished()
+        except Exception as e:  # noqa: BLE001 — the containment boundary:
+            # SimulatedCrash (BaseException) and everything non-Exception
+            # still kill the loop, exactly like the checkpoint writer
+            self._contain(e)
         return True
+
+    def _retry_after_hint(self) -> float:
+        """Backpressure hint for 429 rejections (admission control, load
+        shedding): roughly when a queue slot should open — queue depth x
+        recent per-token wall rate x tokens per request, clamped to
+        [1s, 120s]. The EMA measures the gap between consecutive bursts
+        across ALL rows of the fused batch (W callbacks fire per decode
+        step), so it already amortizes batch width — dividing by W again
+        would understate the wait by ~W and defeat the backpressure."""
+        depth = max(len(self._session.sched.waiting), 1)
+        per_req_s = self._tpot_ema_s * self._session.max_new
+        return min(max(depth * per_req_s, 1.0), 120.0)
+
+    def _shed_overload(self) -> None:
+        """Load shedding: with ``serving.fault.shed_queue_depth`` set,
+        drop policy-selected queued requests (lowest priority first,
+        deterministic) until the waiting queue fits the bound — graceful
+        degradation instead of unbounded queue growth under pressure."""
+        bound = int(self._fault_cfg.shed_queue_depth)
+        if bound <= 0:
+            return
+        sched = self._session.sched
+        while len(sched.waiting) > bound:
+            idx = self.policy.select_shed_victim(sched)
+            if idx is None or not 0 <= idx < len(sched.waiting):
+                break
+            sched.shed_request(sched.waiting[idx])
+        self._session._flush_finished()
+
+    def _contain(self, exc: Exception) -> None:
+        """Step-fault containment: per-request faults were already
+        re-queued/quarantined by the session; an engine-fatal fault (the
+        donated pools died mid-step) triggers a crash-safe restart —
+        bounded by ``serving.fault.max_engine_restarts`` with exponential
+        wall backoff — and, exhausted, opens the crash-loop breaker. An
+        UNATTRIBUTED fault (no action to re-queue — e.g. a broken
+        scheduling policy raising inside ``next_action``) is deterministic
+        recurrence territory no per-request budget can bound: after
+        ``max_request_retries`` consecutive occurrences it escalates to
+        the restart path (and from there, the breaker) instead of letting
+        the loop hot-spin on it forever."""
+        try:
+            outcome = self._session.contain_fault(exc)
+        except Exception as inner:  # noqa: BLE001 — containment itself died
+            self.error = inner
+            raise
+        if outcome == "request":
+            self._unattributed_faults = 0
+            return
+        if outcome == "unattributed":
+            self._unattributed_faults += 1
+            if self._unattributed_faults \
+                    <= int(self._fault_cfg.max_request_retries):
+                return
+            # fall through: escalate like an engine-fatal fault
+        if self.restarts >= int(self._fault_cfg.max_engine_restarts):
+            self._trip_breaker(exc)
+            return
+        backoff = float(self._fault_cfg.restart_backoff_s)
+        if backoff > 0:
+            time.sleep(min(backoff * (1 << self.restarts), 60.0))
+        try:
+            with self.engine._mesh_scope():
+                self._session.restart_engine()
+        except Exception as rebuild_exc:  # noqa: BLE001 — a recovery that
+            # cannot even rebuild its pools is a crash loop, not a retry
+            self._trip_breaker(rebuild_exc)
+            return
+        # recorded only AFTER the rebuild succeeded: restarts/healthz and
+        # the serve.restart event count PERFORMED recoveries, never an
+        # attempt that itself crashed into the breaker
+        self.restarts += 1
+        self._unattributed_faults = 0
+        ev = self.engine._events
+        if ev is not None:
+            ev.emit("serve.restart", restart=self.restarts,
+                    error=f"{type(exc).__name__}: {exc}")
+        tel = self._session.sched.telemetry
+        if tel is not None:
+            tel.engine_restarts.inc()
+
+    def _trip_breaker(self, exc: Exception) -> None:
+        self._crash_loop = True
+        msg = (f"crash-loop breaker open after "
+               f"{int(self._fault_cfg.max_engine_restarts)} engine "
+               f"restart(s): {type(exc).__name__}: {exc}")
+        sched = self._session.sched
+        sched.allocator.set_spill(None)    # no demotions off dead pools
+        for r in list(sched.waiting) + list(sched.running):
+            try:
+                sched.fail_request(r, msg)
+            except Exception:  # noqa: BLE001 — best-effort teardown: one
+                # request's skewed bookkeeping must not strand the REST of
+                # the handles un-terminated (their clients block forever)
+                continue
+        self._session._flush_finished()
 
     def _process_submit(self, h: RequestHandle) -> None:
         sched = self._session.sched
+        if self._crash_loop:
+            h._finish(REJECTED, "serving loop is parked in its crash-loop "
+                                "breaker (engine restarts exhausted)")
+            return
+        if self._draining:
+            # the drain/submit race's loser: the submission passed
+            # add_request's flag check before drain() set it, but reached
+            # the loop after — serving it would let a submission stream
+            # extend "draining" forever, so it rejects instead (pinned)
+            h._finish(REJECTED, "serving loop is draining; request "
+                                "arrived after intake stopped")
+            return
+        if h.deadline_ms is not None and \
+                (time.perf_counter() - h._submit_perf) * 1e3 > h.deadline_ms:
+            # intake deadline check: already late before admission — retire
+            # as timeout without burning a queue slot on it. Counter AND
+            # event both fire (rid-less: the request never reached the
+            # scheduler) so /metrics and the trace cannot disagree.
+            if sched.telemetry is not None:
+                sched.telemetry.timeouts.inc()
+            ev = self.engine._events
+            if ev is not None:
+                ev.emit("req.timeout", generated=0,
+                        error="deadline expired before admission")
+            h._finish(TIMEOUT, f"deadline of {h.deadline_ms:.0f} ms expired "
+                               "before the request reached the scheduler")
+            return
         if not self.policy.admit_ok(sched, int(h.prompt.size)):
             if sched.telemetry is not None:
                 sched.telemetry.rejected_requests.inc()
+            h.retry_after = self._retry_after_hint()
             h._finish(REJECTED, "admission control refused the request "
                                 "(queue bound / KV pool pressure)")
             return
@@ -366,7 +558,9 @@ class AsyncServingEngine:
             req = self._session.add(h.prompt, max_new=h.max_new, eos=h.eos,
                                     priority=h.priority,
                                     ttft_budget=h.ttft_budget,
-                                    t_submit=h._submit_perf)
+                                    t_submit=h._submit_perf,
+                                    deadline_ms=h.deadline_ms,
+                                    deadline_steps=h.deadline_steps)
         except (ValueError, TypeError) as e:
             # oversized prompt / never-admittable: reject THIS handle, the
             # loop itself stays healthy
@@ -409,6 +603,18 @@ class AsyncServingEngine:
     # session callbacks (serving thread)
 
     def _on_tokens(self, req, tokens: List[int]) -> None:
+        now = time.perf_counter()
+        if self._rate_t0 is None:
+            self._rate_t0, self._rate_tokens = now, 0
+        self._rate_tokens += len(tokens)
+        if self._rate_tokens >= 32 and now > self._rate_t0:
+            # one wall-rate sample per ~32 emitted tokens: elapsed/tokens
+            # is the batch-amortized per-token rate the Retry-After hint
+            # needs, immune to the per-row callback clustering of a
+            # fused step
+            rate = (now - self._rate_t0) / self._rate_tokens
+            self._tpot_ema_s += 0.3 * (min(rate, 10.0) - self._tpot_ema_s)
+            self._rate_t0, self._rate_tokens = now, 0
         h = self._handles.get(req.rid)
         if h is not None:
             if h.status == "queued":
@@ -421,6 +627,11 @@ class AsyncServingEngine:
             return
         if req.cancelled:
             h._finish(CANCELLED)
+        elif getattr(req, "timed_out", False):
+            h._finish(TIMEOUT, req.error)
+        elif getattr(req, "shed", False):
+            h.retry_after = self._retry_after_hint()
+            h._finish(REJECTED, req.error)
         elif req.error is not None:
             h._finish(ERROR, req.error)
         else:
@@ -472,6 +683,71 @@ class AsyncServingEngine:
         except Exception as e:  # noqa: BLE001 — shutdown must not raise
             if self.error is None:
                 self.error = e
+
+
+class ServeSignalHandler:
+    """``dscli serve``'s graceful SIGTERM/SIGINT — the serving mirror of
+    the checkpoint side's ``PreemptionHandler``: on the first signal, stop
+    intake (new submissions 503) and unblock ``serve_forever`` so the main
+    path can drain in-flight requests within a bounded grace period and
+    exit ``128 + signum`` (supervisors see a conventional signal death).
+    Re-entrant signals during the drain are ignored; previous handlers are
+    restored on :meth:`uninstall` (the PR-6 handler-restore pattern).
+    Install is a no-op off the main thread (signal handlers are
+    main-thread-only — in-process test servers drive :meth:`trigger`
+    directly)."""
+
+    def __init__(self, server, serving: "AsyncServingEngine",
+                 signals=(_signal.SIGTERM, _signal.SIGINT)):
+        self.server = server
+        self.serving = serving
+        self.signals = tuple(signals)
+        self.signum: Optional[int] = None
+        self._prev: Dict[int, Any] = {}
+        self._installed = False
+
+    def install(self) -> "ServeSignalHandler":
+        if self._installed or \
+                threading.current_thread() is not threading.main_thread():
+            return self
+        for sig in self.signals:
+            self._prev[sig] = _signal.signal(sig, self._handle)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for sig, prev in self._prev.items():
+            try:
+                _signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    def _handle(self, signum, frame) -> None:
+        self.trigger(signum)
+
+    def trigger(self, signum: int) -> None:
+        """The handler body (callable directly by tests): first signal
+        wins — stop intake, then shut the HTTP server down from another
+        thread (``server.shutdown`` deadlocks the ``serve_forever``
+        thread) so the caller's drain-and-exit path runs."""
+        if self.signum is not None:
+            return
+        self.signum = int(signum)
+        try:
+            name = _signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        print(f"dscli serve: {name} received — stopping intake, draining "
+              "in-flight requests", flush=True)
+        try:
+            self.serving.drain()       # new submissions now raise -> 503
+        except Exception:  # noqa: BLE001 — the exit path must proceed
+            pass
+        threading.Thread(target=self.server.shutdown, daemon=True).start()
 
 
 # ---------------------------------------------------------------------- #
@@ -535,14 +811,23 @@ def build_http_server(serving: AsyncServingEngine, host: str = "127.0.0.1",
 
         def do_GET(self):
             if self.path == "/healthz":
-                # load balancers key on the STATUS CODE: a stopped or
-                # crashed loop must read unhealthy, not 200-with-caveats
+                # load balancers key on the STATUS CODE: a stopped,
+                # crashed, or crash-looping loop must read unhealthy, not
+                # 200-with-caveats — the body is the human/status-page
+                # detail (state, queue depth, restarts, uptime ticks)
                 dead = serving._stopped or serving.error is not None
-                self._json(503 if dead else 200,
-                           {"status": ("stopped" if dead else
-                                       "draining" if serving._draining
-                                       else "ok"),
-                            "stopped": serving._stopped})
+                sched = serving._session.sched
+                state = ("stopped" if dead else
+                         "crash_loop" if serving._crash_loop else
+                         "draining" if serving._draining else "serving")
+                self._json(
+                    503 if (dead or serving._crash_loop) else 200,
+                    {"state": state,
+                     "stopped": serving._stopped,
+                     "queue_depth": len(sched.waiting),
+                     "running": len(sched.running),
+                     "restarts": serving.restarts,
+                     "uptime_ticks": sched.step_seq})
             elif self.path == "/metrics":
                 # Prometheus exposition of the process registry — the
                 # scrape-and-alert plane's front door (one shared
@@ -587,6 +872,11 @@ def build_http_server(serving: AsyncServingEngine, host: str = "127.0.0.1",
                 ttft_budget = body.get("ttft_budget")
                 if ttft_budget is not None:
                     ttft_budget = int(ttft_budget)
+                deadline_ms = body.get("deadline_ms")
+                if deadline_ms is not None:
+                    deadline_ms = float(deadline_ms)
+                    if deadline_ms <= 0:
+                        raise ValueError("deadline_ms must be > 0")
                 eos = body.get("eos_token_id")
                 if eos is not None:
                     eos = int(eos)
@@ -596,8 +886,9 @@ def build_http_server(serving: AsyncServingEngine, host: str = "127.0.0.1",
             try:
                 h = serving.add_request(
                     ids, max_new_tokens=max_tokens, priority=priority,
-                    ttft_budget=ttft_budget, eos_token_id=eos)
-            except RuntimeError as e:   # draining/stopped
+                    ttft_budget=ttft_budget, deadline_ms=deadline_ms,
+                    eos_token_id=eos)
+            except RuntimeError as e:   # draining/stopped/crash-loop
                 self._json(503, {"error": str(e)})
                 return
             rid_name = f"cmpl-{id(h):x}"
@@ -640,8 +931,24 @@ def build_http_server(serving: AsyncServingEngine, host: str = "127.0.0.1",
             try:
                 h.result()
             except RequestFailed as e:
-                self._json(409 if h.status == REJECTED else 500,
-                           {"error": str(e)})
+                if h.status == TIMEOUT:
+                    # deadline expiry is a gateway-timeout, not our fault
+                    self._json(504, {"error": str(e)})
+                elif h.status == REJECTED and h.retry_after is not None:
+                    # admission control / load shedding: backpressure the
+                    # client with a Retry-After derived from queue depth x
+                    # recent TPOT (the 429 contract retry loops key on)
+                    payload = json.dumps({"error": str(e)}).encode()
+                    self.send_response(429)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After",
+                                     str(int(math.ceil(h.retry_after))))
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                else:
+                    self._json(409 if h.status == REJECTED else 500,
+                               {"error": str(e)})
                 return
             gen = h.generated
             self._json(200, {
@@ -709,6 +1016,11 @@ def serve_main(argv=None, model=None, params=None,
                              "slo/breaches counters; implies the sampler")
     parser.add_argument("--slo-tpot-ms", type=float, default=0.0,
                         help="p99 TPOT objective in ms (0 = off)")
+    parser.add_argument("--grace", type=float, default=30.0,
+                        help="SIGTERM/SIGINT drain grace period in "
+                             "seconds: intake stops immediately (503), "
+                             "in-flight requests get this long to finish, "
+                             "then the process exits 128+signum")
     args = parser.parse_args(argv)
 
     import deepspeed_tpu
@@ -759,18 +1071,36 @@ def serve_main(argv=None, model=None, params=None,
           flush=True)
     if ready_cb is not None:
         ready_cb(server, serving)
+    # graceful preemption: SIGTERM/SIGINT stop intake and unblock
+    # serve_forever; the finally below drains within --grace seconds and
+    # the process exits 128+signum (installation is a no-op off the main
+    # thread — in-process tests reach the handler via the attribute and
+    # drive trigger() directly)
+    handler = ServeSignalHandler(server, serving).install()
+    serving._signal_handler = handler
     try:
         server.serve_forever()
     except KeyboardInterrupt:
-        pass
+        handler.signum = handler.signum or _signal.SIGINT
     finally:
         server.server_close()
         try:
-            serving.shutdown(drain=True, timeout=60)
+            try:
+                serving.shutdown(drain=True, timeout=args.grace)
+            except TimeoutError:
+                # grace exhausted: abort — cancel what's left rather than
+                # overstay the supervisor's kill window
+                print(f"dscli serve: drain grace of {args.grace:.0f}s "
+                      "exhausted; cancelling in-flight requests",
+                      flush=True)
+                serving.shutdown(drain=False, timeout=10)
         except Exception as e:  # noqa: BLE001 — exit path
             print(f"dscli serve: shutdown error: {e}")
             return 1
         finally:
+            handler.uninstall()
             if sampler is not None:
                 sampler.stop()
+    if handler.signum is not None:
+        return 128 + int(handler.signum)
     return 0

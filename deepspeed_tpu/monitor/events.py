@@ -8,7 +8,8 @@ skip), the continuous-batching scheduler (enqueue / admit / cache hit /
 preempt / retire / cancel, speculative propose / rollback), the inference
 engine (prefill, prefill chunk, COW copy, fused decode tick, speculative
 verify, tiered-KV spill / fetch), the async serving front-end (submit /
-drain), the burn-rate SLO engine (breach), and the crash-safe
+drain, step-fault containment / engine restart / request requeue /
+timeout / shed), the burn-rate SLO engine (breach), and the crash-safe
 checkpoint writer (snapshot / serialize / commit / retry). The buffer keeps the newest
 ``capacity`` events (a flight recorder preserves the TAIL — the moments
 before the incident), counting evictions in ``dropped``.
@@ -88,6 +89,19 @@ EVENT_KINDS = frozenset({
     "serve.end",            # serve span (dur_ns=, requests=)
     "serve.drain",          # async loop stopped intake (waiting=,
     #                         running=, pending=)
+    # serving fault tolerance (serving.fault)
+    "serve.fault",          # an engine-step exception was contained
+    #                         (action= dispatch site, error=)
+    "serve.restart",        # crash-safe engine recovery: pools + jits
+    #                         rebuilt, in-flight re-admitted (restart=,
+    #                         error=)
+    "req.requeue",          # per-request fault retry: re-queued through
+    #                         recompute-preemption with logical-step
+    #                         backoff (retry=, backoff_steps=, error=)
+    "req.timeout",          # deadline expiry retired the request
+    #                         (generated=, error=)
+    "req.shed",             # load shedding dropped a queued request
+    #                         (priority=)
     # scheduler occupancy sample (the counter-track source)
     "sched.gauge",          # queued=, running=, kv_used=, kv_free=
     # SLO engine (monitor/slo.py): a burn-rate alert fired
@@ -277,7 +291,12 @@ _INSTANTS = {"req.enqueue": "enqueue", "req.submit": "submit",
              "req.cache_hit": "cache_hit",
              "req.cache_miss": "cache_miss", "req.preempt": "preempt",
              "req.cancel": "cancel",
-             "req.spec_rollback": "spec_rollback"}
+             "req.spec_rollback": "spec_rollback",
+             "req.requeue": "requeue", "req.timeout": "timeout",
+             "req.shed": "shed"}
+#: retirement-flavored kinds: each CLOSES its request's span (a timed-out
+#: or shed request's lifetime ends there, exactly like cancel)
+_SPAN_CLOSERS = ("req.retire", "req.cancel", "req.timeout", "req.shed")
 
 
 def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
@@ -323,12 +342,16 @@ def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
             meta["prompt_tokens"] = (e.data or {}).get("prompt_tokens")
         elif e.kind == "req.preempt":
             meta["preemptions"] += 1
-        elif e.kind in ("req.retire", "req.cancel"):
-            # cancellation ends the request's lifetime exactly like a
-            # retirement: the span closes at the cancel instant
+        elif e.kind in _SPAN_CLOSERS:
+            # cancellation / timeout / shed end the request's lifetime
+            # exactly like a retirement: the span closes at that instant
             retires[rid] = e
             if e.kind == "req.cancel":
                 meta["cancelled"] = True
+            elif e.kind == "req.timeout":
+                meta["timed_out"] = True
+            elif e.kind == "req.shed":
+                meta["shed"] = True
 
     for rid in sorted(admits):
         out.append({"ph": "M", "name": "thread_name", "pid": _SERVING_PID,
@@ -354,6 +377,14 @@ def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
                         "ts": us(e.ts_ns), "dur": (e.dur_ns or 0) / 1e3,
                         "args": dict(e.data or {})})
         elif e.kind in _INSTANTS:
+            if e.rid is None:
+                # no request track to pin it to (e.g. an intake-deadline
+                # timeout that never reached the scheduler): engine track
+                out.append({"name": _INSTANTS[e.kind], "cat": "serving",
+                            "ph": "i", "s": "p", "pid": _ENGINE_PID,
+                            "tid": _ENGINE_TID, "ts": us(e.ts_ns),
+                            "args": dict(e.data or {})})
+                continue
             out.append({"name": _INSTANTS[e.kind], "cat": "serving",
                         "ph": "i", "s": "t", "pid": _SERVING_PID,
                         "tid": e.rid, "ts": us(e.ts_ns),
@@ -391,6 +422,14 @@ def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
             out.append({"name": "drain", "cat": "serving", "ph": "i",
                         "s": "p", "pid": _ENGINE_PID, "tid": _ENGINE_TID,
                         "ts": us(e.ts_ns), "args": dict(e.data or {})})
+        elif e.kind in ("serve.fault", "serve.restart"):
+            # containment/recovery belongs to the engine timeline: the
+            # trace shows WHEN the step died / the engine rebuilt relative
+            # to the request spans it re-queued
+            out.append({"name": e.kind.split(".", 1)[1], "cat": "serving",
+                        "ph": "i", "s": "p", "pid": _ENGINE_PID,
+                        "tid": _ENGINE_TID, "ts": us(e.ts_ns),
+                        "args": dict(e.data or {})})
         elif e.kind == "slo.breach":
             # burn-rate alerts belong to the engine timeline: the trace
             # shows WHEN the budget blew relative to the request spans

@@ -839,6 +839,24 @@ def render_summary_table(s: Dict[str, Any]) -> str:
         if serving.get("rejected_requests"):
             # admission control is turning traffic away: pool pressure
             parts.append(f"rejected {int(serving['rejected_requests'])}")
+        faults = serving.get("step_faults") or {}
+        n_faults = sum(faults.values())
+        restarts = serving.get("engine_restarts", 0)
+        retries = serving.get("request_retries", 0)
+        if n_faults or restarts or retries:
+            # the fault-containment story: contained step faults, how many
+            # retried per-request, how many cost an engine rebuild
+            line = f"faults {int(n_faults)}"
+            if retries:
+                line += f" retry {int(retries)}"
+            if restarts:
+                line += f" restart {int(restarts)}"
+            parts.append(line)
+        if serving.get("timeouts"):
+            parts.append(f"timeout {int(serving['timeouts'])}")
+        if serving.get("shed_requests"):
+            # load shedding is dropping queued work: sustained = capacity
+            parts.append(f"shed {int(serving['shed_requests'])}")
         if parts:
             lines.append("serving  " + "   ".join(parts))
 
@@ -978,9 +996,17 @@ def health_summary(rec: Dict, prev: Optional[Dict] = None) -> Dict[str, Any]:
                       ("serving/kv_fetch_tokens", "kv_fetch_tokens"),
                       ("serving/kv_host_errors", "kv_host_errors"),
                       ("serving/preemptions", "preemptions"),
-                      ("serving/rejected_requests", "rejected_requests")):
+                      ("serving/rejected_requests", "rejected_requests"),
+                      ("serving/engine_restarts", "engine_restarts"),
+                      ("serving/request_retries", "request_retries"),
+                      ("serving/timeouts", "timeouts"),
+                      ("serving/shed_requests", "shed_requests")):
         if key in c:
             serving[name] = c[key]
+    faults = labeled_series(c, "serving/step_faults")
+    if faults:
+        # contained engine-step exceptions by dispatch site (serving.fault)
+        serving["step_faults"] = {k: int(v) for k, v in sorted(faults.items())}
     if serving:
         out["serving"] = serving
 

@@ -21,6 +21,18 @@ failure modes TPU fleets actually deliver:
 - **bit-flip corruption** (:func:`bit_flip`): post-hoc, flips one bit of an
   already-committed file — the on-disk rot the manifest verification must
   catch.
+- **serving step faults** (:meth:`FaultInjector.fail_step` +
+  ``delay_per_step_s``): the serving-plane mirror of ``guarded_write`` —
+  the paged engine's action executor (``_ServeSession._exec``) consults
+  :func:`step_fault` at every dispatch site (``prefill`` / ``prefill_chunk``
+  / ``decode`` / ``verify`` / ``cow`` / ``spill`` / ``fetch``), one ``None``
+  check when no injector is installed. A scheduled fault raises at a pinned
+  logical step: ``phase="pre"`` fires BEFORE the jit dispatch (the donated
+  pools are intact — the fault is contained per-request), ``phase="post"``
+  fires after the pools were donated but before the step's outputs were
+  adopted (engine-fatal: recovery must rebuild the pool workspace). The
+  step counter advances once per engine action, so a schedule is
+  deterministic given a request trace.
 
 ``SimulatedCrash`` subclasses ``BaseException`` on purpose: retry loops
 catching ``Exception``/``OSError`` must never "survive" a crash — only the
@@ -47,7 +59,7 @@ from typing import List, Optional
 
 __all__ = [
     "SimulatedCrash", "FaultInjector", "install", "clear", "active",
-    "inject", "guarded_write", "guarded_io", "bit_flip",
+    "inject", "guarded_write", "guarded_io", "step_fault", "bit_flip",
 ]
 
 
@@ -66,18 +78,38 @@ class _WriteFault:
         self.count = count
 
 
+class _StepFault:
+    """One scheduled serving-step fault: fires for up to ``count`` engine
+    actions of ``kind`` (empty matches every kind) in ``phase`` once the
+    injector's step counter reaches ``at_step`` (None = immediately)."""
+
+    def __init__(self, kind: str = "", at_step: Optional[int] = None,
+                 count: int = 1, exc=None, phase: str = "pre"):
+        if phase not in ("pre", "post"):
+            raise ValueError(f"phase must be 'pre' or 'post', got {phase!r}")
+        self.kind = kind
+        self.at_step = at_step
+        self.count = count
+        self.exc = exc
+        self.phase = phase
+
+
 class FaultInjector:
     """Deterministic write-path fault plan. Thread-safe: the async
     checkpoint writer hits it from its own thread."""
 
     def __init__(self, kill_at_byte: Optional[int] = None,
-                 delay_per_write_s: float = 0.0):
+                 delay_per_write_s: float = 0.0,
+                 delay_per_step_s: float = 0.0):
         self.kill_at_byte = kill_at_byte
         self.delay_per_write_s = delay_per_write_s
+        self.delay_per_step_s = delay_per_step_s
         self._faults: List[_WriteFault] = []
+        self._step_faults: List[_StepFault] = []
         self._lock = threading.Lock()
         self.bytes_seen = 0          # cumulative bytes offered to storage
         self.writes_seen = 0
+        self.steps_seen = 0          # engine actions observed (pre-phase)
         self.crashed = False
 
     # ---- plan construction ---- #
@@ -89,6 +121,58 @@ class FaultInjector:
         fault that outlives any retry budget). Returns self for chaining."""
         self._faults.append(_WriteFault(errno_code, path_substr, count))
         return self
+
+    def fail_step(self, kind: str = "", at_step: Optional[int] = None,
+                  count: int = 1, exc=None,
+                  phase: str = "pre") -> "FaultInjector":
+        """Schedule ``count`` serving engine steps to raise. ``kind``
+        matches the dispatch site (``prefill`` / ``prefill_chunk`` /
+        ``decode`` / ``verify`` / ``cow`` / ``spill`` / ``fetch``; empty =
+        any), ``at_step`` pins the firing to the injector's engine-action
+        counter (None = the first matching step), ``count < 0`` fails every
+        matching step forever (a persistent fault that outlives any retry
+        budget). ``exc`` is the exception instance (or zero-arg factory) to
+        raise; default ``RuntimeError``. ``phase="pre"`` fires before the
+        jit dispatch (per-request containable); ``phase="post"`` fires with
+        the donated pools already consumed (engine-fatal). Returns self for
+        chaining."""
+        self._step_faults.append(_StepFault(kind, at_step, count, exc, phase))
+        return self
+
+    # ---- the serving step hook ---- #
+
+    def on_step(self, kind: str, phase: str, tick: bool) -> None:
+        """Called by :func:`step_fault` at a serving dispatch site.
+        ``tick`` advances the engine-action counter (True exactly once per
+        scheduler action — the top-of-executor pre consult); sub-action
+        sites (cow/spill/fetch, post consults) observe without ticking so
+        ``at_step`` schedules stay aligned with the scheduler's action
+        sequence — and so does ``delay_per_step_s``, which sleeps once
+        per ACTION (an action consults several times: pre, post, cow/
+        fetch sub-sites). Raises the scheduled exception when a fault
+        matches."""
+        if self.delay_per_step_s > 0.0 and tick:
+            time.sleep(self.delay_per_step_s)
+        with self._lock:
+            if tick:
+                self.steps_seen += 1
+            for f in self._step_faults:
+                if f.count == 0 or f.phase != phase:
+                    continue
+                if f.kind and f.kind != kind:
+                    continue
+                if f.at_step is not None and self.steps_seen < f.at_step:
+                    continue
+                if f.count > 0:
+                    f.count -= 1
+                exc = f.exc
+                if exc is None:
+                    exc = RuntimeError(
+                        f"injected {phase}-dispatch step fault "
+                        f"({kind}, step {self.steps_seen})")
+                elif not isinstance(exc, BaseException):
+                    exc = exc()
+                raise exc
 
     # ---- the write hook ---- #
 
@@ -185,6 +269,19 @@ def guarded_io(path: str, nbytes: int) -> None:
     if allowed < int(nbytes):
         raise SimulatedCrash(
             f"simulated crash after {inj.kill_at_byte} bytes (in {path})")
+
+
+def step_fault(kind: str, phase: str = "pre", tick: bool = False) -> None:
+    """Fault gate for the serving engine's action executor. No injector:
+    one ``None`` check. Installed: scheduled :meth:`FaultInjector.fail_step`
+    faults fire by (kind, phase, step) match — the serving loop contains
+    them per-request (``phase="pre"``) or through engine restart
+    (``phase="post"``) — and ``delay_per_step_s`` slows the loop so
+    deadline / backpressure behavior is observable."""
+    inj = _active
+    if inj is None:
+        return
+    inj.on_step(kind, phase, tick)
 
 
 def bit_flip(path: str, byte_index: Optional[int] = None, bit: int = 0) -> int:

@@ -851,10 +851,19 @@ class TestServeHTTP:
                                  "priority": [3]}).status == 400
         assert self._post(port, {"prompt": [1, 2],
                                  "max_tokens": 0}).status == 400
+        assert self._post(port, {"prompt": [1, 2],
+                                 "deadline_ms": "soon"}).status == 400
+        assert self._post(port, {"prompt": [1, 2],
+                                 "deadline_ms": 0}).status == 400
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
         conn.request("GET", "/healthz")
         health = json.loads(conn.getresponse().read())
-        assert health["status"] == "ok" and health["stopped"] is False
+        # the JSON status body load balancers ignore but status pages key
+        # on: state + queue/restart/uptime detail
+        assert health["state"] == "serving" and health["stopped"] is False
+        assert set(health) == {"state", "stopped", "queue_depth", "running",
+                               "restarts", "uptime_ticks"}
+        assert health["restarts"] == 0 and health["uptime_ticks"] > 0
         conn2 = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
         conn2.request("POST", "/nope", "{}")
         assert conn2.getresponse().status == 404
@@ -864,8 +873,9 @@ class TestServeHTTP:
         assert cli._COMMANDS["serve"] is cli._serve
 
     def test_healthz_503_once_stopped(self):
-        """Load balancers key on the status code: a stopped loop must
-        read 503, not 200-with-caveats."""
+        """Load balancers key on the STATUS CODE (200/503 — pinned);
+        the body is a JSON status (state, queue depth, restarts, uptime
+        ticks) status pages read."""
         dist.set_mesh(None)
         engine = deepspeed_tpu.init_inference(
             tiny_model(), dtype="fp32",
@@ -884,10 +894,37 @@ class TestServeHTTP:
                 r = conn.getresponse()
                 return r.status, json.loads(r.read())
 
-            assert health() == (200, {"status": "ok", "stopped": False})
+            status, body = health()
+            assert status == 200 and body["state"] == "serving"
+            assert body["stopped"] is False and body["queue_depth"] == 0
+            serving.drain()
+            status, body = health()
+            assert status == 200 and body["state"] == "draining"
             serving.shutdown(drain=True)
             status, body = health()
-            assert status == 503 and body["status"] == "stopped"
+            assert status == 503 and body["state"] == "stopped"
+            assert body["stopped"] is True
         finally:
             server.shutdown()
             t.join(60)
+
+    def test_drain_vs_add_request_race_rejects(self):
+        """The drain/submit race, cv-sequenced: a submission that passed
+        ``add_request``'s flag check BEFORE ``drain()`` set the flag but
+        reaches the loop AFTER drain started must terminate ``rejected``
+        — not get served (a submission stream could extend "draining"
+        forever) and never hang its handle."""
+        dist.set_mesh(None)
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32",
+            serving={"block_size": 8, "max_running": 2})
+        serving = AsyncServingEngine(engine, max_new_tokens=4, start=False)
+        # the cv makes add_request's check-and-append atomic, so this IS
+        # the race's loser interleaving: appended to intake pre-drain,
+        # observed by the loop post-drain
+        h = serving.add_request(_prompts((5,))[0])
+        serving.drain()
+        _drive(serving)
+        serving.shutdown(drain=True)
+        assert h.done(), "race-losing submission hung its handle"
+        assert h.status == "rejected" and "draining" in h.error

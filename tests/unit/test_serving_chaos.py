@@ -1,0 +1,1013 @@
+"""Serving-plane chaos suite (the serving mirror of PR 6's checkpoint
+chaos tests, under the same ``chaos`` marker): deterministic step-fault
+injection (``FaultInjector.fail_step``) driven through the always-on
+serving loop — per-request containment (retry with logical-step backoff,
+quarantine after exactly ``max_request_retries``), crash-safe engine
+recovery (pools + jits rebuilt, in-flight re-admitted, token-identical),
+the crash-loop breaker (``/healthz`` 503, ``drain()`` still works),
+request deadlines (logical + wall clock, HTTP 504 / SSE
+``finish_reason: "timeout"``), load shedding (lowest priority first,
+HTTP 429 + Retry-After), the graceful SIGTERM/SIGINT drain of ``dscli
+serve``, the new flight-recorder kinds through ``export_serving_trace``
+and ``tools/validate_trace.py``, the fault rows of the health pane, and
+the ``serving_faulted_steady`` compile-budget contract (recovery may
+recompile each fused entry at most once per restart). The conftest
+``_no_kv_block_leaks`` fixture applies file-wide: every drained scheduler
+— including ones that lived through an engine restart — must leave zero
+live refs and a consistent host tier."""
+
+import http.client
+import importlib.util
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.inference.serve import (AsyncServingEngine, RequestFailed,
+                                           ServeSignalHandler,
+                                           build_http_server, serve_main)
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.models.transformer import TransformerConfig
+from deepspeed_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.chaos
+
+_TOOLS = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                      "..", "..", "tools"))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+_VT_PATH = Path(__file__).resolve().parents[2] / "tools" / "validate_trace.py"
+_spec = importlib.util.spec_from_file_location("validate_trace", _VT_PATH)
+validate_trace = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(validate_trace)
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    dist.set_mesh(None)
+    fi.clear()
+    yield
+    fi.clear()
+    dist.set_mesh(None)
+
+
+def tiny_model(**over):
+    base = dict(vocab_size=64, n_layer=2, n_head=4, d_model=32, d_ff=64,
+                max_seq=64, remat=False)
+    base.update(over)
+    return CausalLM(TransformerConfig(**base))
+
+
+def _prompts(lens=(5, 11, 3), vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, size=n).astype(np.int32) for n in lens]
+
+
+def _engine(telemetry=None, **serving):
+    cfg = {"block_size": 8, "max_running": 2}
+    cfg.update(serving)
+    kw = {"dtype": "fp32", "serving": cfg}
+    if telemetry is not None:
+        kw["telemetry"] = telemetry
+    return deepspeed_tpu.init_inference(tiny_model(), **kw)
+
+
+def _drive(serving, limit=2000):
+    n = 0
+    while serving.step():
+        n += 1
+        assert n < limit, "serving loop did not converge"
+
+
+# --------------------------------------------------------------------- #
+# FaultInjector.fail_step semantics
+
+
+class TestFailStepInjector:
+
+    def test_kind_step_and_count_matching(self):
+        inj = fi.FaultInjector().fail_step("decode", at_step=3, count=2)
+        inj.on_step("prefill", "pre", True)              # step 1: no match
+        inj.on_step("decode", "pre", True)               # step 2: too early
+        with pytest.raises(RuntimeError, match="decode, step 3"):
+            inj.on_step("decode", "pre", True)           # step 3: fires
+        inj.on_step("prefill", "pre", True)              # wrong kind
+        with pytest.raises(RuntimeError):
+            inj.on_step("decode", "pre", True)           # count 2: fires
+        inj.on_step("decode", "pre", True)               # exhausted
+
+    def test_persistent_and_any_kind(self):
+        inj = fi.FaultInjector().fail_step(count=-1)     # everything forever
+        for kind in ("prefill", "decode", "verify"):
+            with pytest.raises(RuntimeError):
+                inj.on_step(kind, "pre", True)
+
+    def test_phase_gating_and_custom_exc(self):
+        boom = ValueError("poison")
+        inj = fi.FaultInjector().fail_step("decode", exc=boom, phase="post")
+        inj.on_step("decode", "pre", True)               # pre: no match
+        with pytest.raises(ValueError, match="poison"):
+            inj.on_step("decode", "post", False)
+        with pytest.raises(ValueError, match="'pre' or 'post'"):
+            fi.FaultInjector().fail_step("decode", phase="mid")
+
+    def test_tick_only_advances_on_action_consults(self):
+        inj = fi.FaultInjector()
+        inj.on_step("prefill", "pre", True)
+        inj.on_step("fetch", "pre", False)               # sub-action site
+        inj.on_step("prefill", "post", False)
+        assert inj.steps_seen == 1
+
+    def test_step_fault_gate_is_noop_without_injector(self):
+        fi.clear()
+        fi.step_fault("decode", "pre", tick=True)        # must not raise
+
+
+# --------------------------------------------------------------------- #
+# per-request containment: retry, backoff, quarantine
+
+
+class TestPerRequestContainment:
+
+    @pytest.mark.parametrize("kind,serving_cfg,lens", [
+        ("prefill", {}, (5, 11, 3)),
+        ("decode", {}, (5, 11, 3)),
+        ("prefill_chunk", {"prefill_chunk_tokens": 4}, (5, 11, 3)),
+    ])
+    def test_fault_at_pinned_step_token_identity(self, kind, serving_cfg,
+                                                 lens):
+        """A pre-dispatch fault in each action kind at a pinned step:
+        every request still completes, token-identical to the un-faulted
+        run (recompute-preemption's guarantee, now under faults)."""
+        engine = _engine(**serving_cfg)
+        prompts = _prompts(lens)
+        refs = [np.asarray(engine.generate(p[None, :], max_new_tokens=8))[0]
+                for p in prompts]
+        serving = AsyncServingEngine(engine, max_new_tokens=8, start=False)
+        with fi.inject(fi.FaultInjector().fail_step(kind, at_step=4,
+                                                    count=1)):
+            hs = [serving.add_request(p) for p in prompts]
+            _drive(serving)
+        serving.shutdown(drain=True)
+        assert [h.status for h in hs] == ["finished"] * len(hs)
+        for h, ref in zip(hs, refs):
+            np.testing.assert_array_equal(np.asarray(h.result(1)), ref)
+
+    def test_verify_fault_token_identity(self):
+        engine = _engine(speculative={"mode": "ngram", "k": 4})
+        rng = np.random.default_rng(1)
+        motif = rng.integers(0, 8, size=8).astype(np.int32)
+        prompt = np.tile(motif, 3)
+        ref = np.asarray(engine.generate(prompt[None, :],
+                                         max_new_tokens=12))[0]
+        serving = AsyncServingEngine(engine, max_new_tokens=12, start=False)
+        with fi.inject(fi.FaultInjector().fail_step("verify", count=1)):
+            h = serving.add_request(prompt)
+            _drive(serving)
+        serving.shutdown(drain=True)
+        np.testing.assert_array_equal(np.asarray(h.result(1)), ref)
+
+    def test_cow_fault_token_identity_on_cache_rehit(self):
+        """A COW-copy fault on a full-prefix cache re-hit: the request
+        re-queues, re-probes the cache, and completes identically — and
+        the fault attributes to the COW dispatch SITE, not the enclosing
+        prefill-chunk action."""
+        from deepspeed_tpu.monitor.metrics import get_registry
+        get_registry().reset()
+        engine = _engine(telemetry=True)
+        # exactly one block: the re-hit is a FULL-prefix hit, which is
+        # what triggers the copy-on-write split
+        prompt = _prompts((8,))[0]
+        ref = np.asarray(engine.generate_batch([prompt],
+                                               max_new_tokens=6)[0])
+        serving = AsyncServingEngine(engine, max_new_tokens=6, start=False)
+        with fi.inject(fi.FaultInjector().fail_step("cow", count=1)):
+            h = serving.add_request(prompt)    # full-prefix hit -> COW
+            _drive(serving)
+        serving.shutdown(drain=True)
+        assert h.status == "finished"
+        np.testing.assert_array_equal(np.asarray(h.result(1)), ref)
+        snap = engine.telemetry_snapshot()
+        assert snap["counters"]['serving/step_faults{kind="cow"}'] == 1
+
+    def test_spill_step_faults_degrade_on_tiered_engine(self):
+        """An injected spill step fault degrades to destroy-on-reclaim
+        (counted into kv_host_errors, never a containment retry) — the
+        loop drains clean and token identity holds."""
+        from deepspeed_tpu.monitor.metrics import get_registry
+        get_registry().reset()
+        engine = _engine(telemetry=True, max_num_blocks=4,
+                         kv_host={"enabled": True})
+        prompt = np.arange(16, dtype=np.int32)
+        ref = np.asarray(engine.generate(prompt[None, :],
+                                         max_new_tokens=5))[0]
+        serving = AsyncServingEngine(engine, max_new_tokens=5, start=False)
+        with fi.inject(fi.FaultInjector().fail_step("spill", count=-1)):
+            h1 = serving.add_request(prompt)     # parks cold blocks
+            _drive(serving)
+            # scratch pressure reclaims them: every demotion attempt
+            # hits the injected fault and degrades to destroy
+            h2 = serving.add_request(np.arange(30, 47, dtype=np.int32),
+                                     max_new_tokens=4)
+            _drive(serving)
+        serving.shutdown(drain=True)
+        np.testing.assert_array_equal(np.asarray(h1.result(1)), ref)
+        assert h2.status == "finished"
+        snap = engine.telemetry_snapshot()
+        assert snap["counters"]["serving/kv_host_errors"] > 0
+        assert engine._kv_host_pool.num_blocks == 0  # nothing demoted
+        assert snap["counters"].get("serving/request_retries", 0) == 0
+
+    def test_fetch_fault_contains_per_request_with_site_label(self):
+        """A fetch (H2D re-materialization) step fault contains
+        per-request — labelled by its own dispatch site, not the
+        enclosing prefill action — and the retry re-hits the surviving
+        host entries for an identical completion."""
+        from deepspeed_tpu.monitor.metrics import get_registry
+        get_registry().reset()
+        engine = _engine(telemetry=True, max_num_blocks=4,
+                         kv_host={"enabled": True})
+        prompt = np.arange(16, dtype=np.int32)
+        ref = np.asarray(engine.generate_batch([prompt],
+                                               max_new_tokens=5)[0])
+        # scratch pressure demotes the prompt's cold blocks to host RAM
+        engine.generate_batch([np.arange(30, 47, dtype=np.int32)],
+                              max_new_tokens=4)
+        assert engine._kv_host_pool.num_blocks >= 2
+        serving = AsyncServingEngine(engine, max_new_tokens=5, start=False)
+        with fi.inject(fi.FaultInjector().fail_step("fetch", count=1)):
+            h = serving.add_request(prompt)      # host hit -> fetch fault
+            _drive(serving)
+        serving.shutdown(drain=True)
+        np.testing.assert_array_equal(np.asarray(h.result(1)), ref)
+        snap = engine.telemetry_snapshot()
+        assert snap["counters"]['serving/step_faults{kind="fetch"}'] == 1
+        assert snap["counters"]["serving/request_retries"] == 1
+
+    def test_requeue_backoff_is_exponential_in_logical_steps(self):
+        engine = _engine(telemetry={"events": True},
+                         fault={"max_request_retries": 3,
+                                "retry_backoff_steps": 2})
+        from deepspeed_tpu.monitor.events import get_flight_recorder
+        get_flight_recorder().clear()
+        serving = AsyncServingEngine(engine, max_new_tokens=4, start=False)
+        with fi.inject(fi.FaultInjector().fail_step("prefill", count=2)):
+            h = serving.add_request(_prompts((5,))[0])
+            _drive(serving)
+        serving.shutdown(drain=True)
+        assert h.status == "finished"
+        req = [e for e in engine._events.snapshot()
+               if e.kind == "req.requeue"]
+        assert [e.data["backoff_steps"] for e in req] == [2, 4]
+        assert [e.data["retry"] for e in req] == [1, 2]
+
+    def test_quarantine_after_exactly_max_retries(self):
+        """THE quarantine pin: a persistent per-request fault retries
+        exactly ``max_request_retries`` times, then the request retires
+        with ``req.error`` — and the loop keeps serving everyone else."""
+        from deepspeed_tpu.monitor.metrics import get_registry
+        get_registry().reset()
+        engine = _engine(telemetry=True,
+                         fault={"max_request_retries": 2,
+                                "retry_backoff_steps": 1})
+        prompts = _prompts((5, 7))
+        ref = np.asarray(engine.generate(prompts[1][None, :],
+                                         max_new_tokens=6))[0]
+        serving = AsyncServingEngine(engine, max_new_tokens=6, start=False)
+        # the fault targets ONLY the first request's whole-prompt prefill
+        # bucket: prompt of 5 -> the first prefill; the second request
+        # prefills after the quarantine (count covers initial + retries)
+        with fi.inject(fi.FaultInjector().fail_step("prefill", count=3)):
+            bad = serving.add_request(prompts[0])
+            _drive(serving)
+        ok = serving.add_request(prompts[1])
+        _drive(serving)
+        serving.shutdown(drain=True)
+        assert bad.status == "error"
+        assert "quarantined after 2" in bad.error
+        with pytest.raises(RequestFailed, match="quarantined"):
+            bad.result(1)
+        assert serving.error is None and not serving._crash_loop
+        np.testing.assert_array_equal(np.asarray(ok.result(1)), ref)
+        snap = engine.telemetry_snapshot()
+        assert snap["counters"]["serving/request_retries"] == 2
+        faults = {k: v for k, v in snap["counters"].items()
+                  if k.startswith("serving/step_faults")}
+        assert faults == {'serving/step_faults{kind="prefill"}': 3}
+
+    def test_progress_resets_retry_count(self):
+        """Retries are scoped to the request that cannot progress: a
+        request hit by MORE than max_request_retries transient faults
+        spread across its lifetime — with successful tokens in between —
+        must NOT quarantine (retry_count resets on every emitted token).
+        Only a request stuck at its faulting action exhausts the budget."""
+        engine = _engine(fault={"max_request_retries": 2,
+                                "retry_backoff_steps": 1})
+        prompt = _prompts((5,))[0]
+        ref = np.asarray(engine.generate(prompt[None, :],
+                                         max_new_tokens=16))[0]
+        serving = AsyncServingEngine(engine, max_new_tokens=16, start=False)
+        inj = fi.FaultInjector()
+        for at in (3, 9, 15, 21):       # 4 faults > max_request_retries=2
+            inj.fail_step("decode", at_step=at, count=1)
+        with fi.inject(inj):
+            h = serving.add_request(prompt)
+            _drive(serving)
+        serving.shutdown(drain=True)
+        assert h.status == "finished"
+        np.testing.assert_array_equal(np.asarray(h.result(1)), ref)
+
+    def test_unattributed_fault_escalates_instead_of_livelocking(self):
+        """A deterministic exception raised BEFORE an action is chosen
+        (e.g. a broken scheduling policy inside next_action) has no
+        request to re-queue: the loop must escalate through the restart
+        path into the breaker — bounded, handles failed — never hot-spin
+        on the recurrence forever (the pre-PR behavior was a loud crash;
+        containment must not turn it into a silent livelock)."""
+        from deepspeed_tpu.inference.policy import SchedulingPolicy
+
+        class Broken(SchedulingPolicy):
+            def select_admission(self, sched):
+                return 99            # out of range -> ValueError per step
+
+        engine = _engine(fault={"max_request_retries": 1,
+                                "max_engine_restarts": 1})
+        serving = AsyncServingEngine(engine, max_new_tokens=4, start=False,
+                                     policy=Broken())
+        h = serving.add_request(_prompts((5,))[0])
+        _drive(serving, limit=200)     # bounded: escalation, not livelock
+        assert serving._crash_loop
+        assert h.done() and h.status == "error"
+        serving.shutdown(drain=True)
+
+    def test_transient_unattributed_faults_do_not_accumulate(self):
+        """'Consecutive' means consecutive: unattributed blips separated
+        by healthy steps reset the escalation counter — a long-running
+        loop with rare transient glitches must never accumulate its way
+        into an unnecessary restart or a bricked breaker."""
+        from deepspeed_tpu.inference.policy import SchedulingPolicy
+
+        class Flaky(SchedulingPolicy):
+            calls = 0
+
+            def select_admission(self, sched):
+                Flaky.calls += 1
+                if Flaky.calls in (1, 3):      # two SEPARATED glitches
+                    raise RuntimeError("transient scheduler glitch")
+                return 0
+
+        engine = _engine(max_running=1,
+                         fault={"max_request_retries": 1,
+                                "max_engine_restarts": 1})
+        serving = AsyncServingEngine(engine, max_new_tokens=4, start=False,
+                                     policy=Flaky())
+        hs = [serving.add_request(p) for p in _prompts((5, 7))]
+        _drive(serving)
+        serving.shutdown(drain=True)
+        assert serving.restarts == 0 and not serving._crash_loop
+        assert all(h.status == "finished" for h in hs)
+
+    def test_fused_fault_requeues_all_rows_identically(self):
+        """A fused decode fault has no single culprit: every row
+        re-queues and recomputes — token identity for all of them, both
+        rows accrue one retry, and the EARLIEST-admitted request
+        re-admits first (the same fairness preemption preserves)."""
+        from deepspeed_tpu.monitor.events import get_flight_recorder
+        from deepspeed_tpu.monitor.metrics import get_registry
+        get_registry().reset()
+        get_flight_recorder().clear()
+        engine = _engine(telemetry={"enabled": True, "events": True})
+        prompts = _prompts((5, 11))
+        refs = [np.asarray(engine.generate(p[None, :], max_new_tokens=8))[0]
+                for p in prompts]
+        serving = AsyncServingEngine(engine, max_new_tokens=8, start=False)
+        with fi.inject(fi.FaultInjector().fail_step("decode", at_step=6,
+                                                    count=1)):
+            hs = [serving.add_request(p) for p in prompts]
+            _drive(serving)
+        serving.shutdown(drain=True)
+        for h, ref in zip(hs, refs):
+            np.testing.assert_array_equal(np.asarray(h.result(1)), ref)
+        snap = engine.telemetry_snapshot()
+        assert snap["counters"]["serving/request_retries"] == 2
+        admits = [e.rid for e in engine._events.snapshot()
+                  if e.kind == "req.admit"]
+        # initial admissions in arrival order, then the post-fault
+        # re-admissions in the SAME order (appendleft walked in reverse)
+        assert admits == [hs[0].rid, hs[1].rid, hs[0].rid, hs[1].rid]
+
+
+# --------------------------------------------------------------------- #
+# engine-fatal faults: crash-safe recovery + the breaker
+
+
+class TestEngineFatalRecovery:
+
+    def test_restart_token_identity_one_restart_event(self):
+        """THE chaos acceptance pin: an engine-fatal fault at a pinned
+        step (the donated pools die mid-step) — every request completes
+        token-identical to the un-faulted run, with exactly one
+        ``serve.restart`` event, and the loop still accepts requests
+        afterwards. KV-block leaks and host consistency are asserted by
+        the file-wide conftest fixture."""
+        from deepspeed_tpu.monitor.events import get_flight_recorder
+        get_flight_recorder().clear()
+        engine = _engine(telemetry={"events": True})
+        prompts = _prompts((5, 11, 3))
+        refs = [np.asarray(engine.generate(p[None, :], max_new_tokens=8))[0]
+                for p in prompts]
+        serving = AsyncServingEngine(engine, max_new_tokens=8, start=False)
+        with fi.inject(fi.FaultInjector().fail_step("decode", at_step=7,
+                                                    count=1, phase="post")):
+            hs = [serving.add_request(p) for p in prompts]
+            _drive(serving)
+        assert serving.restarts == 1 and not serving._crash_loop
+        assert [h.status for h in hs] == ["finished"] * 3
+        for h, ref in zip(hs, refs):
+            np.testing.assert_array_equal(np.asarray(h.result(1)), ref)
+        kinds = [e.kind for e in engine._events.snapshot()]
+        assert kinds.count("serve.restart") == 1
+        assert kinds.count("serve.fault") == 1
+        # the loop is still a server
+        ok = serving.add_request(prompts[0])
+        _drive(serving)
+        serving.shutdown(drain=True)
+        np.testing.assert_array_equal(np.asarray(ok.result(1)), refs[0])
+
+    def test_restart_sequence_is_replay_deterministic(self):
+        """The same request trace + injection schedule replays to the
+        same containment decisions: identical lifecycle event sequences
+        and identical tokens across two fresh engines."""
+        from deepspeed_tpu.monitor.events import get_flight_recorder
+
+        def run():
+            get_flight_recorder().clear()
+            engine = _engine(telemetry={"events": True})
+            serving = AsyncServingEngine(engine, max_new_tokens=8,
+                                         start=False)
+            with fi.inject(fi.FaultInjector()
+                           .fail_step("decode", at_step=6, count=1,
+                                      phase="post")
+                           .fail_step("prefill", at_step=2, count=1)):
+                hs = [serving.add_request(p) for p in _prompts((5, 11))]
+                _drive(serving)
+            serving.shutdown(drain=True)
+            seq = [(e.kind, e.rid) for e in engine._events.snapshot()
+                   if e.kind in ("req.admit", "req.requeue", "serve.fault",
+                                 "serve.restart", "req.retire")]
+            return seq, [h.generated for h in hs]
+
+        seq_a, toks_a = run()
+        seq_b, toks_b = run()
+        assert seq_a == seq_b and toks_a == toks_b
+        assert ("serve.restart", None) in seq_a
+
+    def test_restart_with_prefix_cache_and_host_tier(self):
+        """Recovery under the full cache stack: the device prefix cache
+        restarts cold but the content-addressed host tier survives, and
+        greedy identity holds through the rebuild."""
+        engine = _engine(max_num_blocks=4, kv_host={"enabled": True})
+        prompts = _prompts((10, 9))
+        refs = [np.asarray(engine.generate(p[None, :], max_new_tokens=6))[0]
+                for p in prompts]
+        serving = AsyncServingEngine(engine, max_new_tokens=6, start=False)
+        hs = [serving.add_request(p) for p in prompts]
+        _drive(serving)                       # warm: demotions happened
+        with fi.inject(fi.FaultInjector().fail_step("decode", count=1,
+                                                    phase="post")):
+            hs = [serving.add_request(p) for p in prompts]
+            _drive(serving)
+        serving.shutdown(drain=True)
+        assert serving.restarts == 1
+        for h, ref in zip(hs, refs):
+            np.testing.assert_array_equal(np.asarray(h.result(1)), ref)
+
+    def test_breaker_flips_healthz_503_and_drain_still_works(self):
+        """Breaker exhaustion: restarts bounded, in-flight requests fail,
+        ``/healthz`` flips to 503 with ``state: crash_loop``
+        deterministically, new submissions raise, and drain()/shutdown()
+        still tear the loop down cleanly."""
+        engine = _engine(fault={"max_engine_restarts": 1})
+        serving = AsyncServingEngine(engine, max_new_tokens=8, start=False)
+        server = build_http_server(serving, port=0)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            port = server.server_address[1]
+
+            def health():
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+                conn.request("GET", "/healthz")
+                r = conn.getresponse()
+                return r.status, json.loads(r.read())
+
+            assert health()[0] == 200
+            with fi.inject(fi.FaultInjector().fail_step("decode", count=-1,
+                                                        phase="post")):
+                hs = [serving.add_request(p) for p in _prompts((5, 11))]
+                _drive(serving)
+            assert serving._crash_loop and serving.restarts == 1
+            assert all(h.status == "error" for h in hs)
+            with pytest.raises(RequestFailed, match="crash-loop"):
+                hs[0].result(1)
+            status, body = health()
+            assert status == 503 and body["state"] == "crash_loop"
+            assert body["restarts"] == 1
+            with pytest.raises(RuntimeError, match="crash-loop"):
+                serving.add_request(_prompts((5,))[0])
+            serving.shutdown(drain=True)      # drain still works
+            status, body = health()
+            assert status == 503 and body["state"] == "stopped"
+        finally:
+            server.shutdown()
+            t.join(60)
+
+    def test_breaker_counts_restarts_in_telemetry(self):
+        from deepspeed_tpu.monitor.metrics import get_registry
+        get_registry().reset()
+        engine = _engine(telemetry=True, fault={"max_engine_restarts": 2})
+        serving = AsyncServingEngine(engine, max_new_tokens=6, start=False)
+        with fi.inject(fi.FaultInjector().fail_step("decode", count=-1,
+                                                    phase="post")):
+            serving.add_request(_prompts((5,))[0])
+            _drive(serving)
+        serving.shutdown(drain=True)
+        snap = engine.telemetry_snapshot()
+        assert snap["counters"]["serving/engine_restarts"] == 2
+        assert serving._crash_loop
+
+    def test_closed_loop_still_raises(self):
+        """generate_batch keeps its loud-failure contract: faults are the
+        always-on loop's business, the closed loop propagates."""
+        engine = _engine()
+        with fi.inject(fi.FaultInjector().fail_step("decode", count=1)):
+            with pytest.raises(RuntimeError, match="injected"):
+                engine.generate_batch(_prompts((5,)), max_new_tokens=8)
+
+
+# --------------------------------------------------------------------- #
+# request deadlines
+
+
+class TestDeadlines:
+
+    def test_logical_step_deadline_times_out(self):
+        engine = _engine(max_running=1)
+        serving = AsyncServingEngine(engine, max_new_tokens=8, start=False)
+        doomed = serving.add_request(_prompts((5,))[0], deadline_steps=3)
+        ok = serving.add_request(_prompts((11,))[0])
+        _drive(serving)
+        serving.shutdown(drain=True)
+        assert doomed.status == "timeout" and "scheduler steps" in doomed.error
+        with pytest.raises(RequestFailed, match="timeout"):
+            doomed.result(1)
+        assert ok.status == "finished"
+
+    def test_wall_clock_deadline_at_intake(self, tmp_path):
+        from deepspeed_tpu.monitor.events import get_flight_recorder
+        from deepspeed_tpu.monitor.metrics import get_registry
+        get_registry().reset()
+        get_flight_recorder().clear()
+        engine = _engine(telemetry={"enabled": True, "events": True})
+        serving = AsyncServingEngine(engine, max_new_tokens=4, start=False)
+        h = serving.add_request(_prompts((5,))[0], deadline_ms=0.001)
+        time.sleep(0.01)     # already late before the loop picks it up
+        _drive(serving)
+        serving.shutdown(drain=True)
+        assert h.status == "timeout" and "before the request" in h.error
+        snap = engine.telemetry_snapshot()
+        assert snap["counters"]["serving/timeouts"] == 1
+        # counter and trace must not disagree: the intake path emits a
+        # (rid-less) req.timeout event too, and the trace still validates
+        evs = [e for e in engine._events.snapshot()
+               if e.kind == "req.timeout"]
+        assert len(evs) == 1 and evs[0].rid is None
+        path = str(tmp_path / "intake_timeout_trace.json")
+        engine.export_serving_trace(path)
+        assert validate_trace.validate_path(path, kind="chrome") == []
+
+    def test_timeout_keeps_partial_tokens_and_emits_event(self):
+        from deepspeed_tpu.monitor.events import get_flight_recorder
+        get_flight_recorder().clear()
+        engine = _engine(telemetry={"events": True})
+        serving = AsyncServingEngine(engine, max_new_tokens=30, start=False)
+        h = serving.add_request(_prompts((5,))[0], deadline_steps=8)
+        _drive(serving)
+        serving.shutdown(drain=True)
+        assert h.status == "timeout" and 0 < len(h.generated) < 30
+        evs = [e for e in engine._events.snapshot()
+               if e.kind == "req.timeout"]
+        assert len(evs) == 1 and evs[0].rid == h.rid
+        assert evs[0].data["generated"] == len(h.generated)
+
+    def test_http_504_and_sse_finish_reason(self):
+        engine = _engine(max_running=1)
+        serving = AsyncServingEngine(engine, max_new_tokens=8)
+        server = build_http_server(serving, port=0)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            port = server.server_address[1]
+
+            def post(body):
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=120)
+                conn.request("POST", "/v1/completions", json.dumps(body),
+                             {"Content-Type": "application/json"})
+                return conn.getresponse()
+
+            # expires at intake: wall-clock check -> 504
+            r = post({"prompt": [1, 2, 3], "max_tokens": 4,
+                      "deadline_ms": 0.001})
+            assert r.status == 504
+            assert "deadline" in json.loads(r.read())["error"]
+            # streamed: the final chunk carries finish_reason "timeout"
+            # (drive the deterministic logical budget through the session)
+            r = post({"prompt": [1, 2, 3], "max_tokens": 4})
+            assert r.status == 200       # sanity: the loop still serves
+            r.read()
+        finally:
+            server.shutdown()
+            t.join(60)
+            serving.shutdown(drain=True, timeout=120)
+
+    def test_sse_stream_finish_reason_timeout(self):
+        engine = _engine()
+        serving = AsyncServingEngine(engine, max_new_tokens=30, start=False)
+        h = serving.add_request(_prompts((5,))[0], deadline_steps=8)
+        _drive(serving)
+        serving.shutdown(drain=True)
+        # the SSE layer renders h.status as the finish_reason; pin the
+        # mapping the handler uses
+        assert {"finished": "stop"}.get(h.status, h.status) == "timeout"
+        # the stream ends normally (timeout is not an ERROR raise):
+        bursts = list(h.stream(timeout=0))
+        assert [t for b in bursts for t in b] == h.generated
+
+
+# --------------------------------------------------------------------- #
+# load shedding
+
+
+class TestLoadShedding:
+
+    def test_sheds_lowest_priority_first_deterministically(self):
+        from deepspeed_tpu.monitor.metrics import get_registry
+        get_registry().reset()
+        engine = _engine(telemetry=True, max_running=1,
+                         fault={"shed_queue_depth": 2})
+        serving = AsyncServingEngine(engine, max_new_tokens=4, start=False)
+        prompts = _prompts((5, 6, 7, 8, 9))
+        prios = (5, 0, 0, 3, 1)
+        hs = [serving.add_request(p, priority=pr)
+              for p, pr in zip(prompts, prios)]
+        _drive(serving)
+        serving.shutdown(drain=True)
+        # depth 5 > bound 2 at the first step: shed 3, lowest class
+        # first, newest arrival within a class — deterministic
+        statuses = [h.status for h in hs]
+        assert statuses == ["finished", "rejected", "rejected",
+                            "finished", "rejected"]
+        shed = [h for h in hs if h.status == "rejected"]
+        assert all("shed" in h.error for h in shed)
+        assert all(h.retry_after is not None and h.retry_after >= 1.0
+                   for h in shed)
+        snap = engine.telemetry_snapshot()
+        assert snap["counters"]["serving/shed_requests"] == 3
+
+    def test_shed_event_closes_span_in_trace(self, tmp_path):
+        from deepspeed_tpu.monitor.events import get_flight_recorder
+        get_flight_recorder().clear()
+        engine = _engine(telemetry={"events": True}, max_running=1,
+                         fault={"shed_queue_depth": 1})
+        serving = AsyncServingEngine(engine, max_new_tokens=4, start=False)
+        hs = [serving.add_request(p) for p in _prompts((5, 6, 7))]
+        _drive(serving)
+        serving.shutdown(drain=True)
+        shed_rids = [h.rid for h in hs if h.status == "rejected"]
+        assert shed_rids
+        evs = engine._events.snapshot()
+        assert {e.rid for e in evs if e.kind == "req.shed"} \
+            == set(shed_rids)
+        path = str(tmp_path / "shed_trace.json")
+        engine.export_serving_trace(path)
+        assert validate_trace.validate_path(path, kind="chrome") == []
+
+    def test_admission_control_rejection_carries_retry_after(self):
+        engine = _engine()
+        serving = AsyncServingEngine(
+            engine, max_new_tokens=4, start=False,
+            policy={"name": "fifo", "admission_max_queue": 1})
+        hs = [serving.add_request(p) for p in _prompts((5, 5, 5, 5))]
+        _drive(serving)
+        serving.shutdown(drain=True)
+        rejected = [h for h in hs if h.status == "rejected"]
+        assert rejected
+        assert all(h.retry_after is not None and 1.0 <= h.retry_after <= 120
+                   for h in rejected)
+
+    def test_http_429_with_retry_after_header(self):
+        engine = _engine()
+        serving = AsyncServingEngine(
+            engine, max_new_tokens=16,
+            policy={"name": "fifo", "admission_max_queue": 1})
+        server = build_http_server(serving, port=0)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            port = server.server_address[1]
+            results = []
+
+            def post():
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=300)
+                conn.request("POST", "/v1/completions",
+                             json.dumps({"prompt": [1, 2, 3, 4, 5],
+                                         "max_tokens": 16}),
+                             {"Content-Type": "application/json"})
+                r = conn.getresponse()
+                results.append((r.status, r.getheader("Retry-After"),
+                                r.read()))
+
+            threads = [threading.Thread(target=post) for _ in range(8)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(300)
+            serving.shutdown(drain=True, timeout=300)
+            codes = [c for c, _, _ in results]
+            assert 429 in codes, f"no 429 under queue bound: {codes}"
+            for code, ra, body in results:
+                if code == 429:
+                    assert ra is not None and int(ra) >= 1
+                    assert "admission control" in json.loads(body)["error"]
+        finally:
+            server.shutdown()
+            t.join(60)
+
+
+# --------------------------------------------------------------------- #
+# scheduler-level units: backoff eligibility + wait action
+
+
+class TestSchedulerRetryUnits:
+
+    def _sched(self, **kw):
+        from deepspeed_tpu.inference.block_allocator import BlockAllocator
+        from deepspeed_tpu.inference.scheduler import \
+            ContinuousBatchingScheduler
+        return ContinuousBatchingScheduler(BlockAllocator(9, 8), 2, 8, **kw)
+
+    def test_requeue_sets_holddown_and_wait_action_ticks(self):
+        s = self._sched()
+        r = s.add_request([1] * 4, max_new=4)
+        s.next_action()
+        s.record_prefill(r, 9)
+        s.requeue_for_retry(r, backoff_steps=3, error="boom")
+        assert r.state == "queued" and not r.blocks
+        assert r.retry_at_step == s.step_seq + 3
+        # nothing else runnable: wait actions tick the clock to
+        # eligibility, then the retry admits
+        kinds = []
+        for _ in range(10):
+            action = s.next_action()
+            kinds.append(action[0])
+            if action[0] != "wait":
+                break
+        assert kinds == ["wait", "wait", "wait", "prefill_chunk"] or \
+            kinds == ["wait", "wait", "wait", "prefill"]
+
+    def test_backoff_does_not_starve_other_admissions(self):
+        s = self._sched()
+        r0 = s.add_request([1] * 4, max_new=4)
+        s.next_action()
+        s.record_prefill(r0, 9)
+        s.requeue_for_retry(r0, backoff_steps=50, error="boom")
+        r1 = s.add_request([2] * 4, max_new=2)
+        kind, req = s.next_action()
+        assert req is r1      # FIFO-among-eligible skips the hold-down
+
+
+# --------------------------------------------------------------------- #
+# observability: events validate, health pane rows
+
+
+class TestChaosObservability:
+
+    def test_fault_events_validate_and_render(self, tmp_path):
+        from deepspeed_tpu.monitor.events import get_flight_recorder
+        get_flight_recorder().clear()
+        engine = _engine(telemetry={"events": True},
+                         fault={"max_request_retries": 1,
+                                "retry_backoff_steps": 1})
+        serving = AsyncServingEngine(engine, max_new_tokens=6, start=False)
+        inj = fi.FaultInjector()
+        inj.fail_step("prefill", at_step=1, count=1)       # requeue
+        inj.fail_step("decode", at_step=6, count=1, phase="post")  # restart
+        with fi.inject(inj):
+            hs = [serving.add_request(p) for p in _prompts((5, 11))]
+            doomed = serving.add_request(_prompts((7,))[0],
+                                         deadline_steps=2)
+            _drive(serving)
+        serving.shutdown(drain=True)
+        kinds = {e.kind for e in engine._events.snapshot()}
+        assert {"serve.fault", "serve.restart", "req.requeue",
+                "req.timeout"} <= kinds
+        jp = str(tmp_path / "events.jsonl")
+        engine._events.write_jsonl(jp)
+        assert validate_trace.validate_path(jp, kind="events") == []
+        tp = str(tmp_path / "trace.json")
+        engine.export_serving_trace(tp)
+        assert validate_trace.validate_path(tp, kind="chrome") == []
+        doc = json.load(open(tp))
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert "fault" in names and "restart" in names
+        # the timed-out request's span closed with the timeout flag
+        spans = [e for e in doc["traceEvents"]
+                 if e.get("cat") == "request" and
+                 e.get("tid") == doomed.rid]
+        if spans:         # only exists if the request was ever admitted
+            assert spans[0]["args"].get("timed_out")
+        assert hs[0].status == hs[1].status == "finished"
+
+    def test_health_pane_fault_rows(self):
+        from deepspeed_tpu.monitor.health import (health_summary,
+                                                  render_summary_table)
+        from deepspeed_tpu.monitor.metrics import get_registry
+        get_registry().reset()
+        engine = _engine(telemetry=True, max_running=1,
+                         fault={"max_request_retries": 3,
+                                "retry_backoff_steps": 1,
+                                "shed_queue_depth": 2})
+        serving = AsyncServingEngine(engine, max_new_tokens=4, start=False)
+        inj = fi.FaultInjector()
+        # the pre fault consumes the first decode action (no dispatch, so
+        # no post consult that step); the post fault then fires on the
+        # NEXT decode's post consult — both deterministic
+        inj.fail_step("decode", count=1)
+        inj.fail_step("decode", count=1, phase="post")
+        with fi.inject(inj):
+            hs = [serving.add_request(p, priority=i)
+                  for i, p in enumerate(_prompts((5, 6, 7, 8)))]
+            # priority 9: load shedding (lowest class first) must not
+            # take the deadline-carrying request before it can time out
+            doomed = serving.add_request(_prompts((9,))[0], priority=9,
+                                         deadline_steps=1)
+            _drive(serving)
+        serving.shutdown(drain=True)
+        s = health_summary(engine.telemetry_snapshot())
+        srv = s["serving"]
+        assert sum(srv["step_faults"].values()) == 2
+        assert srv["engine_restarts"] == 1
+        assert srv["request_retries"] >= 1
+        assert srv["timeouts"] == 1
+        assert srv["shed_requests"] >= 1
+        table = render_summary_table(s)
+        assert "faults 2" in table and "restart 1" in table
+        assert "timeout 1" in table and "shed" in table
+
+
+# --------------------------------------------------------------------- #
+# dscli serve graceful SIGTERM/SIGINT
+
+
+class TestGracefulSignal:
+
+    def test_sigterm_drains_and_exits_128_plus_signum(self):
+        """The serving mirror of PR 6's PreemptionHandler: the handler
+        stops intake, unblocks serve_forever, the main path drains
+        in-flight requests within the grace bound, and serve_main
+        returns 128+signum. Driven via trigger() — signal handlers are
+        main-thread-only, and the in-process server runs on a thread."""
+        model = tiny_model()
+        import jax
+        params = model.init_params(jax.random.key(0))
+        holder, ready, rc_box = {}, threading.Event(), {}
+
+        def cb(server, serving):
+            holder.update(server=server, serving=serving)
+            ready.set()
+
+        def run():
+            rc_box["rc"] = serve_main(
+                ["--port", "0", "--dtype", "fp32", "--max-new", "6",
+                 "--block-size", "8", "--max-running", "2",
+                 "--grace", "60"],
+                model=model, params=params, ready_cb=cb)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert ready.wait(300), "dscli serve never bound its socket"
+        serving = holder["serving"]
+        port = holder["server"].server_address[1]
+        h = serving.add_request(np.arange(1, 6, dtype=np.int32))
+        stream = h.stream(timeout=300)
+        first = next(stream)         # the request is mid-decode: the drain
+        # below must serve it OUT, not cut it off
+        assert first
+        # the handler object serve_main installed (install() was a no-op
+        # off the main thread, but trigger() is the handler body)
+        handler = serving._signal_handler
+        handler.trigger(signal.SIGTERM)
+        t.join(300)
+        assert not t.is_alive()
+        assert rc_box["rc"] == 128 + signal.SIGTERM      # 143
+        # the in-flight request was drained out, not cut off
+        assert h.status == "finished" and len(h.generated) == 6
+        # re-entrant signals were ignored (signum latched once)
+        assert handler.signum == signal.SIGTERM
+        handler.trigger(signal.SIGINT)
+        assert handler.signum == signal.SIGTERM
+        # intake stopped: the loop rejects new submissions (503 path)
+        with pytest.raises(RuntimeError):
+            serving.add_request(np.arange(1, 4, dtype=np.int32))
+
+    def test_handler_install_restores_previous(self):
+        """install()/uninstall() follow the PR-6 handler-restore pattern
+        (exercised on the main thread where pytest runs)."""
+        if threading.current_thread() is not threading.main_thread():
+            pytest.skip("signal installation needs the main thread")
+        prev = signal.getsignal(signal.SIGTERM)
+
+        class _Srv:
+            def shutdown(self):
+                pass
+
+        class _Serving:
+            def drain(self):
+                pass
+
+        handler = ServeSignalHandler(_Srv(), _Serving()).install()
+        assert signal.getsignal(signal.SIGTERM) == handler._handle
+        handler.uninstall()
+        assert signal.getsignal(signal.SIGTERM) == prev
+
+
+# --------------------------------------------------------------------- #
+# compile-budget contract: recovery may recompile each entry at most once
+
+
+class TestFaultedContract:
+
+    @pytest.fixture(autouse=True)
+    def clean_compile_state(self):
+        from deepspeed_tpu.monitor.metrics import get_registry
+        from deepspeed_tpu.monitor.trace import get_compile_watchdog
+        get_registry().reset()
+        get_registry().set_enabled(True)
+        get_compile_watchdog().reset()
+        yield
+        get_registry().reset()
+        get_registry().set_enabled(True)
+        get_compile_watchdog().reset()
+
+    def test_serving_faulted_steady_contract(self):
+        """One injected engine-fatal fault: recovery rebuilds the jits,
+        so each fused entry may compile at most ONCE more than its
+        steady budget (rebuild != recompile storm), verified through the
+        CompileWatchdog with strict undeclared-entry reporting."""
+        from dslint.contracts import check_compile_budgets
+
+        engine = _engine(telemetry=True,
+                         speculative={"mode": "ngram", "k": 4})
+        rng = np.random.default_rng(0)
+        motif = rng.integers(0, 8, size=8).astype(np.int32)
+        prompts = [np.tile(motif, 3),
+                   rng.integers(0, 64, size=11).astype(np.int32),
+                   rng.integers(0, 64, size=5).astype(np.int32)]
+        # closed-loop warm-up x2: compiles the steady set incl. the
+        # cache-hit tail chunk + COW programs
+        engine.generate_batch(prompts, max_new_tokens=12)
+        engine.generate_batch(prompts, max_new_tokens=12)
+        warm = dict(engine.telemetry_snapshot()["compile"]["by_fn"])
+
+        serving = AsyncServingEngine(engine, max_new_tokens=12, start=False)
+        with fi.inject(fi.FaultInjector().fail_step("decode", at_step=5,
+                                                    count=1, phase="post")):
+            hs = [serving.add_request(p) for p in prompts]
+            _drive(serving)
+        serving.shutdown(drain=True)
+        assert serving.restarts == 1
+        assert all(h.status == "finished" for h in hs)
+
+        by_fn = engine.telemetry_snapshot()["compile"]["by_fn"]
+        violations = check_compile_budgets(by_fn, "serving_faulted_steady",
+                                           strict=True)
+        assert violations == [], "\n".join(violations)
+        # the restart really did rebuild (the post-restart re-admission
+        # prefills against the cold cache on fresh jit wrappers, so the
+        # compile set grew) — rebuild-without-recompile would silently pin
+        # the budget at the steady set and never exercise the contract
+        assert sum(by_fn.values()) > sum(warm.values())
+        assert by_fn["inference.paged_prefill"] > warm.get(
+            "inference.paged_prefill", 0)

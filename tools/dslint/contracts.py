@@ -71,6 +71,15 @@ class CompileBudget:
 #:                     no-jax-import half statically; this contract pins
 #:                     the dynamic half), so each fused entry compiles
 #:                     exactly as often as the unsampled async scenario
+#:   serving_faulted_steady — the always-on loop surviving ONE injected
+#:                     engine-fatal step fault (the donated pools die
+#:                     mid-step): crash-safe recovery rebuilds the pool
+#:                     workspace AND the fused-step jits, so each entry may
+#:                     recompile AT MOST ONCE PER ENGINE RESTART on top of
+#:                     its steady budget (rebuild != recompile storm — the
+#:                     recovered loop's shapes are exactly the pre-fault
+#:                     shapes, so the post-restart compile set is the warm
+#:                     set, once)
 #:   serving_sharded_steady — generate_batch under serving.tp > 1 (head-
 #:                     sharded KV pools, shard_map'd paged kernel), prefix
 #:                     cache + speculation on, prompts within two 128-token
@@ -212,6 +221,33 @@ BUDGETS: List[CompileBudget] = [
         "inference.paged_fetch_scatter", "serving_tiered_steady", 2,
         "per-block H2D scatter: traced block index + fixed slice shape "
         "— one program however many blocks re-materialize"),
+    CompileBudget(
+        "inference.paged_decode", "serving_faulted_steady", 2,
+        "one steady program + at most one post-restart recompile: the "
+        "scenario injects exactly one engine-fatal fault, and recovery "
+        "rebuilds the jit wrappers once (same shapes, one compile)"),
+    CompileBudget(
+        "inference.paged_prefill", "serving_faulted_steady", 4,
+        "two 128-token prompt buckets, each at most twice (steady + one "
+        "post-restart recompile)"),
+    CompileBudget(
+        "inference.paged_prefill_chunk", "serving_faulted_steady", 8,
+        "(chunk bucket, table-width power-of-two) pairs at most twice "
+        "each across the one restart"),
+    CompileBudget(
+        "inference.paged_verify", "serving_faulted_steady", 2,
+        "one k-window bucket, at most twice across the one restart"),
+    CompileBudget(
+        "inference.paged_cow", "serving_faulted_steady", 2,
+        "fixed block geometry, at most twice across the one restart"),
+    CompileBudget(
+        "inference.paged_spill_gather", "serving_faulted_steady", 4,
+        "block-index-traced copy program (2 donation/layout variants), "
+        "at most twice across the one restart"),
+    CompileBudget(
+        "inference.paged_fetch_scatter", "serving_faulted_steady", 4,
+        "block-index-traced copy program (2 donation/layout variants), "
+        "at most twice across the one restart"),
     CompileBudget(
         "inference.paged_decode", "serving_sharded_steady", 1,
         "THE fused decode step under tp>1: the head split rides the "
