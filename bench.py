@@ -90,6 +90,14 @@ Env knobs (defaults are the chip-measured fast path):
                            BENCH_SERVE_CHAOS_RATE=8 (req/s)
                            BENCH_SERVE_CHAOS_REQS=16
                            BENCH_SERVE_CHAOS_NEW=32
+  BENCH_SERVE_DP=1         replica scale-out probe: the same seeded Poisson
+                           trace through one AsyncServingEngine (dp=1) and
+                           through a two-replica ReplicaRouter with session
+                           affinity (dp=2), value = dp=2 goodput,
+                           vs_baseline = SCALING EFFICIENCY
+                           ((goodput_dp2/goodput_dp1)/2, 1.0 = linear);
+                           BENCH_SERVE_DP_RATE=8 (req/s)
+                           BENCH_SERVE_DP_REQS=16 BENCH_SERVE_DP_NEW=32
   BENCH_SKIP_PROBE=0       skip the subprocess backend probe
   BENCH_PROBE_RETRIES=1    probe retries before giving up on the backend
   BENCH_ALLOW_CPU=0        on probe failure, run a tiny CPU smoke metric
@@ -121,10 +129,15 @@ def _probe_backend(timeout_s: int = 240):
             [sys.executable, "-c", "import jax; jax.devices()"],
             timeout=timeout_s, capture_output=True, text=True)
     except subprocess.TimeoutExpired as e:
+        # an indefinite hang inside backend init is the r03-r05 relay-outage
+        # signature (ports up, C++ init never returns) — tag the records so
+        # the trajectory analyzer can bucket these rounds without regexing
+        # the summary text
         return {"stage": "backend_init_timeout",
                 "summary": f"device backend did not initialize within "
                            f"{timeout_s}s (hung init — TPU relay down?)",
-                "error": str(e)}
+                "error": str(e),
+                "hint": "relay_down"}
     if r.returncode != 0:
         tail = (r.stderr or "").strip().splitlines()[-15:]
         return {"stage": "backend_init_error",
@@ -455,6 +468,7 @@ BENCH_METRICS = [
     ("BENCH_SERVE_SPEC", "1", "gpt2_serving_spec_decode_tpot_ms"),
     ("BENCH_SERVE_ASYNC", "1", "gpt2_serving_async_goodput_tokens_per_sec"),
     ("BENCH_SERVE_CHAOS", "1", "gpt2_serving_chaos_goodput_tokens_per_sec"),
+    ("BENCH_SERVE_DP", "1", "gpt2_serving_dp_goodput_tokens_per_sec"),
     ("BENCH_SERVE_TP", "1", "gpt2_serving_tp_tokens_per_sec"),
     ("BENCH_CKPT", "1", "gpt2_ckpt_async_stall_ms_per_step"),
 ]
@@ -816,30 +830,36 @@ def run_spec_decode_bench():
 
 
 def _drive_open_loop(engine, prompts, gaps, max_new, consume,
-                     injector=None):
-    """Shared Poisson open-loop driver for the async/chaos serving
+                     injector=None, serving=None, sessions=None):
+    """Shared Poisson open-loop driver for the async/chaos/dp serving
     probes: submit the seeded arrival trace (`sleep(gap)` then
     `add_request`) to a fresh ``AsyncServingEngine``, fan one
     ``consume(handle, rec)`` thread per request, join, drain — so the
-    two probes' goodput accounting can never drift methodologically.
+    probes' goodput accounting can never drift methodologically.
     ``injector`` (a ``FaultInjector``) is installed for the run's
-    duration. Returns ``(recs, wall_seconds, serving)``; ``serving`` is
-    already shut down (aborted if the drain failed)."""
+    duration. ``serving`` overrides the engine-wrapping default (the dp
+    probe passes a ``ReplicaRouter`` — same ``add_request``/``shutdown``
+    surface); ``sessions`` is an optional per-request session-key list
+    (drives the router's affinity hash). Returns ``(recs, wall_seconds,
+    serving)``; ``serving`` is already shut down (aborted if the drain
+    failed)."""
     import threading
     import time as _t
 
     from deepspeed_tpu.inference.serve import AsyncServingEngine
     from deepspeed_tpu.utils import fault_injection as fi
 
-    serving = AsyncServingEngine(engine, max_new_tokens=max_new)
+    if serving is None:
+        serving = AsyncServingEngine(engine, max_new_tokens=max_new)
     recs, threads = [], []
     t0 = _t.perf_counter()
     try:
         if injector is not None:
             fi.install(injector)
-        for p, gap in zip(prompts, gaps):
+        for i, (p, gap) in enumerate(zip(prompts, gaps)):
             _t.sleep(gap)
-            h = serving.add_request(p)
+            h = serving.add_request(
+                p, session=sessions[i] if sessions else None)
             rec = {"tpot": [], "tokens": 0}
             th = threading.Thread(target=consume, args=(h, rec),
                                   daemon=True)
@@ -1100,6 +1120,114 @@ def run_serve_chaos_bench():
         del engine
 
 
+def run_serve_dp_bench():
+    """Replica scale-out probe: the SAME seeded Poisson arrival trace
+    through one ``AsyncServingEngine`` (dp=1) and through a two-replica
+    ``ReplicaRouter`` with session affinity (dp=2, replicas share the
+    model params — per-replica state is just the KV pools). Value = the
+    dp=2 run's goodput (generated tokens/s over FINISHED requests);
+    vs_baseline = SCALING EFFICIENCY, (goodput_dp2 / goodput_dp1) / 2 —
+    1.0 means a second serving replica doubles goodput, and on a
+    single-chip box the number quantifies how much of the dp axis is
+    compute-bound (replicas time-slice one chip) vs queue-bound (open-
+    loop arrivals wait less when two intakes drain the backlog).
+    Per-replica routing counters ride the record's telemetry blob."""
+    import numpy as np
+
+    RATE = float(os.environ.get("BENCH_SERVE_DP_RATE", 8.0))
+    NREQ = int(os.environ.get("BENCH_SERVE_DP_REQS", 16))
+    MAX_NEW = int(os.environ.get("BENCH_SERVE_DP_NEW", 32))
+    engines = []
+    try:
+        import deepspeed_tpu
+        import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.inference.router import ReplicaRouter
+        from deepspeed_tpu.inference.serve import AsyncServingEngine
+        from deepspeed_tpu.models import gpt2
+
+        dist.set_mesh(None)
+        _reset_telemetry()
+        model = gpt2("125m", remat=False,
+                     attention_backend=os.environ.get("BENCH_ATTN", "auto"))
+        serving_cfg = {"block_size": 128, "max_running": 8,
+                       "prefix_caching": "off"}
+        engines.append(deepspeed_tpu.init_inference(
+            model, dtype="bf16", telemetry={"events": True},
+            serving=serving_cfg))
+        engines.append(deepspeed_tpu.init_inference(
+            model, params=engines[0].params, dtype="bf16",
+            telemetry={"events": True}, serving=serving_cfg))
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(0, 50257, size=int(n)).astype(np.int32)
+                   for n in rng.integers(64, 192, size=NREQ)]
+        gaps = rng.exponential(1.0 / max(RATE, 1e-6), size=NREQ)
+        # one session per request: the affinity hash spreads fresh
+        # sessions over the ring deterministically
+        sessions = [f"dp-bench-{i}" for i in range(NREQ)]
+        # closed-loop warm-up on BOTH replicas so neither run pays
+        # compile time inside its measured arrival window
+        for e in engines:
+            e.generate_batch(prompts[:2], max_new_tokens=MAX_NEW)
+        _reset_telemetry()
+
+        def consume(h, rec):
+            for burst in h.stream():
+                rec["tokens"] += len(burst)
+            rec["status"] = h.status
+
+        def one_run(serving):
+            recs, wall, serving = _drive_open_loop(
+                engines[0], prompts, gaps, MAX_NEW, consume,
+                serving=serving, sessions=sessions)
+            good = sum(r["tokens"] for r in recs
+                       if r.get("status") == "finished")
+            done = sum(r.get("status") == "finished" for r in recs)
+            return (good / wall if wall > 0 else 0.0), done
+
+        dp1, dp1_done = one_run(
+            AsyncServingEngine(engines[0], max_new_tokens=MAX_NEW))
+        _reset_telemetry()       # the record's blob describes the dp=2 run
+        dp2, dp2_done = one_run(ReplicaRouter(
+            [AsyncServingEngine(e, max_new_tokens=MAX_NEW)
+             for e in engines]))
+
+        eff = (dp2 / dp1) / 2 if dp1 else 0.0
+        out = {
+            "metric": _metric_name("BENCH_SERVE_DP"),
+            "value": round(dp2, 1),
+            "unit": f"goodput tokens/s at dp=2 (bf16 open loop, Poisson "
+                    f"{RATE}/s x {NREQ} reqs x {MAX_NEW} new, session-"
+                    f"affine router; {dp2_done}/{NREQ} finished vs "
+                    f"{dp1_done}/{NREQ} at dp=1, {dp1:.1f} tok/s)",
+            # replica scaling efficiency: 1.0 = second replica doubles
+            # goodput (expect << 1.0 when both time-slice one chip)
+            "vs_baseline": round(eff, 3),
+        }
+        tel = _telemetry_blob(engines[0]) or {}
+        from deepspeed_tpu.monitor.health import labeled_series
+        counters = (engines[0].telemetry_snapshot() or {}).get(
+            "counters", {})
+        routed = {k: int(v) for k, v in labeled_series(
+            counters, "router/requests").items()}
+        if routed:
+            tel["router_requests"] = routed
+        out["telemetry"] = tel
+        print(json.dumps(out), flush=True)
+    except Exception as e:  # noqa: BLE001 — probe failure => skip record
+        print(json.dumps({
+            "metric": _metric_name("BENCH_SERVE_DP"),
+            "value": 0.0,
+            "unit": "goodput tokens/s at dp=2 (skipped: replica scale-out "
+                    "probe failed)",
+            "vs_baseline": 0.0,
+            "skipped": True,
+            "skip_stage": "serve_dp_run",
+            "skip_error": f"{type(e).__name__}: {e}",
+        }), flush=True)
+    finally:
+        del engines
+
+
 def run_serving_tp_bench():
     """Tensor-parallel serving scaling probe: the same mixed prompt set
     through the paged engine at serving.tp=1 and serving.tp=N on one
@@ -1266,7 +1394,7 @@ def _emit_skip_records(err):
         err = {"stage": "backend_probe", "summary": first[0],
                "error": err or ""}
     for name in _enabled_metrics():
-        print(json.dumps({
+        rec = {
             "metric": name,
             "value": 0.0,
             "unit": f"tokens/s (skipped: {err['summary']})",
@@ -1274,7 +1402,11 @@ def _emit_skip_records(err):
             "skipped": True,
             "skip_stage": err["stage"],
             "skip_error": err.get("error", ""),
-        }), flush=True)
+        }
+        if err.get("hint"):
+            # e.g. "relay_down" on the backend-init-timeout signature
+            rec["skip_hint"] = err["hint"]
+        print(json.dumps(rec), flush=True)
 
 
 def _run_cpu_smoke(steps: int):
@@ -1405,7 +1537,7 @@ def main():
            ("BENCH_DECODE_DENSE", "BENCH_DECODE_PAGED",
             "BENCH_SERVE_PREFIX", "BENCH_KV_TIER", "BENCH_SERVE_CHUNKED",
             "BENCH_SERVE_SPEC", "BENCH_SERVE_ASYNC", "BENCH_SERVE_CHAOS",
-            "BENCH_SERVE_TP")):
+            "BENCH_SERVE_DP", "BENCH_SERVE_TP")):
         # free the last training engine's device state before serving
         if engine is not None:
             del engine, model, batch
@@ -1432,6 +1564,9 @@ def main():
             gc.collect()
         if _metric_enabled("BENCH_SERVE_CHAOS"):
             run_serve_chaos_bench()
+            gc.collect()
+        if _metric_enabled("BENCH_SERVE_DP"):
+            run_serve_dp_bench()
             gc.collect()
         if _metric_enabled("BENCH_SERVE_TP"):
             run_serving_tp_bench()

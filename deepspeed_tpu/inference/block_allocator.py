@@ -305,6 +305,52 @@ class BlockAllocator:
             parent = key
         return entries, keys
 
+    def demote_chain(self, tokens) -> int:
+        """Force-demote the COLD cached FULL blocks of ``tokens``'s hash
+        chain into the host tier — the prefill→decode KV handoff's push
+        half (``inference/router.py``): after a prefill replica commits a
+        prompt's blocks, demoting them publishes the content in the
+        SHARED host pool, where a decode replica's tiered admission walk
+        finds it and re-materializes H2D (the PR-12 fetch path — the host
+        tier is the transport, no new wire format).
+
+        Per matched chain position: a block still referenced by a live
+        request is left on device untouched (it is serving traffic here —
+        and unregistering it would violate the one-tier-per-key
+        invariant), a key already host-resident just extends the walk,
+        and a cold block is spilled via the session hook then freed +
+        unregistered (device copy gone, host copy authoritative). A spill
+        hook failure keeps the device copy — demotion is best-effort
+        cache movement, never data loss. Returns the number of blocks
+        demoted."""
+        if (not self.prefix_cache or self.host_pool is None
+                or self._spill_fn is None):
+            return 0
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        parent = ROOT_KEY
+        demoted = 0
+        for j in range(tokens.size // bs):
+            key = self.chain_key(parent, tokens[j * bs:(j + 1) * bs])
+            b = self._table.get(key)
+            if b is None:
+                if self.host_pool.contains(key):
+                    parent = key
+                    continue          # already demoted: keep walking
+                break                 # key in neither tier: chain ends
+            parent = key
+            if b not in self._cold:
+                continue              # hot: a live request holds it
+            if not self._spill_fn(b, key):
+                continue              # spill failed: keep the device copy
+            del self._cold[b]
+            del self._table[key]
+            del self._key_of[b]
+            self._free.append(b)
+            self._free_set.add(b)
+            demoted += 1
+        return demoted
+
     def host_consistency(self) -> List[str]:
         """Tier-discipline violations (empty = consistent): the host
         pool's own invariants plus the cross-tier rule that a chain key

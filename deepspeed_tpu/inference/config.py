@@ -161,6 +161,34 @@ class KvHostConfig(ConfigModel):
     spill: str = "auto"        # auto | off (off = fetch-only, no demotion)
 
 
+class ReplicaConfig(ConfigModel):
+    """Replica scale-out ("serving.replicas" sub-section) — the ``dp``
+    serving axis.
+
+    ``dp`` > 1 stands up N engine replicas (one shared weight pytree,
+    one shared host KV tier) behind the deterministic
+    :class:`~deepspeed_tpu.inference.router.ReplicaRouter`: session-
+    affinity hashing pins multi-turn traffic onto the replica holding
+    its prefix cache, fresh sessions take a queue-depth/burn-rate-aware
+    least-loaded tiebreak, and a replica tripping its crash-loop breaker
+    drains in flight to siblings token-identically. ``roles`` tags each
+    replica ``any`` | ``prefill`` | ``decode``; any ``prefill`` entry
+    enables disaggregated prefill/decode — the prefill replica commits
+    prompt blocks and ships them through the content-addressed
+    ``KvHostPool`` (the host tier is the KV transport), the decode
+    replica re-materializes them H2D instead of re-prefilling.
+    ``affinity="off"`` disables session hashing; ``handoff="off"``
+    disables the disaggregated path while keeping the role tags for
+    routing. Prefer more replicas when throughput-bound with a model
+    that fits one slice; prefer larger ``tp`` when the model (or its KV
+    working set) does not fit."""
+    dp: int = 1                 # serving replicas behind the router
+    roles: list = Field(default_factory=list)   # per-replica role tags,
+    # padded with "any"; any "prefill" entry enables the handoff path
+    affinity: str = "session"   # session | off — session-key hashing
+    handoff: str = "auto"       # auto | off — disaggregated prefill path
+
+
 class ServingFaultConfig(ConfigModel):
     """Serving-plane fault tolerance ("serving.fault" sub-section).
 
@@ -266,6 +294,9 @@ class ServingConfig(ConfigModel):
     kv_host: KvHostConfig = Field(default_factory=KvHostConfig)
     # tiered KV cache: spill cold prefix-cache blocks to a host-RAM pool
     # (see KvHostConfig)
+    replicas: ReplicaConfig = Field(default_factory=ReplicaConfig)
+    # dp serving axis: N replicas behind the deterministic affinity
+    # router, optional prefill/decode role split (see ReplicaConfig)
     speculative: SpeculativeConfig = Field(
         default_factory=SpeculativeConfig)
     fault: ServingFaultConfig = Field(default_factory=ServingFaultConfig)

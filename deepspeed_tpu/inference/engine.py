@@ -837,6 +837,46 @@ class InferenceEngine:
                  ranks=[0])
         return pool
 
+    def ensure_host_kv_pool(self):
+        """Materialize (or return) the persistent host-RAM KV tier for
+        this engine's CURRENT serving geometry without opening a session
+        — the replica-router builder uses it to stand the shared pool up
+        on the first replica before any session exists. None when
+        ``serving.kv_host`` is off or the model cannot prefix-cache."""
+        srv = self._config.serving
+        bs = int(srv.block_size)
+        cfg = self.module.config
+        n_max = -(-cfg.max_seq // bs)
+        num_blocks = int(srv.max_num_blocks) or \
+            (int(srv.max_running) * n_max + 1)
+        caching = (hasattr(self.module, "forward_paged_prefill_chunk")
+                   and str(srv.prefix_caching) != "off")
+        return self._kv_host_pool_for(num_blocks, bs, caching)
+
+    def adopt_host_kv_pool(self, pool) -> None:
+        """Share another engine's host KV tier — the dp serving axis's KV
+        transport (``inference/router.py``): a prefill replica demotes a
+        prompt's committed blocks into the SHARED content-addressed pool
+        and a decode replica's tiered admission fetches them H2D, so
+        disaggregated prefill/decode needs no new wire format. The pool
+        must match this engine's serving geometry (same block slice shape
+        + dtype — content addresses are only portable between identical
+        layouts); subsequent serve sessions then reuse it instead of
+        building a private tier."""
+        if pool is None:
+            self._kv_host_pool = None
+            return
+        cfg = self.module.config
+        shape = (cfg.n_layer, int(self._config.serving.block_size),
+                 cfg.kv_heads, cfg.head_dim)
+        if not pool.matches_geometry(shape, self.dtype.__name__):
+            raise ValueError(
+                f"host KV pool geometry {pool.block_shape}/{pool.dtype} "
+                f"does not match this engine's {shape}/"
+                f"{self.dtype.__name__} — replicas can only share a tier "
+                "when their serving geometry is identical")
+        self._kv_host_pool = pool
+
     def _kv_workspace(self, B: int, need_len: int):
         """Persistent KV workspace (reference ``inference_context.h:49``:
         one workspace allocated once and reused across calls). Grows
@@ -1655,6 +1695,21 @@ class _ServeSession:
             if sched.telemetry is not None:
                 sched.telemetry.kv_spills.inc()
         return ok
+
+    def demote_prompt(self, tokens) -> int:
+        """Force-demote ``tokens``'s committed FULL blocks into the host
+        tier (:meth:`BlockAllocator.demote_chain`) — the prefill→decode
+        KV handoff: a prefill replica calls this once a warm-up request
+        retires, publishing the prompt's KV in the SHARED host pool where
+        the decode replica's tiered admission finds it. Single-threaded
+        by the session contract (the always-on loop routes it through its
+        command intake); returns the number of blocks demoted (0 when
+        the session has no spill hook / host tier)."""
+        if self._closed:
+            raise RuntimeError("serving session is closed")
+        if not self._kv_spill:
+            return 0
+        return self.sched.allocator.demote_chain(tokens)
 
     def _run_fetches(self, req, pools):
         """Land the admission's host-tier hits H2D: device_put each

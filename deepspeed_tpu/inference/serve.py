@@ -241,7 +241,8 @@ class AsyncServingEngine:
                     eos_token_id: Optional[int] = None, priority: int = 0,
                     ttft_budget: Optional[int] = None,
                     deadline_ms: Optional[float] = None,
-                    deadline_steps: Optional[int] = None) -> RequestHandle:
+                    deadline_steps: Optional[int] = None,
+                    session: Optional[str] = None) -> RequestHandle:
         """Submit one request; returns immediately with its streaming
         handle. Raises RuntimeError once the loop is draining/stopped or
         its crash-loop breaker is open. Admission control (the policy's
@@ -249,7 +250,11 @@ class AsyncServingEngine:
         refused submission terminates the handle with status
         ``"rejected"`` instead of raising here. ``deadline_ms`` (wall
         clock from submission) / ``deadline_steps`` (scheduler's logical
-        clock) retire the request as ``"timeout"`` on expiry."""
+        clock) retire the request as ``"timeout"`` on expiry.
+        ``session`` is the replica router's affinity key
+        (``inference/router.py``) — accepted here for surface parity
+        and ignored: one engine is trivially affine."""
+        del session
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -282,6 +287,48 @@ class AsyncServingEngine:
                 return               # finalize already terminated every handle
             self._intake.append(("cancel", h))
             self._cv.notify_all()
+
+    def request_demote(self, prompt) -> threading.Event:
+        """Ask the serving thread to force-demote ``prompt``'s committed
+        FULL blocks into the host KV tier (the prefill→decode handoff's
+        push half — see ``inference/router.py``). Returns an event set
+        once the demotion ran: the router submits the decode-side request
+        only after it fires, so the blocks are host-resident before the
+        decode replica's admission probe walks the tiers. Routed through
+        the command intake because demotion touches allocator state and
+        dispatches the spill jit — serving-thread-only by the session
+        contract. On a stopped/parked loop the event is set immediately
+        (nothing demotes; the decode side falls back to recompute)."""
+        arr = np.asarray(prompt, np.int32).reshape(-1)
+        done = threading.Event()
+        with self._cv:
+            if self._stopped or self._crash_loop:
+                done.set()
+                return done
+            self._intake.append(("demote", (arr, done)))
+            self._cv.notify_all()
+        return done
+
+    def health_state(self):
+        """``(status_code, body)`` for ``GET /healthz`` — extracted from
+        the HTTP handler so a :class:`~deepspeed_tpu.inference.router.
+        ReplicaRouter` can present the identical surface (its aggregate
+        reads 503 only when NO serving-capable replica remains). Load
+        balancers key on the STATUS CODE: a stopped, crashed, or
+        crash-looping loop must read unhealthy, not 200-with-caveats —
+        the body is the human/status-page detail."""
+        dead = self._stopped or self.error is not None
+        sched = self._session.sched
+        state = ("stopped" if dead else
+                 "crash_loop" if self._crash_loop else
+                 "draining" if self._draining else "serving")
+        body = {"state": state,
+                "stopped": self._stopped,
+                "queue_depth": len(sched.waiting),
+                "running": len(sched.running),
+                "restarts": self.restarts,
+                "uptime_ticks": sched.step_seq}
+        return (503 if (dead or self._crash_loop) else 200), body
 
     def drain(self) -> None:
         """Stop intake; the loop keeps stepping until everything in
@@ -387,6 +434,8 @@ class AsyncServingEngine:
         for kind, h in cmds:
             if kind == "submit":
                 self._process_submit(h)
+            elif kind == "demote":
+                self._process_demote(h)
             else:
                 self._process_cancel(h)
         if self._stop_now:
@@ -578,6 +627,23 @@ class AsyncServingEngine:
             ev.emit("req.submit", rid=req.rid, t_ns=h._submit_ns,
                     prompt_tokens=int(h.prompt.size), priority=h.priority)
 
+    def _process_demote(self, cmd) -> None:
+        """The ``request_demote`` command body: force-demote the prompt's
+        committed FULL blocks into the host tier under the mesh scope
+        (the spill jit dispatches here). The completion event is set in a
+        ``finally`` — a demotion failure must not strand the router's
+        handoff wait; the decode side simply recomputes whatever did not
+        make it host-side."""
+        arr, done = cmd
+        try:
+            if not self._crash_loop:
+                with self.engine._mesh_scope():
+                    self._session.demote_prompt(arr)
+        except Exception:  # noqa: BLE001 — handoff is best-effort
+            pass
+        finally:
+            done.set()
+
     def _process_cancel(self, h: RequestHandle) -> None:
         if h.done():
             return
@@ -654,6 +720,8 @@ class AsyncServingEngine:
         for kind, h in leftovers:
             if kind == "submit":
                 h._finish(REJECTED, msg or "serving loop stopped")
+            elif kind == "demote":
+                h[1].set()       # never strand a handoff wait
         if self.error is None and not self._session._closed:
             # aborting shutdown: retire everything still scheduled THROUGH
             # the scheduler so its KV blocks free and the persistent
@@ -768,7 +836,9 @@ def build_http_server(serving: AsyncServingEngine, host: str = "127.0.0.1",
 
         {"prompt": [token ids] | "text" (needs a tokenizer),
          "max_tokens": 16, "stream": false, "priority": 0,
-         "ttft_budget": null, "eos_token_id": null}
+         "ttft_budget": null, "eos_token_id": null,
+         "session": null}  # replica-router affinity key (multi-turn
+                           # clients pass a stable id)
 
     Non-streaming responses return one ``text_completion`` object whose
     choice carries ``token_ids`` (and ``text`` when a detokenizer is
@@ -811,23 +881,11 @@ def build_http_server(serving: AsyncServingEngine, host: str = "127.0.0.1",
 
         def do_GET(self):
             if self.path == "/healthz":
-                # load balancers key on the STATUS CODE: a stopped,
-                # crashed, or crash-looping loop must read unhealthy, not
-                # 200-with-caveats — the body is the human/status-page
-                # detail (state, queue depth, restarts, uptime ticks)
-                dead = serving._stopped or serving.error is not None
-                sched = serving._session.sched
-                state = ("stopped" if dead else
-                         "crash_loop" if serving._crash_loop else
-                         "draining" if serving._draining else "serving")
-                self._json(
-                    503 if (dead or serving._crash_loop) else 200,
-                    {"state": state,
-                     "stopped": serving._stopped,
-                     "queue_depth": len(sched.waiting),
-                     "running": len(sched.running),
-                     "restarts": serving.restarts,
-                     "uptime_ticks": sched.step_seq})
+                # delegated to health_state(): one liveness rule shared by
+                # the single-engine loop and the replica router's
+                # aggregate (503 only when nothing can serve)
+                code, body = serving.health_state()
+                self._json(code, body)
             elif self.path == "/metrics":
                 # Prometheus exposition of the process registry — the
                 # scrape-and-alert plane's front door (one shared
@@ -880,6 +938,9 @@ def build_http_server(serving: AsyncServingEngine, host: str = "127.0.0.1",
                 eos = body.get("eos_token_id")
                 if eos is not None:
                     eos = int(eos)
+                sess = body.get("session")
+                if sess is not None:
+                    sess = str(sess)
             except (ValueError, TypeError) as e:
                 self._json(400, {"error": str(e)})
                 return
@@ -887,7 +948,7 @@ def build_http_server(serving: AsyncServingEngine, host: str = "127.0.0.1",
                 h = serving.add_request(
                     ids, max_new_tokens=max_tokens, priority=priority,
                     ttft_budget=ttft_budget, deadline_ms=deadline_ms,
-                    eos_token_id=eos)
+                    eos_token_id=eos, session=sess)
             except RuntimeError as e:   # draining/stopped/crash-loop
                 self._json(503, {"error": str(e)})
                 return
@@ -998,6 +1059,15 @@ def serve_main(argv=None, model=None, params=None,
     parser.add_argument("--block-size", type=int, default=128)
     parser.add_argument("--max-running", type=int, default=8)
     parser.add_argument("--max-blocks", type=int, default=0)
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="dp serving axis: N engine replicas behind "
+                             "the deterministic affinity router (shared "
+                             "weights, shared host KV tier)")
+    parser.add_argument("--replica-roles", default="",
+                        help="comma list of per-replica roles (any | "
+                             "prefill | decode), e.g. 'prefill,decode' "
+                             "enables disaggregated prefill/decode over "
+                             "the host KV tier (default: all 'any')")
     parser.add_argument("--spec", default="off",
                         help="speculative decoding: off | ngram")
     parser.add_argument("--telemetry", action="store_true",
@@ -1061,13 +1131,34 @@ def serve_main(argv=None, model=None, params=None,
         sampler = MetricsSampler(interval_s=args.sample_interval,
                                  path=args.sample_jsonl, slo=slo).start()
 
-    serving = AsyncServingEngine(engine, max_new_tokens=args.max_new)
+    n_rep = max(int(args.replicas), 1)
+    if n_rep > 1:
+        # dp serving axis: N engines share one weight pytree and one host
+        # KV tier (the prefill->decode transport), each behind its own
+        # always-on loop; the router fronts them all
+        from deepspeed_tpu.inference.router import ReplicaRouter
+        pool = engine.ensure_host_kv_pool()
+        engines = [engine]
+        for _ in range(n_rep - 1):
+            e = deepspeed_tpu.init_inference(model, params=engine.params,
+                                             **kwargs)
+            if pool is not None:
+                e.adopt_host_kv_pool(pool)
+            engines.append(e)
+        roles = [r.strip() for r in args.replica_roles.split(",")
+                 if r.strip()]
+        serving = ReplicaRouter(
+            [AsyncServingEngine(e, max_new_tokens=args.max_new)
+             for e in engines],
+            roles=roles or None)
+    else:
+        serving = AsyncServingEngine(engine, max_new_tokens=args.max_new)
     server = build_http_server(serving, host=args.host, port=args.port)
     host, port = server.server_address[:2]
     print(f"dscli serve: {args.model} listening on "
           f"http://{host}:{port}/v1/completions "
-          f"(policy={serving.policy.name}, max_running={args.max_running}; "
-          f"metrics at /metrics)",
+          f"(policy={serving.policy.name}, replicas={n_rep}, "
+          f"max_running={args.max_running}; metrics at /metrics)",
           flush=True)
     if ready_cb is not None:
         ready_cb(server, serving)

@@ -88,7 +88,12 @@ EVENT_KINDS = frozenset({
     "serve.begin",          # generate_batch / async-loop entry (requests=)
     "serve.end",            # serve span (dur_ns=, requests=)
     "serve.drain",          # async loop stopped intake (waiting=,
-    #                         running=, pending=)
+    #                         running=, pending=; router-level drains add
+    #                         replica= — the breaker-tripped source being
+    #                         drained to siblings)
+    "serve.route",          # replica router decision (seq=, replica=,
+    #                         reason= affinity | least_loaded | failover |
+    #                         handoff | prefill, session=)
     # serving fault tolerance (serving.fault)
     "serve.fault",          # an engine-step exception was contained
     #                         (action= dispatch site, error=)
@@ -421,6 +426,13 @@ def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
         elif e.kind == "serve.drain":
             out.append({"name": "drain", "cat": "serving", "ph": "i",
                         "s": "p", "pid": _ENGINE_PID, "tid": _ENGINE_TID,
+                        "ts": us(e.ts_ns), "args": dict(e.data or {})})
+        elif e.kind == "serve.route":
+            # replica-router decisions render on the engine track: the
+            # trace shows WHICH replica each request landed on and WHY
+            # (affinity re-hit, least-loaded, drain failover, handoff)
+            out.append({"name": "route", "cat": "serving", "ph": "i",
+                        "s": "t", "pid": _ENGINE_PID, "tid": _ENGINE_TID,
                         "ts": us(e.ts_ns), "args": dict(e.data or {})})
         elif e.kind in ("serve.fault", "serve.restart"):
             # containment/recovery belongs to the engine timeline: the

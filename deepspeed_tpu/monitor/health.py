@@ -860,6 +860,31 @@ def render_summary_table(s: Dict[str, Any]) -> str:
         if parts:
             lines.append("serving  " + "   ".join(parts))
 
+    # ---- replica router (dp serving axis) ---- #
+    rep = s.get("replicas")
+    if rep is not None:
+        parts = []
+        names = sorted(set(rep.get("requests", {}))
+                       | set(rep.get("healthy", {}))
+                       | set(rep.get("queue_depth", {}))
+                       | set(rep.get("drained_requests", {})))
+        for name in names:
+            ok = rep.get("healthy", {}).get(name)
+            # DOWN = breaker-tripped/stopped/draining: the router is
+            # steering its traffic (and drained its in-flight) elsewhere
+            line = (f"{name} {'up' if ok is None or ok else 'DOWN'}"
+                    f" q{int(rep.get('queue_depth', {}).get(name, 0))}"
+                    f" {int(rep.get('requests', {}).get(name, 0))}req")
+            drained = rep.get("drained_requests", {}).get(name, 0)
+            if drained:
+                line += f" drained {int(drained)}"
+            parts.append(line)
+        if rep.get("handoffs"):
+            # disaggregated prefill->decode transfers via the host tier
+            parts.append(f"handoff {int(rep['handoffs'])}")
+        if parts:
+            lines.append("replicas " + "   ".join(parts))
+
     # ---- SLO burn rates ---- #
     slo = s.get("slo")
     if slo is not None:
@@ -1009,6 +1034,23 @@ def health_summary(rec: Dict, prev: Optional[Dict] = None) -> Dict[str, Any]:
         serving["step_faults"] = {k: int(v) for k, v in sorted(faults.items())}
     if serving:
         out["serving"] = serving
+
+    # ---- replica router (dp serving axis, inference/router.py) ---- #
+    replicas: Dict[str, Any] = {}
+    for key, name in (("router/requests", "requests"),
+                      ("router/drained_requests", "drained_requests")):
+        series = labeled_series(c, key)
+        if series:
+            replicas[name] = {k: int(v) for k, v in sorted(series.items())}
+    for key, name in (("router/healthy", "healthy"),
+                      ("router/queue_depth", "queue_depth")):
+        series = labeled_series(g, key)
+        if series:
+            replicas[name] = {k: v for k, v in sorted(series.items())}
+    if "router/handoffs" in c:
+        replicas["handoffs"] = int(c["router/handoffs"])
+    if replicas:
+        out["replicas"] = replicas
 
     # ---- SLO burn rates / breaches (monitor/slo.py) ---- #
     slo: Dict[str, Any] = {}

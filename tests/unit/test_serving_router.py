@@ -1,0 +1,660 @@
+"""Replica scale-out acceptance suite (``inference/router.py``): routing
+decisions replay-identical on a fixed request trace, session affinity
+re-hitting the affine replica's prefix cache (pinned through the cache-hit
+token counter), N=2 greedy token-identical to N=1, the disaggregated
+prefill->decode handoff serving a request with ZERO whole-prompt prefills
+on the decode replica (blocks arrive through the content-addressed host
+KV tier), breaker-tripped fault drain completing every in-flight request
+on siblings greedy-identically while the router's /healthz stays 200, the
+``serving_replicated_steady`` compile-budget contract (routing adds zero
+programs: every fused entry at exactly 2x its one-replica budget), the
+``router/*`` metrics surfaced in ``health_summary`` + the ``dscli top``
+replicas pane, and ``serve.route`` events through
+``export_serving_trace`` + ``tools/validate_trace.py``."""
+
+import http.client
+import importlib.util
+import json
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.inference.router import ReplicaRouter, RouterHandle
+from deepspeed_tpu.inference.serve import (AsyncServingEngine, RequestFailed,
+                                           build_http_server)
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.models.transformer import TransformerConfig
+from deepspeed_tpu.utils import fault_injection as fi
+
+_VT_PATH = Path(__file__).resolve().parents[2] / "tools" / "validate_trace.py"
+_spec = importlib.util.spec_from_file_location("validate_trace", _VT_PATH)
+validate_trace = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(validate_trace)
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    from deepspeed_tpu.monitor.metrics import get_registry
+    dist.set_mesh(None)
+    get_registry().reset()
+    get_registry().set_enabled(True)
+    yield
+    dist.set_mesh(None)
+    get_registry().reset()
+    get_registry().set_enabled(True)
+
+
+def tiny_model(**over):
+    base = dict(vocab_size=64, n_layer=2, n_head=4, d_model=32, d_ff=64,
+                max_seq=64, remat=False)
+    base.update(over)
+    return CausalLM(TransformerConfig(**base))
+
+
+def _prompts(lens=(5, 11, 3, 8), vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, size=n).astype(np.int32) for n in lens]
+
+
+def _engines(n=2, model=None, telemetry=None, **serving):
+    """n paged engines on ONE weight pytree (the replica contract)."""
+    model = model or tiny_model()
+    cfg = {"block_size": 8, "max_running": 2, **serving}
+    kw = {} if telemetry is None else {"telemetry": telemetry}
+    dist.set_mesh(None)
+    first = deepspeed_tpu.init_inference(model, dtype="fp32", serving=cfg,
+                                         **kw)
+    out = [first]
+    for _ in range(n - 1):
+        dist.set_mesh(None)
+        out.append(deepspeed_tpu.init_inference(
+            model, params=first.params, dtype="fp32", serving=cfg, **kw))
+    return out
+
+
+def _router(engines, max_new=8, **kw):
+    return ReplicaRouter(
+        [AsyncServingEngine(e, max_new_tokens=max_new, start=False)
+         for e in engines], **kw)
+
+
+def _drive(router):
+    while router.step():
+        pass
+
+
+# --------------------------------------------------------------------- #
+# deterministic routing
+
+
+class TestRoutingDeterminism:
+
+    def test_same_trace_replays_identical_decisions(self):
+        """THE determinism pin: the same request trace through the same
+        replica set yields a byte-identical ``decisions`` list — routing
+        consults nothing but the session hash, the router's own
+        outstanding counts, and restart counts."""
+        engines = _engines(2)
+        trace = list(zip(_prompts((5, 11, 3, 8, 6)),
+                         ["alice", None, "bob", "alice", None]))
+
+        def run():
+            router = _router(engines)
+            hs = [router.add_request(p, session=s) for p, s in trace]
+            _drive(router)
+            assert all(h.status == "finished" for h in hs)
+            got = [(d["replica"], d["reason"], d["session"])
+                   for d in router.decisions]
+            toks = [h.generated for h in hs]
+            # release the engines' serve sessions for the replay run
+            router.shutdown()
+            return got, toks
+
+        first, toks1 = run()
+        second, toks2 = run()
+        assert first == second
+        assert toks1 == toks2
+        # both reasons exercised: sessions hash, fresh traffic spreads
+        reasons = {r for _, r, _ in first}
+        assert "affinity" in reasons and "least_loaded" in reasons
+
+    def test_least_loaded_spreads_and_index_breaks_ties(self):
+        """Session-less traffic takes the smallest (outstanding,
+        restarts, index) key: first request lands r0 (tie -> index),
+        the second lands r1 while r0 still holds its request."""
+        engines = _engines(2)
+        router = _router(engines)
+        p = _prompts((5, 5))
+        h0 = router.add_request(p[0])
+        h1 = router.add_request(p[1])
+        assert [d["replica"] for d in router.decisions] == ["r0", "r1"]
+        assert [d["reason"] for d in router.decisions] == \
+            ["least_loaded", "least_loaded"]
+        _drive(router)
+        assert h0.status == h1.status == "finished"
+        router.shutdown()
+
+    def test_affinity_pins_session_to_one_replica(self):
+        """Every turn of one session routes to the SAME replica; the
+        assignment is a pure hash (no health/load input), so it holds
+        across interleaved other-session traffic."""
+        engines = _engines(2)
+        router = _router(engines)
+        hs = []
+        for turn in range(3):
+            hs.append(router.add_request(_prompts((7,))[0], session="conv-1"))
+            hs.append(router.add_request(_prompts((5,), seed=turn + 1)[0]))
+            _drive(router)
+        conv = [d["replica"] for d in router.decisions
+                if d["session"] == "conv-1"]
+        assert len(set(conv)) == 1 and len(conv) == 3
+        assert all(d["reason"] == "affinity" for d in router.decisions
+                   if d["session"] == "conv-1")
+        assert all(h.status == "finished" for h in hs)
+        router.shutdown()
+
+    def test_affinity_off_via_config(self):
+        """``serving.replicas.affinity: off`` drops session hashing:
+        sessioned requests take the least-loaded path."""
+        engines = _engines(2, replicas={"affinity": "off"})
+        router = _router(engines)
+        router.add_request(_prompts((5,))[0], session="alice")
+        assert router.decisions[0]["reason"] == "least_loaded"
+        _drive(router)
+        router.shutdown()
+
+    def test_roles_resolve_from_config(self):
+        """``serving.replicas.roles`` seeds the role split without a
+        constructor argument (the ``dscli serve --replicas`` path), and
+        short lists pad with "any"."""
+        engines = _engines(2, replicas={"roles": ["prefill"]})
+        router = _router(engines)
+        assert router.roles == ["prefill", "any"]
+        assert router._prefill_idx == [0] and router._serving_idx == [1]
+        router.shutdown()
+
+    def test_all_prefill_roles_rejected(self):
+        engines = _engines(1)
+        with pytest.raises(ValueError, match="decode-capable"):
+            _router(engines, roles=["prefill"])
+
+
+# --------------------------------------------------------------------- #
+# affinity re-hits the replica-local prefix cache
+
+
+class TestAffinityCacheReuse:
+
+    def test_second_turn_rehits_affine_prefix_cache(self):
+        """Multi-turn: turn 2's prompt (turn 1 prompt + its reply) must
+        re-hit the prefix cache turn 1 built — pinned through the
+        ``serving/prefix_cache_hit_tokens`` counter, which only the
+        affine replica can move (its sibling never saw the chain)."""
+        from deepspeed_tpu.monitor.metrics import get_registry
+        engines = _engines(2, telemetry=True, prefix_caching="on")
+        router = _router(engines)
+        prompt = _prompts((17,))[0]
+        h1 = router.add_request(prompt, session="conv-1")
+        _drive(router)
+        assert h1.status == "finished"
+        turn2 = np.concatenate(
+            [prompt, np.asarray(h1.generated, np.int32)])
+
+        before = get_registry().snapshot()["counters"].get(
+            "serving/prefix_cache_hit_tokens", 0)
+        h2 = router.add_request(turn2, session="conv-1")
+        _drive(router)
+        assert h2.status == "finished"
+        hit = get_registry().snapshot()["counters"].get(
+            "serving/prefix_cache_hit_tokens", 0) - before
+        # turn 1 committed floor(25/8) = 3 full blocks = 24 tokens; the
+        # re-hit must cover every full block of turn 2's prompt prefix
+        assert hit >= (turn2.size // 8) * 8 - 8
+        assert hit > 0
+        conv = [d["replica"] for d in router.decisions]
+        assert len(set(conv)) == 1
+        router.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# N=2 token identity
+
+
+class TestReplicaTokenIdentity:
+
+    def test_n2_token_identical_to_n1(self):
+        """THE scale-out acceptance pin: the same trace through one
+        always-on loop and through a 2-replica router yields identical
+        greedy tokens per request."""
+        model = tiny_model()
+        engines = _engines(3, model=model)
+        sessions = [f"sess{i}" for i in range(4)]
+
+        s1 = AsyncServingEngine(engines[0], max_new_tokens=8, start=False)
+        hs = [s1.add_request(p, session=s)
+              for p, s in zip(_prompts(), sessions)]
+        while s1.step():
+            pass
+        ref = [h.generated for h in hs]
+        s1.shutdown()
+
+        router = _router(engines[1:])
+        hs2 = [router.add_request(p, session=s)
+               for p, s in zip(_prompts(), sessions)]
+        _drive(router)
+        got = [h.generated for h in hs2]
+        assert got == ref
+        # the trace really used both replicas
+        assert len({d["replica"] for d in router.decisions}) == 2
+        code, _body = router.health_state()
+        assert code == 200
+        router.shutdown()
+        code, body = router.health_state()
+        assert code == 503 and body["state"] == "stopped"
+
+    def test_handle_result_and_stream_surfaces(self):
+        """RouterHandle keeps the RequestHandle consumer contract:
+        ``result`` returns prompt + generated, ``stream`` yields bursts
+        in order, ``cancel`` terminates."""
+        engines = _engines(2)
+        router = _router(engines)
+        p = _prompts((5,))[0]
+        h = router.add_request(p)
+        t = threading.Thread(target=_drive, args=(router,), daemon=True)
+        t.start()
+        full = h.result(timeout=120)
+        t.join(120)
+        assert isinstance(h, RouterHandle)
+        np.testing.assert_array_equal(full[:p.size], p)
+        assert list(full[p.size:]) == h.generated
+
+        hc = router.add_request(_prompts((6,))[0])
+        hc.cancel()
+        _drive(router)
+        assert hc.status in ("cancelled", "finished")
+        router.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# disaggregated prefill/decode over the host KV tier
+
+
+class TestDisaggregatedHandoff:
+
+    def test_decode_replica_never_runs_whole_prompt_prefill(self):
+        """THE disaggregation pin: with roles ["prefill", "decode"] and a
+        shared host pool, the decode replica serves the request with
+        ZERO whole-prompt prefills — its only prefill work is the
+        sub-block tail; every full block arrives through the host tier
+        (kv_fetch_hits == floor(len(prompt)/block_size)) — and the
+        tokens are greedy-identical to a single-engine serve."""
+        from deepspeed_tpu.monitor.events import get_flight_recorder
+        from deepspeed_tpu.monitor.metrics import get_registry
+
+        model = tiny_model()
+        cfg = {"block_size": 8, "max_running": 2, "prefix_caching": "on",
+               "kv_host": {"enabled": True}}
+        dist.set_mesh(None)
+        ep = deepspeed_tpu.init_inference(model, dtype="fp32", serving=cfg,
+                                          telemetry={"events": True})
+        dist.set_mesh(None)
+        ed = deepspeed_tpu.init_inference(model, params=ep.params,
+                                          dtype="fp32", serving=cfg,
+                                          telemetry={"events": True})
+        pool = ep.ensure_host_kv_pool()
+        assert pool is not None
+        ed.adopt_host_kv_pool(pool)
+
+        dist.set_mesh(None)
+        eref = deepspeed_tpu.init_inference(
+            model, params=ep.params, dtype="fp32",
+            serving={"block_size": 8, "max_running": 2})
+        prompt = _prompts((21,), seed=1)[0]
+        ref = np.asarray(eref.generate(prompt[None, :],
+                                       max_new_tokens=8))[0]
+
+        sp = AsyncServingEngine(ep, max_new_tokens=8, start=False)
+        sd = AsyncServingEngine(ed, max_new_tokens=8, start=False)
+        router = ReplicaRouter([sp, sd], roles=["prefill", "decode"])
+        h = router.add_request(prompt)
+        assert h._stage == "warm"
+        assert [d["reason"] for d in router.decisions] == \
+            ["handoff", "prefill"]
+        assert [d["replica"] for d in router.decisions] == ["r1", "r0"]
+
+        # drive the prefill replica ALONE until the blocks ship: from
+        # here on, any prefill/fetch activity belongs to the decode side
+        n = 0
+        while h._stage in ("warm", "demote") and n < 200:
+            sp.step()
+            router._advance(h)
+            n += 1
+        assert h._stage == "running"
+
+        reg = get_registry()
+        fetch0 = reg.snapshot()["counters"].get("serving/kv_fetch_hits", 0)
+        rec = get_flight_recorder()
+        mark = len(rec.snapshot())
+
+        _drive(router)
+        got = h.result()
+        np.testing.assert_array_equal(got, ref)
+
+        fetched = reg.snapshot()["counters"].get(
+            "serving/kv_fetch_hits", 0) - fetch0
+        assert fetched == prompt.size // 8      # every full block H2D
+        prefills = [e for e in rec.snapshot()[mark:]
+                    if e.kind in ("req.prefill", "req.prefill_chunk")]
+        assert prefills, "decode side ran no prefill work at all?"
+        for e in prefills:                      # sub-block tail only
+            assert e.data.get("tokens", 0) < prompt.size, \
+                f"whole-prompt prefill on the decode replica: {e.data}"
+        assert reg.snapshot()["counters"].get("router/handoffs") == 1
+        router.shutdown()
+        for s in (sp, sd):
+            assert s._session.sched.allocator.host_consistency() == []
+
+    def test_handoff_skipped_for_sub_block_prompts(self):
+        """A prompt under one block has nothing to ship — it routes
+        plainly (no warm-up decision, no handoff counter)."""
+        model = tiny_model()
+        cfg = {"prefix_caching": "on", "kv_host": {"enabled": True}}
+        engines = _engines(2, model=model, **cfg)
+        pool = engines[0].ensure_host_kv_pool()
+        engines[1].adopt_host_kv_pool(pool)
+        router = _router(engines, roles=["prefill", "decode"])
+        h = router.add_request(_prompts((5,))[0])
+        assert h._stage == "running"
+        assert [d["reason"] for d in router.decisions] == ["least_loaded"]
+        _drive(router)
+        assert h.status == "finished"
+        router.shutdown()
+
+    def test_handoff_off_via_config(self):
+        """``serving.replicas.handoff: off`` keeps the role split for
+        routing but never warms through the prefill replica."""
+        model = tiny_model()
+        engines = _engines(2, model=model, prefix_caching="on",
+                           kv_host={"enabled": True},
+                           replicas={"handoff": "off"})
+        router = _router(engines, roles=["prefill", "decode"])
+        h = router.add_request(_prompts((21,))[0])
+        assert h._stage == "running"
+        _drive(router)
+        assert h.status == "finished"
+        router.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# breaker-tripped fault drain
+
+
+class TestBreakerDrain:
+
+    def test_drain_completes_on_siblings_token_identical(self):
+        """THE fault-drain pin: r0 trips its crash-loop breaker with
+        requests queued and running; every one of its requests completes
+        on r1 greedy-identical to a clean single-engine decode; the
+        drained replica's own /healthz is 503 crash_loop while the
+        router's stays 200."""
+        model = tiny_model()
+        cfg = {"block_size": 8, "max_running": 2,
+               "fault": {"max_engine_restarts": 1,
+                         "restart_backoff_s": 0.0}}
+        engines = _engines(2, model=model, **cfg)
+        dist.set_mesh(None)
+        eref = deepspeed_tpu.init_inference(
+            model, params=engines[0].params, dtype="fp32",
+            serving={"block_size": 8, "max_running": 2})
+        ps = _prompts((5, 11, 7))
+        refs = [np.asarray(eref.generate(p[None, :], max_new_tokens=8))[0]
+                for p in ps]
+
+        s0 = AsyncServingEngine(engines[0], max_new_tokens=8, start=False)
+        s1 = AsyncServingEngine(engines[1], max_new_tokens=8, start=False)
+        router = ReplicaRouter([s0, s1])
+        server = build_http_server(router, port=0)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            port = server.server_address[1]
+
+            def health():
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+                conn.request("GET", "/healthz")
+                r = conn.getresponse()
+                return r.status, json.loads(r.read())
+
+            hs = [router.add_request(p) for p in ps]
+            assert {d["replica"] for d in router.decisions} == {"r0", "r1"}
+
+            # engine-fatal fault pinned to r0: step it ALONE under a
+            # persistent post-phase decode fault until the breaker opens
+            with fi.inject(fi.FaultInjector().fail_step(
+                    "decode", count=-1, phase="post")):
+                n = 0
+                while not s0._crash_loop and n < 300:
+                    s0.step()
+                    n += 1
+            assert s0._crash_loop and s0.restarts == 1
+
+            _drive(router)          # the drain: r0's requests replay on r1
+            for h, ref, p in zip(hs, refs, ps):
+                assert h.status == "finished", (h.status, h.error)
+                got = np.concatenate(
+                    [p, np.asarray(h.generated, np.int32)])
+                np.testing.assert_array_equal(got, ref)
+            assert any(d["reason"] == "failover"
+                       for d in router.decisions)
+            assert all(d["replica"] == "r1" for d in router.decisions
+                       if d["reason"] == "failover")
+
+            status, body = health()
+            assert status == 200 and body["state"] == "serving"
+            assert body["healthy_replicas"] == 1
+            assert body["total_replicas"] == 2
+            assert body["replicas"]["r0"]["state"] == "crash_loop"
+            c0, b0 = s0.health_state()
+            assert c0 == 503 and b0["state"] == "crash_loop"
+
+            # drain metrics + events: every r0 request was drained once
+            from deepspeed_tpu.monitor.health import labeled_series
+            from deepspeed_tpu.monitor.metrics import get_registry
+            drained = labeled_series(
+                get_registry().snapshot()["counters"],
+                "router/drained_requests")
+            n_r0 = sum(1 for d in router.decisions
+                       if d["replica"] == "r0")
+            assert drained.get("r0") == n_r0 > 0
+            ev = engines[0]._events
+            if ev is not None:
+                kinds = [e.kind for e in ev.snapshot()]
+                assert "serve.drain" in kinds
+        finally:
+            server.shutdown()
+            t.join(60)
+        router.shutdown()
+        code, body = router.health_state()
+        assert code == 503 and body["state"] == "stopped"
+
+    def test_new_traffic_avoids_tripped_replica(self):
+        """After the breaker trip, fresh requests — including ones whose
+        session hashes onto the dead replica — route to the healthy
+        sibling (reason ``failover``)."""
+        model = tiny_model()
+        engines = _engines(2, model=model,
+                           fault={"max_engine_restarts": 0,
+                                  "restart_backoff_s": 0.0})
+        router = _router(engines)
+        with fi.inject(fi.FaultInjector().fail_step(
+                "decode", count=-1, phase="post")):
+            h0 = router.add_request(_prompts((5,))[0])
+            n = 0
+            while not router.replicas[0]._crash_loop and n < 300:
+                router.replicas[0].step()
+                router._advance(h0)
+                n += 1
+        assert router.replicas[0]._crash_loop
+        # "sess0" hashes onto r0 (pinned by the determinism suite): its
+        # next turn must fail over, not 503
+        h1 = router.add_request(_prompts((7,))[0], session="sess0")
+        _drive(router)
+        assert h0.status == "finished" and h1.status == "finished"
+        last = router.decisions[-1]
+        assert last["reason"] == "failover" and last["replica"] == "r1"
+        router.shutdown()
+
+    def test_all_replicas_down_add_request_raises(self):
+        engines = _engines(2, fault={"max_engine_restarts": 0,
+                                     "restart_backoff_s": 0.0})
+        router = _router(engines)
+        with fi.inject(fi.FaultInjector().fail_step(
+                "decode", count=-1, phase="post")):
+            hs = [router.add_request(p) for p in _prompts((5, 7))]
+            _drive(router)
+        assert all(r._crash_loop for r in router.replicas)
+        assert all(h.status == "error" for h in hs)
+        with pytest.raises(RequestFailed):
+            hs[0].result(1)
+        with pytest.raises(RuntimeError, match="no healthy replica"):
+            router.add_request(_prompts((5,))[0])
+        code, body = router.health_state()
+        assert code == 503 and body["state"] == "crash_loop"
+        router.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# compile-budget contract
+
+
+class TestReplicatedSteadyContract:
+
+    @pytest.fixture(autouse=True)
+    def clean_watchdog(self):
+        from deepspeed_tpu.monitor.trace import get_compile_watchdog
+        get_compile_watchdog().reset()
+        yield
+        get_compile_watchdog().reset()
+
+    def test_serving_replicated_steady_contract(self):
+        """Routing adds ZERO compiles: after a closed-loop warm-up on
+        each replica, routed open-loop traffic (both replicas, affinity
+        + least-loaded + a cache re-hit) leaves the process-global
+        compile counts untouched, and every fused entry sits within the
+        N=2 ``serving_replicated_steady`` budget (exactly double the
+        one-replica budgets)."""
+        import sys
+        _TOOLS = str(Path(__file__).resolve().parents[2] / "tools")
+        if _TOOLS not in sys.path:
+            sys.path.insert(0, _TOOLS)
+        from dslint.contracts import check_compile_budgets
+
+        model = tiny_model()
+        cfg = {"block_size": 8, "max_running": 2,
+               "speculative": {"mode": "ngram", "k": 4}}
+        dist.set_mesh(None)
+        e0 = deepspeed_tpu.init_inference(model, dtype="fp32",
+                                          telemetry=True, serving=cfg)
+        dist.set_mesh(None)
+        e1 = deepspeed_tpu.init_inference(model, params=e0.params,
+                                          dtype="fp32", telemetry=True,
+                                          serving=cfg)
+        rng = np.random.default_rng(0)
+        motif = rng.integers(0, 8, size=8).astype(np.int32)
+        warm_prompts = [np.tile(motif, 3),
+                        rng.integers(0, 64, size=11).astype(np.int32),
+                        rng.integers(0, 64, size=5).astype(np.int32)]
+        for e in (e0, e1):
+            e.generate_batch(warm_prompts, max_new_tokens=12)
+            # the cache-hit re-serve compiles the tail chunk + COW
+            # programs the routed traffic will reuse
+            e.generate_batch(warm_prompts, max_new_tokens=12)
+        warm = dict(e0.telemetry_snapshot()["compile"]["by_fn"])
+        assert warm.get("inference.paged_decode") == 2  # one per replica
+
+        router = _router([e0, e1], max_new=12)
+        hs = [router.add_request(warm_prompts[0], session="sess0"),
+              router.add_request(warm_prompts[1], session="sess1"),
+              router.add_request(warm_prompts[2])]
+        _drive(router)
+        hs.append(router.add_request(warm_prompts[0], session="sess0"))
+        _drive(router)
+        assert all(h.status == "finished" for h in hs)
+        assert len({d["replica"] for d in router.decisions}) == 2
+        router.shutdown()
+
+        by_fn = e0.telemetry_snapshot()["compile"]["by_fn"]
+        assert by_fn == warm, (
+            f"routed traffic recompiled: warm {warm} -> {by_fn}")
+        violations = check_compile_budgets(
+            by_fn, "serving_replicated_steady", strict=True)
+        assert violations == [], "\n".join(violations)
+
+
+# --------------------------------------------------------------------- #
+# observability: metrics pane + route events in the trace
+
+
+class TestRouterObservability:
+
+    def test_health_summary_replicas_section_and_pane(self):
+        from deepspeed_tpu.monitor.health import (health_summary,
+                                                  render_summary_table)
+        from deepspeed_tpu.monitor.metrics import get_registry
+        engines = _engines(2)
+        router = _router(engines)
+        hs = [router.add_request(p, session=s) for p, s in
+              zip(_prompts((5, 7, 6)), ["sess0", "sess1", None])]
+        _drive(router)
+        assert all(h.status == "finished" for h in hs)
+        summary = health_summary({**get_registry().snapshot()})
+        reps = summary.get("replicas")
+        assert reps is not None
+        assert set(reps["requests"]) == {"r0", "r1"}
+        assert sum(reps["requests"].values()) == 3
+        assert reps["healthy"] == {"r0": 1, "r1": 1}
+        table = render_summary_table(summary)
+        assert "replicas" in table
+        assert "r0 up" in table and "r1 up" in table
+        router.shutdown()
+        summary = health_summary({**get_registry().snapshot()})
+        table = render_summary_table(summary)
+        assert "r0 DOWN" in table and "r1 DOWN" in table
+
+    def test_route_events_and_trace_validate(self, tmp_path):
+        """Every decision lands a ``serve.route`` flight-recorder event
+        (seq/replica/reason/session) and the exported chrome trace —
+        route instants included — passes ``tools/validate_trace.py``."""
+        model = tiny_model()
+        cfg = {"block_size": 8, "max_running": 2}
+        dist.set_mesh(None)
+        e0 = deepspeed_tpu.init_inference(model, dtype="fp32", serving=cfg,
+                                          telemetry={"events": True})
+        dist.set_mesh(None)
+        e1 = deepspeed_tpu.init_inference(model, params=e0.params,
+                                          dtype="fp32", serving=cfg,
+                                          telemetry={"events": True})
+        rec = e0._events
+        assert rec is not None
+        rec.clear()
+        router = _router([e0, e1])
+        hs = [router.add_request(p, session=s) for p, s in
+              zip(_prompts((5, 11)), ["alice", None])]
+        _drive(router)
+        assert all(h.status == "finished" for h in hs)
+        routes = [e for e in rec.snapshot() if e.kind == "serve.route"]
+        assert [e.data["seq"] for e in routes] == [0, 1]
+        assert [e.data["reason"] for e in routes] == \
+            [d["reason"] for d in router.decisions]
+        assert routes[0].data["session"] == "alice"
+        path = str(tmp_path / "router_trace.json")
+        e0.export_serving_trace(path)
+        assert validate_trace.validate_path(path, kind="chrome") == []
+        doc = json.load(open(path))
+        assert any(e.get("name") == "route" for e in doc["traceEvents"])
+        router.shutdown()

@@ -88,6 +88,18 @@ class CompileBudget:
 #:                     counterpart (the shard_map and the sharding
 #:                     constraints are part of the traced program, not a
 #:                     per-shard re-trace)
+#:   serving_replicated_steady — TWO serving replicas behind the
+#:                     deterministic ReplicaRouter (the dp serving axis,
+#:                     inference/router.py) with the tiered KV host pool
+#:                     shared between them, each engine warmed by one
+#:                     closed-loop call, then routed open-loop traffic:
+#:                     ROUTING ADDS ZERO NEW COMPILES — the router is pure
+#:                     host-side dispatch (hashing, queue-depth compares,
+#:                     handle pumping), so the process-wide compile count
+#:                     is exactly N x the per-engine serving_tiered_steady
+#:                     set (each replica owns its jit wrappers; the
+#:                     budgets below are the N=2 totals) and stays frozen
+#:                     however much traffic the router spreads
 BUDGETS: List[CompileBudget] = [
     CompileBudget(
         "engine.train_batch[gas=1]", "steady_train", 1,
@@ -269,6 +281,36 @@ BUDGETS: List[CompileBudget] = [
         "inference.paged_cow", "serving_sharded_steady", 1,
         "copy-on-write block copy: fixed block geometry, sharding rides "
         "the constrained pool layout"),
+    CompileBudget(
+        "inference.paged_decode", "serving_replicated_steady", 2,
+        "one fused decode program PER REPLICA (N=2): each engine owns "
+        "its jit wrappers; the router's host-side dispatch must add "
+        "zero — a third compile means routed traffic retraced a step"),
+    CompileBudget(
+        "inference.paged_verify", "serving_replicated_steady", 2,
+        "one k-window-bucket verify program per replica (N=2); routed "
+        "speculation reuses each engine's own program"),
+    CompileBudget(
+        "inference.paged_prefill", "serving_replicated_steady", 4,
+        "two 128-token prompt buckets x two replicas: routing (incl. "
+        "prefill-role warm-ups) must hit existing buckets only"),
+    CompileBudget(
+        "inference.paged_prefill_chunk", "serving_replicated_steady", 8,
+        "(chunk bucket, table-width power-of-two) pairs x two replicas; "
+        "host-tier cache-hit tails ride the same chunk programs"),
+    CompileBudget(
+        "inference.paged_cow", "serving_replicated_steady", 2,
+        "fixed block geometry, one program per replica (N=2)"),
+    CompileBudget(
+        "inference.paged_spill_gather", "serving_replicated_steady", 4,
+        "block-index-traced D2H gather (2 donation/layout variants) per "
+        "replica: the prefill->decode handoff's push half shares the "
+        "tiered-KV spill program, shipping blocks compiles nothing new"),
+    CompileBudget(
+        "inference.paged_fetch_scatter", "serving_replicated_steady", 4,
+        "block-index-traced H2D scatter (2 donation/layout variants) per "
+        "replica: the handoff's decode-side fetch IS the PR-12 path — "
+        "the host tier as KV transport adds zero programs"),
 ]
 
 
