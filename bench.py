@@ -213,6 +213,28 @@ def _telemetry_blob(engine):
               "checkpoint/failures"):
         if k in c:
             blob[k] = c[k]
+    # request latency anatomy: per-phase p50/p99 (fleet-summed counts
+    # keep the record compact — per-replica detail stays in /metrics)
+    # and the wasted-token causes, so BENCH records carry TTFT anatomy
+    from deepspeed_tpu.monitor.health import multilabel_series
+    phases = {}
+    for labels, v in multilabel_series(h, "serving/phase_ms"):
+        p = labels.get("phase")
+        if p is None or not (v or {}).get("count"):
+            continue
+        agg = phases.setdefault(p, {"count": 0, "p50": 0.0, "p99": 0.0})
+        agg["count"] += int(v["count"])
+        agg["p50"] = round(max(agg["p50"], float(v.get("p50", 0.0))), 3)
+        agg["p99"] = round(max(agg["p99"], float(v.get("p99", 0.0))), 3)
+    if phases:
+        blob["serving/phase_ms"] = phases
+    wasted = {}
+    for labels, v in multilabel_series(c, "serving/wasted_tokens"):
+        cause = labels.get("cause")
+        if cause is not None and v:
+            wasted[cause] = wasted.get(cause, 0) + int(v)
+    if wasted:
+        blob["serving/wasted_tokens"] = wasted
     # health summary: detector firings (zero-valued on a clean run)
     from deepspeed_tpu.monitor.health import labeled_series
     faults = {k: int(v)

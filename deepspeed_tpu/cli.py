@@ -166,19 +166,65 @@ def _load_validator():
 
 
 def _trace(argv):
-    """Trace tooling. ``--validate <path>...`` schema-checks chrome-trace
-    JSON / flight-recorder events.jsonl exports (rc=1 on violations)."""
+    """Trace tooling. ``dscli trace <request-id> --events <jsonl>``
+    prints one request's latency anatomy (the phase ledger, recomputed
+    from the flight-recorder export — ``<request-id>`` is an integer rid
+    or a router trace id like ``t0``, which prints every leg of the
+    causal chain plus the handoff hops). ``--validate <path>...``
+    schema-checks chrome-trace JSON / events.jsonl exports (rc=1 on
+    violations)."""
     import argparse
+    import json as _json
 
     parser = argparse.ArgumentParser(
         prog="dscli trace",
-        description="chrome-trace / events.jsonl schema validation")
+        description="request latency anatomy + chrome-trace/events.jsonl "
+                    "schema validation")
+    parser.add_argument("request_id", nargs="?", default=None,
+                        help="rid (integer) or trace id (t<seq>) to "
+                             "decompose; needs --events")
+    parser.add_argument("--events", metavar="PATH", default=None,
+                        help="flight-recorder events.jsonl export "
+                             "(FlightRecorder.write_jsonl) to read the "
+                             "anatomy from")
+    parser.add_argument("--json", action="store_true",
+                        help="print the anatomy as JSON instead of the "
+                             "phase table")
     parser.add_argument("--validate", nargs="+", metavar="PATH",
-                        required=True, help="file(s) to validate")
+                        default=None, help="file(s) to schema-validate")
     parser.add_argument("--kind", choices=("auto", "chrome", "events"),
                         default="auto")
     args = parser.parse_args(argv)
-    return _load_validator().main(["--kind", args.kind] + args.validate)
+    if args.validate is not None:
+        return _load_validator().main(["--kind", args.kind] + args.validate)
+    if args.request_id is None:
+        parser.error("need a <request-id> (with --events) or --validate")
+    if args.events is None:
+        parser.error("anatomy needs --events <events.jsonl> (export one "
+                     "with engine.export_events / the serve front-end)")
+    from deepspeed_tpu.monitor.anatomy import (
+        format_anatomy, format_trace_anatomy, request_anatomy,
+        resolve_request_id, trace_anatomy)
+    events = []
+    with open(args.events) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(_json.loads(line))
+    trace, rid = resolve_request_id(args.request_id)
+    if rid is not None:
+        a = request_anatomy(events, rid)
+        if a is None:
+            print(f"rid {rid}: no events in {args.events}")
+            return 1
+        print(_json.dumps(a) if args.json else format_anatomy(a))
+        return 0
+    t = trace_anatomy(events, trace)
+    if t is None:
+        print(f"trace {trace}: no req.enqueue carries it in {args.events}")
+        return 1
+    print(_json.dumps(t) if args.json else format_trace_anatomy(t))
+    return 0
 
 
 def _profile(argv):

@@ -387,9 +387,12 @@ class InferenceEngine:
             self._tel_watchdog.storm_threshold = tcfg.compile_storm_threshold
             self._serving_tel = ServingTelemetry(reg)
             if tcfg.events.enabled:
-                from deepspeed_tpu.monitor.events import get_flight_recorder
-                self._events = get_flight_recorder().enable(
-                    capacity=tcfg.events.capacity)
+                from deepspeed_tpu.monitor.events import (TaggedRecorder,
+                                                          get_flight_recorder)
+                # every replica shares the ONE global ring; the per-engine
+                # wrapper stamps replica= so the fleet renderer can group
+                self._events = TaggedRecorder(get_flight_recorder().enable(
+                    capacity=tcfg.events.capacity))
 
         log_dist(f"InferenceEngine ready: dtype={self.dtype.__name__}, tp={tp_size}, "
                  f"mesh={dict(self.mesh.shape)}"
@@ -431,6 +434,18 @@ class InferenceEngine:
                 "init_inference")
         from deepspeed_tpu.monitor.events import export_serving_trace
         return export_serving_trace(self._events.snapshot(), path)
+
+    def set_replica(self, name: str) -> None:
+        """Name this engine's replica for observability: the tag lands on
+        every flight-recorder event it emits (the fleet trace's track
+        grouping) and on its ``serving/phase_ms`` / ``wasted_tokens``
+        label sets. The router calls this at construction; a standalone
+        engine stays ``r0``."""
+        name = str(name)
+        if self._events is not None:
+            self._events.replica = name
+        if self._serving_tel is not None:
+            self._serving_tel.replica = name
 
     # ------------------------------------------------------------------ #
 
@@ -1486,7 +1501,7 @@ class _ServeSession:
 
     def add(self, prompt, max_new=None, eos=_UNSET, priority: int = 0,
             ttft_budget=None, t_submit=None, deadline_ms=None,
-            deadline_steps=None):
+            deadline_steps=None, trace=None, parent=None):
         """Enqueue one request (any time — mid-decode arrivals are the
         point). ``max_new``/``eos`` default to the session-wide values."""
         if self._closed:
@@ -1505,7 +1520,8 @@ class _ServeSession:
         return self.sched.add_request(
             prompt, mn, self.eos_token_id if eos is self._UNSET else eos,
             priority=priority, ttft_budget=ttft_budget, t_submit=t_submit,
-            deadline_ms=deadline_ms, deadline_steps=deadline_steps)
+            deadline_ms=deadline_ms, deadline_steps=deadline_steps,
+            trace=trace, parent=parent)
 
     def cancel(self, req) -> bool:
         """Cancel between engine steps; fires ``on_finish`` for the
@@ -1687,11 +1703,13 @@ class _ServeSession:
                 # async-copy kick-off: the D2H itself overlaps the next
                 # fused steps (that overlap is the whole point), so a
                 # sync here would serialize what the tier exists to hide
-                ev.emit("kv.spill", t_ns=t0,
-                        dur_ns=time.monotonic_ns() - t0,  # dslint: disable=DS005
+                dur = time.monotonic_ns() - t0  # dslint: disable=DS005
+                ev.emit("kv.spill", t_ns=t0, dur_ns=dur,
                         blocks=1,
                         bytes=int(sl["k"].nbytes) + int(sl["v"].nbytes),
                         block=block)
+                if sched.telemetry is not None:
+                    sched.telemetry.phase("spill", dur / 1e6)
             if sched.telemetry is not None:
                 sched.telemetry.kv_spills.inc()
         return ok
@@ -1757,9 +1775,11 @@ class _ServeSession:
             # the scatters are async dispatches: sync so the slice covers
             # device work, not µs of dispatch (the DS005 rule)
             jax.block_until_ready(pools)
-            ev.emit("kv.fetch", rid=req.rid, t_ns=t0,
-                    dur_ns=time.monotonic_ns() - t0,
+            dur = time.monotonic_ns() - t0
+            ev.emit("kv.fetch", rid=req.rid, t_ns=t0, dur_ns=dur,
                     blocks=len(fetches), bytes=nbytes)
+            if sched.telemetry is not None:
+                sched.telemetry.phase("fetch", dur / 1e6, rid=req.rid)
         self.fault_site = prev_site
         return pools
 
@@ -1809,8 +1829,12 @@ class _ServeSession:
                 tok = np.asarray(engine._sample_host(
                     logits.astype(jnp.float32), temperature, top_k, sub))
                 if ev is not None:
+                    dur = time.monotonic_ns() - t0
                     ev.emit("req.prefill", rid=req.rid, t_ns=t0,
-                            dur_ns=time.monotonic_ns() - t0, tokens=L)
+                            dur_ns=dur, tokens=L)
+                    if sched.telemetry is not None:
+                        sched.telemetry.phase("prefill", dur / 1e6,
+                                              rid=req.rid)
                 sched.record_prefill(req, int(tok[0]))
                 self._emit_tokens(req, [int(tok[0])])
             elif kind == "prefill_chunk":
@@ -1833,9 +1857,12 @@ class _ServeSession:
                         # dispatch is async: wait for the copy so the
                         # span covers device work, not µs of dispatch
                         jax.block_until_ready(pools)
+                        dur = time.monotonic_ns() - t0
                         ev.emit("req.cow_copy", rid=req.rid, t_ns=t0,
-                                dur_ns=time.monotonic_ns() - t0,
-                                src=src, dst=dst)
+                                dur_ns=dur, src=src, dst=dst)
+                        if sched.telemetry is not None:
+                            sched.telemetry.phase("cow", dur / 1e6,
+                                                  rid=req.rid)
                     req.cow_pending = None
                 start = req.pos
                 remaining = req.prefill_target - start
@@ -1867,9 +1894,12 @@ class _ServeSession:
                     # dispatch alone would clock near-zero: sync first
                     # (tracing-only cost) so the slice is device time
                     jax.block_until_ready(logits)
+                    dur = time.monotonic_ns() - t0
                     ev.emit("req.prefill_chunk", rid=req.rid, t_ns=t0,
-                            dur_ns=time.monotonic_ns() - t0,
-                            start=start, tokens=step)
+                            dur_ns=dur, start=start, tokens=step)
+                    if sched.telemetry is not None:
+                        sched.telemetry.phase("prefill_chunk", dur / 1e6,
+                                              rid=req.rid)
                 if start + step == req.prefill_target:
                     self.rng, sub = jax.random.split(self.rng)
                     tok = engine._sample_host(logits.astype(jnp.float32),
@@ -1917,6 +1947,10 @@ class _ServeSession:
                 greedy = np.asarray(jnp.argmax(
                     logits.astype(jnp.float32), axis=-1))
                 dur = time.monotonic_ns() - t0 if ev is not None else 0
+                if ev is not None and sched.telemetry is not None:
+                    # one ledger sample per fused verify step (the
+                    # per-rid spec_verify events below carry identity)
+                    sched.telemetry.phase("verify", dur / 1e6)
                 for i, r in enumerate(reqs):
                     cands = r.spec_tokens
                     n_acc = 0
@@ -1959,9 +1993,11 @@ class _ServeSession:
                 if ev is not None:
                     # emitted BEFORE record_decode so a retirement this
                     # tick triggers lands after its final decode slice
-                    ev.emit("decode.tick", t_ns=t0,
-                            dur_ns=time.monotonic_ns() - t0,
+                    dur = time.monotonic_ns() - t0
+                    ev.emit("decode.tick", t_ns=t0, dur_ns=dur,
                             rids=[r.rid for r in reqs], n=len(reqs))
+                    if sched.telemetry is not None:
+                        sched.telemetry.phase("decode", dur / 1e6)
                 for i, r in enumerate(reqs):
                     sched.record_decode(r, int(tok[i]))
                     self._emit_tokens(r, [int(tok[i])])

@@ -100,11 +100,14 @@ class RouterHandle:
         # -> "running" (decode replica streams) ; non-handoff requests
         # start at "running"
         self._stage = "running"
+        self.trace: Optional[str] = None   # router-minted causal trace id
+        #                                    (deterministic: decision seq)
         self._inner = None                 # current RequestHandle
         self._inner_idx: Optional[int] = None
         self._warm = None                  # prefill warm-up handle
         self._warm_idx: Optional[int] = None
         self._demote_evt: Optional[threading.Event] = None
+        self._demote_t0: Optional[float] = None   # handoff phase clock
         self._target_idx: Optional[int] = None   # decode-side target
         self._skip = 0          # failover replay: tokens already forwarded
         self._failovers = 0
@@ -263,6 +266,10 @@ class ReplicaRouter:
         if handoff is None:
             handoff = str(getattr(rep_cfg, "handoff", "auto")) != "off"
         self._handoff = bool(handoff) and bool(self._prefill_idx)
+        # tag each replica's recorder + phase-ledger telemetry so fleet
+        # merges and serving/phase_ms{replica=} carry the router's names
+        for i, name in enumerate(self.names):
+            self.replicas[i].engine.set_replica(name)
         self.decisions: List[Dict[str, Any]] = []
         self._seq = 0
         self._lock = threading.RLock()
@@ -414,6 +421,9 @@ class ReplicaRouter:
                     "no healthy replica: every serving loop is stopped, "
                     "draining, or parked in its crash-loop breaker")
             h._target_idx = idx
+            # causal trace id, minted from the first decision's seq —
+            # deterministic under replay, unique per routed request
+            h.trace = f"t{self._seq}"
             pidx = None
             if (self._handoff and idx not in self._prefill_idx
                     and prompt.size >= int(self.replicas[0].engine
@@ -439,7 +449,8 @@ class ReplicaRouter:
     def _submit_warm(self, h: RouterHandle, pidx: int) -> None:
         try:
             h._warm = self.replicas[pidx].add_request(
-                h.prompt, max_new_tokens=1, priority=h.priority)
+                h.prompt, max_new_tokens=1, priority=h.priority,
+                trace=h.trace)
         except (RuntimeError, ValueError):
             # prefill replica refused (raced into drain/breaker, or the
             # prompt is never-admittable there): fall back to the plain
@@ -471,7 +482,9 @@ class ReplicaRouter:
                     h.prompt, max_new_tokens=h.max_new,
                     eos_token_id=h.eos, priority=h.priority,
                     ttft_budget=h.ttft_budget, deadline_ms=h.deadline_ms,
-                    deadline_steps=h.deadline_steps)
+                    deadline_steps=h.deadline_steps, trace=h.trace,
+                    parent=(h._warm.rid if h._warm is not None
+                            else None))
             except RuntimeError:
                 # raced into drain/breaker between the health check and
                 # the intake append — try the next healthy sibling
@@ -505,6 +518,7 @@ class ReplicaRouter:
                 if h._demote_evt is not None and h._demote_evt.is_set():
                     with self._lock:
                         self._m_handoffs.inc()
+                    self._note_handoff(h)
                     self._submit_inner(h)
             if h._stage == "running" and h._inner is not None:
                 self._pump_running(h)
@@ -526,11 +540,32 @@ class ReplicaRouter:
             # admission probe finds the chain host-resident
             h._demote_evt = self.replicas[h._warm_idx].request_demote(
                 h.prompt)
+            h._demote_t0 = time.perf_counter()
             h._stage = "demote"
         else:
             # warm-up failed (rejected under pressure, faulted, timed
             # out): serve the plain way — the decode replica recomputes
             self._submit_inner(h)
+
+    def _note_handoff(self, h: RouterHandle) -> None:
+        """Handoff completed: the warmed blocks are host-resident and the
+        decode-side submission goes out next. Emits the cross-replica
+        ``serve.handoff`` flow anchor (rid = the prefill-side rid, so the
+        fleet merge can pin the hop) and books the demote wall time as
+        the ``handoff`` phase on the prefill replica's ledger."""
+        wrid = h._warm.rid if h._warm is not None else None
+        if self._events is not None:
+            self._events.emit(
+                "serve.handoff", rid=wrid, trace=h.trace,
+                from_replica=self.names[h._warm_idx],
+                to_replica=(self.names[h._target_idx]
+                            if h._target_idx is not None else ""),
+                replica=self.names[h._warm_idx])
+        tel = self.replicas[h._warm_idx].engine._serving_tel
+        if tel is not None and h._demote_t0 is not None:
+            tel.phase("handoff",
+                      max(time.perf_counter() - h._demote_t0, 0.0) * 1e3,
+                      rid=wrid)
 
     def _pump_running(self, h: RouterHandle) -> None:
         inner = h._inner
@@ -592,6 +627,12 @@ class ReplicaRouter:
             h._finish(ERROR, err or f"replica {name} failed and no "
                                     "healthy sibling remains")
             return
+        # wasted-work ledger: every token the failed replica produced is
+        # recomputed by the sibling's replay (booked on the FAILED
+        # replica — the waste is its fault domain's)
+        tel = self.replicas[from_idx].engine._serving_tel
+        if tel is not None and h._tokens:
+            tel.waste("failover", len(h._tokens))
         h._skip = len(h._tokens)
         h._failovers += 1
         h.rid = None
@@ -631,6 +672,18 @@ class ReplicaRouter:
                 busy = True
         self._refresh_gauges()
         return busy
+
+    def export_fleet_trace(self, path: str) -> str:
+        """Merge every replica's serving events plus the router's own
+        decision/handoff markers onto ONE Perfetto timeline (chrome
+        trace JSON) with flow arrows across prefill→decode handoffs.
+        Replicas share the process-global flight-recorder ring, so the
+        first replica's snapshot already covers the fleet."""
+        from deepspeed_tpu.monitor.events import export_fleet_trace
+        if self._events is None:
+            raise RuntimeError("flight recorder disabled "
+                               "(telemetry.events.enable)")
+        return export_fleet_trace(self._events.snapshot(), path)
 
     def health_state(self):
         """Aggregate ``(status_code, body)`` for ``/healthz``: 503 only

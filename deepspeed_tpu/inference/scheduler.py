@@ -150,13 +150,16 @@ class ServingTelemetry:
                "spec_proposed_tokens", "spec_accepted_tokens",
                "spec_rollbacks", "spec_acceptance_rate", "tp",
                "step_faults", "engine_restarts", "request_retries",
-               "timeouts", "shed_requests")
+               "timeouts", "shed_requests", "phase_ms", "wasted_tokens")
 
-    def __init__(self, registry=None):
+    def __init__(self, registry=None, replica: str = "r0"):
         if registry is None:
             from deepspeed_tpu.monitor.metrics import get_registry
             registry = get_registry()
         self.registry = registry
+        #: replica label stamped on phase/waste observations — mutable,
+        #: the router renames engines after construction (set_replica)
+        self.replica = replica
         self.ensure()
 
     def ensure(self) -> None:
@@ -415,6 +418,41 @@ class ServingTelemetry:
             "queued requests dropped by load shedding under queue "
             "pressure (policy select_shed_victim, lowest priority first)")
 
+    # ---- request latency anatomy (phase ledger) ---- #
+
+    @property
+    def phase_ms(self):
+        return self.registry.histogram(
+            "serving/phase_ms",
+            "per-request latency anatomy, one histogram per phase and "
+            "replica: TTFT = intake + queue + prefill (+ fetch) + "
+            "first decode; TPOT = scheduler wait + decode step. Phases "
+            "with device work observe at the recorder's sync points, so "
+            "they populate when telemetry.events is on",
+            labelnames=("phase", "replica"))
+
+    @property
+    def wasted_tokens(self):
+        return self.registry.counter(
+            "serving/wasted_tokens",
+            "tokens whose compute produced no delivered output, by cause: "
+            "recompute (preemption re-prefill), spec_reject (verify "
+            "rollback), timeout / shed (retired unfinished), failover "
+            "(sibling re-derived a failed replica's progress) — the "
+            "goodput-vs-throughput gap", labelnames=("cause", "replica"))
+
+    def phase(self, phase: str, ms: float, rid=None) -> None:
+        """Observe one phase-ledger sample (exemplar = the request id, so
+        a p99 bucket links back to the merged trace's request track)."""
+        self.phase_ms.labels(phase=phase, replica=self.replica).observe(
+            ms, exemplar={"rid": str(rid)} if rid is not None else None)
+
+    def waste(self, cause: str, n) -> None:
+        """Count wasted tokens (``n == 0`` still materializes the series,
+        so a fleet scrape shows every cause it is tracking)."""
+        self.wasted_tokens.labels(cause=cause,
+                                  replica=self.replica).inc(int(n))
+
 
 @dataclasses.dataclass
 class Request:
@@ -434,6 +472,12 @@ class Request:
     # the serving/queue_wait_ms base
     t_first_token: Optional[float] = None   # TTFT stamp (set once, ever)
     t_last_token: float = 0.0       # previous token's stamp (TPOT base)
+    # ---- causal trace context (fleet tracing) ----
+    trace: Optional[str] = None     # trace id minted at router intake and
+    # carried across the prefill->decode handoff (requests sharing it are
+    # one causal chain; the fleet renderer stitches them with flow arrows)
+    parent: Optional[int] = None    # parent span = the rid of the
+    # upstream hop (the prefill-side warm rid on the decode replica)
     # ---- scheduling-policy inputs (inference/policy.py) ----
     priority: int = 0               # PriorityPolicy class (higher = sooner)
     ttft_budget: Optional[int] = None  # SlaPolicy: scheduler steps past
@@ -607,7 +651,9 @@ class ContinuousBatchingScheduler:
                     ttft_budget: Optional[int] = None,
                     t_submit: Optional[float] = None,
                     deadline_ms: Optional[float] = None,
-                    deadline_steps: Optional[int] = None) -> Request:
+                    deadline_steps: Optional[int] = None,
+                    trace: Optional[str] = None,
+                    parent: Optional[int] = None) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -642,14 +688,24 @@ class ContinuousBatchingScheduler:
                                    else float(deadline_ms)),
                       deadline_steps=(None if deadline_steps is None
                                       else int(deadline_steps)),
-                      arrival_step=self.step_seq)
+                      arrival_step=self.step_seq,
+                      trace=(None if trace is None else str(trace)),
+                      parent=(None if parent is None else int(parent)))
         self._next_rid += 1
         if req.deadline_ms is not None or req.deadline_steps is not None:
             self._deadline_live += 1
         self.waiting.append(req)
         if self.events is not None:
+            # the trace context rides the enqueue: rids sharing a trace id
+            # are one causal chain (the fleet renderer's flow-arrow source)
+            ctx = {}
+            if req.trace is not None:
+                ctx["trace"] = req.trace
+            if req.parent is not None:
+                ctx["parent"] = req.parent
             self.events.emit("req.enqueue", rid=req.rid,
-                             prompt_tokens=int(prompt.size), max_new=max_new)
+                             prompt_tokens=int(prompt.size),
+                             max_new=max_new, **ctx)
         if self.telemetry is not None:
             self.telemetry.requests.inc()
         self._tel_gauges()
@@ -735,6 +791,9 @@ class ContinuousBatchingScheduler:
                 req.timed_out = True
                 if self.telemetry is not None:
                     self.telemetry.timeouts.inc()
+                    # everything generated dies with the deadline: the
+                    # client gets a 504, not the tokens
+                    self.telemetry.waste("timeout", len(req.generated))
                 if self.events is not None:
                     self.events.emit("req.timeout", rid=req.rid,
                                      generated=len(req.generated),
@@ -743,6 +802,9 @@ class ContinuousBatchingScheduler:
                 req.shed = True
                 if self.telemetry is not None:
                     self.telemetry.shed_requests.inc()
+                    # shed requests are QUEUED (generated == 0): the zero
+                    # inc still materializes the cause series
+                    self.telemetry.waste("shed", len(req.generated))
                 if self.events is not None:
                     self.events.emit("req.shed", rid=req.rid,
                                      priority=req.priority)
@@ -998,12 +1060,21 @@ class ContinuousBatchingScheduler:
                             cached - bs * len(entries)))
 
         del self.waiting[idx]
-        if self.telemetry is not None and req.admit_seq == -1:
+        first_admit = req.admit_seq == -1
+        if self.telemetry is not None and first_admit:
             # first admission only: the submit->admit wait (a preemption
             # re-admission is recompute latency, not queueing delay)
+            now = time.perf_counter()
             self.telemetry.queue_wait.observe(
-                (time.perf_counter() - req.t_submit) * 1e3,
+                (now - req.t_submit) * 1e3,
                 exemplar={"rid": str(req.rid)})
+            # phase ledger: intake = submit->enqueue (front-end hand-off),
+            # queue = enqueue->admit (admission wait proper)
+            self.telemetry.phase(
+                "intake", max(req.t_arrival - req.t_submit, 0.0) * 1e3,
+                rid=req.rid)
+            self.telemetry.phase(
+                "queue", max(now - req.t_arrival, 0.0) * 1e3, rid=req.rid)
         req.blocks = blocks
         req.keys = list(keys)
         req.pos = cached
@@ -1030,6 +1101,18 @@ class ContinuousBatchingScheduler:
             self.events.emit("req.admit", rid=req.rid,
                              cached_tokens=cached, blocks=len(req.blocks),
                              prefill_target=target)
+            if first_admit:
+                # phase-ledger spans for the pre-admission phases (the
+                # compute phases carry their own timed events); durations
+                # are already-elapsed intervals ending here
+                now_ns = time.monotonic_ns()
+                self.events.emit(
+                    "req.phase", rid=req.rid, t_ns=now_ns, phase="intake",
+                    dur_ns=int(max(req.t_arrival - req.t_submit, 0.0) * 1e9))
+                self.events.emit(
+                    "req.phase", rid=req.rid, t_ns=now_ns, phase="queue",
+                    dur_ns=int(max(time.perf_counter() - req.t_arrival, 0.0)
+                               * 1e9))
         if self.telemetry is not None:
             self.telemetry.prefill_steps.inc()
             if cached:
@@ -1237,6 +1320,9 @@ class ContinuousBatchingScheduler:
         if self.telemetry is not None:
             self.telemetry.preemptions.inc()
             self.telemetry.recompute_tokens.inc(len(victim.prefix()))
+            # wasted-work ledger: the evicted prefix is compute the pool
+            # pressure threw away (re-prefilled on re-admission)
+            self.telemetry.waste("recompute", len(victim.prefix()))
         # FRONT of the queue: the victim was admitted before anything still
         # waiting, so FIFO fairness re-admits it first
         self._demote_to_queue(victim)
@@ -1390,6 +1476,9 @@ class ContinuousBatchingScheduler:
             self.stats["spec_rollbacks"] += 1
             if self.telemetry is not None:
                 self.telemetry.spec_rollbacks.inc()
+                # rejected candidates were scattered and verified on the
+                # device, then thrown away: speculative wasted work
+                self.telemetry.waste("spec_reject", drop)
             if self.events is not None:
                 self.events.emit("req.spec_rollback", rid=req.rid,
                                  rejected=drop, unregistered=unregistered)

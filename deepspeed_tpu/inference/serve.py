@@ -82,7 +82,9 @@ class RequestHandle:
                  max_new: int, eos: Optional[int], priority: int,
                  ttft_budget: Optional[int],
                  deadline_ms: Optional[float] = None,
-                 deadline_steps: Optional[int] = None):
+                 deadline_steps: Optional[int] = None,
+                 trace: Optional[str] = None,
+                 parent: Optional[int] = None):
         self._owner = owner
         self.prompt = prompt
         self.max_new = max_new
@@ -91,6 +93,8 @@ class RequestHandle:
         self.ttft_budget = ttft_budget
         self.deadline_ms = deadline_ms
         self.deadline_steps = deadline_steps
+        self.trace = trace       # causal trace id (router-minted); carried
+        self.parent = parent     # into req.enqueue for fleet trace merges
         self.rid: Optional[int] = None     # filled once the loop enqueues it
         self.status = "pending"
         self.error: Optional[str] = None
@@ -242,7 +246,9 @@ class AsyncServingEngine:
                     ttft_budget: Optional[int] = None,
                     deadline_ms: Optional[float] = None,
                     deadline_steps: Optional[int] = None,
-                    session: Optional[str] = None) -> RequestHandle:
+                    session: Optional[str] = None,
+                    trace: Optional[str] = None,
+                    parent: Optional[int] = None) -> RequestHandle:
         """Submit one request; returns immediately with its streaming
         handle. Raises RuntimeError once the loop is draining/stopped or
         its crash-loop breaker is open. Admission control (the policy's
@@ -253,7 +259,10 @@ class AsyncServingEngine:
         clock) retire the request as ``"timeout"`` on expiry.
         ``session`` is the replica router's affinity key
         (``inference/router.py``) — accepted here for surface parity
-        and ignored: one engine is trivially affine."""
+        and ignored: one engine is trivially affine. ``trace`` /
+        ``parent`` are the causal trace context (trace id + parent rid)
+        stamped onto the request's ``req.enqueue`` event so
+        ``export_fleet_trace`` can stitch cross-replica handoffs."""
         del session
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
@@ -267,7 +276,9 @@ class AsyncServingEngine:
                           deadline_ms=(None if deadline_ms is None
                                        else float(deadline_ms)),
                           deadline_steps=(None if deadline_steps is None
-                                          else int(deadline_steps)))
+                                          else int(deadline_steps)),
+                          trace=(None if trace is None else str(trace)),
+                          parent=(None if parent is None else int(parent)))
         with self._cv:
             if self._crash_loop:
                 raise RuntimeError(
@@ -609,7 +620,8 @@ class AsyncServingEngine:
                                     ttft_budget=h.ttft_budget,
                                     t_submit=h._submit_perf,
                                     deadline_ms=h.deadline_ms,
-                                    deadline_steps=h.deadline_steps)
+                                    deadline_steps=h.deadline_steps,
+                                    trace=h.trace, parent=h.parent)
         except (ValueError, TypeError) as e:
             # oversized prompt / never-admittable: reject THIS handle, the
             # loop itself stays healthy

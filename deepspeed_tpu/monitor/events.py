@@ -31,8 +31,12 @@ Two export shapes:
   chrome-trace JSON (Perfetto / chrome://tracing): one track per request
   holding its admission→retire span with prefill/decode/preempt child
   events, plus queue-depth and KV-block counter tracks.
+- :func:`export_fleet_trace` — the replica fleet's merged timeline: one
+  track group per replica (events tagged by :class:`TaggedRecorder`),
+  the router's decision track, and ``ph:"s"``/``ph:"f"`` flow arrows
+  stitching the disaggregated prefill→decode handoff across replicas.
 
-Both are schema-checked by ``tools/validate_trace.py``
+All are schema-checked by ``tools/validate_trace.py``
 (``dscli trace --validate``).
 """
 
@@ -107,6 +111,17 @@ EVENT_KINDS = frozenset({
     #                         (generated=, error=)
     "req.shed",             # load shedding dropped a queued request
     #                         (priority=)
+    "serve.handoff",        # disaggregated prefill->decode transfer
+    #                         completed: the prefill replica demoted the
+    #                         chain to the host tier and the decode
+    #                         sibling resubmitted (trace=, from_replica=,
+    #                         to_replica=; rid = the prefill-side rid)
+    # request latency anatomy (phase ledger)
+    "req.phase",            # one phase of a request's latency anatomy
+    #                         (phase= intake | queue | ..., dur_ns= the
+    #                         phase duration — an already-elapsed
+    #                         interval ENDING at ts, unlike the timed
+    #                         compute spans above)
     # scheduler occupancy sample (the counter-track source)
     "sched.gauge",          # queued=, running=, kv_used=, kv_free=
     # SLO engine (monitor/slo.py): a burn-rate alert fired
@@ -251,6 +266,43 @@ def get_flight_recorder() -> FlightRecorder:
     return _recorder
 
 
+class TaggedRecorder:
+    """Replica-tagging emit proxy over a (shared) :class:`FlightRecorder`.
+
+    Every in-process replica records into the ONE global ring (so a merged
+    post-mortem interleaves the whole fleet), which means the ring itself
+    cannot say which replica an event came from. Each engine therefore
+    wraps the shared recorder in its own ``TaggedRecorder``: ``emit``
+    stamps ``replica=<name>`` into the payload (``setdefault`` — emit
+    sites that name a replica explicitly, like router drains, win), and
+    the fleet renderer groups tracks by that tag. ``replica`` is mutable:
+    the router renames engines after construction and the schedulers
+    holding this wrapper pick the new tag up on their next emit.
+
+    Everything else (``snapshot``/``clear``/``enable``/``write_jsonl``/
+    ``dropped``/...) proxies to the wrapped recorder, so existing callers
+    cannot tell the difference."""
+
+    def __init__(self, recorder: FlightRecorder, replica: str = "r0"):
+        self._recorder = recorder
+        self.replica = replica
+
+    def emit(self, kind: str, rid: Optional[int] = None,
+             step: Optional[int] = None, dur_ns: Optional[int] = None,
+             t_ns: Optional[int] = None, **data) -> None:
+        if not self._recorder.enabled:
+            return
+        data.setdefault("replica", self.replica)
+        self._recorder.emit(kind, rid=rid, step=step, dur_ns=dur_ns,
+                            t_ns=t_ns, **data)
+
+    def __len__(self) -> int:
+        return len(self._recorder)
+
+    def __getattr__(self, name):
+        return getattr(self._recorder, name)
+
+
 def export_recorder_metrics(registry=None,
                             recorder: Optional[FlightRecorder] = None
                             ) -> None:
@@ -304,21 +356,31 @@ _INSTANTS = {"req.enqueue": "enqueue", "req.submit": "submit",
 _SPAN_CLOSERS = ("req.retire", "req.cancel", "req.timeout", "req.shed")
 
 
-def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
+def render_serving_trace(events: Iterable[Event], *,
+                         t0_ns: Optional[int] = None,
+                         serving_pid: int = _SERVING_PID,
+                         engine_pid: int = _ENGINE_PID,
+                         name_prefix: str = "") -> Dict[str, Any]:
     """Render serving events as a chrome-trace document: per-request
     tracks (pid 1, tid = rid) each holding exactly ONE admission→retire
     span (first admission to final retirement — a preempted-and-resumed
     request stays one span, with its preemption as an instant inside)
     with prefill / prefill-chunk / decode-tick / COW child slices, plus
     ``queue_depth`` and ``kv_blocks`` counter tracks and the
-    ``generate_batch`` engine spans (pid 2)."""
+    ``generate_batch`` engine spans (pid 2).
+
+    The keyword overrides exist for :func:`render_fleet_trace`, which
+    renders each replica's slice of the shared ring as its own process
+    pair on ONE timeline: a shared ``t0_ns`` epoch, per-replica pids,
+    and a ``name_prefix`` distinguishing the track groups. Defaults
+    reproduce the single-replica document exactly."""
     events = [e for e in events
               if e.kind.startswith(("req.", "serve.", "decode.", "sched.",
                                     "kv.", "slo."))]
     out: List[Dict[str, Any]] = []
     if not events:
         return {"traceEvents": out, "displayTimeUnit": "ms"}
-    t0 = min(e.ts_ns for e in events)
+    t0 = t0_ns if t0_ns is not None else min(e.ts_ns for e in events)
 
     def us(ts_ns: int) -> float:
         return (ts_ns - t0) / 1e3
@@ -337,8 +399,12 @@ def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
             continue
         if rid is None:
             continue
+        # req.phase durations are already-elapsed intervals ENDING at ts
+        # (a queue wait reported at admission), so they must not push the
+        # request's observed end forward
         last_seen[rid] = max(last_seen.get(rid, 0),
-                             e.ts_ns + (e.dur_ns or 0))
+                             e.ts_ns + (0 if e.kind == "req.phase"
+                                        else (e.dur_ns or 0)))
         meta = info.setdefault(rid, {"preemptions": 0, "cached_tokens": 0})
         if e.kind == "req.admit":
             admits.setdefault(rid, e.ts_ns)
@@ -359,8 +425,8 @@ def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
                 meta["shed"] = True
 
     for rid in sorted(admits):
-        out.append({"ph": "M", "name": "thread_name", "pid": _SERVING_PID,
-                    "tid": rid, "args": {"name": f"req {rid}"}})
+        out.append({"ph": "M", "name": "thread_name", "pid": serving_pid,
+                    "tid": rid, "args": {"name": f"{name_prefix}req {rid}"}})
         start = admits[rid]
         ret = retires.get(rid)
         end = ret.ts_ns if ret is not None else last_seen[rid]
@@ -371,14 +437,14 @@ def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
         else:
             args["incomplete"] = True      # truncated ring / still running
         out.append({"name": f"request {rid}", "cat": "request", "ph": "X",
-                    "pid": _SERVING_PID, "tid": rid, "ts": us(start),
+                    "pid": serving_pid, "tid": rid, "ts": us(start),
                     "dur": max((end - start) / 1e3, 0.001), "args": args})
 
     # ---- child slices, instants, counters, engine spans ---- #
     for e in events:
         if e.kind in _CHILD_SLICES:
             out.append({"name": _CHILD_SLICES[e.kind], "cat": "serving",
-                        "ph": "X", "pid": _SERVING_PID, "tid": e.rid,
+                        "ph": "X", "pid": serving_pid, "tid": e.rid,
                         "ts": us(e.ts_ns), "dur": (e.dur_ns or 0) / 1e3,
                         "args": dict(e.data or {})})
         elif e.kind in _INSTANTS:
@@ -386,28 +452,38 @@ def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
                 # no request track to pin it to (e.g. an intake-deadline
                 # timeout that never reached the scheduler): engine track
                 out.append({"name": _INSTANTS[e.kind], "cat": "serving",
-                            "ph": "i", "s": "p", "pid": _ENGINE_PID,
+                            "ph": "i", "s": "p", "pid": engine_pid,
                             "tid": _ENGINE_TID, "ts": us(e.ts_ns),
                             "args": dict(e.data or {})})
                 continue
             out.append({"name": _INSTANTS[e.kind], "cat": "serving",
-                        "ph": "i", "s": "t", "pid": _SERVING_PID,
+                        "ph": "i", "s": "t", "pid": serving_pid,
                         "tid": e.rid, "ts": us(e.ts_ns),
                         "args": dict(e.data or {})})
+        elif e.kind == "req.phase":
+            # phase-ledger entries: the interval already elapsed when the
+            # phase was reported, so an X slice would spill outside the
+            # request span — render as an instant carrying the duration
+            d = dict(e.data or {})
+            d["dur_ms"] = (e.dur_ns or 0) / 1e6
+            out.append({"name": f"phase:{d.get('phase', '?')}",
+                        "cat": "serving", "ph": "i", "s": "t",
+                        "pid": serving_pid, "tid": e.rid,
+                        "ts": us(e.ts_ns), "args": d})
         elif e.kind == "decode.tick":
             d = dict(e.data or {})
             for rid in d.get("rids", ()):
                 out.append({"name": "decode", "cat": "serving", "ph": "X",
-                            "pid": _SERVING_PID, "tid": rid,
+                            "pid": serving_pid, "tid": rid,
                             "ts": us(e.ts_ns), "dur": (e.dur_ns or 0) / 1e3,
                             "args": {"n": d.get("n")}})
         elif e.kind == "sched.gauge":
             d = dict(e.data or {})
-            out.append({"name": "queue_depth", "ph": "C", "pid": _ENGINE_PID,
+            out.append({"name": "queue_depth", "ph": "C", "pid": engine_pid,
                         "tid": _ENGINE_TID, "ts": us(e.ts_ns),
                         "args": {"queued": d.get("queued", 0),
                                  "running": d.get("running", 0)}})
-            out.append({"name": "kv_blocks", "ph": "C", "pid": _ENGINE_PID,
+            out.append({"name": "kv_blocks", "ph": "C", "pid": engine_pid,
                         "tid": _ENGINE_TID, "ts": us(e.ts_ns),
                         "args": {"used": d.get("kv_used", 0),
                                  "free": d.get("kv_free", 0)}})
@@ -415,45 +491,51 @@ def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
             # demotions have no single request: they happen inside another
             # request's allocation, so they render on the engine track
             out.append({"name": "kv_spill", "cat": "serving", "ph": "X",
-                        "pid": _ENGINE_PID, "tid": _ENGINE_TID,
+                        "pid": engine_pid, "tid": _ENGINE_TID,
                         "ts": us(e.ts_ns), "dur": (e.dur_ns or 0) / 1e3,
                         "args": dict(e.data or {})})
         elif e.kind == "serve.end":
             out.append({"name": "generate_batch", "cat": "serving",
-                        "ph": "X", "pid": _ENGINE_PID, "tid": _ENGINE_TID,
+                        "ph": "X", "pid": engine_pid, "tid": _ENGINE_TID,
                         "ts": us(e.ts_ns), "dur": (e.dur_ns or 0) / 1e3,
                         "args": dict(e.data or {})})
         elif e.kind == "serve.drain":
             out.append({"name": "drain", "cat": "serving", "ph": "i",
-                        "s": "p", "pid": _ENGINE_PID, "tid": _ENGINE_TID,
+                        "s": "p", "pid": engine_pid, "tid": _ENGINE_TID,
                         "ts": us(e.ts_ns), "args": dict(e.data or {})})
         elif e.kind == "serve.route":
             # replica-router decisions render on the engine track: the
             # trace shows WHICH replica each request landed on and WHY
             # (affinity re-hit, least-loaded, drain failover, handoff)
             out.append({"name": "route", "cat": "serving", "ph": "i",
-                        "s": "t", "pid": _ENGINE_PID, "tid": _ENGINE_TID,
+                        "s": "t", "pid": engine_pid, "tid": _ENGINE_TID,
+                        "ts": us(e.ts_ns), "args": dict(e.data or {})})
+        elif e.kind == "serve.handoff":
+            # prefill->decode transfer completion (the router's causal
+            # stitch point; the fleet renderer also draws flow arrows)
+            out.append({"name": "handoff", "cat": "serving", "ph": "i",
+                        "s": "t", "pid": engine_pid, "tid": _ENGINE_TID,
                         "ts": us(e.ts_ns), "args": dict(e.data or {})})
         elif e.kind in ("serve.fault", "serve.restart"):
             # containment/recovery belongs to the engine timeline: the
             # trace shows WHEN the step died / the engine rebuilt relative
             # to the request spans it re-queued
             out.append({"name": e.kind.split(".", 1)[1], "cat": "serving",
-                        "ph": "i", "s": "p", "pid": _ENGINE_PID,
+                        "ph": "i", "s": "p", "pid": engine_pid,
                         "tid": _ENGINE_TID, "ts": us(e.ts_ns),
                         "args": dict(e.data or {})})
         elif e.kind == "slo.breach":
             # burn-rate alerts belong to the engine timeline: the trace
             # shows WHEN the budget blew relative to the request spans
             out.append({"name": "slo_breach", "cat": "serving", "ph": "i",
-                        "s": "p", "pid": _ENGINE_PID, "tid": _ENGINE_TID,
+                        "s": "p", "pid": engine_pid, "tid": _ENGINE_TID,
                         "ts": us(e.ts_ns), "args": dict(e.data or {})})
 
-    out.append({"ph": "M", "name": "process_name", "pid": _SERVING_PID,
-                "args": {"name": "serving requests"}})
-    out.append({"ph": "M", "name": "process_name", "pid": _ENGINE_PID,
-                "args": {"name": "serving engine"}})
-    out.append({"ph": "M", "name": "thread_name", "pid": _ENGINE_PID,
+    out.append({"ph": "M", "name": "process_name", "pid": serving_pid,
+                "args": {"name": f"{name_prefix}serving requests"}})
+    out.append({"ph": "M", "name": "process_name", "pid": engine_pid,
+                "args": {"name": f"{name_prefix}serving engine"}})
+    out.append({"ph": "M", "name": "thread_name", "pid": engine_pid,
                 "tid": _ENGINE_TID, "args": {"name": "engine steps"}})
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
@@ -461,6 +543,135 @@ def render_serving_trace(events: Iterable[Event]) -> Dict[str, Any]:
 def export_serving_trace(events: Iterable[Event], path: str) -> str:
     """Write :func:`render_serving_trace` of ``events`` to ``path``."""
     doc = render_serving_trace(events)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+# ------------------------------------------------------------------ #
+# fleet trace rendering: every replica's slice of the (shared) ring as
+# its own track group on ONE timeline, router decisions on their own
+# track, and chrome-trace flow arrows stitching the prefill->decode
+# handoff across replicas
+
+_ROUTER_PID = 99      # the replica router's decision track
+_ROUTER_TID = 0
+
+
+def render_fleet_trace(events: Iterable[Event]) -> Dict[str, Any]:
+    """Merge a replica fleet's serving events onto ONE chrome-trace
+    timeline: each replica (the ``replica=`` tag :class:`TaggedRecorder`
+    stamps) renders as its own process pair via
+    :func:`render_serving_trace` with a SHARED epoch, router decisions
+    (``serve.route`` / ``serve.handoff``) land on a dedicated router
+    track, and every causal handoff — requests sharing a ``trace=`` id
+    across different replicas — gets a ``ph:"s"``/``ph:"f"`` flow arrow
+    from the prefill-side span's close to the decode-side span's
+    admission, so Perfetto draws the cross-replica hop that a
+    per-replica export cannot show."""
+    events = [e for e in events
+              if e.kind.startswith(("req.", "serve.", "decode.", "sched.",
+                                    "kv.", "slo."))]
+    out: List[Dict[str, Any]] = []
+    if not events:
+        return {"traceEvents": out, "displayTimeUnit": "ms"}
+    t0 = min(e.ts_ns for e in events)
+
+    def us(ts_ns: int) -> float:
+        return (ts_ns - t0) / 1e3
+
+    # ---- split the router plane from the per-replica groups ---- #
+    router_events: List[Event] = []
+    groups: Dict[str, List[Event]] = {}
+    for e in events:
+        if e.kind in ("serve.route", "serve.handoff"):
+            router_events.append(e)
+        else:
+            groups.setdefault((e.data or {}).get("replica", "r0"),
+                              []).append(e)
+
+    pids: Dict[str, int] = {}              # replica -> its request pid
+    for i, name in enumerate(sorted(groups)):
+        spid, epid = 2 * i + 1, 2 * i + 2
+        pids[name] = spid
+        doc = render_serving_trace(groups[name], t0_ns=t0,
+                                   serving_pid=spid, engine_pid=epid,
+                                   name_prefix=f"{name} ")
+        out.extend(doc["traceEvents"])
+
+    for e in router_events:
+        out.append({"name": "route" if e.kind == "serve.route"
+                    else "handoff", "cat": "serving", "ph": "i", "s": "t",
+                    "pid": _ROUTER_PID, "tid": _ROUTER_TID,
+                    "ts": us(e.ts_ns), "args": dict(e.data or {})})
+    if router_events:
+        out.append({"ph": "M", "name": "process_name", "pid": _ROUTER_PID,
+                    "args": {"name": "replica router"}})
+        out.append({"ph": "M", "name": "thread_name", "pid": _ROUTER_PID,
+                    "tid": _ROUTER_TID, "args": {"name": "decisions"}})
+
+    # ---- flow arrows: requests chained by a shared trace id ---- #
+    # rids are per-engine counters, so cross-replica collisions are the
+    # NORM (both sides of a handoff are often rid 0): every lookup keys
+    # on (replica, rid)
+    enq: Dict[Any, Any] = {}        # (replica, rid) -> (enqueue ts, trace)
+    admits: Dict[Any, int] = {}
+    ends: Dict[Any, int] = {}
+    for e in events:
+        if e.rid is None:
+            continue
+        key = ((e.data or {}).get("replica", "r0"), e.rid)
+        if e.kind == "req.enqueue":
+            tr = (e.data or {}).get("trace")
+            if tr is not None:
+                enq[key] = (e.ts_ns, tr)
+        elif e.kind == "req.admit":
+            admits.setdefault(key, e.ts_ns)
+        elif e.kind in _SPAN_CLOSERS:
+            ends[key] = e.ts_ns
+    by_trace: Dict[Any, List] = {}
+    for (rep, rid), (ts, tr) in enq.items():
+        by_trace.setdefault(tr, []).append((ts, rid, rep))
+    for tr in sorted(by_trace, key=str):
+        hops = sorted(by_trace[tr])        # causal order = enqueue order
+        for k, ((ts_a, rid_a, rep_a), (ts_b, rid_b, rep_b)) \
+                in enumerate(zip(hops, hops[1:])):
+            if rep_a == rep_b or rep_a not in pids or rep_b not in pids:
+                continue
+            fid = f"{tr}/{k}"
+            out.append({"name": "handoff", "cat": "handoff", "ph": "s",
+                        "id": fid, "pid": pids[rep_a], "tid": rid_a,
+                        "ts": us(ends.get((rep_a, rid_a), ts_a))})
+            out.append({"name": "handoff", "cat": "handoff", "ph": "f",
+                        "bp": "e", "id": fid, "pid": pids[rep_b],
+                        "tid": rid_b,
+                        "ts": us(admits.get((rep_b, rid_b), ts_b))})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def export_fleet_trace(sources, path: str) -> str:
+    """Write :func:`render_fleet_trace` to ``path``. ``sources`` is an
+    iterable of :class:`Event` (e.g. one shared ring's ``snapshot()``),
+    a single recorder, or a list of recorders — recorder snapshots are
+    merged by timestamp with identity dedupe, so in-process replicas
+    whose :class:`TaggedRecorder` wrappers share the ONE global ring
+    merge without duplication."""
+    items = [sources] if hasattr(sources, "snapshot") else list(sources)
+    if items and hasattr(items[0], "snapshot"):
+        seen: set = set()
+        merged: List[Event] = []
+        for rec in items:
+            for e in rec.snapshot():
+                if id(e) not in seen:
+                    seen.add(id(e))
+                    merged.append(e)
+        merged.sort(key=lambda e: e.ts_ns)
+        events = merged
+    else:
+        events = items
+    doc = render_fleet_trace(events)
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     with open(path, "w") as f:

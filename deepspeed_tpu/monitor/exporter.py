@@ -8,7 +8,9 @@ process-global registry:
 - ``GET /metrics`` — Prometheus text exposition
   (:meth:`MetricsRegistry.to_prometheus`), with the flight recorder's
   ring-loss gauges (``events/dropped``/``events/capacity``) refreshed
-  per scrape;
+  per scrape. The ``serving/phase_ms`` ledger renders with OpenMetrics
+  exemplars (``# {rid="..."} v``) so a p99 bucket links straight to the
+  request in a merged fleet trace;
 - ``GET /healthz`` — 200 while serving, for scrape-target liveness.
 
 Config: ``telemetry.metrics_port`` (the training engine starts/stops one

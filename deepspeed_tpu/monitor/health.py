@@ -860,6 +860,31 @@ def render_summary_table(s: Dict[str, Any]) -> str:
         if parts:
             lines.append("serving  " + "   ".join(parts))
 
+    # ---- request phase ledger (serving/phase_ms, anatomy order) ---- #
+    ph = (serving or {}).get("phases") or {}
+    if ph:
+        order = ["intake", "queue", "prefill", "prefill_chunk", "cow",
+                 "fetch", "spill", "handoff", "verify", "decode"]
+        pparts = []
+        for p in order + sorted(set(ph) - set(order)):
+            reps = ph.get(p)
+            if not reps:
+                continue
+            n = sum(int(v.get("count", 0)) for v in reps.values())
+            tot = sum(float(v.get("sum", 0.0)) for v in reps.values())
+            p99 = max(float(v.get("p99", 0.0)) for v in reps.values())
+            # count-weighted fleet mean / worst-replica p99
+            pparts.append(f"{p} {tot / max(n, 1):.1f}/{p99:.1f}ms")
+        if pparts:
+            lines.append("phases   " + "  ".join(pparts) + "  [mean/p99]")
+    wt = (serving or {}).get("wasted_tokens") or {}
+    if wt:
+        wparts = [f"{cause} {int(sum(reps.values()))}"
+                  for cause, reps in sorted(wt.items())
+                  if sum(reps.values())]
+        if wparts:
+            lines.append("wasted   " + "   ".join(wparts) + " tok")
+
     # ---- replica router (dp serving axis) ---- #
     rep = s.get("replicas")
     if rep is not None:
@@ -1032,6 +1057,23 @@ def health_summary(rec: Dict, prev: Optional[Dict] = None) -> Dict[str, Any]:
     if faults:
         # contained engine-step exceptions by dispatch site (serving.fault)
         serving["step_faults"] = {k: int(v) for k, v in sorted(faults.items())}
+    # request latency anatomy: {phase: {replica: histogram summary}} —
+    # the phase ledger the trace/top/scrape surfaces all render from
+    phases: Dict[str, Dict[str, Any]] = {}
+    for labels, v in multilabel_series(h, "serving/phase_ms"):
+        p, rep = labels.get("phase"), labels.get("replica")
+        if p is not None and rep is not None and (v or {}).get("count"):
+            phases.setdefault(p, {})[rep] = v
+    if phases:
+        serving["phases"] = phases
+    # wasted-work accounting: {cause: {replica: tokens}}
+    wasted: Dict[str, Dict[str, int]] = {}
+    for labels, v in multilabel_series(c, "serving/wasted_tokens"):
+        cause, rep = labels.get("cause"), labels.get("replica")
+        if cause is not None and rep is not None:
+            wasted.setdefault(cause, {})[rep] = int(v)
+    if wasted:
+        serving["wasted_tokens"] = wasted
     if serving:
         out["serving"] = serving
 

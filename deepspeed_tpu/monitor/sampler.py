@@ -2,8 +2,10 @@
 
 The registry (``monitor/metrics.py``) is a point-in-time aggregate; the
 sampler turns it into a series: every ``interval_s`` it appends one
-registry snapshot to a size-rotated JSONL sink (the ``dscli health`` /
-``dscli top`` offline source) and to an in-memory ring, refreshes the
+registry snapshot — including the labeled ``serving/phase_ms`` /
+``serving/wasted_tokens`` ledger families — to a size-rotated JSONL
+sink (the ``dscli health`` / ``dscli top`` offline source) and to an
+in-memory ring, refreshes the
 flight-recorder loss gauges (``events/dropped``/``events/capacity``),
 and — when an :class:`~deepspeed_tpu.monitor.slo.SloEngine` is attached
 — runs one burn-rate evaluation tick.

@@ -47,7 +47,15 @@ class StepTracer:
         self._thread_names: Dict[int, str] = {}   # tid -> thread name
         self._lock = threading.Lock()
         self._dropped = 0
+        self._warned_drop = False
         self._t0 = time.perf_counter()
+
+    @property
+    def dropped(self) -> int:
+        """Spans discarded at ``max_events`` — nonzero means every
+        export from this tracer is TRUNCATED, not complete."""
+        with self._lock:
+            return self._dropped
 
     def _accelerator(self):
         if not self.use_accelerator:
@@ -87,7 +95,24 @@ class StepTracer:
                 # thread, by which point this one may be gone
                 self._thread_names[tid] = threading.current_thread().name
             if len(self._events) >= self.max_events:
+                # never silent: the registry counter makes truncation
+                # scrapeable, the once-per-run warning makes it loud
                 self._dropped += 1
+                warn_now = not self._warned_drop
+                self._warned_drop = True
+                try:
+                    get_registry().counter(
+                        "trace/dropped_events",
+                        "StepTracer spans discarded at max_events — a "
+                        "nonzero value means exported chrome traces are "
+                        "truncated, not complete").inc()
+                except Exception:
+                    pass     # a broken registry must never kill a span
+                if warn_now:
+                    logger.warning(
+                        f"StepTracer hit max_events={self.max_events}; "
+                        "further spans are dropped (trace/dropped_events "
+                        "counts them) — exported traces are truncated")
                 return
             self._events.append(ev)
 
@@ -101,6 +126,7 @@ class StepTracer:
             self._events.clear()
             self._thread_names.clear()
             self._dropped = 0
+            self._warned_drop = False    # a fresh run warns afresh
 
     def export_chrome_trace(self, path: str) -> str:
         """Write the recorded spans as chrome-trace JSON; returns path.

@@ -1,0 +1,394 @@
+"""Request latency anatomy + fleet trace acceptance suite (ISSUE 18):
+the phase ledger's phases sum to end-to-end latency exactly, the
+decomposition is replay-identical given a request trace, a dp=2
+disaggregated prefill->decode handoff shows ``fetch`` phase work on the
+decode replica ONLY and merges onto one Perfetto timeline with
+cross-replica flow arrows (``ph:"s"/"f"``) that validates clean, the
+router-federated ``/metrics`` exposes ``serving/phase_ms`` +
+``serving/wasted_tokens`` with per-replica labels and rid exemplars,
+``dscli trace <request-id>`` renders the same anatomy, the
+``serving_traced_steady`` compile-budget contract (tracing adds ZERO
+steady-state compiles), and the StepTracer drop counter satellite."""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.inference.router import ReplicaRouter
+from deepspeed_tpu.inference.serve import AsyncServingEngine
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.models.transformer import TransformerConfig
+from deepspeed_tpu.monitor.anatomy import (PHASES, format_anatomy,
+                                           request_anatomy, trace_anatomy)
+
+_VT_PATH = Path(__file__).resolve().parents[2] / "tools" / "validate_trace.py"
+_spec = importlib.util.spec_from_file_location("validate_trace", _VT_PATH)
+validate_trace = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(validate_trace)
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    from deepspeed_tpu.monitor.events import get_flight_recorder
+    from deepspeed_tpu.monitor.metrics import get_registry
+    dist.set_mesh(None)
+    get_registry().reset()
+    get_registry().set_enabled(True)
+    get_flight_recorder().clear()
+    yield
+    dist.set_mesh(None)
+    get_registry().reset()
+    get_registry().set_enabled(True)
+    get_flight_recorder().clear()
+
+
+def tiny_model(**over):
+    base = dict(vocab_size=64, n_layer=2, n_head=4, d_model=32, d_ff=64,
+                max_seq=64, remat=False)
+    base.update(over)
+    return CausalLM(TransformerConfig(**base))
+
+
+def _prompts(lens=(5, 11, 3), vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, size=n).astype(np.int32) for n in lens]
+
+
+def _drive(serving_or_router):
+    while serving_or_router.step():
+        pass
+
+
+def _serve_one(prompt, max_new=6):
+    """One traced synchronous serve; returns (engine, rid, events)."""
+    engine = deepspeed_tpu.init_inference(
+        tiny_model(), dtype="fp32", telemetry={"events": True},
+        serving={"block_size": 8, "max_running": 2})
+    serving = AsyncServingEngine(engine, max_new_tokens=max_new,
+                                 start=False)
+    h = serving.add_request(prompt)
+    _drive(serving)
+    assert h.status == "finished"
+    serving.shutdown()
+    return engine, h.rid, engine._events.snapshot()
+
+
+# --------------------------------------------------------------------- #
+# the ledger's core invariants
+
+
+class TestPhaseLedger:
+
+    def test_phases_sum_to_end_to_end_latency(self):
+        """THE anatomy pin: every phase (incl. the sched_wait remainder)
+        sums to the submit->retire wall total EXACTLY — nothing of a
+        request's latency is unaccounted."""
+        engine, rid, events = _serve_one(_prompts((11,))[0])
+        a = request_anatomy(events, rid)
+        assert a is not None and a["outcome"] == "retire"
+        assert set(a["phases_ms"]) == set(PHASES)
+        total = sum(a["phases_ms"].values())
+        assert total == pytest.approx(a["total_ms"], abs=1e-9)
+        # the compute phases actually fired and TTFT is a sub-total
+        assert a["counts"]["prefill"] >= 1
+        assert a["counts"]["decode"] >= 1
+        assert 0 < a["ttft_ms"] <= a["total_ms"] + 1e-9
+        # the live ledger observed the same phases into the histogram
+        from deepspeed_tpu.monitor.metrics import get_registry
+        h = get_registry().snapshot()["histograms"]
+        for p in ("intake", "queue", "prefill", "decode"):
+            key = f'serving/phase_ms{{phase="{p}",replica="r0"}}'
+            assert h.get(key, {}).get("count", 0) >= 1, key
+
+    def test_decomposition_replay_identical(self):
+        """The anatomy is a pure function of the event trace: feeding the
+        SAME events back in (round-tripped through to_dict, the JSONL
+        form) yields a byte-identical decomposition, and a fresh engine
+        serving the same request trace yields the same structure."""
+        from deepspeed_tpu.monitor.events import get_flight_recorder
+        prompt = _prompts((11,))[0]
+        engine, rid, events = _serve_one(prompt)
+        a1 = request_anatomy(events, rid)
+        a2 = request_anatomy([e.to_dict() for e in events], rid)
+        assert a1 == a2                       # Event vs JSONL dict form
+        assert a1 == request_anatomy(events, rid)      # pure: no state
+        dist.set_mesh(None)
+        get_flight_recorder().clear()   # a fresh run's own trace
+        engine2, rid2, events2 = _serve_one(prompt)
+        b = request_anatomy(events2, rid2)
+        assert rid2 == rid
+        # wall-clock magnitudes differ run to run; the STRUCTURE —
+        # which phases fired, how many events each — is the replay pin
+        assert b["counts"] == a1["counts"]
+        assert b["outcome"] == a1["outcome"]
+        assert b["generated"] == a1["generated"]
+        assert format_anatomy(a1).splitlines()[0].startswith("request")
+
+    def test_wasted_tokens_recompute_cause(self):
+        """A preemption books the victim's committed prefix into
+        ``serving/wasted_tokens{cause="recompute"}``."""
+        from deepspeed_tpu.monitor.metrics import get_registry
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32", telemetry=True,
+            serving={"block_size": 8, "max_running": 2,
+                     "max_num_blocks": 8})
+        out = engine.generate_batch(_prompts((12, 12, 12)),
+                                    max_new_tokens=10)
+        assert len(out) == 3
+        c = get_registry().snapshot()["counters"]
+        pre = c.get("serving/preemptions", 0)
+        if pre:                     # pool pressure actually preempted
+            key = 'serving/wasted_tokens{cause="recompute",replica="r0"}'
+            assert c.get(key, 0) > 0
+
+
+# --------------------------------------------------------------------- #
+# dp=2 disaggregated handoff: cross-replica anatomy + fleet trace
+
+
+class TestFleetTrace:
+
+    def _handoff_run(self):
+        model = tiny_model()
+        cfg = {"block_size": 8, "max_running": 2, "prefix_caching": "on",
+               "kv_host": {"enabled": True}}
+        dist.set_mesh(None)
+        ep = deepspeed_tpu.init_inference(model, dtype="fp32", serving=cfg,
+                                          telemetry={"events": True})
+        dist.set_mesh(None)
+        ed = deepspeed_tpu.init_inference(model, params=ep.params,
+                                          dtype="fp32", serving=cfg,
+                                          telemetry={"events": True})
+        pool = ep.ensure_host_kv_pool()
+        ed.adopt_host_kv_pool(pool)
+        sp = AsyncServingEngine(ep, max_new_tokens=8, start=False)
+        sd = AsyncServingEngine(ed, max_new_tokens=8, start=False)
+        router = ReplicaRouter([sp, sd], roles=["prefill", "decode"])
+        prompt = _prompts((21,), seed=1)[0]
+        h = router.add_request(prompt)
+        assert h._stage == "warm" and h.trace == "t0"
+        n = 0
+        while h._stage in ("warm", "demote") and n < 200:
+            sp.step()
+            router._advance(h)
+            n += 1
+        _drive(router)
+        assert h.status == "finished"
+        return router, h
+
+    def test_handoff_fetch_phase_on_decode_replica_only(self, tmp_path):
+        """The acceptance pin: a dp=2 prefill->decode request yields
+        ``fetch`` phase work on the DECODE replica only, a causal chain
+        of two legs under one trace id (decode leg's parent = prefill
+        rid), and one merged Perfetto trace with flow arrows crossing
+        the replicas that validates clean."""
+        from deepspeed_tpu.monitor.metrics import get_registry
+        router, h = self._handoff_run()
+        events = router._events.snapshot()
+
+        # ledger: fetch observed on r1 (decode), never on r0 (prefill)
+        hists = get_registry().snapshot()["histograms"]
+        assert hists.get('serving/phase_ms{phase="fetch",replica="r1"}',
+                         {}).get("count", 0) >= 1
+        assert 'serving/phase_ms{phase="fetch",replica="r0"}' not in hists
+        # the handoff phase is booked on the prefill replica's ledger
+        assert hists.get('serving/phase_ms{phase="handoff",replica="r0"}',
+                         {}).get("count", 0) == 1
+
+        # causal chain: two legs under t0, decode leg parented on the
+        # prefill rid; the fetch events live on the decode leg only
+        t = trace_anatomy(events, "t0")
+        assert t is not None and len(t["legs"]) == 2
+        warm, dec = t["legs"]
+        assert warm["replica"] == "r0" and dec["replica"] == "r1"
+        assert dec["parent"] == warm["rid"]
+        assert dec["counts"]["fetch"] >= 1
+        assert warm["counts"]["fetch"] == 0
+        assert t["handoffs"] == [{"from": "r0", "to": "r1",
+                                  "rid": warm["rid"]}]
+        for leg in t["legs"]:      # each leg's phases still sum exactly
+            assert sum(leg["phases_ms"].values()) == \
+                pytest.approx(leg["total_ms"], abs=1e-9)
+
+        # ONE merged timeline: per-replica track groups, a router track,
+        # and a flow arrow (s on r0's leg, f on r1's leg) for the hop
+        path = str(tmp_path / "fleet.json")
+        router.export_fleet_trace(path)
+        assert validate_trace.validate_path(path, kind="chrome") == []
+        doc = json.load(open(path))
+        evs = doc["traceEvents"]
+        names = {e["args"]["name"] for e in evs
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert "r0 serving requests" in names
+        assert "r1 serving requests" in names
+        assert "replica router" in names
+        flows = [e for e in evs if e.get("ph") in ("s", "f")]
+        assert {e["ph"] for e in flows} == {"s", "f"}
+        s = next(e for e in flows if e["ph"] == "s")
+        f = next(e for e in flows if e["ph"] == "f")
+        assert s["id"] == f["id"] == "t0/0"
+        assert s["pid"] != f["pid"], "flow arrow must cross replicas"
+        assert (s["tid"], f["tid"]) == (warm["rid"], dec["rid"])
+        router.shutdown()
+
+    def test_fleet_metrics_federated_with_exemplars(self):
+        """One scrape covers the fleet: the shared registry's OpenMetrics
+        body carries serving/phase_ms for BOTH replica labels, with rid
+        exemplars on the ledger buckets, plus the wasted-token family."""
+        from deepspeed_tpu.monitor.metrics import get_registry
+        router, h = self._handoff_run()
+        # a shed on the decode replica books wasted tokens with cause=
+        sched = router.replicas[1]._session.sched
+        sched.telemetry.waste("shed", 0)      # materialize the series
+        text = get_registry().to_prometheus(exemplars=True)
+        assert 'serving_phase_ms_bucket{phase="prefill",replica="r0"' \
+            in text
+        assert 'serving_phase_ms_bucket{phase="fetch",replica="r1"' in text
+        assert "# {rid=" in text              # exemplar -> trace linkage
+        assert 'serving_wasted_tokens{cause="shed",replica="r1"}' in text
+        router.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# surfaces: dscli trace, dscli top pane
+
+
+class TestAnatomySurfaces:
+
+    def test_dscli_trace_prints_anatomy(self, tmp_path, capsys):
+        from deepspeed_tpu.cli import _trace
+        engine, rid, events = _serve_one(_prompts((11,))[0])
+        path = str(tmp_path / "events.jsonl")
+        engine._events.write_jsonl(path)
+        assert _trace([str(rid), "--events", path]) == 0
+        out = capsys.readouterr().out
+        assert f"request {rid}" in out
+        for p in ("prefill", "decode", "sched_wait", "ttft="):
+            assert p in out
+        # --json emits the raw dict; an unknown rid is rc=1
+        assert _trace([str(rid), "--events", path, "--json"]) == 0
+        blob = json.loads(capsys.readouterr().out)
+        assert sum(blob["phases_ms"].values()) == \
+            pytest.approx(blob["total_ms"], abs=1e-9)
+        assert _trace(["9999", "--events", path]) == 1
+        capsys.readouterr()
+        # the --validate surface is intact
+        tp = str(tmp_path / "trace.json")
+        engine.export_serving_trace(tp)
+        assert _trace(["--validate", tp]) == 0
+
+    def test_top_pane_renders_phases_and_wasted(self):
+        from deepspeed_tpu.monitor.health import (health_summary,
+                                                  render_summary_table)
+        from deepspeed_tpu.monitor.metrics import get_registry
+        engine, rid, events = _serve_one(_prompts((11,))[0])
+        engine._serving_tel.waste("timeout", 7)
+        summary = health_summary({**get_registry().snapshot()})
+        phases = summary["serving"]["phases"]
+        assert "prefill" in phases and "r0" in phases["prefill"]
+        assert summary["serving"]["wasted_tokens"]["timeout"]["r0"] == 7
+        table = render_summary_table(summary)
+        assert "phases" in table and "[mean/p99]" in table
+        assert "wasted" in table and "timeout 7" in table
+
+
+# --------------------------------------------------------------------- #
+# cost discipline: tracing adds zero steady-state compiles
+
+
+class TestTracedSteadyContract:
+
+    @pytest.fixture(autouse=True)
+    def clean_watchdog(self):
+        from deepspeed_tpu.monitor.trace import get_compile_watchdog
+        get_compile_watchdog().reset()
+        yield
+        get_compile_watchdog().reset()
+
+    def test_serving_traced_steady_contract(self):
+        """The full anatomy plane on (events + phase ledger + trace ids)
+        compiles EXACTLY what the untraced loop compiles: a closed-loop
+        warm-up followed by traced open-loop traffic leaves the compile
+        counts untouched and within the serving_traced_steady budget."""
+        import sys
+        _TOOLS = str(Path(__file__).resolve().parents[2] / "tools")
+        if _TOOLS not in sys.path:
+            sys.path.insert(0, _TOOLS)
+        from dslint.contracts import check_compile_budgets
+
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(), dtype="fp32",
+            telemetry={"events": True},
+            serving={"block_size": 8, "max_running": 2,
+                     "prefix_caching": "on",
+                     "speculative": {"mode": "ngram", "k": 4}})
+        rng = np.random.default_rng(0)
+        motif = rng.integers(0, 8, size=8).astype(np.int32)
+        warm_prompts = [np.tile(motif, 3),
+                        rng.integers(0, 64, size=11).astype(np.int32),
+                        rng.integers(0, 64, size=5).astype(np.int32)]
+        engine.generate_batch(warm_prompts, max_new_tokens=12)
+        engine.generate_batch(warm_prompts, max_new_tokens=12)
+        warm = dict(engine.telemetry_snapshot()["compile"]["by_fn"])
+
+        serving = AsyncServingEngine(engine, max_new_tokens=12,
+                                     start=False)
+        hs = [serving.add_request(p, trace=f"t{i}")
+              for i, p in enumerate(warm_prompts)]
+        _drive(serving)
+        assert all(h.status == "finished" for h in hs)
+        serving.shutdown()
+
+        by_fn = engine.telemetry_snapshot()["compile"]["by_fn"]
+        assert by_fn == warm, (
+            f"traced traffic recompiled: warm {warm} -> {by_fn}")
+        violations = check_compile_budgets(
+            by_fn, "serving_traced_steady", strict=True)
+        assert violations == [], "\n".join(violations)
+
+
+# --------------------------------------------------------------------- #
+# satellite: StepTracer drop accounting
+
+
+class TestStepTracerDrops:
+
+    def test_dropped_events_counted_and_warned(self):
+        import logging
+
+        from deepspeed_tpu.monitor.metrics import get_registry
+        from deepspeed_tpu.monitor.trace import StepTracer
+        from deepspeed_tpu.utils.logging import logger
+
+        records = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        handler = _Capture(level=logging.WARNING)
+        logger.addHandler(handler)   # the repo logger does not propagate
+        try:
+            tracer = StepTracer(max_events=2, use_accelerator=False)
+            for i in range(5):
+                tracer.add_event(f"s{i}", 0.0, 0.001)
+            assert len(tracer.events) == 2
+            assert tracer.dropped == 3
+            c = get_registry().snapshot()["counters"]
+            assert c.get("trace/dropped_events") == 3
+            warns = [r for r in records if "max_events" in r.getMessage()]
+            assert len(warns) == 1, "warning must fire once per run"
+            tracer.clear()
+            assert tracer.dropped == 0
+            tracer.add_event("a", 0.0, 0.001)
+            tracer.add_event("b", 0.0, 0.001)
+            tracer.add_event("c", 0.0, 0.001)
+            warns = [r for r in records if "max_events" in r.getMessage()]
+            assert len(warns) == 2, "a cleared tracer warns afresh"
+        finally:
+            logger.removeHandler(handler)

@@ -623,7 +623,9 @@ class TestAsyncObservability:
         assert all(e.rid is not None and e.data["prompt_tokens"] > 0
                    for e in subs)
         drain = next(e for e in events if e.kind == "serve.drain")
-        assert set(drain.data) == {"waiting", "running", "pending"}
+        # every serving event also carries the replica tag (fleet merge)
+        assert set(drain.data) == {"waiting", "running", "pending",
+                                   "replica"}
         # the cancelled request's lifecycle: submitted, never retired
         rid_cancel = next(e.rid for e in events if e.kind == "req.cancel")
         retired = {e.rid for e in events if e.kind == "req.retire"}

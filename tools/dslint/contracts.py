@@ -100,6 +100,19 @@ class CompileBudget:
 #:                     set (each replica owns its jit wrappers; the
 #:                     budgets below are the N=2 totals) and stays frozen
 #:                     however much traffic the router spreads
+#:   serving_traced_steady — the async serving loop with the FULL request
+#:                     latency-anatomy plane on: flight recorder enabled,
+#:                     trace context propagated, every phase observed
+#:                     into serving/phase_ms (with exemplars) and the
+#:                     wasted-token ledger, prefix cache + speculation
+#:                     on, prompts within two 128-token buckets: TRACING
+#:                     ADDS ZERO STEADY-STATE COMPILES — every emit /
+#:                     histogram observe / trace-id stamp is host-side
+#:                     dict work AFTER the step's existing sync point
+#:                     (dslint DS005 pins the no-new-sync half
+#:                     statically; this contract pins the dynamic half),
+#:                     so each fused entry compiles exactly as often as
+#:                     the untraced serving_async_steady scenario
 BUDGETS: List[CompileBudget] = [
     CompileBudget(
         "engine.train_batch[gas=1]", "steady_train", 1,
@@ -311,6 +324,29 @@ BUDGETS: List[CompileBudget] = [
         "block-index-traced H2D scatter (2 donation/layout variants) per "
         "replica: the handoff's decode-side fetch IS the PR-12 path — "
         "the host tier as KV transport adds zero programs"),
+    CompileBudget(
+        "inference.paged_decode", "serving_traced_steady", 1,
+        "tracing is host-side emit/observe work after the step's "
+        "existing sync: the fused decode program compiles exactly as "
+        "often as untraced — a second compile means instrumentation "
+        "leaked into the traced program"),
+    CompileBudget(
+        "inference.paged_verify", "serving_traced_steady", 1,
+        "one k-window-bucket verify program, same as untraced: the "
+        "verify phase observe reuses the step's existing host sync"),
+    CompileBudget(
+        "inference.paged_prefill", "serving_traced_steady", 2,
+        "one program per 128-token prompt bucket (the scenario spans "
+        "two), same as untraced: the prefill phase ledger rides the "
+        "sample readback that already synced"),
+    CompileBudget(
+        "inference.paged_prefill_chunk", "serving_traced_steady", 4,
+        "one program per (chunk bucket, table-width power-of-two) pair, "
+        "same as untraced; phase observes add zero retraces"),
+    CompileBudget(
+        "inference.paged_cow", "serving_traced_steady", 1,
+        "copy-on-write block copy: fixed block geometry; the cow phase "
+        "observe happens after its block_until_ready"),
 ]
 
 
