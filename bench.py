@@ -98,6 +98,19 @@ Env knobs (defaults are the chip-measured fast path):
                            ((goodput_dp2/goodput_dp1)/2, 1.0 = linear);
                            BENCH_SERVE_DP_RATE=8 (req/s)
                            BENCH_SERVE_DP_REQS=16 BENCH_SERVE_DP_NEW=32
+  BENCH_CTL=1              adaptive-autopilot spike probe: one engine, the
+                           same seeded Poisson trace with a mid-trace
+                           arrival SPIKE, driven twice — controller OFF
+                           (static config posture) then ON (the
+                           monitor/controller.py SLO-burn autopilot,
+                           dscli serve --adaptive); value = adaptive-run
+                           goodput at the p99 TPOT target, vs_baseline =
+                           adaptive/static goodput; per-run SLO breach /
+                           shed / knob-action counts and the decision
+                           ledger ride the telemetry blob;
+                           BENCH_CTL_RATE=6 (req/s) BENCH_CTL_REQS=18
+                           BENCH_CTL_NEW=32 BENCH_CTL_TPOT_MS=50
+                           BENCH_CTL_SPIKE=6 (spike factor)
   BENCH_SKIP_PROBE=0       skip the subprocess backend probe
   BENCH_PROBE_RETRIES=1    probe retries before giving up on the backend
   BENCH_ALLOW_CPU=0        on probe failure, run a tiny CPU smoke metric
@@ -491,6 +504,7 @@ BENCH_METRICS = [
     ("BENCH_SERVE_ASYNC", "1", "gpt2_serving_async_goodput_tokens_per_sec"),
     ("BENCH_SERVE_CHAOS", "1", "gpt2_serving_chaos_goodput_tokens_per_sec"),
     ("BENCH_SERVE_DP", "1", "gpt2_serving_dp_goodput_tokens_per_sec"),
+    ("BENCH_CTL", "1", "gpt2_serving_adaptive_goodput_tokens_per_sec"),
     ("BENCH_SERVE_TP", "1", "gpt2_serving_tp_tokens_per_sec"),
     ("BENCH_CKPT", "1", "gpt2_ckpt_async_stall_ms_per_step"),
 ]
@@ -1142,6 +1156,168 @@ def run_serve_chaos_bench():
         del engine
 
 
+def run_serve_adaptive_bench():
+    """Adaptive-autopilot spike probe: one engine, the same seeded
+    Poisson arrival trace with a MID-TRACE ARRIVAL SPIKE (the middle
+    third's inter-arrival gaps divided by BENCH_CTL_SPIKE), driven twice
+    — STATIC first (controller off: the config posture rides the spike),
+    then ADAPTIVE (the monitor/controller.py burn-rate autopilot ticking
+    on a background sampler, actions applied between engine steps — the
+    ``dscli serve --adaptive`` wiring). Value = the adaptive run's
+    goodput at the p99 TPOT target (the async probe's definition:
+    tokens/s from finished requests whose own p99 TPOT met it);
+    vs_baseline = adaptive/static goodput — above 1.0 the autopilot
+    bought goodput under the spike. Per-run SLO breach / shed /
+    knob-action counts plus the decision ledger's audit lines ride the
+    telemetry blob. Failures degrade to the standard skip record."""
+    import time as _t
+
+    import numpy as np
+
+    RATE = float(os.environ.get("BENCH_CTL_RATE", 6.0))
+    NREQ = int(os.environ.get("BENCH_CTL_REQS", 18))
+    MAX_NEW = int(os.environ.get("BENCH_CTL_NEW", 32))
+    TARGET = float(os.environ.get("BENCH_CTL_TPOT_MS", 50.0))
+    SPIKE = max(float(os.environ.get("BENCH_CTL_SPIKE", 6.0)), 1.0)
+    engine = None
+    try:
+        import deepspeed_tpu
+        import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.inference.serve import AsyncServingEngine
+        from deepspeed_tpu.models import gpt2
+        from deepspeed_tpu.monitor.controller import (AdaptiveController,
+                                                      explain_decisions,
+                                                      knobs_from_serving)
+        from deepspeed_tpu.monitor.health import (labeled_series,
+                                                  multilabel_series)
+        from deepspeed_tpu.monitor.sampler import MetricsSampler
+        from deepspeed_tpu.monitor.slo import (SloEngine, parse_objectives,
+                                               serving_objectives)
+
+        dist.set_mesh(None)
+        _reset_telemetry()
+        model = gpt2("125m", remat=False,
+                     attention_backend=os.environ.get("BENCH_ATTN", "auto"))
+        # chunked prefill gives the controller a real prefill_chunk
+        # ladder; admission/shed knobs bootstrap from the default policy
+        engine = deepspeed_tpu.init_inference(
+            model, dtype="bf16", telemetry={"events": True},
+            serving={"block_size": 128, "max_running": 8,
+                     "prefix_caching": "off",
+                     "prefill_chunk_tokens": 256})
+        rng = np.random.default_rng(19)
+        prompts = [rng.integers(0, 50257, size=int(n)).astype(np.int32)
+                   for n in rng.integers(64, 192, size=NREQ)]
+        gaps = rng.exponential(1.0 / max(RATE, 1e-6), size=NREQ)
+        # the spike: the middle third arrives SPIKE x faster than the
+        # steady Poisson rate — the burn the autopilot is built to read
+        lo, hi = NREQ // 3, 2 * NREQ // 3
+        gaps[lo:hi] /= SPIKE
+        # closed-loop warm-up: both runs reuse the warm programs, and
+        # every knob-ladder rung stays inside the compiled buckets (the
+        # serving_adaptive_steady contract), so neither run pays compile
+        # time inside its measured arrival window
+        engine.generate_batch(prompts[:2], max_new_tokens=MAX_NEW)
+
+        def consume(h, rec):
+            last = None
+            for burst in h.stream():
+                now = _t.perf_counter()
+                if last is not None:
+                    rec["tpot"] += [(now - last) / len(burst)] * len(burst)
+                last = now
+                rec["tokens"] += len(burst)
+            rec["status"] = h.status
+
+        def one_run(adaptive):
+            _reset_telemetry()
+            serving = AsyncServingEngine(engine, max_new_tokens=MAX_NEW)
+            slo = SloEngine(
+                parse_objectives(serving_objectives(tpot_p99_ms=TARGET),
+                                 default_windows=[16, 4]),
+                events=engine._events)
+            ctl = None
+            if adaptive:
+                ctl = AdaptiveController(
+                    knobs_from_serving(engine.config.serving,
+                                       policy=serving.policy),
+                    events=engine._events,
+                    apply_fn=serving.apply_knobs)
+            sampler = MetricsSampler(interval_s=0.2, slo=slo,
+                                     ctl=ctl).start()
+            try:
+                recs, wall, _serving = _drive_open_loop(
+                    engine, prompts, gaps, MAX_NEW, consume,
+                    serving=serving)
+            finally:
+                sampler.stop(final_tick=False)
+            good = met = 0
+            for rec in recs:
+                p99_ms = (float(np.percentile(rec["tpot"], 99)) * 1e3
+                          if rec["tpot"] else 0.0)
+                if rec.get("status") == "finished" and p99_ms <= TARGET:
+                    good += rec["tokens"]
+                    met += 1
+            counters = (engine.telemetry_snapshot() or {}).get(
+                "counters", {})
+            return {
+                "goodput": good / wall if wall > 0 else 0.0,
+                "met": met,
+                "breaches": int(sum(labeled_series(
+                    counters, "slo/breaches").values())),
+                "shed": int(counters.get("serving/shed_requests", 0)),
+                "actions": int(sum(v for _, v in multilabel_series(
+                    counters, "ctl/actions"))),
+            }
+
+        static = one_run(adaptive=False)
+        adapt = one_run(adaptive=True)
+
+        out = {
+            "metric": _metric_name("BENCH_CTL"),
+            "value": round(adapt["goodput"], 1),
+            "unit": f"goodput tokens/s under a {SPIKE:.0f}x arrival spike "
+                    f"(bf16 open loop, Poisson {RATE}/s x {NREQ} reqs x "
+                    f"{MAX_NEW} new, p99 TPOT target {TARGET:.0f} ms; "
+                    f"adaptive {adapt['met']}/{NREQ} met it with "
+                    f"{adapt['breaches']} SLO breaches vs static "
+                    f"{static['met']}/{NREQ} with {static['breaches']} "
+                    f"at {static['goodput']:.1f} tok/s)",
+            # the autopilot's value: goodput bought (or lost) vs riding
+            # the spike in the static config posture
+            "vs_baseline": (round(adapt["goodput"] / static["goodput"], 3)
+                            if static["goodput"] else 0.0),
+        }
+        tel = _telemetry_blob(engine) or {}
+        for label, run in (("static", static), ("adaptive", adapt)):
+            tel[label] = {"goodput_tokens_per_sec": round(run["goodput"], 1),
+                          "slo_met_requests": run["met"],
+                          "slo_breaches": run["breaches"],
+                          "shed_requests": run["shed"]}
+        tel["ctl_actions"] = adapt["actions"]
+        ev = engine._events
+        if ev is not None:
+            ledger = explain_decisions(
+                e.to_dict() for e in ev.snapshot())
+            if ledger:
+                tel["ctl_ledger"] = ledger[:40]
+        out["telemetry"] = tel
+        print(json.dumps(out), flush=True)
+    except Exception as e:  # noqa: BLE001 — probe failure => skip record
+        print(json.dumps({
+            "metric": _metric_name("BENCH_CTL"),
+            "value": 0.0,
+            "unit": "goodput tokens/s under an arrival spike (skipped: "
+                    "adaptive serving probe failed)",
+            "vs_baseline": 0.0,
+            "skipped": True,
+            "skip_stage": "serve_adaptive_run",
+            "skip_error": f"{type(e).__name__}: {e}",
+        }), flush=True)
+    finally:
+        del engine
+
+
 def run_serve_dp_bench():
     """Replica scale-out probe: the SAME seeded Poisson arrival trace
     through one ``AsyncServingEngine`` (dp=1) and through a two-replica
@@ -1559,7 +1735,7 @@ def main():
            ("BENCH_DECODE_DENSE", "BENCH_DECODE_PAGED",
             "BENCH_SERVE_PREFIX", "BENCH_KV_TIER", "BENCH_SERVE_CHUNKED",
             "BENCH_SERVE_SPEC", "BENCH_SERVE_ASYNC", "BENCH_SERVE_CHAOS",
-            "BENCH_SERVE_DP", "BENCH_SERVE_TP")):
+            "BENCH_SERVE_DP", "BENCH_CTL", "BENCH_SERVE_TP")):
         # free the last training engine's device state before serving
         if engine is not None:
             del engine, model, batch
@@ -1589,6 +1765,9 @@ def main():
             gc.collect()
         if _metric_enabled("BENCH_SERVE_DP"):
             run_serve_dp_bench()
+            gc.collect()
+        if _metric_enabled("BENCH_CTL"):
+            run_serve_adaptive_bench()
             gc.collect()
         if _metric_enabled("BENCH_SERVE_TP"):
             run_serving_tp_bench()

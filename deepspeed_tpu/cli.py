@@ -13,6 +13,9 @@ Subcommands mirror the reference's script family:
 - ``dscli ckpt verify <dir>``       — checkpoint integrity audit (per-tag manifest check)
 - ``dscli lint``                    — dslint trace-safety static analysis (rc=1 on new findings)
 - ``dscli trace --validate <path>`` — chrome-trace / events.jsonl schema check
+- ``dscli ctl replay|explain <events.jsonl>`` — adaptive-controller decision-
+  ledger audit: re-run the pure decision core over the recorded observations
+  (rc=1 on divergence) or print the human-readable decision story
 - ``dscli profile <logdir|trace>``  — summarize a jax.profiler capture / chrome trace
 - ``dscli elastic <config>``        — ``ds_elastic`` elastic-config inspector
 - ``dscli autotune <config>``       — ``deepspeed --autotuning`` config search
@@ -227,6 +230,67 @@ def _trace(argv):
     return 0
 
 
+def _ctl(argv):
+    """``dscli ctl`` — audit an adaptive-controller decision ledger
+    (a flight-recorder ``events.jsonl`` export holding ``ctl.*``
+    events). ``replay`` re-runs the pure decision core over the recorded
+    ``ctl.observe`` trace and diffs against the recorded ``ctl.decide``
+    sequence — rc=0 on an exact reproduction, rc=1 on divergence (a
+    divergence means the controller was NOT a pure function of its
+    observations: nondeterminism worth paging on). ``explain`` prints
+    the decision story: every knob movement with the burn/pressure
+    observation that triggered it."""
+    import argparse
+    import json as _json
+
+    parser = argparse.ArgumentParser(
+        prog="dscli ctl",
+        description="adaptive-controller decision-ledger audit "
+                    "(monitor/controller.py)")
+    sub = parser.add_subparsers(dest="action", required=True)
+    rp = sub.add_parser("replay", help="re-run the decision core over the "
+                                       "recorded observations and diff")
+    rp.add_argument("events", help="events.jsonl ledger export")
+    rp.add_argument("--json", action="store_true",
+                    help="print the replayed action sequence as JSON")
+    xp = sub.add_parser("explain", help="print the human-readable "
+                                        "decision story")
+    xp.add_argument("events", help="events.jsonl ledger export")
+    args = parser.parse_args(argv)
+
+    from deepspeed_tpu.monitor.controller import (
+        explain_decisions, recorded_decisions, replay_decisions)
+    if args.action == "explain":
+        lines = explain_decisions(args.events)
+        if not lines:
+            print(f"{args.events}: no ctl.* events (run with --adaptive "
+                  "/ telemetry.ctl enabled and export the recorder)")
+            return 1
+        for line in lines:
+            print(line)
+        return 0
+    try:
+        replayed = replay_decisions(args.events)
+    except ValueError as e:
+        print(f"replay failed: {e}")
+        return 1
+    recorded = recorded_decisions(args.events)
+    if args.json:
+        print(_json.dumps(replayed))
+    if replayed == recorded:
+        print(f"replay OK: {len(recorded)} action(s) reproduced exactly")
+        return 0
+    print(f"REPLAY DIVERGED: {len(recorded)} recorded vs "
+          f"{len(replayed)} replayed action(s)")
+    for i, (a, b) in enumerate(zip(recorded, replayed)):
+        if a != b:
+            print(f"  first divergence at action #{i}:")
+            print(f"    recorded: {_json.dumps(a, sort_keys=True)}")
+            print(f"    replayed: {_json.dumps(b, sort_keys=True)}")
+            break
+    return 1
+
+
 def _profile(argv):
     """Summarize a profiling artifact: a ``jax.profiler`` capture dir
     (``telemetry.profile`` / ``engine.profile(steps=N)``) — run inventory
@@ -386,7 +450,7 @@ def _dlts_hostfile():
 
 _COMMANDS = {"run": _run, "serve": _serve, "report": _report,
              "health": _health, "top": _top, "bench": _bench,
-             "ckpt": _ckpt, "lint": _lint, "trace": _trace,
+             "ckpt": _ckpt, "lint": _lint, "trace": _trace, "ctl": _ctl,
              "profile": _profile, "elastic": _elastic, "autotune": _autotune,
              "ssh": _ssh}
 
@@ -395,7 +459,7 @@ def main():
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
         print(__doc__)
         print("usage: dscli {run|serve|report|health|top|bench|ckpt|lint|"
-              "trace|profile|elastic|autotune|ssh} [args...]")
+              "trace|ctl|profile|elastic|autotune|ssh} [args...]")
         return 0
     cmd = sys.argv[1]
     if cmd not in _COMMANDS:

@@ -257,6 +257,14 @@ class ServingConfig(ConfigModel):
     step — running decodes keep making progress instead of stalling for a
     whole long prompt. 0 = whole-prompt prefill (the default).
 
+    Several serving knobs — the prefill chunk size, speculative ``k``,
+    the policy's ``admission_*`` bounds, the shed depth, and host-tier
+    spill — double as the adaptive controller's actuation surface
+    (``monitor/controller.py``, ``dscli serve --adaptive``): their config
+    values are the BASELINE the controller tightens away from under SLO
+    burn and steps back to under sustained headroom. Pin one static with
+    ``telemetry.ctl.knobs.<name>: off``.
+
     ``speculative`` configures n-gram self-speculation (verified
     multi-token decode steps) — see :class:`SpeculativeConfig`.
 

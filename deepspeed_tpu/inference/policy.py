@@ -49,7 +49,11 @@ class SchedulingPolicy:
     """Base policy = the FIFO rules the scheduler has always used.
 
     Subclasses override the selection hooks; the admission-control knobs
-    live here so every policy composes with them."""
+    live here so every policy composes with them. ``admission_max_queue``
+    and ``admission_min_free_blocks`` are plain mutable ints by contract:
+    the adaptive controller (``monitor/controller.py``) tightens and
+    relaxes them at runtime on the serving thread, between engine
+    steps."""
 
     name = "fifo"
 

@@ -555,6 +555,12 @@ class ContinuousBatchingScheduler:
         self.max_running = max_running
         self.max_blocks_per_seq = max_blocks_per_seq
         self.prefix_caching = prefix_caching and allocator.prefix_cache
+        # chunk_tokens and spec_k are runtime-mutable by contract: the
+        # adaptive controller (monitor/controller.py) lowers them under
+        # SLO burn and restores them under headroom, always between steps
+        # on the serving thread, and only to values inside the compile
+        # buckets the engine already owns (128-multiple chunks; spec k
+        # within its fixed pow2 verify window)
         self.chunk_tokens = chunk_tokens
         # speculative decoding: propose up to spec_k candidates per decode-
         # ready request and verify them in one fused step (0/None = off)

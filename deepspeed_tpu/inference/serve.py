@@ -215,6 +215,12 @@ class AsyncServingEngine:
         self._finalized = False
         self._n_submitted = 0
         self.error: Optional[BaseException] = None
+        # ---- adaptive controller (monitor/controller.py) ---- #
+        # knob -> last applied action payload: the loop-local replica of
+        # the decision ledger, re-applied after an engine restart so the
+        # recovered engine comes back in the SAME posture it crashed in
+        self._ctl_values: Dict[str, Dict] = {}
+        self._shed_override = 0            # 0 = follow serving.fault config
         # ---- fault tolerance (serving.fault) ---- #
         self._fault_cfg = engine.config.serving.fault
         self.restarts = 0                  # engine-fatal recoveries so far
@@ -320,6 +326,27 @@ class AsyncServingEngine:
             self._cv.notify_all()
         return done
 
+    def apply_knobs(self, actions) -> None:
+        """Queue adaptive-controller knob movements for application on
+        the serving thread (the :class:`~deepspeed_tpu.monitor.
+        controller.AdaptiveController`'s ``apply_fn``). Mutation happens
+        in :meth:`_step_once` BETWEEN engine steps — the donated pools
+        and the jit dispatch stay single-threaded — and each applied
+        movement lands in the ledger as ``ctl.apply`` (``ctl.revert``
+        when a relax returns the knob to its config baseline). Accepts
+        :class:`KnobAction` objects or their payload dicts; silently
+        dropped on a stopped or crash-looping loop (the posture of a
+        dead engine is moot)."""
+        payloads = [a.to_payload() if hasattr(a, "to_payload") else dict(a)
+                    for a in actions]
+        if not payloads:
+            return
+        with self._cv:
+            if self._stopped or self._crash_loop:
+                return
+            self._intake.append(("knobs", payloads))
+            self._cv.notify_all()
+
     def health_state(self):
         """``(status_code, body)`` for ``GET /healthz`` — extracted from
         the HTTP handler so a :class:`~deepspeed_tpu.inference.router.
@@ -339,6 +366,11 @@ class AsyncServingEngine:
                 "running": len(sched.running),
                 "restarts": self.restarts,
                 "uptime_ticks": sched.step_seq}
+        if self._ctl_values:
+            # adaptive posture: knob -> applied value (why is in the
+            # decision ledger / ctl/last_action gauges)
+            body["ctl_knobs"] = {k: a.get("value")
+                                 for k, a in sorted(self._ctl_values.items())}
         return (503 if (dead or self._crash_loop) else 200), body
 
     def drain(self) -> None:
@@ -447,6 +479,8 @@ class AsyncServingEngine:
                 self._process_submit(h)
             elif kind == "demote":
                 self._process_demote(h)
+            elif kind == "knobs":
+                self._process_knobs(h)
             else:
                 self._process_cancel(h)
         if self._stop_now:
@@ -494,12 +528,73 @@ class AsyncServingEngine:
         per_req_s = self._tpot_ema_s * self._session.max_new
         return min(max(depth * per_req_s, 1.0), 120.0)
 
+    def _process_knobs(self, payloads) -> None:
+        """Apply queued controller actions on the serving thread (the
+        only thread allowed to touch the session, scheduler, allocator
+        and policy) and ledger each one as ``ctl.apply``/``ctl.revert``."""
+        ev = self.engine._events
+        for a in payloads:
+            name, value = a.get("knob"), a.get("value")
+            if name is None or value is None:
+                continue
+            if not self._apply_one_knob(str(name), int(value)):
+                continue                   # unknown knob: ledger nothing
+            self._ctl_values[str(name)] = dict(a)
+            if ev is not None:
+                kind = ("ctl.revert" if a.get("direction") == "relax"
+                        and a.get("at_baseline") else "ctl.apply")
+                ev.emit(kind, knob=name, value=int(value),
+                        prev=a.get("prev"), tick=a.get("tick"),
+                        reason=a.get("reason"))
+
+    def _apply_one_knob(self, name: str, value: int) -> bool:
+        """One knob mutation. Every target is plain host state read by
+        the NEXT step's scheduling/dispatch decisions — ladder rungs are
+        chosen (``knobs_from_serving``) so each value lands inside the
+        compile buckets the warm engine already owns, which is what the
+        ``serving_adaptive_steady`` contract pins."""
+        sess = self._session
+        sched = sess.sched
+        if name == "prefill_chunk":
+            # both homes: the scheduler decides WHETHER to chunk, the
+            # session sizes each chunk step
+            sess.chunk_tokens = value
+            sched.chunk_tokens = value
+            return True
+        if name == "spec_k":
+            if sched.spec_proposer is None:
+                return False
+            # the verify program pads to the FIXED window set at session
+            # open, so any k <= the configured k is compile-free
+            sched.spec_k = value
+            return True
+        if name == "max_queue":
+            self.policy.admission_max_queue = value
+            return True
+        if name == "min_free_blocks":
+            self.policy.admission_min_free_blocks = value
+            return True
+        if name == "shed_depth":
+            self._shed_override = value
+            return True
+        if name == "kv_spill":
+            spill = getattr(sess, "_spill_block", None)
+            if spill is None:
+                return False
+            sess._kv_spill = bool(value)
+            sched.allocator.set_spill(spill if value else None)
+            return True
+        return False
+
     def _shed_overload(self) -> None:
         """Load shedding: with ``serving.fault.shed_queue_depth`` set,
         drop policy-selected queued requests (lowest priority first,
         deterministic) until the waiting queue fits the bound — graceful
-        degradation instead of unbounded queue growth under pressure."""
-        bound = int(self._fault_cfg.shed_queue_depth)
+        degradation instead of unbounded queue growth under pressure.
+        A controller-tightened ``shed_depth`` overrides the config bound
+        until the controller relaxes it back to baseline."""
+        bound = (self._shed_override if self._shed_override > 0
+                 else int(self._fault_cfg.shed_queue_depth))
         if bound <= 0:
             return
         sched = self._session.sched
@@ -561,6 +656,18 @@ class AsyncServingEngine:
         tel = self._session.sched.telemetry
         if tel is not None:
             tel.engine_restarts.inc()
+        # crash-safety for the adaptive posture: the rebuild re-derives
+        # engine state from config, so every controller action applied
+        # before the fault is re-applied FROM THE LEDGER replica — the
+        # recovered engine serves in the posture it crashed in, and the
+        # re-applications are themselves ledgered (restart=True)
+        for name, a in sorted(self._ctl_values.items()):
+            if not self._apply_one_knob(name, int(a["value"])):
+                continue
+            if ev is not None:
+                ev.emit("ctl.apply", knob=name, value=int(a["value"]),
+                        prev=a.get("prev"), tick=a.get("tick"),
+                        reason=a.get("reason"), restart=True)
 
     def _trip_breaker(self, exc: Exception) -> None:
         self._crash_loop = True
@@ -1098,6 +1205,13 @@ def serve_main(argv=None, model=None, params=None,
                              "slo/breaches counters; implies the sampler")
     parser.add_argument("--slo-tpot-ms", type=float, default=0.0,
                         help="p99 TPOT objective in ms (0 = off)")
+    parser.add_argument("--adaptive", action="store_true",
+                        help="close the loop: the SLO-burn-rate autopilot "
+                             "(monitor/controller.py) moves serving knobs "
+                             "under burn and steps them back under "
+                             "headroom, with every decision ledgered as "
+                             "ctl.* events; implies the sampler plane "
+                             "(single-replica only)")
     parser.add_argument("--grace", type=float, default=30.0,
                         help="SIGTERM/SIGINT drain grace period in "
                              "seconds: intake stops immediately (503), "
@@ -1118,7 +1232,7 @@ def serve_main(argv=None, model=None, params=None,
     if args.policy is not None:
         serving_cfg["policy"] = args.policy
     slo_on = bool(args.slo_ttft_ms or args.slo_tpot_ms)
-    want_plane = bool(args.sample_jsonl or slo_on)
+    want_plane = bool(args.sample_jsonl or slo_on or args.adaptive)
     kwargs: Dict[str, Any] = {"dtype": args.dtype, "serving": serving_cfg}
     if args.telemetry or want_plane:
         kwargs["telemetry"] = {"events": True}
@@ -1126,24 +1240,28 @@ def serve_main(argv=None, model=None, params=None,
         kwargs["checkpoint"] = args.checkpoint
     engine = deepspeed_tpu.init_inference(model, params=params, **kwargs)
 
+    n_rep = max(int(args.replicas), 1)
+    if args.adaptive and n_rep > 1:
+        # the controller folds ONE engine's pressure signals and mutates
+        # ONE serving loop; a fleet needs one controller per replica
+        # (ROADMAP item — run replicas static for now)
+        print("dscli serve: --adaptive supports a single replica; "
+              "running the fleet with static config", flush=True)
+
     sampler = None
+    slo = None
     if want_plane:
-        # the SLO engine evaluates on the sampler's ticks; either flag
-        # stands the sampling plane up (ring-only without --sample-jsonl)
-        from deepspeed_tpu.monitor.sampler import MetricsSampler
+        # the SLO engine evaluates on the sampler's ticks; any of the
+        # flags stands the sampling plane up (ring-only without
+        # --sample-jsonl)
         from deepspeed_tpu.monitor.slo import (SloEngine, parse_objectives,
                                                serving_objectives)
-        slo = None
         if slo_on:
             slo = SloEngine(
                 parse_objectives(serving_objectives(
                     ttft_p99_ms=args.slo_ttft_ms or None,
                     tpot_p99_ms=args.slo_tpot_ms or None)),
                 events=engine._events)
-        sampler = MetricsSampler(interval_s=args.sample_interval,
-                                 path=args.sample_jsonl, slo=slo).start()
-
-    n_rep = max(int(args.replicas), 1)
     if n_rep > 1:
         # dp serving axis: N engines share one weight pytree and one host
         # KV tier (the prefill->decode transport), each behind its own
@@ -1165,6 +1283,26 @@ def serve_main(argv=None, model=None, params=None,
             roles=roles or None)
     else:
         serving = AsyncServingEngine(engine, max_new_tokens=args.max_new)
+    if want_plane:
+        # sampler construction waits for the serving loop: the adaptive
+        # controller's apply_fn is the loop's knob intake
+        from deepspeed_tpu.monitor.sampler import MetricsSampler
+        ctl = None
+        if args.adaptive and n_rep == 1:
+            from deepspeed_tpu.monitor.controller import (
+                AdaptiveController, knobs_from_serving)
+            knobs = knobs_from_serving(engine.config.serving,
+                                       policy=serving.policy)
+            if knobs:
+                ctl = AdaptiveController(knobs, events=engine._events,
+                                         apply_fn=serving.apply_knobs)
+            else:
+                print("dscli serve: --adaptive found no movable knobs "
+                      "(chunking/spec/admission/shed all off); running "
+                      "static", flush=True)
+        sampler = MetricsSampler(interval_s=args.sample_interval,
+                                 path=args.sample_jsonl, slo=slo,
+                                 ctl=ctl).start()
     server = build_http_server(serving, host=args.host, port=args.port)
     host, port = server.server_address[:2]
     print(f"dscli serve: {args.model} listening on "

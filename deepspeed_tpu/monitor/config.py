@@ -132,6 +132,38 @@ class SloConfig(ConfigModel):
     burn_rate_threshold: float = 1.0
 
 
+class CtlConfig(ConfigModel):
+    """"telemetry.ctl" sub-block: the deterministic SLO-burn-rate
+    autopilot (``monitor/controller.py``). When enabled (and the serving
+    front-end runs with ``--adaptive`` / a controller attached), each
+    sampler tick folds the burn-rate gauges plus serving pressure
+    signals into one observation and may move serving knobs one ladder
+    rung (tighten under burn, relax back toward config after sustained
+    headroom). Every decision is a typed flight-recorder event — the
+    auditable ledger ``replay_decisions`` reproduces exactly. Enabling
+    ctl implies the sampler (something must tick the loop); pin a single
+    knob static with ``knobs: {"<name>": "off"}``."""
+    enabled: bool = False
+    # burn rate at/above which a pressure class tightens its knobs
+    tighten_threshold: float = 1.0
+    # burn rate at/below which a tick counts toward the headroom streak;
+    # the (relax_threshold, tighten_threshold) gap is the hysteresis
+    # dead band where posture holds
+    relax_threshold: float = 0.25
+    # minimum ticks between movements of the SAME knob (flap guard)
+    cooldown_ticks: int = 5
+    # consecutive headroom ticks before knobs start stepping back
+    relax_after: int = 10
+    # tpot pressure only drops spec k while acceptance sits below this
+    spec_accept_floor: float = 0.5
+    # KV-block utilization at/above which spill aggressiveness rises
+    # (only when the host tier is present and error-free)
+    kv_util_high: float = 0.9
+    # per-knob overrides: {"prefill_chunk": "off"} pins that knob at its
+    # config value — the controller never builds a ladder for it
+    knobs: Dict[str, str] = Field(default_factory=dict)
+
+
 class TelemetryConfig(ConfigModel):
     """"telemetry" section: the cross-layer metrics registry + tracing.
 
@@ -176,6 +208,8 @@ class TelemetryConfig(ConfigModel):
     sampler: SamplerConfig = Field(default_factory=SamplerConfig)
     # burn-rate SLO engine over the sampler's ring; bool shorthand
     slo: SloConfig = Field(default_factory=SloConfig)
+    # adaptive serving controller over the SLO plane; bool shorthand
+    ctl: CtlConfig = Field(default_factory=CtlConfig)
 
 
 def get_telemetry_config(param_dict: dict) -> TelemetryConfig:
@@ -218,11 +252,12 @@ def get_telemetry_config(param_dict: dict) -> TelemetryConfig:
     events = _sub_shorthand("events")
     sampler = _sub_shorthand("sampler")
     slo = _sub_shorthand("slo")
+    ctl = _sub_shorthand("ctl")
     if t.get("profile") is None and "profile" in t:
         t["profile"] = {}    # null = defaults
     # enabling a sub-block implies the telemetry substrate it rides on,
     # unless the user explicitly disabled telemetry itself
-    for sub in (health, events, sampler, slo):
+    for sub in (health, events, sampler, slo, ctl):
         if isinstance(sub, dict) and sub.get("enabled") \
                 and "enabled" not in t:
             t["enabled"] = True
@@ -231,9 +266,11 @@ def get_telemetry_config(param_dict: dict) -> TelemetryConfig:
     if t.get("metrics_port") is not None and "enabled" not in t:
         t["enabled"] = True
     # SLOs need something ticking the evaluation: enabling slo implies
-    # the sampler (ring-only when no path is configured)
-    if isinstance(slo, dict) and slo.get("enabled") \
-            and isinstance(sampler, dict) and "enabled" not in sampler:
+    # the sampler (ring-only when no path is configured); same for the
+    # controller, which ticks on the sampler's cadence
+    if isinstance(sampler, dict) and "enabled" not in sampler and (
+            (isinstance(slo, dict) and slo.get("enabled"))
+            or (isinstance(ctl, dict) and ctl.get("enabled"))):
         sampler["enabled"] = True
     return TelemetryConfig(**t)
 

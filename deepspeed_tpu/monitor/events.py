@@ -128,6 +128,23 @@ EVENT_KINDS = frozenset({
     "slo.breach",           # objective=, tick=, burn_rate=, threshold=,
     #                         window= (the longest evaluation window —
     #                         also the refire period)
+    # adaptive controller decision ledger (monitor/controller.py):
+    # observation -> decision -> application, replayable end to end
+    "ctl.observe",          # one folded sampler-tick observation (tick=,
+    #                         ttft_burn=, tpot_burn=, goodput_burn=,
+    #                         queue_depth=, kv_util=, spec_acceptance=,
+    #                         ...; the FIRST entry also carries config=
+    #                         the ladder/threshold manifest replay seeds
+    #                         from)
+    "ctl.decide",           # one knob movement decided (tick=, knob=,
+    #                         direction= tighten | relax, value=, prev=,
+    #                         reason=, at_baseline=)
+    "ctl.apply",            # serving thread applied the movement between
+    #                         engine steps (knob=, value=, prev=, tick=,
+    #                         reason=; restart=True when re-applied from
+    #                         the ledger after an engine restart)
+    "ctl.revert",           # a relax landed the knob back on its config
+    #                         baseline (same payload as ctl.apply)
 })
 
 
@@ -376,7 +393,7 @@ def render_serving_trace(events: Iterable[Event], *,
     reproduce the single-replica document exactly."""
     events = [e for e in events
               if e.kind.startswith(("req.", "serve.", "decode.", "sched.",
-                                    "kv.", "slo."))]
+                                    "kv.", "slo.", "ctl."))]
     out: List[Dict[str, Any]] = []
     if not events:
         return {"traceEvents": out, "displayTimeUnit": "ms"}
@@ -530,6 +547,20 @@ def render_serving_trace(events: Iterable[Event], *,
             out.append({"name": "slo_breach", "cat": "serving", "ph": "i",
                         "s": "p", "pid": engine_pid, "tid": _ENGINE_TID,
                         "ts": us(e.ts_ns), "args": dict(e.data or {})})
+        elif e.kind in ("ctl.apply", "ctl.revert"):
+            # controller knob applications on the engine timeline (the
+            # serving thread mutates between steps, so the instant sits
+            # exactly where the posture changed relative to the request
+            # spans), plus a per-knob counter track plotting the value
+            d = dict(e.data or {})
+            out.append({"name": e.kind.replace(".", "_"), "cat": "serving",
+                        "ph": "i", "s": "p", "pid": engine_pid,
+                        "tid": _ENGINE_TID, "ts": us(e.ts_ns), "args": d})
+            if d.get("knob") is not None and d.get("value") is not None:
+                out.append({"name": f"ctl/knob:{d['knob']}", "ph": "C",
+                            "pid": engine_pid, "tid": _ENGINE_TID,
+                            "ts": us(e.ts_ns),
+                            "args": {"value": d["value"]}})
 
     out.append({"ph": "M", "name": "process_name", "pid": serving_pid,
                 "args": {"name": f"{name_prefix}serving requests"}})
@@ -573,7 +604,7 @@ def render_fleet_trace(events: Iterable[Event]) -> Dict[str, Any]:
     per-replica export cannot show."""
     events = [e for e in events
               if e.kind.startswith(("req.", "serve.", "decode.", "sched.",
-                                    "kv.", "slo."))]
+                                    "kv.", "slo.", "ctl."))]
     out: List[Dict[str, Any]] = []
     if not events:
         return {"traceEvents": out, "displayTimeUnit": "ms"}

@@ -931,6 +931,30 @@ def render_summary_table(s: Dict[str, Any]) -> str:
         if parts:
             lines.append("slo      " + "   ".join(parts))
 
+    # ---- adaptive controller pane ---- #
+    ctl = s.get("ctl")
+    if ctl is not None:
+        parts = []
+        for name, kv in (ctl.get("knobs") or {}).items():
+            v, b = kv.get("value"), kv.get("baseline")
+            seg = f"{name} {int(v)}"
+            if b is not None and v != b:
+                # tightened away from config: show the baseline it left
+                seg += f"<cfg {int(b)}>"
+            parts.append(seg)
+        if parts:
+            lines.append("ctl      " + "   ".join(parts))
+        info = []
+        la = ctl.get("last_action")
+        if la:
+            info.append(f"last {la.get('direction')} {la.get('knob')} "
+                        f"@t{la.get('tick')} [{la.get('reason')}]")
+        n = ctl.get("actions_in_window")
+        if n:
+            info.append(f"{int(n)} action(s) this window")
+        if info:
+            lines.append("         " + "   ".join(info))
+
     # ---- flight-recorder ring loss ---- #
     ev = s.get("events")
     if ev and ev.get("dropped"):
@@ -1109,6 +1133,40 @@ def health_summary(rec: Dict, prev: Optional[Dict] = None) -> Dict[str, Any]:
         slo["burn_rate"] = burn
     if slo:
         out["slo"] = slo
+
+    # ---- adaptive controller posture (monitor/controller.py) ---- #
+    ctl: Dict[str, Any] = {}
+    knobs = labeled_series(g, "ctl/knob")
+    if knobs:
+        base = labeled_series(g, "ctl/knob_baseline")
+        ctl["knobs"] = {k: {"value": v, "baseline": base.get(k)}
+                        for k, v in sorted(knobs.items())}
+    acts: Dict[str, Dict[str, int]] = {}
+    for labels, v in multilabel_series(c, "ctl/actions"):
+        kn, d = labels.get("knob"), labels.get("direction")
+        if kn is not None and d is not None and v:
+            acts.setdefault(kn, {})[d] = int(v)
+    if acts:
+        ctl["actions"] = acts
+    pc = (prev or {}).get("counters") or {}
+    if prev is not None and knobs:
+        # movements since the previous snapshot: the pane's
+        # actions-per-window readout (0 = posture held)
+        now = sum(v for k, v in c.items() if k.startswith("ctl/actions{"))
+        before = sum(v for k, v in pc.items()
+                     if k.startswith("ctl/actions{"))
+        ctl["actions_in_window"] = int(now - before)
+    last = None
+    for labels, v in multilabel_series(g, "ctl/last_action"):
+        if last is None or v > last[0]:
+            last = (v, labels)
+    if last is not None:
+        ctl["last_action"] = {"tick": int(last[0]),
+                              "knob": last[1].get("knob"),
+                              "direction": last[1].get("direction"),
+                              "reason": last[1].get("reason")}
+    if ctl:
+        out["ctl"] = ctl
 
     # ---- flight-recorder ring loss (events/dropped gauges) ---- #
     if "events/dropped" in g:

@@ -45,7 +45,7 @@ class MetricsSampler:
 
     def __init__(self, registry=None, *, interval_s: float = 1.0,
                  path: Optional[str] = None, max_bytes: int = 16 << 20,
-                 keep: int = 2, ring: int = 512, slo=None):
+                 keep: int = 2, ring: int = 512, slo=None, ctl=None):
         if interval_s <= 0:
             raise ValueError("interval_s must be > 0")
         if keep < 1:
@@ -59,6 +59,7 @@ class MetricsSampler:
         self.max_bytes = int(max_bytes)
         self.keep = int(keep)
         self.slo = slo
+        self.ctl = ctl
         self.ring: deque = deque(maxlen=max(1, int(ring)))
         self.seq = 0
         self._lock = threading.Lock()     # manual tick() vs daemon thread
@@ -78,12 +79,20 @@ class MetricsSampler:
             breaches: List[Dict] = []
             if self.slo is not None:
                 breaches = self.slo.sample()
+            actions = []
+            if self.ctl is not None:
+                # controller ticks AFTER the SLO evaluation (it reads the
+                # burn gauges that sample() just refreshed) and BEFORE the
+                # snapshot, so ctl/knob gauges in this record are current
+                actions = self.ctl.tick()
             rec: Dict = {"ts": time.time(), "seq": self.seq}
             if breaches:
                 # breach markers ride the snapshot line so an offline
                 # tail (dscli top over the JSONL) sees the firing even
                 # between counter reads
                 rec["slo_breaches"] = breaches
+            if actions:
+                rec["ctl_actions"] = [a.to_payload() for a in actions]
             rec.update(self.registry.snapshot())
             self.ring.append(rec)
             if self.path:
@@ -155,12 +164,14 @@ class MetricsSampler:
         self.stop()
 
 
-def sampler_from_config(tcfg, registry=None, events=None
+def sampler_from_config(tcfg, registry=None, events=None, ctl=None
                         ) -> Optional[MetricsSampler]:
     """Build the sampler (with an attached SLO engine when
-    ``telemetry.slo`` declares objectives) a :class:`TelemetryConfig`
-    asks for. None when neither sampler nor slo is enabled. The caller
-    owns ``start()``/``stop()``."""
+    ``telemetry.slo`` declares objectives, and an attached
+    :class:`~deepspeed_tpu.monitor.controller.AdaptiveController` when
+    the caller passes one) a :class:`TelemetryConfig` asks for. None
+    when neither sampler nor slo is enabled. The caller owns
+    ``start()``/``stop()``."""
     scfg = getattr(tcfg, "sampler", None)
     slo_cfg = getattr(tcfg, "slo", None)
     slo_on = slo_cfg is not None and slo_cfg.enabled
@@ -171,4 +182,5 @@ def sampler_from_config(tcfg, registry=None, events=None
         if slo_on else None
     return MetricsSampler(
         registry, interval_s=scfg.interval_s, path=scfg.path,
-        max_bytes=scfg.max_bytes, keep=scfg.keep, ring=scfg.ring, slo=slo)
+        max_bytes=scfg.max_bytes, keep=scfg.keep, ring=scfg.ring,
+        slo=slo, ctl=ctl)

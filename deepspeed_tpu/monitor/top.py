@@ -3,9 +3,10 @@
 One refreshing terminal screen with serving panes (queue depth, running
 rows, TTFT/TPOT/queue-wait percentiles, the request phase-ledger
 breakdown from ``serving/phase_ms`` + wasted-token causes, KV pool +
-host tier, prefix cache, SLO burn rates) and training panes (loss EWMA,
-grad norm, tokens/s, MFU, fp16 skips), from either of the plane's two
-surfaces:
+host tier, prefix cache, SLO burn rates, the adaptive controller's knob
+posture vs its config baseline with the last action + reason) and
+training panes (loss EWMA, grad norm, tokens/s, MFU, fp16 skips), from
+either of the plane's two surfaces:
 
 - **scrape mode** — ``dscli top http://host:port/metrics``: fetch the
   Prometheus exposition (the ``dscli serve`` front-end's ``/metrics``

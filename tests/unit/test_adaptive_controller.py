@@ -1,0 +1,657 @@
+"""Adaptive SLO-burn autopilot acceptance suite (ISSUE 19): the
+decision core is a pure function of its observation trace (replay from
+the ledger reproduces the exact action sequence), hysteresis bands +
+per-knob cooldowns mean an oscillating burn signal cannot flap a knob,
+knob actions applied between engine steps tighten under an injected
+load spike and revert on sustained headroom while staying greedy
+token-identical, the adaptive run finishes with strictly fewer SLO
+breaches than the static run, applied posture survives a crash-safe
+engine restart via ledger re-application, the ``serving_adaptive_steady``
+compile-budget contract pins a full tighten-then-revert cycle at ZERO
+new steady-state programs, and the ledger renders into the serving
+trace / health panes / ``dscli ctl`` audit surfaces."""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.inference.serve import AsyncServingEngine
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.models.transformer import TransformerConfig
+from deepspeed_tpu.monitor.controller import (AdaptiveController,
+                                              DecisionCore, KnobSpec,
+                                              Observation, _chunk_ladder,
+                                              _spec_ladder,
+                                              explain_decisions,
+                                              knobs_from_serving,
+                                              recorded_decisions,
+                                              replay_decisions)
+from deepspeed_tpu.monitor.events import FlightRecorder
+
+_VT_PATH = Path(__file__).resolve().parents[2] / "tools" / "validate_trace.py"
+_spec = importlib.util.spec_from_file_location("validate_trace", _VT_PATH)
+validate_trace = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(validate_trace)
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    from deepspeed_tpu.monitor.events import get_flight_recorder
+    from deepspeed_tpu.monitor.metrics import get_registry
+    dist.set_mesh(None)
+    get_registry().reset()
+    get_registry().set_enabled(True)
+    get_flight_recorder().clear()
+    yield
+    dist.set_mesh(None)
+    get_registry().reset()
+    get_registry().set_enabled(True)
+    get_flight_recorder().clear()
+
+
+def tiny_model(**over):
+    base = dict(vocab_size=64, n_layer=2, n_head=4, d_model=32, d_ff=64,
+                max_seq=64, remat=False)
+    base.update(over)
+    return CausalLM(TransformerConfig(**base))
+
+
+def _prompts(lens, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, size=n).astype(np.int32) for n in lens]
+
+
+def _drive(serving, limit=10_000):
+    for _ in range(limit):
+        if not serving.step():
+            return
+    raise AssertionError("serving loop did not drain within the limit")
+
+
+def _set_burn(value, objectives=("ttft_p99", "tpot_p99", "goodput"),
+              windows=("8", "2")):
+    """Inject ``slo/burn_rate`` gauges the controller's observe() folds —
+    the deterministic stand-in for a live SloEngine."""
+    from deepspeed_tpu.monitor.metrics import get_registry
+    g = get_registry().gauge("slo/burn_rate", "error-budget burn",
+                             labelnames=("objective", "window"))
+    for obj in objectives:
+        for w in windows:
+            g.labels(objective=obj, window=w).set(value)
+
+
+def _knobs():
+    """A representative synthetic knob set for pure-core tests."""
+    return [KnobSpec("prefill_chunk", (512, 256, 128)),
+            KnobSpec("spec_k", (4, 3, 1, 0)),
+            KnobSpec("max_queue", (0, 16, 8, 4)),
+            KnobSpec("min_free_blocks", (0, 2, 4)),
+            KnobSpec("shed_depth", (0, 16, 8))]
+
+
+def _obs(tick, ttft=0.0, tpot=0.0, goodput=0.0, accept=1.0, kv=0.0,
+         host_ok=False):
+    return Observation(tick=tick, ttft_burn=ttft, tpot_burn=tpot,
+                       goodput_burn=goodput, spec_acceptance=accept,
+                       kv_util=kv, host_tier_ok=host_ok)
+
+
+# --------------------------------------------------------------------- #
+# ladders: every rung must land in an already-compiled bucket
+
+
+class TestKnobLadders:
+
+    def test_chunk_ladder_is_descending_128_multiples(self):
+        assert _chunk_ladder(512) == (512, 256, 128)
+        assert _chunk_ladder(256) == (256, 128)
+        for ladder in (_chunk_ladder(512), _chunk_ladder(384)):
+            assert all(r % 128 == 0 for r in ladder)
+            assert list(ladder) == sorted(ladder, reverse=True)
+
+    def test_chunk_ladder_never_enables_chunking(self):
+        # chunking off (0) or already at the floor bucket: no knob at all
+        assert _chunk_ladder(0) is None
+        assert _chunk_ladder(128) is None
+
+    def test_spec_ladder_descends_to_zero_inside_the_window(self):
+        assert _spec_ladder(4) == (4, 3, 1, 0)
+        assert _spec_ladder(7) == (7, 3, 1, 0)
+        assert _spec_ladder(1) == (1, 0)
+        assert _spec_ladder(0) is None
+
+    def test_knobs_from_serving_respects_pinning(self):
+        from deepspeed_tpu.inference.config import ServingConfig
+        from deepspeed_tpu.inference.policy import FifoPolicy
+        cfg = ServingConfig(prefill_chunk_tokens=256,
+                            speculative={"mode": "ngram", "k": 2})
+        pol = FifoPolicy(admission_max_queue=4)
+        names = [k.name for k in knobs_from_serving(cfg, policy=pol)]
+        assert names == ["prefill_chunk", "spec_k", "max_queue",
+                         "min_free_blocks", "shed_depth"]
+        pinned = [k.name for k in knobs_from_serving(
+            cfg, policy=pol, pinned=("spec_k", "max_queue"))]
+        assert "spec_k" not in pinned and "max_queue" not in pinned
+        assert "prefill_chunk" in pinned
+
+
+# --------------------------------------------------------------------- #
+# the pure decision core: hysteresis, cooldown, slow revert
+
+
+class TestDecisionCore:
+
+    def test_hysteresis_no_flap_pin(self):
+        """THE no-flap pin: a burn signal oscillating every tick between
+        tighten-worthy and the dead band moves each knob AT MOST once
+        per cooldown window — never once per oscillation."""
+        core = DecisionCore(_knobs(), cooldown_ticks=5, relax_after=10)
+        actions = []
+        for t in range(1, 21):
+            burn = 2.0 if t % 2 else 0.5      # tighten / dead band, 10 Hz
+            actions += core.decide(_obs(t, ttft=burn))
+        per_knob = {}
+        for a in actions:
+            per_knob.setdefault(a.knob, []).append(a.tick)
+        for knob, ticks in per_knob.items():
+            gaps = [b - a for a, b in zip(ticks, ticks[1:])]
+            assert all(g >= 5 for g in gaps), \
+                f"{knob} flapped: action ticks {ticks}"
+
+    def test_dead_band_holds_posture_and_resets_streak(self):
+        core = DecisionCore(_knobs(), cooldown_ticks=1, relax_after=3)
+        assert core.decide(_obs(1, ttft=2.0))       # tightened
+        tightened = dict(core.values())
+        # 2 headroom ticks, then a dead-band tick, then 2 more headroom:
+        # the streak restarts — no relax until 3 CONSECUTIVE headroom
+        for t, burn in ((2, 0.0), (3, 0.0), (4, 0.5), (5, 0.0), (6, 0.0)):
+            assert core.decide(_obs(t, ttft=burn)) == []
+        assert core.values() == tightened
+        acts = core.decide(_obs(7, ttft=0.0))       # 3rd consecutive
+        assert acts and all(a.direction == "relax" for a in acts)
+
+    def test_tighten_reasons_route_to_the_right_knobs(self):
+        core = DecisionCore(_knobs(), cooldown_ticks=1)
+        by_reason = {a.knob: a.reason
+                     for a in core.decide(_obs(1, ttft=2.0))}
+        assert by_reason == {"prefill_chunk": "ttft_burn",
+                             "max_queue": "ttft_burn"}
+        core2 = DecisionCore(_knobs(), cooldown_ticks=1)
+        # TPOT burn alone is not enough: spec_k drops only when the
+        # speculator is also wasting work (acceptance under the floor)
+        assert core2.decide(_obs(1, tpot=2.0, accept=0.9)) == []
+        acts = core2.decide(_obs(2, tpot=2.0, accept=0.2))
+        assert [(a.knob, a.reason) for a in acts] == \
+            [("spec_k", "tpot_burn")]
+        core3 = DecisionCore(_knobs(), cooldown_ticks=1)
+        assert {a.knob for a in core3.decide(_obs(1, goodput=2.0))} == \
+            {"shed_depth", "max_queue", "min_free_blocks"}
+
+    def test_kv_pressure_requires_healthy_host_tier(self):
+        knobs = _knobs() + [KnobSpec("kv_spill", (0, 1))]
+        core = DecisionCore(knobs, cooldown_ticks=1, kv_util_high=0.9)
+        assert core.decide(_obs(1, kv=0.95, host_ok=False)) == []
+        acts = core.decide(_obs(2, kv=0.95, host_ok=True))
+        assert [(a.knob, a.value, a.reason) for a in acts] == \
+            [("kv_spill", 1, "kv_pressure")]
+
+    def test_full_cycle_returns_to_baseline(self):
+        core = DecisionCore(_knobs(), cooldown_ticks=1, relax_after=2)
+        t = 0
+        for _ in range(6):                        # tighten to the floor
+            t += 1
+            core.decide(_obs(t, ttft=2.0, tpot=2.0, goodput=2.0,
+                             accept=0.0))
+        assert any(core.values()[n] != s.baseline
+                   for n, s in core.knobs.items())
+        last = []
+        for _ in range(12):                       # sustained headroom
+            t += 1
+            last += core.decide(_obs(t))
+        assert core.values() == \
+            {n: s.baseline for n, s in core.knobs.items()}
+        finals = {a.knob: a for a in last}
+        assert all(a.at_baseline for a in finals.values())
+
+
+# --------------------------------------------------------------------- #
+# replay: the ledger reproduces the exact action sequence
+
+
+class TestReplayIdentity:
+
+    def _run_controller(self, rec, n_ticks=30):
+        ctl = AdaptiveController(_knobs(), events=rec, cooldown_ticks=2,
+                                 relax_after=3)
+        for t in range(n_ticks):
+            if t < 8:
+                _set_burn(2.0)
+                _set_burn(0.0, objectives=("tpot_p99",))
+            elif t < 12:
+                _set_burn(0.6)                    # dead band
+            else:
+                _set_burn(0.0)                    # headroom -> revert
+            ctl.tick()
+        return ctl
+
+    def test_replay_identity_pin(self):
+        """THE determinism pin: re-deciding from the ledger's observe
+        trace reproduces the recorded ctl.decide payloads exactly."""
+        rec = FlightRecorder(4096, enabled=True)
+        self._run_controller(rec)
+        events = [e.to_dict() for e in rec.snapshot()]
+        recorded = recorded_decisions(events)
+        assert recorded, "scenario produced no decisions to pin"
+        assert any(a["direction"] == "tighten" for a in recorded)
+        assert any(a["direction"] == "relax" for a in recorded)
+        assert replay_decisions(events) == recorded
+
+    def test_replay_from_jsonl_path(self, tmp_path):
+        rec = FlightRecorder(4096, enabled=True)
+        self._run_controller(rec)
+        path = rec.write_jsonl(str(tmp_path / "events.jsonl"))
+        assert replay_decisions(path) == recorded_decisions(path)
+
+    def test_replay_needs_a_manifest(self):
+        with pytest.raises(ValueError, match="manifest"):
+            replay_decisions([{"kind": "ctl.observe", "tick": 1}])
+
+    def test_ctl_cli_replay_and_explain(self, tmp_path, capsys):
+        from deepspeed_tpu.cli import _ctl
+        rec = FlightRecorder(4096, enabled=True)
+        self._run_controller(rec)
+        path = rec.write_jsonl(str(tmp_path / "events.jsonl"))
+        assert _ctl(["replay", path]) == 0
+        assert "replay OK" in capsys.readouterr().out
+        assert _ctl(["explain", path]) == 0
+        out = capsys.readouterr().out
+        assert "tighten" in out and "relax" in out
+        # a tampered ledger diverges loudly
+        lines = [json.loads(ln) for ln in
+                 Path(path).read_text().splitlines()]
+        for e in lines:
+            if e.get("kind") == "ctl.decide":
+                e["value"] = 999
+                break
+        bad = tmp_path / "tampered.jsonl"
+        bad.write_text("\n".join(json.dumps(e) for e in lines) + "\n")
+        assert _ctl(["replay", str(bad)]) == 1
+        assert "DIVERGED" in capsys.readouterr().out
+
+    def test_explain_annotates_decisions_with_burns(self):
+        rec = FlightRecorder(4096, enabled=True)
+        self._run_controller(rec)
+        lines = explain_decisions([e.to_dict() for e in rec.snapshot()])
+        assert any("ttft=2.00" in ln and "tighten" in ln for ln in lines)
+
+
+# --------------------------------------------------------------------- #
+# live application: knobs land between engine steps, posture everywhere
+
+
+def _build_serving(max_queue=6, max_new=6, **serving_over):
+    serving_cfg = {"block_size": 8, "max_running": 2,
+                   "policy": {"name": "fifo",
+                              "admission_max_queue": max_queue}}
+    serving_cfg.update(serving_over)
+    engine = deepspeed_tpu.init_inference(
+        tiny_model(), dtype="fp32", telemetry={"events": True},
+        serving=serving_cfg)
+    serving = AsyncServingEngine(engine, max_new_tokens=max_new,
+                                 start=False)
+    return engine, serving
+
+
+def _make_ctl(engine, serving, **params):
+    base = dict(cooldown_ticks=1, relax_after=2)
+    base.update(params)
+    return AdaptiveController(
+        knobs_from_serving(engine.config.serving, policy=serving.policy),
+        events=engine._events, apply_fn=serving.apply_knobs, **base)
+
+
+class TestKnobApplication:
+
+    def test_actions_apply_on_the_serving_thread_between_steps(self):
+        engine, serving = _build_serving()
+        ctl = _make_ctl(engine, serving)
+        _set_burn(2.0, objectives=("ttft_p99",))
+        actions = ctl.tick()
+        assert any(a.knob == "max_queue" for a in actions)
+        # queued, not yet applied: the serving loop owns the mutation
+        assert serving.policy.admission_max_queue == 6
+        serving.step()
+        assert serving.policy.admission_max_queue == 3
+        kinds = [e.kind for e in engine._events.snapshot()]
+        assert "ctl.apply" in kinds
+        # posture is visible to /healthz
+        assert serving.health_state()[1]["ctl_knobs"]["max_queue"] == 3
+        serving.shutdown()
+
+    def test_revert_emits_ctl_revert_and_restores_baseline(self):
+        engine, serving = _build_serving()
+        ctl = _make_ctl(engine, serving)
+        _set_burn(2.0, objectives=("ttft_p99",))
+        ctl.tick()
+        serving.step()
+        _set_burn(0.0)
+        ctl.tick()                                 # headroom streak 1
+        acts = ctl.tick()                          # streak 2 -> relax
+        assert any(a.direction == "relax" and a.at_baseline for a in acts)
+        serving.step()
+        assert serving.policy.admission_max_queue == 6
+        kinds = [e.kind for e in engine._events.snapshot()]
+        assert "ctl.revert" in kinds
+        serving.shutdown()
+
+    def test_adaptive_run_is_greedy_token_identical(self):
+        """Knob churn mid-flight (admission tighten + revert) must not
+        change a single emitted token."""
+        prompts = _prompts((5, 9, 7, 11))
+        engine, serving = _build_serving()
+        refs = [np.asarray(engine.generate(p[None, :],
+                                           max_new_tokens=6))[0]
+                for p in prompts]
+        ctl = _make_ctl(engine, serving)
+        hs = [serving.add_request(p) for p in prompts]
+        _set_burn(2.0, objectives=("ttft_p99", "goodput"))
+        for i in range(4):                         # tighten mid-decode
+            serving.step()
+            ctl.tick()
+        _set_burn(0.0)
+        for _ in range(4):                         # revert mid-decode
+            serving.step()
+            ctl.tick()
+        _drive(serving)
+        serving.shutdown(drain=True)
+        assert [h.status for h in hs] == ["finished"] * 4
+        for h, ref in zip(hs, refs):
+            np.testing.assert_array_equal(np.asarray(h.result(1)), ref)
+
+
+# --------------------------------------------------------------------- #
+# the spike: adaptive strictly beats static on SLO breaches
+
+
+class TestSpikeRecovery:
+
+    def _spike_run(self, adaptive):
+        """One deterministic logical-clock spike: a burst of deadline-
+        carrying requests against max_running=2 backlogs the queue past
+        what the deadline allows. Static rides it into timeouts; the
+        autopilot reads the burn and tightens admission."""
+        from deepspeed_tpu.monitor.slo import SloEngine, parse_objectives
+        from deepspeed_tpu.monitor.metrics import get_registry
+        from deepspeed_tpu.monitor.events import get_flight_recorder
+        from deepspeed_tpu.monitor.health import labeled_series
+        get_registry().reset()
+        get_flight_recorder().clear()
+        prompts = _prompts(tuple([5, 7, 9] * 8))       # 24-request burst
+        engine, serving = _build_serving(max_queue=8, max_new=4)
+        refs = [np.asarray(engine.generate(p[None, :],
+                                           max_new_tokens=4))[0]
+                for p in prompts]
+        slo = SloEngine(parse_objectives(
+            [{"name": "timeout_rate", "kind": "ratio",
+              "metric": "serving/timeouts",
+              "total_metric": "serving/requests", "objective": 0.9}],
+            default_windows=[3, 2]), events=engine._events)
+        ctl = (_make_ctl(engine, serving, relax_after=100)
+               if adaptive else None)
+
+        def control_tick():
+            slo.sample()
+            if ctl is not None:
+                ctl.tick()
+
+        # one arrival per scheduler step: the backlog outgrows what
+        # deadline_steps allows, so mid-burst the early queue times out
+        # WHILE submissions continue — sustained burn, not a blip
+        hs = []
+        for i, p in enumerate(prompts):
+            hs.append(serving.add_request(p, deadline_steps=12))
+            serving.step()
+            if i % 2 == 1:
+                control_tick()
+        for i in range(80):
+            alive = serving.step()
+            if i % 2 == 1:
+                control_tick()
+            if not alive:
+                break
+        _drive(serving)
+        serving.shutdown(drain=True)
+        snap = engine.telemetry_snapshot()["counters"]
+        breaches = int(sum(labeled_series(snap, "slo/breaches").values()))
+        timeouts = int(snap.get("serving/timeouts", 0))
+        finished = [(i, h) for i, h in enumerate(hs)
+                    if h.status == "finished"]
+        for i, h in finished:
+            np.testing.assert_array_equal(np.asarray(h.result(1)),
+                                          refs[i])
+        return breaches, timeouts, len(finished)
+
+    def test_adaptive_spike_strictly_fewer_breaches(self):
+        """THE acceptance pin: under the same injected spike the
+        adaptive engine finishes with strictly fewer SLO breaches than
+        the static config — and every token either run emits is the
+        greedy reference (asserted inside the run)."""
+        static_breaches, static_timeouts, _ = self._spike_run(False)
+        adaptive_breaches, adaptive_timeouts, _ = self._spike_run(True)
+        assert static_breaches > 0, \
+            "spike too gentle: the static run never breached"
+        assert adaptive_breaches < static_breaches, (
+            f"autopilot did not help: {adaptive_breaches} breaches "
+            f"adaptive vs {static_breaches} static")
+        assert adaptive_timeouts <= static_timeouts
+
+
+# --------------------------------------------------------------------- #
+# crash safety: the ledger survives the engine
+
+
+class TestCrashSafety:
+
+    def test_posture_survives_engine_restart(self):
+        """Applied actions are re-applied from the decision ledger after
+        a crash-safe engine restart: the recovered loop serves in the
+        posture it crashed in, with ``restart=True`` ledger entries."""
+        from deepspeed_tpu.utils import fault_injection as fi
+        engine, serving = _build_serving(max_queue=6, max_new=8)
+        ctl = _make_ctl(engine, serving)
+        prompts = _prompts((5, 9))
+        refs = [np.asarray(engine.generate(p[None, :],
+                                           max_new_tokens=8))[0]
+                for p in prompts]
+        _set_burn(2.0, objectives=("ttft_p99",))
+        ctl.tick()
+        serving.step()                       # apply before the fault
+        assert serving.policy.admission_max_queue == 3
+        with fi.inject(fi.FaultInjector().fail_step(
+                "decode", at_step=7, count=1, phase="post")):
+            hs = [serving.add_request(p) for p in prompts]
+            _drive(serving)
+        serving.shutdown(drain=True)
+        assert serving.restarts == 1 and not serving._crash_loop
+        # the tightened posture survived the pool/jit rebuild
+        assert serving.policy.admission_max_queue == 3
+        restart_applies = [e for e in engine._events.snapshot()
+                           if e.kind == "ctl.apply"
+                           and (e.data or {}).get("restart")]
+        assert [(e.data or {}).get("knob") for e in restart_applies] == \
+            ["max_queue"]
+        for h, ref in zip(hs, refs):
+            assert h.status == "finished"
+            np.testing.assert_array_equal(np.asarray(h.result(1)), ref)
+
+
+# --------------------------------------------------------------------- #
+# the compile contract: a full knob cycle adds ZERO programs
+
+
+class TestAdaptiveSteadyContract:
+
+    @pytest.fixture(autouse=True)
+    def clean_watchdog(self):
+        from deepspeed_tpu.monitor.trace import get_compile_watchdog
+        get_compile_watchdog().reset()
+        yield
+        get_compile_watchdog().reset()
+
+    def test_serving_adaptive_steady_contract(self):
+        """Two full tighten-then-revert cycles over a warm engine with
+        chunked prefill + speculation + admission knobs all moving:
+        cycle 2's compile counts equal cycle 1's (the cycle is a compile
+        fixed point) and both sit inside the serving_adaptive_steady
+        budget — the autopilot adds ZERO new steady-state programs."""
+        _TOOLS = str(Path(__file__).resolve().parents[2] / "tools")
+        if _TOOLS not in sys.path:
+            sys.path.insert(0, _TOOLS)
+        from dslint.contracts import check_compile_budgets
+
+        engine = deepspeed_tpu.init_inference(
+            tiny_model(max_seq=448), dtype="fp32",
+            telemetry={"events": True},
+            serving={"block_size": 8, "max_running": 2,
+                     "prefix_caching": "on",
+                     "prefill_chunk_tokens": 256,
+                     "speculative": {"mode": "ngram", "k": 2},
+                     "policy": {"name": "fifo",
+                                "admission_max_queue": 6}})
+        rng = np.random.default_rng(3)
+        motif = rng.integers(0, 8, size=8).astype(np.int32)
+        long_prompt = np.tile(motif, 40)            # 320 tokens: chunks
+        warm_prompts = [long_prompt,
+                        np.tile(motif, 4),          # spec-friendly short
+                        rng.integers(0, 64, size=11).astype(np.int32)]
+        engine.generate_batch(warm_prompts, max_new_tokens=10)
+        engine.generate_batch(warm_prompts, max_new_tokens=10)
+
+        def cycle():
+            serving = AsyncServingEngine(engine, max_new_tokens=10,
+                                         start=False)
+            ctl = _make_ctl(engine, serving, relax_after=1)
+            hs = [serving.add_request(p) for p in warm_prompts]
+            _set_burn(2.0)                          # burn everything
+            from deepspeed_tpu.monitor.metrics import get_registry
+            get_registry().gauge("serving/spec_acceptance_rate",
+                                 "x").set(0.0)
+            for _ in range(4):                      # tighten to the floor
+                serving.step()
+                ctl.tick()
+                serving.step()
+            _set_burn(0.0)
+            get_registry().gauge("serving/spec_acceptance_rate",
+                                 "x").set(1.0)
+            for _ in range(4):                      # revert to baseline
+                serving.step()
+                ctl.tick()
+                serving.step()
+            assert ctl.values() == \
+                {n: s.baseline for n, s in ctl.core.knobs.items()}
+            _drive(serving)
+            serving.shutdown(drain=True)
+            assert all(h.status == "finished" for h in hs)
+            return dict(engine.telemetry_snapshot()["compile"]["by_fn"])
+
+        by_fn_1 = cycle()
+        by_fn_2 = cycle()
+        assert by_fn_2 == by_fn_1, (
+            f"second knob cycle recompiled: {by_fn_1} -> {by_fn_2}")
+        violations = check_compile_budgets(
+            by_fn_2, "serving_adaptive_steady", strict=True)
+        assert violations == [], "\n".join(violations)
+
+
+# --------------------------------------------------------------------- #
+# satellites: trace rendering, panes, config plumbing
+
+
+class TestLedgerSurfaces:
+
+    def _tightened_engine(self):
+        engine, serving = _build_serving()
+        ctl = _make_ctl(engine, serving)
+        h = serving.add_request(_prompts((7,))[0])
+        _set_burn(2.0, objectives=("ttft_p99",))
+        ctl.tick()
+        serving.step()
+        _set_burn(0.0)
+        ctl.tick()
+        ctl.tick()
+        _drive(serving)
+        serving.shutdown(drain=True)
+        assert h.status == "finished"
+        return engine
+
+    def test_ctl_events_render_into_a_valid_serving_trace(self, tmp_path):
+        engine = self._tightened_engine()
+        path = str(tmp_path / "trace.json")
+        engine.export_serving_trace(path)
+        trace = json.loads(Path(path).read_text())
+        names = [e.get("name") for e in trace["traceEvents"]]
+        assert "ctl_apply" in names and "ctl_revert" in names
+        counters = [e for e in trace["traceEvents"]
+                    if e.get("ph") == "C"
+                    and str(e.get("name", "")).startswith("ctl/knob:")]
+        assert counters, "no ctl/knob counter track in the trace"
+        assert validate_trace.validate_chrome_trace(trace) == []
+
+    def test_health_summary_ctl_pane(self):
+        from deepspeed_tpu.monitor.health import (health_summary,
+                                                  render_summary_table)
+        from deepspeed_tpu.monitor.metrics import get_registry
+        engine, serving = _build_serving()
+        ctl = _make_ctl(engine, serving)
+        _set_burn(2.0, objectives=("ttft_p99",))
+        ctl.tick()
+        serving.step()
+        rec = {"ts": 0.0, **get_registry().snapshot()}
+        s = health_summary(rec)
+        assert s["ctl"]["knobs"]["max_queue"] == \
+            {"value": 3, "baseline": 6}
+        assert s["ctl"]["last_action"]["knob"] in ("max_queue",
+                                                   "prefill_chunk")
+        assert s["ctl"]["last_action"]["direction"] == "tighten"
+        table = render_summary_table(s)
+        assert "ctl" in table and "max_queue" in table
+        serving.shutdown()
+
+    def test_controller_from_config_plumbs_pins_and_disable(self):
+        from deepspeed_tpu.monitor.config import get_telemetry_config
+        from deepspeed_tpu.inference.config import ServingConfig
+        from deepspeed_tpu.inference.policy import FifoPolicy
+        from deepspeed_tpu.monitor.controller import controller_from_config
+        serving = ServingConfig(prefill_chunk_tokens=256)
+        pol = FifoPolicy(admission_max_queue=4)
+        tcfg = get_telemetry_config({"telemetry": {"ctl": True}})
+        assert tcfg.enabled and tcfg.ctl.enabled and tcfg.sampler.enabled
+        ctl = controller_from_config(tcfg.ctl, serving, policy=pol)
+        assert ctl is not None and "prefill_chunk" in ctl.values()
+        tcfg2 = get_telemetry_config({"telemetry": {"ctl": {
+            "enabled": True, "cooldown_ticks": 9,
+            "knobs": {"prefill_chunk": "off"}}}})
+        ctl2 = controller_from_config(tcfg2.ctl, serving, policy=pol)
+        assert ctl2.core.cooldown_ticks == 9
+        assert "prefill_chunk" not in ctl2.values()
+        off = get_telemetry_config({"telemetry": {}})
+        assert controller_from_config(off.ctl, serving, policy=pol) is None
+
+    def test_sampler_tick_drives_the_controller(self):
+        from deepspeed_tpu.monitor.sampler import MetricsSampler
+        engine, serving = _build_serving()
+        ctl = _make_ctl(engine, serving)
+        sampler = MetricsSampler(interval_s=3600, ctl=ctl)
+        _set_burn(2.0, objectives=("ttft_p99",))
+        rec = sampler.tick()
+        assert rec["ctl_actions"], "sampler tick produced no actions"
+        assert rec["ctl_actions"][0]["direction"] == "tighten"
+        serving.step()
+        assert serving.policy.admission_max_queue == 3
+        serving.shutdown()

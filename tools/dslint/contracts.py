@@ -113,6 +113,22 @@ class CompileBudget:
 #:                     statically; this contract pins the dynamic half),
 #:                     so each fused entry compiles exactly as often as
 #:                     the untraced serving_async_steady scenario
+#:   serving_adaptive_steady — the async serving loop with the adaptive
+#:                     controller (monitor/controller.py) driven through a
+#:                     FULL tighten-then-revert knob cycle: chunk shrinks,
+#:                     spec k drops, admission tightens, then sustained
+#:                     headroom steps everything back to the config
+#:                     baseline. THE AUTOPILOT ADDS ZERO NEW STEADY-STATE
+#:                     PROGRAMS — every knob ladder rung is constructed
+#:                     inside an already-compiled bucket (chunk rungs are
+#:                     128-multiples at or below the baseline bucket,
+#:                     spec-k rungs stay inside the fixed verify window
+#:                     with k=0 riding the plain decode program, admission
+#:                     / shed / spill knobs are pure host-side scheduler
+#:                     state), so each fused entry compiles exactly as
+#:                     often as the controller-off serving_async_steady
+#:                     scenario — a single extra compile means a knob
+#:                     action escaped its compile bucket
 BUDGETS: List[CompileBudget] = [
     CompileBudget(
         "engine.train_batch[gas=1]", "steady_train", 1,
@@ -347,6 +363,31 @@ BUDGETS: List[CompileBudget] = [
         "inference.paged_cow", "serving_traced_steady", 1,
         "copy-on-write block copy: fixed block geometry; the cow phase "
         "observe happens after its block_until_ready"),
+    CompileBudget(
+        "inference.paged_decode", "serving_adaptive_steady", 1,
+        "THE fused decode step is knob-independent: chunk/admission/shed/"
+        "spill actions are host-side scheduler state, spec k=0 rides "
+        "this same program — a second compile means a knob action "
+        "perturbed the decode signature"),
+    CompileBudget(
+        "inference.paged_verify", "serving_adaptive_steady", 1,
+        "the verify window is bucketed to the power of two of the "
+        "CONFIG k at session open; every spec_k ladder rung stays "
+        "inside that window, so tighten->revert reuses one program"),
+    CompileBudget(
+        "inference.paged_prefill", "serving_adaptive_steady", 2,
+        "admission prefill: one program per 128-token prompt bucket "
+        "(the scenario spans two); the admission knobs gate arrivals, "
+        "they never reshape a prefill"),
+    CompileBudget(
+        "inference.paged_prefill_chunk", "serving_adaptive_steady", 4,
+        "chunk-knob rungs are 128-multiples at or below the baseline, "
+        "so every tightened chunk lands in a (chunk bucket, table-width "
+        "power-of-two) pair the warm loop already compiled"),
+    CompileBudget(
+        "inference.paged_cow", "serving_adaptive_steady", 1,
+        "copy-on-write block copy: fixed block geometry, untouched by "
+        "any knob"),
 ]
 
 
